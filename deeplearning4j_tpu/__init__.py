@@ -1,0 +1,33 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A ground-up rebuild of the capabilities of Eclipse Deeplearning4j
+(reference: OrenBochman/deeplearning4j) designed for TPU hardware:
+JAX/XLA for compute, whole-step ``jax.jit`` tracing instead of eager
+per-op JNI dispatch, ``jax.sharding`` meshes instead of
+ParallelWrapper/Aeron, Pallas kernels for ops XLA lacks.
+
+Layer map (vs. the reference; see SURVEY.md):
+
+=====================  ==============================================
+Reference              This package
+=====================  ==============================================
+libnd4j kernels        XLA (via jax.numpy/lax) + ``ops/`` Pallas kernels
+INDArray / Nd4j        ``ndarray.NDArray`` façade over ``jax.Array``
+SameDiff               ``autodiff.samediff.SameDiff`` tracing frontend
+MultiLayerNetwork      ``nn.multilayer.MultiLayerNetwork``
+ComputationGraph       ``nn.graph.ComputationGraph``
+Updaters               ``nn.updaters`` (optax-backed)
+ParallelWrapper        ``parallel.wrapper.ParallelWrapper`` (mesh DP)
+Aeron param server     XLA collectives over ICI/DCN (``parallel``)
+DataVec                ``data.records`` / ``data.transform``
+Evaluation             ``eval_`` package
+ModelSerializer        ``serialization``
+=====================  ==============================================
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu import dtypes as dtypes
+from deeplearning4j_tpu.ndarray import NDArray, Nd4j
+
+__all__ = ["NDArray", "Nd4j", "dtypes", "__version__"]

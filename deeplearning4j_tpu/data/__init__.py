@@ -1,0 +1,17 @@
+"""Data pipeline — reference: ``org.nd4j.linalg.dataset`` (DataSet,
+iterators, normalizers) + datavec ETL (``data.records`` / ``transform``).
+"""
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterators import (
+    DataSetIterator, ListDataSetIterator, AsyncDataSetIterator,
+)
+from deeplearning4j_tpu.data.normalizers import (
+    NormalizerStandardize, NormalizerMinMaxScaler,
+    ImagePreProcessingScaler,
+)
+
+__all__ = [
+    "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
+    "AsyncDataSetIterator", "NormalizerStandardize",
+    "NormalizerMinMaxScaler", "ImagePreProcessingScaler",
+]

@@ -1,0 +1,121 @@
+"""DataSet iterators — reference:
+``org.nd4j.linalg.dataset.api.iterator.DataSetIterator`` SPI +
+``AsyncDataSetIterator`` (background prefetch thread feeding the train
+loop, SURVEY §3.2 fitHelper).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+class DataSetIterator:
+    """Base iterator; subclasses implement ``_build()`` returning a list
+    of DataSets, or override __iter__ for streaming."""
+
+    def __init__(self, batch_size: int = 32):
+        self.batch_size = batch_size
+        self.pre_processor = None  # normalizer hook (reference name)
+
+    def reset(self):
+        pass
+
+    def set_pre_processor(self, p):
+        self.pre_processor = p
+
+    def _apply_pp(self, ds: DataSet) -> DataSet:
+        if self.pre_processor is not None:
+            ds = self.pre_processor.transform_dataset(ds)
+        return ds
+
+    def __iter__(self) -> Iterator[DataSet]:
+        raise NotImplementedError
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterates a pre-batched or single DataSet (reference
+    ListDataSetIterator)."""
+
+    def __init__(self, data, batch_size: int = 32, shuffle: bool = False,
+                 seed: int = 0):
+        super().__init__(batch_size)
+        self._data = data
+        self.shuffle = shuffle
+        self.seed = seed
+        self._epoch = 0
+
+    def __iter__(self):
+        data = self._data
+        if isinstance(data, DataSet):
+            if self.shuffle:
+                data = data.shuffle(self.seed + self._epoch)
+                self._epoch += 1
+            for b in data.batch_by(self.batch_size):
+                yield self._apply_pp(b)
+        else:
+            for b in data:
+                yield self._apply_pp(b)
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch wrapper (reference
+    AsyncDataSetIterator): overlaps host ETL with device compute. On TPU
+    the jitted step runs async anyway (dispatch returns immediately), so
+    a small queue suffices to hide ETL latency."""
+
+    def __init__(self, base: DataSetIterator, queue_size: int = 4):
+        super().__init__(base.batch_size)
+        self.base = base
+        self.queue_size = queue_size
+
+    def reset(self):
+        self.base.reset()
+
+    def __iter__(self):
+        q: queue.Queue = queue.Queue(maxsize=self.queue_size)
+        _END = object()
+        err: List[BaseException] = []
+
+        def worker():
+            try:
+                for ds in self.base:
+                    q.put(ds)
+            except BaseException as e:  # propagate to consumer
+                err.append(e)
+            finally:
+                q.put(_END)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            yield item
+        t.join()
+        if err:
+            raise err[0]
+
+
+class IteratorDataSetIterator(DataSetIterator):
+    """Wraps any python iterable of (x, y) tuples into DataSet batches."""
+
+    def __init__(self, iterable, batch_size: int = 32):
+        super().__init__(batch_size)
+        self._iterable = iterable
+
+    def __iter__(self):
+        xs, ys = [], []
+        for x, y in self._iterable:
+            xs.append(x)
+            ys.append(y)
+            if len(xs) == self.batch_size:
+                yield self._apply_pp(DataSet(np.stack(xs), np.stack(ys)))
+                xs, ys = [], []
+        if xs:
+            yield self._apply_pp(DataSet(np.stack(xs), np.stack(ys)))

@@ -1,0 +1,91 @@
+"""Data-type registry.
+
+Mirrors the reference dtype matrix (libnd4j ``ArrayOptions.h`` /
+``org.nd4j.linalg.api.buffer.DataType``: fp16/bf16/fp32/fp64, int8..64,
+uint8..64, bool, utf8) mapped onto JAX dtypes. UTF8 arrays are not a
+device type on TPU; strings stay host-side (numpy object arrays) in the
+data pipeline.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical name -> jnp dtype
+_REGISTRY = {
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "uint8": jnp.uint8,
+    "uint16": jnp.uint16,
+    "uint32": jnp.uint32,
+    "uint64": jnp.uint64,
+    "bool": jnp.bool_,
+}
+
+# Reference-style aliases (DataType enum names in nd4j).
+_ALIASES = {
+    "half": "float16",
+    "float": "float32",
+    "double": "float64",
+    "long": "int64",
+    "int": "int32",
+    "short": "int16",
+    "byte": "int8",
+    "ubyte": "uint8",
+    "bfloat16": "bfloat16",
+}
+
+FLOAT_TYPES = ("float16", "bfloat16", "float32", "float64")
+INT_TYPES = ("int8", "int16", "int32", "int64",
+             "uint8", "uint16", "uint32", "uint64")
+
+_DEFAULT = ["float32"]
+
+
+def resolve(name_or_dtype):
+    """Resolve a dtype name / numpy dtype / jnp dtype to a jnp dtype."""
+    if name_or_dtype is None:
+        return _REGISTRY[_DEFAULT[0]]
+    if isinstance(name_or_dtype, str):
+        key = name_or_dtype.lower()
+        key = _ALIASES.get(key, key)
+        if key not in _REGISTRY:
+            raise ValueError(f"Unknown dtype {name_or_dtype!r}")
+        return _REGISTRY[key]
+    return jnp.dtype(name_or_dtype)
+
+
+def name_of(dtype) -> str:
+    d = jnp.dtype(dtype)
+    for k, v in _REGISTRY.items():
+        if jnp.dtype(v) == d:
+            return k
+    return str(d)
+
+
+def default_dtype():
+    """Global default float dtype (reference: Nd4j.defaultFloatingPointType)."""
+    return _REGISTRY[_DEFAULT[0]]
+
+
+def set_default_dtype(name: str) -> None:
+    dt = resolve(name)  # validate
+    if not is_float(dt):
+        raise ValueError(
+            f"default dtype must be a float type, got {name!r}")
+    _DEFAULT[0] = _ALIASES.get(name.lower(), name.lower())
+
+
+def is_float(dtype) -> bool:
+    return np.issubdtype(jnp.dtype(dtype), np.floating) or \
+        jnp.dtype(dtype) == jnp.bfloat16
+
+
+def is_integer(dtype) -> bool:
+    return np.issubdtype(jnp.dtype(dtype), np.integer)

@@ -1,0 +1,438 @@
+"""Eager ndarray façade — the ``INDArray`` / ``Nd4j`` equivalent.
+
+Reference: ``org.nd4j.linalg.api.ndarray.INDArray`` (~700 methods) and the
+``org.nd4j.linalg.factory.Nd4j`` static factory. Here the heavy lifting is
+``jax.Array`` + XLA: every method is a thin call into ``jax.numpy``, which
+jit-caches compiled kernels per shape/dtype, so eager UX costs O(cache
+lookup) instead of a JNI crossing per op (reference call stack SURVEY §3.2).
+
+Design notes (TPU-first):
+ - No strides/views/TAD machinery — XLA owns layout. ``i``-suffixed
+   "in-place" methods from the reference (``addi``, ``subi``…) exist for
+   API parity but are functional underneath (they rebind the wrapped
+   buffer; jax.Array is immutable).
+ - dtype promotion follows jnp; default float dtype from ``dtypes``.
+"""
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu import dtypes
+
+
+def _unwrap(x):
+    return x.jax() if isinstance(x, NDArray) else x
+
+
+class NDArray:
+    """Thin eager wrapper over a ``jax.Array``.
+
+    Reference parity: org.nd4j.linalg.api.ndarray.BaseNDArray.
+    """
+
+    __slots__ = ("_a",)
+    __array_priority__ = 100  # beat numpy in mixed expressions
+
+    def __init__(self, value, dtype=None):
+        if isinstance(value, NDArray):
+            value = value._a
+        if dtype is not None:
+            self._a = jnp.asarray(value, dtype=dtypes.resolve(dtype))
+        else:
+            self._a = jnp.asarray(value)
+
+    # -- interop ----------------------------------------------------------
+    def jax(self) -> jax.Array:
+        return self._a
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._a)
+
+    def __jax_array__(self):
+        return self._a
+
+    def item(self):
+        return self._a.item()
+
+    # -- properties -------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._a.shape)
+
+    @property
+    def dtype(self):
+        return self._a.dtype
+
+    @property
+    def ndim(self) -> int:
+        return self._a.ndim
+
+    def rank(self) -> int:
+        return self._a.ndim
+
+    def length(self) -> int:
+        return int(self._a.size)
+
+    @property
+    def size(self) -> int:
+        return int(self._a.size)
+
+    def size_at(self, dim: int) -> int:
+        return self._a.shape[dim]
+
+    def is_scalar(self) -> bool:
+        return self._a.ndim == 0
+
+    def is_vector(self) -> bool:
+        return self._a.ndim == 1
+
+    def is_matrix(self) -> bool:
+        return self._a.ndim == 2
+
+    # -- shape ops --------------------------------------------------------
+    def reshape(self, *shape) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return NDArray(jnp.reshape(self._a, shape))
+
+    def ravel(self) -> "NDArray":
+        return NDArray(jnp.ravel(self._a))
+
+    def transpose(self, *axes) -> "NDArray":
+        if not axes:
+            return NDArray(jnp.transpose(self._a))
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return NDArray(jnp.transpose(self._a, axes))
+
+    def permute(self, *axes) -> "NDArray":
+        return self.transpose(*axes)
+
+    @property
+    def T(self) -> "NDArray":
+        return NDArray(self._a.T)
+
+    def swap_axes(self, a: int, b: int) -> "NDArray":
+        return NDArray(jnp.swapaxes(self._a, a, b))
+
+    def broadcast_to(self, shape) -> "NDArray":
+        return NDArray(jnp.broadcast_to(self._a, tuple(shape)))
+
+    def expand_dims(self, axis: int) -> "NDArray":
+        return NDArray(jnp.expand_dims(self._a, axis))
+
+    def squeeze(self, axis=None) -> "NDArray":
+        return NDArray(jnp.squeeze(self._a, axis))
+
+    def repeat(self, repeats, axis=None) -> "NDArray":
+        return NDArray(jnp.repeat(self._a, repeats, axis))
+
+    def tile(self, reps) -> "NDArray":
+        return NDArray(jnp.tile(self._a, reps))
+
+    def dup(self) -> "NDArray":
+        """Reference: INDArray.dup(). jax.Array is immutable; copy is free."""
+        return NDArray(self._a)
+
+    def cast(self, dtype) -> "NDArray":
+        return NDArray(self._a.astype(dtypes.resolve(dtype)))
+
+    astype = cast
+
+    # -- indexing ---------------------------------------------------------
+    def __getitem__(self, idx) -> "NDArray":
+        return NDArray(self._a[_unwrap(idx) if not isinstance(idx, tuple)
+                               else tuple(_unwrap(i) for i in idx)])
+
+    def put(self, idx, value) -> "NDArray":
+        """Functional scatter (reference putScalar/put are mutating)."""
+        if isinstance(idx, tuple):
+            idx = tuple(_unwrap(i) for i in idx)
+        else:
+            idx = _unwrap(idx)
+        return NDArray(self._a.at[idx].set(_unwrap(value)))
+
+    def get_scalar(self, *idx):
+        return self._a[tuple(idx)].item()
+
+    def slice_along(self, i: int, axis: int = 0) -> "NDArray":
+        return NDArray(jnp.take(self._a, i, axis=axis))
+
+    # -- arithmetic (functional + reference "i"-parity names) -------------
+    def _binop(self, other, fn) -> "NDArray":
+        return NDArray(fn(self._a, _unwrap(other)))
+
+    def add(self, o): return self._binop(o, jnp.add)
+    def sub(self, o): return self._binop(o, jnp.subtract)
+    def mul(self, o): return self._binop(o, jnp.multiply)
+    def div(self, o): return self._binop(o, jnp.divide)
+    def rsub(self, o): return NDArray(jnp.subtract(_unwrap(o), self._a))
+    def rdiv(self, o): return NDArray(jnp.divide(_unwrap(o), self._a))
+    def pow(self, o): return self._binop(o, jnp.power)
+    def fmod(self, o): return self._binop(o, jnp.fmod)
+
+    # In-place spellings rebind the buffer (functional underneath).
+    def addi(self, o): self._a = jnp.add(self._a, _unwrap(o)); return self
+    def subi(self, o): self._a = jnp.subtract(self._a, _unwrap(o)); return self
+    def muli(self, o): self._a = jnp.multiply(self._a, _unwrap(o)); return self
+    def divi(self, o): self._a = jnp.divide(self._a, _unwrap(o)); return self
+    def assign(self, o):
+        self._a = jnp.broadcast_to(jnp.asarray(_unwrap(o), self._a.dtype),
+                                   self._a.shape)
+        return self
+
+    __add__ = add
+    __radd__ = add
+    __sub__ = sub
+    def __rsub__(self, o): return self.rsub(o)
+    __mul__ = mul
+    __rmul__ = mul
+    __truediv__ = div
+    def __rtruediv__(self, o): return self.rdiv(o)
+    __pow__ = pow
+    def __neg__(self): return NDArray(-self._a)
+    def __abs__(self): return NDArray(jnp.abs(self._a))
+    def __matmul__(self, o): return self.mmul(o)
+
+    # -- comparisons ------------------------------------------------------
+    def __lt__(self, o): return self._binop(o, jnp.less)
+    def __le__(self, o): return self._binop(o, jnp.less_equal)
+    def __gt__(self, o): return self._binop(o, jnp.greater)
+    def __ge__(self, o): return self._binop(o, jnp.greater_equal)
+    def eq(self, o): return self._binop(o, jnp.equal)
+    def neq(self, o): return self._binop(o, jnp.not_equal)
+
+    def __eq__(self, o):
+        """Elementwise equality (numpy semantics — safe under jit
+        tracing). For the reference's INDArray.equals whole-array
+        boolean, use :meth:`equals`."""
+        if isinstance(o, (NDArray, jax.Array, np.ndarray, int, float,
+                          bool)):
+            return self._binop(o, jnp.equal)
+        return NotImplemented
+
+    def equals(self, o) -> bool:
+        """Whole-array value equality (reference INDArray.equals).
+        Eager-only: do not call inside jit."""
+        a, b = self._a, _unwrap(o)
+        return a.shape == b.shape and bool(jnp.all(a == b))
+
+    # Elementwise __eq__ ⇒ unhashable, same stance as np.ndarray.
+    __hash__ = None
+
+    # -- linalg -----------------------------------------------------------
+    def mmul(self, other) -> "NDArray":
+        return NDArray(jnp.matmul(self._a, _unwrap(other)))
+
+    def dot(self, other) -> "NDArray":
+        return NDArray(jnp.dot(self._a, _unwrap(other)))
+
+    def tensordot(self, other, axes) -> "NDArray":
+        return NDArray(jnp.tensordot(self._a, _unwrap(other), axes))
+
+    # -- reductions -------------------------------------------------------
+    def _reduce(self, fn, axis, keepdims=False) -> "NDArray":
+        return NDArray(fn(self._a, axis=axis, keepdims=keepdims))
+
+    def sum(self, axis=None, keepdims=False):
+        return self._reduce(jnp.sum, axis, keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self._reduce(jnp.mean, axis, keepdims)
+
+    def std(self, axis=None, keepdims=False, ddof=1):
+        return NDArray(jnp.std(self._a, axis=axis, keepdims=keepdims,
+                               ddof=ddof))
+
+    def var(self, axis=None, keepdims=False, ddof=1):
+        return NDArray(jnp.var(self._a, axis=axis, keepdims=keepdims,
+                               ddof=ddof))
+
+    def max(self, axis=None, keepdims=False):
+        return self._reduce(jnp.max, axis, keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return self._reduce(jnp.min, axis, keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return self._reduce(jnp.prod, axis, keepdims)
+
+    def argmax(self, axis=None):
+        return NDArray(jnp.argmax(self._a, axis=axis))
+
+    def argmin(self, axis=None):
+        return NDArray(jnp.argmin(self._a, axis=axis))
+
+    def cumsum(self, axis=None):
+        return NDArray(jnp.cumsum(self._a, axis=axis))
+
+    def norm1(self, axis=None):
+        return NDArray(jnp.sum(jnp.abs(self._a), axis=axis))
+
+    def norm2(self, axis=None):
+        return NDArray(jnp.sqrt(jnp.sum(jnp.square(self._a), axis=axis)))
+
+    def norm_max(self, axis=None):
+        return NDArray(jnp.max(jnp.abs(self._a), axis=axis))
+
+    def any(self): return bool(jnp.any(self._a))
+    def all(self): return bool(jnp.all(self._a))
+
+    # -- elementwise math (reference Transforms.*) ------------------------
+    def _map(self, fn) -> "NDArray":
+        return NDArray(fn(self._a))
+
+    def abs(self): return self._map(jnp.abs)
+    def neg(self): return self._map(jnp.negative)
+    def exp(self): return self._map(jnp.exp)
+    def log(self): return self._map(jnp.log)
+    def sqrt(self): return self._map(jnp.sqrt)
+    def square(self): return self._map(jnp.square)
+    def sin(self): return self._map(jnp.sin)
+    def cos(self): return self._map(jnp.cos)
+    def tanh(self): return self._map(jnp.tanh)
+    def sigmoid(self): return self._map(jax.nn.sigmoid)
+    def relu(self): return self._map(jax.nn.relu)
+    def softmax(self, axis=-1):
+        return NDArray(jax.nn.softmax(self._a, axis=axis))
+    def floor(self): return self._map(jnp.floor)
+    def ceil(self): return self._map(jnp.ceil)
+    def round(self): return self._map(jnp.round)
+    def sign(self): return self._map(jnp.sign)
+    def clip(self, lo, hi): return NDArray(jnp.clip(self._a, lo, hi))
+
+    # -- misc -------------------------------------------------------------
+    def isnan(self): return self._map(jnp.isnan)
+    def isinf(self): return self._map(jnp.isinf)
+
+    def __len__(self):
+        return self._a.shape[0]
+
+    def __repr__(self):
+        return f"NDArray({np.asarray(self._a)!r})"
+
+    def __format__(self, spec):
+        return format(np.asarray(self._a), spec)
+
+
+def _ndarray_unflatten(_, children):
+    # Rebind the leaf directly: transforms (eval_shape, jit tracing) pass
+    # tracer/ShapeDtypeStruct leaves that jnp.asarray would reject.
+    obj = object.__new__(NDArray)
+    obj._a = children[0]
+    return obj
+
+
+jax.tree_util.register_pytree_node(
+    NDArray,
+    lambda x: ((x._a,), None),
+    _ndarray_unflatten,
+)
+
+
+class Nd4j:
+    """Static factory — reference: ``org.nd4j.linalg.factory.Nd4j``."""
+
+    @staticmethod
+    def create(data=None, shape=None, dtype=None) -> NDArray:
+        if data is None:
+            return Nd4j.zeros(shape, dtype)
+        arr = NDArray(data, dtype=dtype or dtypes.default_dtype())
+        if shape is not None:
+            arr = arr.reshape(shape)
+        return arr
+
+    @staticmethod
+    def zeros(shape, dtype=None) -> NDArray:
+        return NDArray(jnp.zeros(_shape(shape), dtypes.resolve(dtype)))
+
+    @staticmethod
+    def ones(shape, dtype=None) -> NDArray:
+        return NDArray(jnp.ones(_shape(shape), dtypes.resolve(dtype)))
+
+    @staticmethod
+    def full(shape, value, dtype=None) -> NDArray:
+        return NDArray(jnp.full(_shape(shape), value, dtypes.resolve(dtype)))
+
+    value_array_of = full
+
+    @staticmethod
+    def eye(n, dtype=None) -> NDArray:
+        return NDArray(jnp.eye(n, dtype=dtypes.resolve(dtype)))
+
+    @staticmethod
+    def arange(*args, dtype=None) -> NDArray:
+        return NDArray(jnp.arange(*args, dtype=dtype and dtypes.resolve(dtype)))
+
+    @staticmethod
+    def linspace(lo, hi, num, dtype=None) -> NDArray:
+        return NDArray(jnp.linspace(lo, hi, num,
+                                    dtype=dtypes.resolve(dtype)))
+
+    @staticmethod
+    def rand(shape, seed: Optional[int] = None) -> NDArray:
+        """Uniform [0,1). Without ``seed``, draws from an advancing
+        global stream (reference Nd4j.rand semantics — successive calls
+        differ); with ``seed``, deterministic."""
+        return NDArray(jax.random.uniform(_next_key(seed), _shape(shape),
+                                          dtypes.default_dtype()))
+
+    @staticmethod
+    def randn(shape, seed: Optional[int] = None) -> NDArray:
+        return NDArray(jax.random.normal(_next_key(seed), _shape(shape),
+                                         dtypes.default_dtype()))
+
+    @staticmethod
+    def set_random_seed(seed: int) -> None:
+        """Reset the global stream (reference Nd4j.getRandom().setSeed)."""
+        _GLOBAL_KEY[0] = jax.random.PRNGKey(seed)
+
+    @staticmethod
+    def concat(axis: int, *arrays) -> NDArray:
+        return NDArray(jnp.concatenate([_unwrap(a) for a in arrays],
+                                       axis=axis))
+
+    @staticmethod
+    def stack(axis: int, *arrays) -> NDArray:
+        return NDArray(jnp.stack([_unwrap(a) for a in arrays], axis=axis))
+
+    @staticmethod
+    def hstack(*arrays) -> NDArray:
+        return NDArray(jnp.hstack([_unwrap(a) for a in arrays]))
+
+    @staticmethod
+    def vstack(*arrays) -> NDArray:
+        return NDArray(jnp.vstack([_unwrap(a) for a in arrays]))
+
+    @staticmethod
+    def where(cond, x, y) -> NDArray:
+        return NDArray(jnp.where(_unwrap(cond), _unwrap(x), _unwrap(y)))
+
+    @staticmethod
+    def sort(arr, axis=-1, descending=False) -> NDArray:
+        out = jnp.sort(_unwrap(arr), axis=axis)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return NDArray(out)
+
+
+def _shape(shape) -> tuple:
+    if shape is None:
+        raise ValueError("shape required")
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+_GLOBAL_KEY = [jax.random.PRNGKey(0)]
+
+
+def _next_key(seed: Optional[int] = None):
+    if seed is not None:
+        return jax.random.PRNGKey(seed)
+    _GLOBAL_KEY[0], sub = jax.random.split(_GLOBAL_KEY[0])
+    return sub

@@ -1,0 +1,17 @@
+"""NN framework layer — reference: ``deeplearning4j-nn``.
+
+Config beans (JSON round-trip) build pytree-param models; training is a
+single jitted step (grad + optax update), replacing the reference's
+Solver/Updater plumbing (SURVEY §3.2) with whole-step XLA compilation.
+"""
+from deeplearning4j_tpu.nn.config import (
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+__all__ = [
+    "NeuralNetConfiguration",
+    "MultiLayerConfiguration",
+    "MultiLayerNetwork",
+]

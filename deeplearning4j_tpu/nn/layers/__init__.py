@@ -1,0 +1,40 @@
+"""Layer implementations — reference: ``org.deeplearning4j.nn.conf.layers``
+(config beans) + ``org.deeplearning4j.nn.layers.**`` (impls), ~60 layers.
+
+Here config and impl are one class per layer: a serializable dataclass
+bean with ``init(...)`` (parameter creation + shape inference, the
+reference's ``getOutputType``/``initializer``) and a pure functional
+``apply(...)`` used under jit (the reference's ``activate``). Gradients
+come from jax autodiff — no ``backpropGradient`` methods.
+
+Layout conventions are TPU-first: channels-last everywhere (NHWC / NWC /
+[B,T,F] for sequences) — the reference's NCHW/[B,F,T] layouts are a CUDA
+idiom; XLA on TPU prefers trailing feature dims.
+"""
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer, layer_from_dict
+from deeplearning4j_tpu.nn.layers.core import (
+    DenseLayer, OutputLayer, LossLayer, ActivationLayer, DropoutLayer,
+    EmbeddingLayer, EmbeddingSequenceLayer, ElementWiseMultiplicationLayer,
+    BatchNormalization, LayerNormalization, LocalResponseNormalization,
+)
+from deeplearning4j_tpu.nn.layers.conv import (
+    ConvolutionLayer, Convolution1DLayer, Convolution3DLayer,
+    Deconvolution2DLayer, DepthwiseConvolution2DLayer,
+    SeparableConvolution2DLayer, SubsamplingLayer, Subsampling1DLayer,
+    Subsampling3DLayer, GlobalPoolingLayer, Upsampling2DLayer,
+    ZeroPaddingLayer, CroppingLayer, SpaceToDepthLayer, DepthToSpaceLayer,
+)
+from deeplearning4j_tpu.nn.layers.recurrent import (
+    LSTM, GravesLSTM, SimpleRnn, GRU, Bidirectional, LastTimeStep,
+    RnnOutputLayer, RnnLossLayer, MaskZeroLayer, TimeDistributed,
+)
+from deeplearning4j_tpu.nn.layers.attention import (
+    SelfAttentionLayer, LearnedSelfAttentionLayer, MultiHeadAttention,
+    TransformerEncoderBlock, PositionalEmbeddingLayer,
+)
+from deeplearning4j_tpu.nn.layers.special import (
+    AutoEncoder, VariationalAutoencoder, CenterLossOutputLayer,
+    FrozenLayer, LambdaLayer, CapsuleLayer, PReLULayer,
+)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

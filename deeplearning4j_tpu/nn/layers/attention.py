@@ -1,0 +1,206 @@
+"""Attention layers.
+
+Reference: org.deeplearning4j.nn.conf.layers.SelfAttentionLayer /
+LearnedSelfAttentionLayer / RecurrentAttentionLayer (deeplearning4j-nn)
+over libnd4j ops ``dot_product_attention`` /
+``multi_head_dot_product_attention``; plus the transformer-era stack
+(MultiHeadAttention, TransformerEncoderBlock, positional embeddings) the
+BERT-base BASELINE config needs. Long-context ring attention lives in
+``parallel.ring_attention``.
+
+All shapes [B, T, F]; mask [B, T] (key mask). Attention math is
+``jax.nn.dot_product_attention`` — XLA fuses it into flash-attention-
+style blocks on TPU.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.nn.layers.core import LayerNormalization
+from deeplearning4j_tpu.nn import weights as winit
+
+
+def _split_heads(x, n_heads):
+    b, t, f = x.shape
+    return x.reshape(b, t, n_heads, f // n_heads)
+
+
+def _merge_heads(x):
+    b, t, h, d = x.shape
+    return x.reshape(b, t, h * d)
+
+
+def scaled_dot_attention(q, k, v, mask=None, causal=False):
+    """q,k,v: [B, T, H, D] (head axis 2). mask: [B, Tk] key mask.
+
+    Explicit einsum+softmax (not jax.nn.dot_product_attention, which is
+    not exact in float64 — breaks gradient checking); XLA fuses this
+    into flash-style blocks on TPU regardless.
+    """
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(d, q.dtype))
+    neg = jnp.asarray(-1e30 if q.dtype == jnp.float64 else -1e9, q.dtype)
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :] > 0, logits, neg)
+    if causal:
+        tq, tk = logits.shape[-2:]
+        cm = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
+        logits = jnp.where(cm, logits, neg)
+    w = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+@register_layer
+@dataclass
+class MultiHeadAttention(Layer):
+    """Self multi-head attention projection block (reference
+    multi_head_dot_product_attention op + AttentionVertex)."""
+    n_in: Optional[int] = None
+    n_out: int = 0
+    n_heads: int = 1
+    causal: bool = False
+    project_out: bool = True
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        n_in = self.n_in or input_shape[-1]
+        n_out = self.n_out or n_in
+        if n_out % self.n_heads:
+            raise ValueError(f"n_out={n_out} not divisible by "
+                             f"n_heads={self.n_heads}")
+        wi = winit.get(self.weight_init or "xavier")
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        params = {"Wq": wi(kq, (n_in, n_out), dtype),
+                  "Wk": wi(kk, (n_in, n_out), dtype),
+                  "Wv": wi(kv, (n_in, n_out), dtype)}
+        if self.project_out:
+            params["Wo"] = wi(ko, (n_out, n_out), dtype)
+            params["bo"] = jnp.zeros((n_out,), dtype)
+        t = input_shape[0]
+        return params, {}, (t, n_out)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        q = _split_heads(x @ params["Wq"], self.n_heads)
+        k = _split_heads(x @ params["Wk"], self.n_heads)
+        v = _split_heads(x @ params["Wv"], self.n_heads)
+        o = _merge_heads(scaled_dot_attention(q, k, v, mask, self.causal))
+        if self.project_out:
+            o = o @ params["Wo"] + params["bo"]
+        if mask is not None:
+            o = o * mask[..., None].astype(o.dtype)
+        return self._maybe_dropout(self._act()(o), train, rng), state
+
+
+@register_layer
+@dataclass
+class SelfAttentionLayer(MultiHeadAttention):
+    """Reference SelfAttentionLayer: self-attention, output per timestep."""
+
+
+@register_layer
+@dataclass
+class LearnedSelfAttentionLayer(Layer):
+    """Attention with ``n_queries`` learned query vectors (reference
+    LearnedSelfAttentionLayer) — pools [B,T,F] to [B,Q,F_out]."""
+    n_in: Optional[int] = None
+    n_out: int = 0
+    n_heads: int = 1
+    n_queries: int = 1
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        n_in = self.n_in or input_shape[-1]
+        n_out = self.n_out or n_in
+        wi = winit.get(self.weight_init or "xavier")
+        kq, kk, kv, kp = jax.random.split(key, 4)
+        params = {"Q": wi(kq, (self.n_queries, n_out), dtype),
+                  "Wk": wi(kk, (n_in, n_out), dtype),
+                  "Wv": wi(kv, (n_in, n_out), dtype)}
+        return params, {}, (self.n_queries, n_out)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        b = x.shape[0]
+        q = jnp.broadcast_to(params["Q"][None], (b,) + params["Q"].shape)
+        q = _split_heads(q, self.n_heads)
+        k = _split_heads(x @ params["Wk"], self.n_heads)
+        v = _split_heads(x @ params["Wv"], self.n_heads)
+        o = _merge_heads(scaled_dot_attention(q, k, v, mask))
+        return self._act()(o), state
+
+    def propagate_mask(self, mask, input_shape):
+        return None  # fixed n_queries output, fully valid
+
+
+@register_layer
+@dataclass
+class PositionalEmbeddingLayer(Layer):
+    """Learned positional embeddings added to [B,T,F] (BERT-style)."""
+    max_len: int = 512
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        t, f = input_shape
+        params = {"pos": jax.random.normal(
+            key, (self.max_len, f), dtype) * 0.02}
+        return params, {}, tuple(input_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        t = x.shape[1]
+        return x + params["pos"][None, :t, :], state
+
+
+@register_layer
+@dataclass
+class TransformerEncoderBlock(Layer):
+    """Pre-LN transformer encoder block: MHA + MLP with residuals.
+
+    The reference has no transformer block layer (its BERT support comes
+    through TF import, SURVEY §3.4) — provided natively here since the
+    BASELINE BERT config demands it.
+    """
+    n_in: Optional[int] = None
+    n_heads: int = 8
+    ffn_mult: int = 4
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        f = self.n_in = self.n_in or input_shape[-1]
+        wi = winit.get(self.weight_init or "xavier")
+        ks = jax.random.split(key, 6)
+        self._mha = MultiHeadAttention(n_in=f, n_out=f, n_heads=self.n_heads)
+        self._ln1 = LayerNormalization()
+        self._ln2 = LayerNormalization()
+        pa, _, _ = self._mha.init(ks[0], input_shape, dtype)
+        p1, _, _ = self._ln1.init(ks[1], input_shape, dtype)
+        p2, _, _ = self._ln2.init(ks[2], input_shape, dtype)
+        hid = f * self.ffn_mult
+        params = {"mha": pa, "ln1": p1, "ln2": p2,
+                  "W1": wi(ks[3], (f, hid), dtype),
+                  "b1": jnp.zeros((hid,), dtype),
+                  "W2": wi(ks[4], (hid, f), dtype),
+                  "b2": jnp.zeros((f,), dtype)}
+        return params, {}, tuple(input_shape)
+
+    def _subs(self, input_shape=None):
+        f = self.n_in
+        if not hasattr(self, "_mha"):
+            self._mha = MultiHeadAttention(n_in=f, n_out=f,
+                                           n_heads=self.n_heads)
+            self._ln1 = LayerNormalization()
+            self._ln2 = LayerNormalization()
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        self._subs()
+        r1, r2 = (jax.random.split(rng) if rng is not None else (None, None))
+        h, _ = self._ln1.apply(params["ln1"], {}, x)
+        a, _ = self._mha.apply(params["mha"], {}, h, train=train, rng=r1,
+                               mask=mask)
+        x = x + a
+        h, _ = self._ln2.apply(params["ln2"], {}, x)
+        h = jax.nn.gelu(h @ params["W1"] + params["b1"])
+        h = h @ params["W2"] + params["b2"]
+        x = x + self._maybe_dropout(h, train, r2)
+        return x, state

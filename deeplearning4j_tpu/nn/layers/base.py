@@ -1,0 +1,115 @@
+"""Layer base class + registry.
+
+Reference: ``org.deeplearning4j.nn.conf.layers.Layer`` bean hierarchy and
+``org.deeplearning4j.nn.api.Layer`` runtime interface, unified: one
+dataclass per layer with config (serialized to JSON), shape inference
+(``init``) and a pure apply used under jit.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops import activations
+
+_LAYER_REGISTRY: Dict[str, type] = {}
+
+
+def register_layer(cls):
+    """Class decorator adding the layer to the serialization registry."""
+    _LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def layer_from_dict(d: Dict[str, Any]) -> "Layer":
+    d = dict(d)
+    kind = d.pop("@class")
+    if kind not in _LAYER_REGISTRY:
+        raise ValueError(f"Unknown layer class {kind!r}")
+    cls = _LAYER_REGISTRY[kind]
+    nested = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for k, v in d.items():
+        if k in nested:
+            # Re-hydrate nested layer beans (e.g. Bidirectional wrapping)
+            if isinstance(v, dict) and "@class" in v:
+                v = layer_from_dict(v)
+            kwargs[k] = v
+    return cls(**kwargs)
+
+
+@dataclass
+class Layer:
+    """Base config bean + runtime for all layers.
+
+    Subclasses implement:
+      init(key, input_shape, dtype) -> (params, state, output_shape)
+      apply(params, state, x, *, train, rng, mask) -> (y, new_state)
+
+    ``input_shape``/``output_shape`` exclude the batch dimension.
+    ``params`` are trainable leaves; ``state`` is non-trainable (e.g.
+    batch-norm running stats). ``mask`` is [B, T] for sequence data.
+    """
+    name: Optional[str] = None
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    bias_init: float = 0.0
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    weight_decay: Optional[float] = None
+    dropout: Optional[float] = None          # keep-prob complement: drop rate
+    updater: Optional[Any] = None            # per-layer updater override
+    learning_rate: Optional[float] = None    # per-layer LR override
+    trainable: bool = True
+
+    # ---- serialization ---------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        out = {"@class": type(self).__name__}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, Layer):
+                v = v.to_dict()
+            elif hasattr(v, "to_dict") and not isinstance(v, type):
+                v = v.to_dict()
+            out[f.name] = v
+        return out
+
+    # ---- runtime ---------------------------------------------------------
+    def init(self, key, input_shape, dtype=jnp.float32):
+        raise NotImplementedError
+
+    def apply(self, params, state, x, *, train: bool = False, rng=None,
+              mask=None):
+        raise NotImplementedError
+
+    def propagate_mask(self, mask, input_shape):
+        """Transform an incoming [B,T] mask for downstream layers.
+
+        Reference: Layer.feedForwardMaskArray. Default: unchanged.
+        """
+        return mask
+
+    # ---- helpers ---------------------------------------------------------
+    def _act(self, default="identity"):
+        return activations.get(self.activation or default)
+
+    def _maybe_dropout(self, x, train, rng):
+        if not train or not self.dropout or self.dropout <= 0.0:
+            return x
+        if rng is None:
+            raise ValueError(
+                f"Layer {self.name or type(self).__name__} has dropout "
+                "but no rng was supplied to apply()")
+        keep = 1.0 - self.dropout
+        m = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(m, x / keep, 0.0).astype(x.dtype)
+
+    def has_params(self) -> bool:
+        return True
+
+    def n_params(self, params) -> int:
+        return sum(int(jnp.size(p)) for p in jax.tree.leaves(params))

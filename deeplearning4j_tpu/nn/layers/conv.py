@@ -1,0 +1,444 @@
+"""Convolution / pooling / spatial layers — channels-last (NHWC/NWC/NDHWC).
+
+Reference classes (deeplearning4j-nn):
+  org.deeplearning4j.nn.conf.layers.ConvolutionLayer (+ Convolution1DLayer,
+  Convolution3D, Deconvolution2D, DepthwiseConvolution2D,
+  SeparableConvolution2D), SubsamplingLayer (+1D/3D), GlobalPoolingLayer,
+  Upsampling2D, ZeroPaddingLayer, Cropping2D, SpaceToDepthLayer; the
+  cuDNN fast path (CudnnConvolutionHelper) is replaced by XLA's native
+  convolution lowering, which autotunes for the MXU.
+
+Padding modes mirror the reference ConvolutionMode: TRUNCATE ≈ VALID,
+SAME = SAME. Kernels are stored [*spatial, in, out] (HWIO) so XLA needs
+no transposes.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.nn import weights as winit
+
+
+def _tup(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(v)
+    if len(t) != n:
+        raise ValueError(f"expected {n}-tuple, got {t}")
+    return t
+
+
+def _conv_dims(n_spatial):
+    # channels-last dimension_numbers for 1/2/3-D conv
+    spec = {1: ("NWC", "WIO", "NWC"),
+            2: ("NHWC", "HWIO", "NHWC"),
+            3: ("NDHWC", "DHWIO", "NDHWC")}[n_spatial]
+    return spec
+
+
+def _out_spatial(size, k, s, d, padding):
+    eff = (k - 1) * d + 1
+    if padding == "SAME":
+        return -(-size // s)
+    return (size - eff) // s + 1
+
+
+@register_layer
+@dataclass
+class ConvolutionLayer(Layer):
+    """2-D convolution (reference ConvolutionLayer / cuDNN helper path)."""
+    n_in: Optional[int] = None
+    n_out: int = 0
+    kernel_size: Sequence[int] = (3, 3)
+    stride: Sequence[int] = (1, 1)
+    padding: str = "VALID"            # reference ConvolutionMode
+    dilation: Sequence[int] = (1, 1)
+    has_bias: bool = True
+    groups: int = 1
+    _spatial: int = field(default=2, repr=False)
+
+    def _kshape(self, c_in):
+        k = _tup(self.kernel_size, self._spatial)
+        return k + (c_in // self.groups, self.n_out)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        c_in = self.n_in or input_shape[-1]
+        params = {"W": winit.get(self.weight_init or "xavier")(
+            key, self._kshape(c_in), dtype)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        k = _tup(self.kernel_size, self._spatial)
+        s = _tup(self.stride, self._spatial)
+        d = _tup(self.dilation, self._spatial)
+        out_sp = tuple(_out_spatial(input_shape[i], k[i], s[i], d[i],
+                                    self.padding)
+                       for i in range(self._spatial))
+        return params, {}, out_sp + (self.n_out,)
+
+    def _conv(self, x, w):
+        return lax.conv_general_dilated(
+            x, w,
+            window_strides=_tup(self.stride, self._spatial),
+            padding=self.padding,
+            rhs_dilation=_tup(self.dilation, self._spatial),
+            dimension_numbers=_conv_dims(self._spatial),
+            feature_group_count=self.groups)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        z = self._conv(x, params["W"])
+        if self.has_bias:
+            z = z + params["b"]
+        y = self._act()(z)
+        return self._maybe_dropout(y, train, rng), state
+
+
+@register_layer
+@dataclass
+class Convolution1DLayer(ConvolutionLayer):
+    """1-D conv over [B,T,C] (reference Convolution1DLayer)."""
+    kernel_size: Sequence[int] = (3,)
+    stride: Sequence[int] = (1,)
+    dilation: Sequence[int] = (1,)
+    _spatial: int = field(default=1, repr=False)
+
+    def propagate_mask(self, mask, input_shape):
+        if mask is None or self.padding == "SAME":
+            return mask
+        k = _tup(self.kernel_size, 1)[0]
+        s = _tup(self.stride, 1)[0]
+        d = _tup(self.dilation, 1)[0]
+        t_out = _out_spatial(mask.shape[1], k, s, d, self.padding)
+        return mask[:, :t_out * s:s]
+
+
+@register_layer
+@dataclass
+class Convolution3DLayer(ConvolutionLayer):
+    """3-D conv over [B,D,H,W,C] (reference Convolution3D)."""
+    kernel_size: Sequence[int] = (3, 3, 3)
+    stride: Sequence[int] = (1, 1, 1)
+    dilation: Sequence[int] = (1, 1, 1)
+    _spatial: int = field(default=3, repr=False)
+
+
+@register_layer
+@dataclass
+class Deconvolution2DLayer(ConvolutionLayer):
+    """Transposed conv (reference Deconvolution2D)."""
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        c_in = self.n_in or input_shape[-1]
+        k = _tup(self.kernel_size, 2)
+        s = _tup(self.stride, 2)
+        params = {"W": winit.get(self.weight_init or "xavier")(
+            key, k + (c_in, self.n_out), dtype)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        if self.padding == "SAME":
+            out_sp = tuple(input_shape[i] * s[i] for i in range(2))
+        else:
+            out_sp = tuple((input_shape[i] - 1) * s[i] + k[i]
+                           for i in range(2))
+        return params, {}, out_sp + (self.n_out,)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        z = lax.conv_transpose(
+            x, params["W"], strides=_tup(self.stride, 2),
+            padding=self.padding, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self._act()(z), state
+
+
+@register_layer
+@dataclass
+class DepthwiseConvolution2DLayer(ConvolutionLayer):
+    """Depthwise conv (reference DepthwiseConvolution2D): depth_multiplier
+    output channels per input channel via feature_group_count=C."""
+    depth_multiplier: int = 1
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        c_in = self.n_in or input_shape[-1]
+        self.n_out = c_in * self.depth_multiplier
+        k = _tup(self.kernel_size, 2)
+        params = {"W": winit.get(self.weight_init or "xavier")(
+            key, k + (1, self.n_out), dtype)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        s = _tup(self.stride, 2)
+        d = _tup(self.dilation, 2)
+        out_sp = tuple(_out_spatial(input_shape[i], k[i], s[i], d[i],
+                                    self.padding) for i in range(2))
+        return params, {}, out_sp + (self.n_out,)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        z = lax.conv_general_dilated(
+            x, params["W"], window_strides=_tup(self.stride, 2),
+            padding=self.padding, rhs_dilation=_tup(self.dilation, 2),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=x.shape[-1])
+        if self.has_bias:
+            z = z + params["b"]
+        return self._act()(z), state
+
+
+@register_layer
+@dataclass
+class SeparableConvolution2DLayer(ConvolutionLayer):
+    """Depthwise + pointwise (reference SeparableConvolution2D)."""
+    depth_multiplier: int = 1
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        c_in = self.n_in or input_shape[-1]
+        k = _tup(self.kernel_size, 2)
+        kd, kp = jax.random.split(key)
+        wi = winit.get(self.weight_init or "xavier")
+        params = {
+            "depthW": wi(kd, k + (1, c_in * self.depth_multiplier), dtype),
+            "pointW": wi(kp, (1, 1, c_in * self.depth_multiplier,
+                              self.n_out), dtype),
+        }
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        s = _tup(self.stride, 2)
+        d = _tup(self.dilation, 2)
+        out_sp = tuple(_out_spatial(input_shape[i], k[i], s[i], d[i],
+                                    self.padding) for i in range(2))
+        return params, {}, out_sp + (self.n_out,)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        z = lax.conv_general_dilated(
+            x, params["depthW"], window_strides=_tup(self.stride, 2),
+            padding=self.padding, rhs_dilation=_tup(self.dilation, 2),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=x.shape[-1])
+        z = lax.conv_general_dilated(
+            z, params["pointW"], window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self._act()(z), state
+
+
+@register_layer
+@dataclass
+class SubsamplingLayer(Layer):
+    """2-D pooling (reference SubsamplingLayer, PoolingType MAX/AVG/PNORM).
+    lax.reduce_window — XLA fuses with neighbors."""
+    kernel_size: Sequence[int] = (2, 2)
+    stride: Sequence[int] = (2, 2)
+    padding: str = "VALID"
+    pooling_type: str = "max"
+    pnorm: int = 2
+    _spatial: int = field(default=2, repr=False)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        k = _tup(self.kernel_size, self._spatial)
+        s = _tup(self.stride, self._spatial)
+        out_sp = tuple(_out_spatial(input_shape[i], k[i], s[i], 1,
+                                    self.padding)
+                       for i in range(self._spatial))
+        return {}, {}, out_sp + (input_shape[-1],)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        k = (1,) + _tup(self.kernel_size, self._spatial) + (1,)
+        s = (1,) + _tup(self.stride, self._spatial) + (1,)
+        pt = self.pooling_type.lower()
+        if pt == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, k, s, self.padding)
+        elif pt in ("avg", "mean"):
+            total = lax.reduce_window(x, 0.0, lax.add, k, s, self.padding)
+            ones = jnp.ones_like(x)
+            cnt = lax.reduce_window(ones, 0.0, lax.add, k, s, self.padding)
+            y = total / cnt
+        elif pt == "pnorm":
+            p = float(self.pnorm)
+            tot = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, k, s,
+                                    self.padding)
+            y = tot ** (1.0 / p)
+        elif pt == "sum":
+            y = lax.reduce_window(x, 0.0, lax.add, k, s, self.padding)
+        else:
+            raise ValueError(f"unknown pooling type {self.pooling_type!r}")
+        return y, state
+
+    def has_params(self):
+        return False
+
+
+@register_layer
+@dataclass
+class Subsampling1DLayer(SubsamplingLayer):
+    kernel_size: Sequence[int] = (2,)
+    stride: Sequence[int] = (2,)
+    _spatial: int = field(default=1, repr=False)
+
+
+@register_layer
+@dataclass
+class Subsampling3DLayer(SubsamplingLayer):
+    kernel_size: Sequence[int] = (2, 2, 2)
+    stride: Sequence[int] = (2, 2, 2)
+    _spatial: int = field(default=3, repr=False)
+
+
+@register_layer
+@dataclass
+class GlobalPoolingLayer(Layer):
+    """Global pooling over all spatial/time axes (reference
+    GlobalPoolingLayer; mask-aware for sequences)."""
+    pooling_type: str = "max"
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        return {}, {}, (input_shape[-1],)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        axes = tuple(range(1, x.ndim - 1))
+        pt = self.pooling_type.lower()
+        if mask is not None and x.ndim == 3:
+            m = mask[..., None].astype(x.dtype)
+            if pt == "max":
+                y = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=1)
+            elif pt in ("avg", "mean"):
+                y = jnp.sum(x * m, axis=1) / jnp.maximum(
+                    jnp.sum(m, axis=1), 1e-9)
+            elif pt == "sum":
+                y = jnp.sum(x * m, axis=1)
+            elif pt == "pnorm":
+                p = float(self.pnorm)
+                y = (jnp.sum((jnp.abs(x) * m) ** p, axis=1)) ** (1 / p)
+            else:
+                raise ValueError(self.pooling_type)
+            return y, state
+        if pt == "max":
+            y = jnp.max(x, axis=axes)
+        elif pt in ("avg", "mean"):
+            y = jnp.mean(x, axis=axes)
+        elif pt == "sum":
+            y = jnp.sum(x, axis=axes)
+        elif pt == "pnorm":
+            p = float(self.pnorm)
+            y = jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1 / p)
+        else:
+            raise ValueError(self.pooling_type)
+        return y, state
+
+    def propagate_mask(self, mask, input_shape):
+        return None  # pooled away
+
+    def has_params(self):
+        return False
+
+
+@register_layer
+@dataclass
+class Upsampling2DLayer(Layer):
+    """Nearest-neighbor upsampling (reference Upsampling2D)."""
+    size: Sequence[int] = (2, 2)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        s = _tup(self.size, 2)
+        return {}, {}, (input_shape[0] * s[0], input_shape[1] * s[1],
+                        input_shape[2])
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        s = _tup(self.size, 2)
+        y = jnp.repeat(jnp.repeat(x, s[0], axis=1), s[1], axis=2)
+        return y, state
+
+    def has_params(self):
+        return False
+
+
+@register_layer
+@dataclass
+class ZeroPaddingLayer(Layer):
+    """Spatial zero padding (reference ZeroPaddingLayer)."""
+    padding: Sequence[int] = (1, 1, 1, 1)  # top,bottom,left,right
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        t, b, l, r = self.padding
+        return {}, {}, (input_shape[0] + t + b, input_shape[1] + l + r,
+                        input_shape[2])
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        t, b, l, r = self.padding
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state
+
+    def has_params(self):
+        return False
+
+
+@register_layer
+@dataclass
+class CroppingLayer(Layer):
+    """Spatial cropping (reference Cropping2D)."""
+    cropping: Sequence[int] = (0, 0, 0, 0)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        t, b, l, r = self.cropping
+        return {}, {}, (input_shape[0] - t - b, input_shape[1] - l - r,
+                        input_shape[2])
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        t, b, l, r = self.cropping
+        h, w = x.shape[1], x.shape[2]
+        return x[:, t:h - b, l:w - r, :], state
+
+    def has_params(self):
+        return False
+
+
+@register_layer
+@dataclass
+class SpaceToDepthLayer(Layer):
+    """Space-to-depth (reference SpaceToDepthLayer)."""
+    block_size: int = 2
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        b = self.block_size
+        h, w, c = input_shape
+        return {}, {}, (h // b, w // b, c * b * b)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        b = self.block_size
+        n, h, w, c = x.shape
+        y = x.reshape(n, h // b, b, w // b, b, c)
+        y = y.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // b, w // b,
+                                                  c * b * b)
+        return y, state
+
+    def has_params(self):
+        return False
+
+
+@register_layer
+@dataclass
+class DepthToSpaceLayer(Layer):
+    """Inverse of SpaceToDepth (reference libnd4j depth_to_space op)."""
+    block_size: int = 2
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        b = self.block_size
+        h, w, c = input_shape
+        return {}, {}, (h * b, w * b, c // (b * b))
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        b = self.block_size
+        n, h, w, c = x.shape
+        y = x.reshape(n, h, w, b, b, c // (b * b))
+        y = y.transpose(0, 1, 3, 2, 4, 5).reshape(n, h * b, w * b,
+                                                  c // (b * b))
+        return y, state
+
+    def has_params(self):
+        return False

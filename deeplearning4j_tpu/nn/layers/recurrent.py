@@ -1,0 +1,367 @@
+"""Recurrent layers — [B, T, F] layout, lax.scan time loops.
+
+Reference classes (deeplearning4j-nn):
+  org.deeplearning4j.nn.conf.layers.LSTM / GravesLSTM /
+  GravesBidirectionalLSTM (→ Bidirectional wrapper here), SimpleRnn,
+  recurrent.Bidirectional, recurrent.LastTimeStep, util.MaskZeroLayer,
+  RnnOutputLayer / RnnLossLayer; math in
+  org.deeplearning4j.nn.layers.recurrent.LSTMHelpers (+CudnnLSTMHelper).
+
+TPU design: the input projection for ALL timesteps is one large batched
+matmul ([B*T, F] @ [F, 4H] — lands on the MXU); only the recurrent
+h @ U part runs inside ``lax.scan``. Masked steps hold state (h,c carry
+through) and emit zeros, matching the reference's mask semantics.
+Stored-state inference (reference ``rnnTimeStep`` /
+``rnnActivateUsingStoredState`` for truncated BPTT) is supported via the
+``initial_state``/returned-state pair.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.nn.layers.core import (DenseLayer, LossLayer,
+                                               OutputLayer)
+from deeplearning4j_tpu.nn import weights as winit
+from deeplearning4j_tpu.ops import activations
+
+
+class BaseRecurrentLayer(Layer):
+    """Common recurrent machinery: returns (y[B,T,H], state with
+    'h' (+'c') final carries for tBPTT)."""
+
+    def rnn_state_shapes(self, hidden):
+        raise NotImplementedError
+
+
+@register_layer
+@dataclass
+class LSTM(BaseRecurrentLayer):
+    """LSTM without peepholes (reference LSTM — the cuDNN-compatible
+    variant). Gate order [i, f, o, g] like the reference LSTMHelpers."""
+    n_in: Optional[int] = None
+    n_out: int = 0
+    forget_gate_bias_init: float = 1.0
+    gate_activation: str = "sigmoid"
+    peephole: bool = field(default=False, repr=False)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        n_in = self.n_in or input_shape[-1]
+        h = self.n_out
+        kW, kU, kP = jax.random.split(key, 3)
+        wi = winit.get(self.weight_init or "xavier")
+        params = {
+            "W": wi(kW, (n_in, 4 * h), dtype),   # input → gates
+            "U": wi(kU, (h, 4 * h), dtype),      # recurrent → gates
+            "b": jnp.concatenate([
+                jnp.zeros((h,), dtype),
+                jnp.full((h,), self.forget_gate_bias_init, dtype),
+                jnp.zeros((2 * h,), dtype)]),
+        }
+        if self.peephole:
+            params["P"] = jnp.zeros((3, h), dtype)  # pi, pf, po
+        t = input_shape[0] if len(input_shape) == 2 else None
+        return params, {}, (t, h)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None,
+              initial_state=None):
+        b, t, _ = x.shape
+        h = self.n_out
+        dt = x.dtype
+        gate_act = activations.get(self.gate_activation)
+        act = self._act("tanh")
+        if initial_state is None:
+            h0 = jnp.zeros((b, h), dt)
+            c0 = jnp.zeros((b, h), dt)
+        else:
+            h0, c0 = initial_state["h"], initial_state["c"]
+
+        # One big MXU matmul for every timestep's input projection.
+        xg = (x.reshape(b * t, -1) @ params["W"] + params["b"]).reshape(
+            b, t, 4 * h)
+        xg = jnp.swapaxes(xg, 0, 1)  # [T, B, 4H] scan-major
+        m = (jnp.ones((t, b, 1), dt) if mask is None
+             else jnp.swapaxes(mask, 0, 1)[..., None].astype(dt))
+
+        U = params["U"]
+        P = params.get("P")
+
+        def step(carry, inp):
+            hp, cp = carry
+            g, mt = inp
+            z = g + hp @ U
+            zi, zf, zo, zg = jnp.split(z, 4, axis=-1)
+            if P is not None:  # Graves peepholes on i, f from c_{t-1}
+                zi = zi + cp * P[0]
+                zf = zf + cp * P[1]
+            i = gate_act(zi)
+            f = gate_act(zf)
+            gg = act(zg)
+            c = f * cp + i * gg
+            if P is not None:  # peephole on o from c_t
+                zo = zo + c * P[2]
+            o = gate_act(zo)
+            hh = o * act(c)
+            # masked steps: hold state, emit zeros
+            c = mt * c + (1 - mt) * cp
+            hn = mt * hh + (1 - mt) * hp
+            return (hn, c), hh * mt
+
+        (hT, cT), ys = lax.scan(step, (h0, c0), (xg, m))
+        y = jnp.swapaxes(ys, 0, 1)
+        y = self._maybe_dropout(y, train, rng)
+        return y, {"h": hT, "c": cT}
+
+
+@register_layer
+@dataclass
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections (reference GravesLSTM, per
+    Graves 2013)."""
+    peephole: bool = field(default=True, repr=False)
+
+
+@register_layer
+@dataclass
+class SimpleRnn(BaseRecurrentLayer):
+    """Elman RNN: h_t = act(x W + h_{t-1} U + b) (reference SimpleRnn)."""
+    n_in: Optional[int] = None
+    n_out: int = 0
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        n_in = self.n_in or input_shape[-1]
+        kW, kU = jax.random.split(key)
+        wi = winit.get(self.weight_init or "xavier")
+        params = {"W": wi(kW, (n_in, self.n_out), dtype),
+                  "U": wi(kU, (self.n_out, self.n_out), dtype),
+                  "b": jnp.zeros((self.n_out,), dtype)}
+        t = input_shape[0] if len(input_shape) == 2 else None
+        return params, {}, (t, self.n_out)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None,
+              initial_state=None):
+        b, t, _ = x.shape
+        dt = x.dtype
+        act = self._act("tanh")
+        h0 = (jnp.zeros((b, self.n_out), dt) if initial_state is None
+              else initial_state["h"])
+        xg = jnp.swapaxes(x @ params["W"] + params["b"], 0, 1)
+        m = (jnp.ones((t, b, 1), dt) if mask is None
+             else jnp.swapaxes(mask, 0, 1)[..., None].astype(dt))
+        U = params["U"]
+
+        def step(hp, inp):
+            g, mt = inp
+            hh = act(g + hp @ U)
+            hn = mt * hh + (1 - mt) * hp
+            return hn, hh * mt
+
+        hT, ys = lax.scan(step, h0, (xg, m))
+        y = jnp.swapaxes(ys, 0, 1)
+        return self._maybe_dropout(y, train, rng), {"h": hT}
+
+
+@register_layer
+@dataclass
+class GRU(BaseRecurrentLayer):
+    """GRU (reference libnd4j ``gruCell`` op / samediff GRU)."""
+    n_in: Optional[int] = None
+    n_out: int = 0
+    gate_activation: str = "sigmoid"
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        n_in = self.n_in or input_shape[-1]
+        h = self.n_out
+        kW, kU = jax.random.split(key)
+        wi = winit.get(self.weight_init or "xavier")
+        params = {"W": wi(kW, (n_in, 3 * h), dtype),
+                  "U": wi(kU, (h, 3 * h), dtype),
+                  "b": jnp.zeros((3 * h,), dtype)}
+        t = input_shape[0] if len(input_shape) == 2 else None
+        return params, {}, (t, h)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None,
+              initial_state=None):
+        b, t, _ = x.shape
+        h = self.n_out
+        dt = x.dtype
+        gact = activations.get(self.gate_activation)
+        act = self._act("tanh")
+        h0 = (jnp.zeros((b, h), dt) if initial_state is None
+              else initial_state["h"])
+        xg = jnp.swapaxes(
+            (x.reshape(b * t, -1) @ params["W"] + params["b"]).reshape(
+                b, t, 3 * h), 0, 1)
+        m = (jnp.ones((t, b, 1), dt) if mask is None
+             else jnp.swapaxes(mask, 0, 1)[..., None].astype(dt))
+        U = params["U"]
+
+        def step(hp, inp):
+            g, mt = inp
+            xr, xz, xn = jnp.split(g, 3, axis=-1)
+            hr, hz, hn_ = jnp.split(hp @ U, 3, axis=-1)
+            r = gact(xr + hr)
+            z = gact(xz + hz)
+            n = act(xn + r * hn_)
+            hh = (1 - z) * n + z * hp
+            hn = mt * hh + (1 - mt) * hp
+            return hn, hh * mt
+
+        hT, ys = lax.scan(step, h0, (xg, m))
+        y = jnp.swapaxes(ys, 0, 1)
+        return self._maybe_dropout(y, train, rng), {"h": hT}
+
+
+@register_layer
+@dataclass
+class Bidirectional(Layer):
+    """Bidirectional wrapper (reference recurrent.Bidirectional; covers
+    GravesBidirectionalLSTM as Bidirectional(GravesLSTM)). Modes: concat,
+    add, mul, average (reference Bidirectional.Mode)."""
+    fwd: Optional[Layer] = None
+    mode: str = "concat"
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        kf, kb = jax.random.split(key)
+        pf, sf, of = self.fwd.init(kf, input_shape, dtype)
+        pb, sb, _ = self.fwd.init(kb, input_shape, dtype)
+        out = of
+        if self.mode == "concat":
+            out = of[:-1] + (of[-1] * 2,)
+        return {"fwd": pf, "bwd": pb}, {"fwd": sf, "bwd": sb}, out
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        # apply() is pure given params — the same config drives both
+        # directions with their own param subtrees.
+        r1, r2 = (jax.random.split(rng) if rng is not None else (None, None))
+        yf, sf = self.fwd.apply(params["fwd"], state.get("fwd", {}), x,
+                                train=train, rng=r1, mask=mask)
+        # mask-aware time reversal: reverse only the valid prefix
+        if mask is not None:
+            lengths = jnp.sum(mask.astype(jnp.int32), axis=1)
+            xr = _reverse_padded(x, lengths)
+        else:
+            xr = jnp.flip(x, axis=1)
+        yb, sb = self.fwd.apply(params["bwd"], state.get("bwd", {}), xr,
+                                train=train, rng=r2, mask=mask)
+        if mask is not None:
+            yb = _reverse_padded(yb, lengths)
+        else:
+            yb = jnp.flip(yb, axis=1)
+        if self.mode == "concat":
+            y = jnp.concatenate([yf, yb], axis=-1)
+        elif self.mode == "add":
+            y = yf + yb
+        elif self.mode == "mul":
+            y = yf * yb
+        elif self.mode == "average":
+            y = 0.5 * (yf + yb)
+        else:
+            raise ValueError(f"unknown Bidirectional mode {self.mode!r}")
+        return y, {"fwd": sf, "bwd": sb}
+
+
+def _reverse_padded(x, lengths):
+    """Reverse each sequence's valid prefix, keeping padding in place."""
+    t = x.shape[1]
+    idx = jnp.arange(t)[None, :]                        # [1,T]
+    rev = lengths[:, None] - 1 - idx                    # valid reversed pos
+    gather = jnp.where(idx < lengths[:, None], rev, idx)
+    return jnp.take_along_axis(
+        x, gather[..., None].astype(jnp.int32), axis=1)
+
+
+@register_layer
+@dataclass
+class LastTimeStep(Layer):
+    """Wraps a recurrent layer, emits only the last *valid* timestep
+    (reference recurrent.LastTimeStep)."""
+    underlying: Optional[Layer] = None
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        p, s, out = self.underlying.init(key, input_shape, dtype)
+        return p, s, (out[-1],)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        y, s = self.underlying.apply(params, state, x, train=train, rng=rng,
+                                     mask=mask)
+        if mask is not None:
+            lengths = jnp.sum(mask.astype(jnp.int32), axis=1)
+            idx = jnp.maximum(lengths - 1, 0)
+            out = jnp.take_along_axis(
+                y, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        else:
+            out = y[:, -1]
+        return out, s
+
+    def propagate_mask(self, mask, input_shape):
+        return None
+
+
+@register_layer
+@dataclass
+class MaskZeroLayer(Layer):
+    """Derives a time mask from input rows equal to ``mask_value`` and
+    applies the underlying layer with it (reference util.MaskZeroLayer)."""
+    underlying: Optional[Layer] = None
+    mask_value: float = 0.0
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        return self.underlying.init(key, input_shape, dtype)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        derived = jnp.any(x != self.mask_value, axis=-1).astype(x.dtype)
+        if mask is not None:
+            derived = derived * mask
+        return self.underlying.apply(params, state, x, train=train, rng=rng,
+                                     mask=derived)
+
+
+@register_layer
+@dataclass
+class TimeDistributed(Layer):
+    """Applies a feed-forward layer independently per timestep
+    (reference misc.TimeDistributed): folds time into batch around one
+    big batched op."""
+    underlying: Optional[Layer] = None
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        p, s, out = self.underlying.init(key, input_shape[1:], dtype)
+        return p, s, (input_shape[0],) + out
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        b, t = x.shape[:2]
+        flat = x.reshape((b * t,) + x.shape[2:])
+        y, s = self.underlying.apply(params, state, flat, train=train,
+                                     rng=rng)
+        return y.reshape((b, t) + y.shape[1:]), s
+
+
+@register_layer
+@dataclass
+class RnnOutputLayer(OutputLayer):
+    """Per-timestep dense + loss head over [B,T,F] (reference
+    RnnOutputLayer)."""
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        n_in = self.n_in or input_shape[-1]
+        params, state, _ = DenseLayer.init(self, key, (n_in,), dtype)
+        t = input_shape[0] if len(input_shape) == 2 else None
+        return params, state, (t, self.n_out)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return self._act()(z), state
+
+
+@register_layer
+@dataclass
+class RnnLossLayer(LossLayer):
+    """Loss-only over sequences (reference RnnLossLayer)."""

@@ -1,0 +1,250 @@
+"""Special-purpose layers.
+
+Reference: org.deeplearning4j.nn.conf.layers.variational.
+VariationalAutoencoder, AutoEncoder, CenterLossOutputLayer,
+misc.FrozenLayer, util.IdentityLayer / LambdaLayer (samediff),
+CapsuleLayer, PReLULayer.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn import weights as winit
+
+
+@register_layer
+@dataclass
+class AutoEncoder(Layer):
+    """Denoising autoencoder layer (reference AutoEncoder): forward pass
+    encodes; pretraining reconstructs with tied-ish decode weights."""
+    n_in: Optional[int] = None
+    n_out: int = 0
+    corruption_level: float = 0.3
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        n_in = self.n_in or input_shape[-1]
+        kW, = jax.random.split(key, 1)
+        wi = winit.get(self.weight_init or "xavier")
+        params = {"W": wi(kW, (n_in, self.n_out), dtype),
+                  "b": jnp.zeros((self.n_out,), dtype),
+                  "vb": jnp.zeros((n_in,), dtype)}  # visible bias (decode)
+        return params, {}, (self.n_out,)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if train and rng is not None and self.corruption_level > 0:
+            keep = jax.random.bernoulli(rng, 1 - self.corruption_level,
+                                        x.shape)
+            x = jnp.where(keep, x, 0.0).astype(x.dtype)
+        return self._act("sigmoid")(x @ params["W"] + params["b"]), state
+
+    def reconstruct(self, params, h):
+        return self._act("sigmoid")(h @ params["W"].T + params["vb"])
+
+
+@register_layer
+@dataclass
+class VariationalAutoencoder(Layer):
+    """VAE (reference variational.VariationalAutoencoder): gaussian
+    reparameterization; ``elbo_loss`` gives the pretraining objective."""
+    n_in: Optional[int] = None
+    n_out: int = 0                      # latent size
+    encoder_layer_sizes: Sequence[int] = (256,)
+    decoder_layer_sizes: Sequence[int] = (256,)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        n_in = self.n_in or input_shape[-1]
+        wi = winit.get(self.weight_init or "xavier")
+        params = {"enc": [], "dec": []}
+        sizes = [n_in, *self.encoder_layer_sizes]
+        keys = jax.random.split(key, len(sizes) + len(
+            self.decoder_layer_sizes) + 4)
+        ki = iter(keys)
+        for a, b in zip(sizes[:-1], sizes[1:]):
+            params["enc"].append({"W": wi(next(ki), (a, b), dtype),
+                                  "b": jnp.zeros((b,), dtype)})
+        h = sizes[-1]
+        params["mu"] = {"W": wi(next(ki), (h, self.n_out), dtype),
+                        "b": jnp.zeros((self.n_out,), dtype)}
+        params["logvar"] = {"W": wi(next(ki), (h, self.n_out), dtype),
+                            "b": jnp.zeros((self.n_out,), dtype)}
+        dsizes = [self.n_out, *self.decoder_layer_sizes]
+        for a, b in zip(dsizes[:-1], dsizes[1:]):
+            params["dec"].append({"W": wi(next(ki), (a, b), dtype),
+                                  "b": jnp.zeros((b,), dtype)})
+        params["out"] = {"W": wi(next(ki), (dsizes[-1], n_in), dtype),
+                         "b": jnp.zeros((n_in,), dtype)}
+        return params, {}, (self.n_out,)
+
+    def _encode(self, params, x):
+        h = x
+        act = self._act("leakyrelu")
+        for lyr in params["enc"]:
+            h = act(h @ lyr["W"] + lyr["b"])
+        mu = h @ params["mu"]["W"] + params["mu"]["b"]
+        logvar = h @ params["logvar"]["W"] + params["logvar"]["b"]
+        return mu, logvar
+
+    def _decode(self, params, z):
+        h = z
+        act = self._act("leakyrelu")
+        for lyr in params["dec"]:
+            h = act(h @ lyr["W"] + lyr["b"])
+        return h @ params["out"]["W"] + params["out"]["b"]
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        mu, logvar = self._encode(params, x)
+        if train and rng is not None:
+            z = mu + jnp.exp(0.5 * logvar) * jax.random.normal(
+                rng, mu.shape, mu.dtype)
+        else:
+            z = mu
+        return z, state
+
+    def elbo_loss(self, params, x, rng):
+        mu, logvar = self._encode(params, x)
+        z = mu + jnp.exp(0.5 * logvar) * jax.random.normal(
+            rng, mu.shape, mu.dtype)
+        recon = self._decode(params, z)
+        rec = jnp.mean(jnp.sum(jnp.square(recon - x), axis=-1))
+        kl = -0.5 * jnp.mean(jnp.sum(
+            1 + logvar - jnp.square(mu) - jnp.exp(logvar), axis=-1))
+        return rec + kl
+
+
+@register_layer
+@dataclass
+class CenterLossOutputLayer(OutputLayer):
+    """Output layer with center loss (reference CenterLossOutputLayer):
+    pulls features toward per-class centers. Centers live in state."""
+    alpha: float = 0.05
+    lambda_: float = 2e-4
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        params, state, out = super().init(key, input_shape, dtype)
+        n_in = self.n_in or input_shape[-1]
+        state = dict(state)
+        state["centers"] = jnp.zeros((self.n_out, n_in), dtype)
+        return params, state, out
+
+    def center_loss(self, state, features, label_idx):
+        centers = state["centers"][label_idx]
+        return 0.5 * self.lambda_ * jnp.mean(
+            jnp.sum(jnp.square(features - centers), axis=-1))
+
+    def update_centers(self, state, features, label_idx):
+        centers = state["centers"]
+        diff = centers[label_idx] - features
+        counts = jax.ops.segment_sum(
+            jnp.ones_like(label_idx, jnp.float32), label_idx,
+            centers.shape[0]) + 1.0
+        delta = jax.ops.segment_sum(diff, label_idx, centers.shape[0])
+        new = centers - self.alpha * delta / counts[:, None]
+        return {**state, "centers": new}
+
+
+@register_layer
+@dataclass
+class FrozenLayer(Layer):
+    """Wrapper excluding the underlying layer's params from training
+    (reference FrozenLayer; used by transfer learning)."""
+    underlying: Optional[Layer] = None
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        return self.underlying.init(key, input_shape, dtype)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        # train=False for the wrapped layer: frozen layers run in
+        # inference mode (reference semantics, e.g. BN uses running stats)
+        return self.underlying.apply(params, state, x, train=False,
+                                     rng=rng, mask=mask)
+
+    def propagate_mask(self, mask, input_shape):
+        return self.underlying.propagate_mask(mask, input_shape)
+
+    @property
+    def trainable_(self):
+        return False
+
+
+@register_layer
+@dataclass
+class LambdaLayer(Layer):
+    """Arbitrary paramless function layer (reference samediff Lambda
+    layers / SameDiffLayer simple case). Not JSON-serializable unless
+    ``fn`` is re-attached after load."""
+    fn: Optional[Callable] = None
+    output_shape_fn: Optional[Callable] = None
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        out = (self.output_shape_fn(input_shape) if self.output_shape_fn
+               else tuple(input_shape))
+        return {}, {}, out
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return self.fn(x), state
+
+    def to_dict(self):
+        d = super().to_dict()
+        d["fn"] = None
+        d["output_shape_fn"] = None
+        return d
+
+    def has_params(self):
+        return False
+
+
+@register_layer
+@dataclass
+class PReLULayer(Layer):
+    """Parametric ReLU with learned per-feature alpha (reference
+    PReLULayer)."""
+    def init(self, key, input_shape, dtype=jnp.float32):
+        return ({"alpha": jnp.full((input_shape[-1],), 0.25, dtype)},
+                {}, tuple(input_shape))
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.where(x >= 0, x, params["alpha"] * x), state
+
+
+@register_layer
+@dataclass
+class CapsuleLayer(Layer):
+    """Capsule layer with dynamic routing (reference CapsuleLayer,
+    capsnet family). Routing iterations unrolled (static count) for jit."""
+    n_in: Optional[int] = None
+    capsules: int = 10
+    capsule_dim: int = 16
+    routings: int = 3
+    input_capsules: Optional[int] = None
+    input_capsule_dim: Optional[int] = None
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        ic, icd = input_shape[-2], input_shape[-1]
+        wi = winit.get(self.weight_init or "xavier")
+        params = {"W": wi(key, (ic, self.capsules * self.capsule_dim, icd),
+                          dtype)}
+        self.input_capsules, self.input_capsule_dim = ic, icd
+        return params, {}, (self.capsules, self.capsule_dim)
+
+    @staticmethod
+    def _squash(v, axis=-1):
+        n2 = jnp.sum(jnp.square(v), axis=axis, keepdims=True)
+        return (n2 / (1 + n2)) * v / jnp.sqrt(n2 + 1e-9)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        # x: [B, IC, ICD] -> predictions u_hat [B, IC, C, CD]
+        u_hat = jnp.einsum("bid,icd->bic", x, params["W"]).reshape(
+            x.shape[0], x.shape[1], self.capsules, self.capsule_dim)
+        b_logits = jnp.zeros(u_hat.shape[:3], u_hat.dtype)
+        for _ in range(self.routings):
+            c = jax.nn.softmax(b_logits, axis=-1)
+            s = jnp.einsum("bic,bicd->bcd", c, u_hat)
+            v = self._squash(s)
+            b_logits = b_logits + jnp.einsum("bicd,bcd->bic", u_hat, v)
+        return v, state

@@ -1,0 +1,459 @@
+"""MultiLayerNetwork — reference:
+``org.deeplearning4j.nn.multilayer.MultiLayerNetwork`` (~4k-line class,
+SURVEY §2.3/§3.2).
+
+TPU-native redesign: instead of the reference's per-op eager dispatch
+(layer.activate → JNI → kernel, one crossing per op), the WHOLE training
+step — forward, loss, backward, updater, param update — is one traced
+``jax.jit`` computation: XLA fuses it and keeps everything in HBM.
+``fit`` then just streams batches into the compiled step.
+
+Supports: fit/output/score, masks, truncated BPTT with stored recurrent
+state (reference rnnTimeStep / rnnActivateUsingStoredState), listeners,
+per-layer updater/LR overrides, frozen layers, l1/l2/weight-decay,
+gradient normalization modes.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu import dtypes
+from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.nn.layers.core import OutputLayer, LossLayer
+from deeplearning4j_tpu.nn.layers.recurrent import (
+    BaseRecurrentLayer, RnnOutputLayer, RnnLossLayer)
+from deeplearning4j_tpu.nn.layers.special import FrozenLayer
+from deeplearning4j_tpu.nn import updaters as upd
+from deeplearning4j_tpu.ops import losses as losses_mod
+
+# losses that support the fused from_logits path, keyed by activation
+_FUSABLE = {
+    ("softmax", "mcxent"), ("softmax", "negativeloglikelihood"),
+    ("softmax", "sparse_mcxent"), ("sigmoid", "xent"),
+    ("sigmoid", "binary_xent"),
+}
+
+
+def _lname(i: int) -> str:
+    return f"layer_{i}"
+
+
+class MultiLayerNetwork:
+    """Sequential stack model (reference MultiLayerNetwork)."""
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers: List[Layer] = conf.layers
+        self.params: Dict[str, Any] = {}
+        self.state: Dict[str, Any] = {}
+        self.opt_state = None
+        self.listeners: List[Any] = []
+        self.iteration = 0
+        self.epoch = 0
+        self._rnn_state: Optional[Dict[str, Any]] = None  # stored-state API
+        self._train_step_fn = None
+        self._output_fn = None
+        self._optimizer = None
+        self.score_ = float("nan")
+
+    # ------------------------------------------------------------------
+    # init
+    # ------------------------------------------------------------------
+    def init(self, input_shape: Optional[Tuple[int, ...]] = None):
+        """Build params (reference MultiLayerNetwork.init()). Shape comes
+        from conf.input_type unless given explicitly (no batch dim)."""
+        if input_shape is None:
+            if self.conf.input_type is None:
+                raise ValueError("init() needs input_shape or "
+                                 "conf.input_type")
+            input_shape = self.conf.input_type.shape
+            if self.conf.input_type.kind == "rnn" and input_shape[0] == -1:
+                input_shape = (None,) + input_shape[1:]
+        dtype = dtypes.resolve(self.conf.dtype)
+        key = jax.random.PRNGKey(self.conf.seed)
+        shape = tuple(input_shape)
+        self._input_shape = shape
+        self._layer_shapes = []
+        for i, layer in enumerate(self.layers):
+            key, sub = jax.random.split(key)
+            p, s, shape = layer.init(sub, shape, dtype)
+            self.params[_lname(i)] = p
+            self.state[_lname(i)] = s
+            self._layer_shapes.append(shape)
+        self._output_shape = shape
+        self._build_optimizer()
+        return self
+
+    def _layer_updater(self, layer: Layer):
+        u = layer.updater
+        if u is None and layer.learning_rate is not None:
+            import copy
+            u = copy.deepcopy(self.conf.updater)
+            u.learning_rate = layer.learning_rate
+            u.schedule = None
+        return u or self.conf.updater
+
+    def _build_optimizer(self):
+        transforms, labels = {}, {}
+        for i, layer in enumerate(self.layers):
+            name = _lname(i)
+            frozen = isinstance(layer, FrozenLayer) or not layer.trainable
+            if frozen:
+                transforms[name] = optax.set_to_zero()
+            else:
+                chain = [upd.gradient_normalization(
+                    self.conf.gradient_normalization,
+                    self.conf.gradient_normalization_threshold)]
+                if layer.weight_decay:
+                    chain.append(optax.add_decayed_weights(
+                        layer.weight_decay))
+                chain.append(self._layer_updater(layer).to_optax())
+                transforms[name] = optax.chain(*chain)
+            labels[name] = name
+        self._optimizer = optax.multi_transform(
+            transforms, param_labels=labels)
+        self.opt_state = self._optimizer.init(self.params)
+
+    # ------------------------------------------------------------------
+    # forward
+    # ------------------------------------------------------------------
+    def _forward(self, params, state, x, *, train, rng, mask=None,
+                 rnn_init=None, stop_at: Optional[int] = None,
+                 pre_output_last: bool = False):
+        """Returns (activation, new_state, rnn_states)."""
+        if not params:
+            raise RuntimeError(
+                "Network has no parameters — call init() before "
+                "fit()/output() (reference: MultiLayerNetwork.init()).")
+        new_state = {}
+        rnn_states = {}
+        n = len(self.layers) if stop_at is None else stop_at
+        for i in range(n):
+            layer = self.layers[i]
+            name = _lname(i)
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            kwargs = {}
+            if isinstance(layer, BaseRecurrentLayer) and rnn_init:
+                kwargs["initial_state"] = rnn_init.get(name)
+            if (pre_output_last and i == n - 1
+                    and isinstance(layer, (OutputLayer,))):
+                # pre-activation logits for fused loss
+                z = x.reshape(x.shape[0], -1) if (
+                    not isinstance(layer, RnnOutputLayer) and x.ndim > 2
+                ) else x
+                z = z @ params[name]["W"]
+                if layer.has_bias:
+                    z = z + params[name]["b"]
+                x = z
+                new_state[name] = state.get(name, {})
+                continue
+            x, s = layer.apply(params.get(name, {}), state.get(name, {}),
+                               x, train=train, rng=sub, mask=mask, **kwargs)
+            if isinstance(layer, BaseRecurrentLayer):
+                rnn_states[name] = s
+                new_state[name] = state.get(name, {})
+            else:
+                new_state[name] = s
+            mask = layer.propagate_mask(mask, None)
+        return x, new_state, rnn_states
+
+    # ------------------------------------------------------------------
+    # loss
+    # ------------------------------------------------------------------
+    def _last_loss(self):
+        last = self.layers[-1]
+        loss_name = getattr(last, "loss", None)
+        if loss_name is None:
+            raise ValueError("last layer has no loss; use an OutputLayer/"
+                             "LossLayer variant for fit()")
+        act = (last.activation or "identity").lower()
+        fused = (act, loss_name.lower()) in _FUSABLE
+        return loss_name, fused
+
+    def _reg_score(self, params):
+        total = 0.0
+        for i, layer in enumerate(self.layers):
+            l1v, l2v = layer.l1, layer.l2
+            if not l1v and not l2v:
+                continue
+            for leaf in jax.tree.leaves(params[_lname(i)]):
+                if l1v:
+                    total = total + l1v * jnp.sum(jnp.abs(leaf))
+                if l2v:
+                    total = total + 0.5 * l2v * jnp.sum(jnp.square(leaf))
+        return total
+
+    def _loss_fn(self, params, state, x, y, mask, lmask, rng):
+        loss_name, fused = self._last_loss()
+        out, new_state, _ = self._forward(
+            params, state, x, train=True, rng=rng, mask=mask,
+            pre_output_last=fused)
+        loss_fn = losses_mod.get(loss_name)
+        kw = {"from_logits": True} if fused else {}
+        data_loss = loss_fn(y, out, mask=lmask, **kw)
+        return data_loss + self._reg_score(params), new_state
+
+    # ------------------------------------------------------------------
+    # fit
+    # ------------------------------------------------------------------
+    def _make_train_step(self):
+        optimizer = self._optimizer
+
+        def step(params, opt_state, state, x, y, mask, lmask, rng):
+            (loss, new_state), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True)(
+                    params, state, x, y, mask, lmask, rng)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, new_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def fit(self, features, labels=None, *, epochs: int = 1,
+            features_mask=None, labels_mask=None):
+        """fit(x, y) for one batch, or fit(iterator, epochs=N).
+
+        Iterator elements: DataSet-like (``.features``/``.labels``/
+        ``.features_mask``/``.labels_mask``) or (x, y) tuples.
+        Reference: MultiLayerNetwork.fit(DataSetIterator) — SURVEY §3.2.
+        """
+        if labels is not None:
+            self._fit_batch(features, labels, features_mask, labels_mask)
+            return self
+        it = features
+        for _ in range(epochs):
+            for l in self.listeners:
+                l.on_epoch_start(self)
+            if hasattr(it, "reset"):
+                it.reset()
+            for ds in it:
+                if hasattr(ds, "features"):
+                    x, y = ds.features, ds.labels
+                    fm = getattr(ds, "features_mask", None)
+                    lm = getattr(ds, "labels_mask", None)
+                else:
+                    x, y = ds
+                    fm = lm = None
+                self._fit_batch(x, y, fm, lm)
+            for l in self.listeners:
+                l.on_epoch_end(self)
+            self.epoch += 1
+        return self
+
+    def _fit_batch(self, x, y, fmask=None, lmask=None):
+        x = jnp.asarray(np.asarray(x))
+        y = jnp.asarray(np.asarray(y))
+        if (self.conf.backprop_type == "TruncatedBPTT" and x.ndim == 3):
+            return self._fit_tbptt(x, y, fmask, lmask)
+        if self._train_step_fn is None:
+            self._train_step_fn = self._make_train_step()
+        rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed),
+                                 self.iteration)
+        self.params, self.opt_state, self.state, loss = \
+            self._train_step_fn(self.params, self.opt_state, self.state,
+                                x, y, fmask, lmask, rng)
+        self.score_ = float(loss)
+        self.iteration += 1
+        for l in self.listeners:
+            l.iteration_done(self, self.iteration, self.epoch)
+
+    # -- truncated BPTT (reference: fit segments of tbpttLength, carrying
+    #    rnn state across segments; MultiLayerNetwork truncated-BPTT path)
+    def _fit_tbptt(self, x, y, fmask, lmask):
+        k = self.conf.tbptt_fwd_length
+        t = x.shape[1]
+        rnn_states = None
+        if self._tbptt_step_fn_ is None:
+            self._tbptt_step_fn_ = self._make_tbptt_step()
+        for s0 in range(0, t, k):
+            xs = x[:, s0:s0 + k]
+            ys = y[:, s0:s0 + k] if y.ndim == 3 else y
+            fs = fmask[:, s0:s0 + k] if fmask is not None else None
+            ls = lmask[:, s0:s0 + k] if lmask is not None else None
+            rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed),
+                                     self.iteration)
+            (self.params, self.opt_state, self.state, rnn_states,
+             loss) = self._tbptt_step_fn_(
+                self.params, self.opt_state, self.state, rnn_states,
+                xs, ys, fs, ls, rng)
+            self.score_ = float(loss)
+        self.iteration += 1
+        for l in self.listeners:
+            l.iteration_done(self, self.iteration, self.epoch)
+
+    _tbptt_step_fn_ = None
+
+    def _make_tbptt_step(self):
+        optimizer = self._optimizer
+        loss_name, fused = self._last_loss()
+        loss_fn = losses_mod.get(loss_name)
+
+        def loss_with_state(params, state, rnn_init, x, y, mask, lmask,
+                            rng):
+            out, new_state, rnn_states = self._forward(
+                params, state, x, train=True, rng=rng, mask=mask,
+                rnn_init=rnn_init, pre_output_last=fused)
+            kw = {"from_logits": True} if fused else {}
+            loss = loss_fn(y, out, mask=lmask, **kw)
+            return loss + self._reg_score(params), (new_state, rnn_states)
+
+        def step(params, opt_state, state, rnn_init, x, y, mask, lmask,
+                 rng):
+            (loss, (new_state, rnn_states)), grads = jax.value_and_grad(
+                loss_with_state, has_aux=True)(
+                    params, state, rnn_init, x, y, mask, lmask, rng)
+            # stop state gradients across segment boundary (truncation)
+            rnn_states = jax.tree.map(jax.lax.stop_gradient, rnn_states)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, new_state, rnn_states, loss
+
+        return jax.jit(step)
+
+    # ------------------------------------------------------------------
+    # inference
+    # ------------------------------------------------------------------
+    def output(self, x, train: bool = False, mask=None):
+        """Reference: MultiLayerNetwork.output (SURVEY §3.3)."""
+        x = jnp.asarray(np.asarray(x))
+        if self._output_fn is None:
+            def infer(params, state, x, mask):
+                out, _, _ = self._forward(params, state, x, train=False,
+                                          rng=None, mask=mask)
+                return out
+            self._output_fn = jax.jit(infer)
+        return self._output_fn(self.params, self.state, x, mask)
+
+    def feed_forward(self, x, train: bool = False):
+        """All layer activations (reference feedForward): list, input
+        first."""
+        x = jnp.asarray(np.asarray(x))
+        acts = [x]
+        cur = x
+        for i, layer in enumerate(self.layers):
+            cur, _ = layer.apply(self.params[_lname(i)],
+                                 self.state[_lname(i)], cur,
+                                 train=train, rng=None)
+            acts.append(cur)
+        return acts
+
+    def activate_selected_layers(self, from_: int, to: int, x):
+        cur = jnp.asarray(np.asarray(x))
+        for i in range(from_, to + 1):
+            cur, _ = self.layers[i].apply(
+                self.params[_lname(i)], self.state[_lname(i)], cur,
+                train=False, rng=None)
+        return cur
+
+    def rnn_clear_previous_state(self):
+        self._rnn_state = None
+
+    def rnn_time_step(self, x, mask=None):
+        """Stateful single/multi-step inference (reference rnnTimeStep):
+        carries recurrent state between calls."""
+        x = jnp.asarray(np.asarray(x))
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[:, None, :]
+        out, _, rnn_states = self._forward(
+            self.params, self.state, x, train=False, rng=None, mask=mask,
+            rnn_init=self._rnn_state)
+        self._rnn_state = rnn_states
+        if squeeze and out.ndim == 3:
+            out = out[:, -1]
+        return out
+
+    # ------------------------------------------------------------------
+    # scoring / evaluation
+    # ------------------------------------------------------------------
+    def score(self, dataset=None) -> float:
+        if dataset is None:
+            return self.score_
+        x, y = dataset.features, dataset.labels
+        loss_name, fused = self._last_loss()
+        out, _, _ = self._forward(
+            self.params, self.state, jnp.asarray(np.asarray(x)),
+            train=False, rng=None,
+            mask=getattr(dataset, "features_mask", None),
+            pre_output_last=fused)
+        kw = {"from_logits": True} if fused else {}
+        loss = losses_mod.get(loss_name)(
+            jnp.asarray(np.asarray(y)), out,
+            mask=getattr(dataset, "labels_mask", None), **kw)
+        return float(loss + self._reg_score(self.params))
+
+    def evaluate(self, iterator):
+        """Classification evaluation (reference MultiLayerNetwork
+        .evaluate(DataSetIterator) → Evaluation)."""
+        from deeplearning4j_tpu.eval_.evaluation import Evaluation
+        e = Evaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            if hasattr(ds, "features"):
+                x, y = ds.features, ds.labels
+            else:
+                x, y = ds
+            out = self.output(x)
+            e.eval(np.asarray(y), np.asarray(out))
+        return e
+
+    def evaluate_regression(self, iterator):
+        from deeplearning4j_tpu.eval_.evaluation import RegressionEvaluation
+        e = RegressionEvaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            x, y = (ds.features, ds.labels) if hasattr(ds, "features") \
+                else ds
+            e.eval(np.asarray(y), np.asarray(self.output(x)))
+        return e
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    def num_params(self) -> int:
+        return sum(int(np.prod(np.shape(l)))
+                   for l in jax.tree.leaves(self.params))
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def add_listeners(self, *listeners):
+        self.listeners.extend(listeners)
+        return self
+
+    def summary(self) -> str:
+        lines = ["=" * 68,
+                 f"{'Layer':<30}{'Output':<20}{'Params':>10}",
+                 "=" * 68]
+        total = 0
+        for i, layer in enumerate(self.layers):
+            n = sum(int(np.prod(np.shape(l)))
+                    for l in jax.tree.leaves(self.params[_lname(i)]))
+            total += n
+            lines.append(f"{type(layer).__name__:<30}"
+                         f"{str(self._layer_shapes[i]):<20}{n:>10,}")
+        lines.append("=" * 68)
+        lines.append(f"Total params: {total:,}")
+        return "\n".join(lines)
+
+    def clone(self) -> "MultiLayerNetwork":
+        import copy
+        net = MultiLayerNetwork(copy.deepcopy(self.conf))
+        net.params = jax.tree.map(lambda x: x, self.params)
+        net.state = jax.tree.map(lambda x: x, self.state)
+        net._input_shape = getattr(self, "_input_shape", None)
+        net._build_optimizer()
+        return net

@@ -1,0 +1,359 @@
+"""Updaters (optimizers) — reference: ``org.nd4j.linalg.learning.config.IUpdater``
+beans (Adam, AdamW, Nadam, AMSGrad, Nesterovs, RmsProp, AdaGrad, AdaDelta,
+Sgd, NoOp) + ``org.nd4j.linalg.schedule.ISchedule`` impls, and the dl4j-side
+``BaseMultiLayerUpdater``/``UpdaterBlock`` plumbing (per-layer LR,
+regularization applied inside updater blocks, gradient clipping/
+normalization modes).
+
+TPU-native: each bean maps to an optax GradientTransformation; the
+network builds ONE optax optimizer over the whole param pytree with
+per-layer overrides via ``optax.multi_transform`` — the update runs
+inside the jitted train step.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+_UPDATER_REGISTRY: Dict[str, type] = {}
+
+
+def register_updater(cls):
+    _UPDATER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def updater_from_dict(d):
+    if isinstance(d, Updater):
+        return d
+    d = dict(d)
+    cls = _UPDATER_REGISTRY[d.pop("@class")]
+    if "schedule" in d and isinstance(d["schedule"], dict):
+        d["schedule"] = schedule_from_dict(d["schedule"])
+    return cls(**d)
+
+
+# ---------------------------------------------------------------------------
+# Schedules — reference org.nd4j.linalg.schedule.*
+# ---------------------------------------------------------------------------
+
+_SCHEDULE_REGISTRY: Dict[str, type] = {}
+
+
+def register_schedule(cls):
+    _SCHEDULE_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def schedule_from_dict(d):
+    d = dict(d)
+    cls = _SCHEDULE_REGISTRY[d.pop("@class")]
+    return cls(**d)
+
+
+@dataclass
+class Schedule:
+    def __call__(self, step):
+        raise NotImplementedError
+
+    def to_dict(self):
+        import dataclasses as dc
+        out = {"@class": type(self).__name__}
+        out.update(dc.asdict(self))
+        return out
+
+
+@register_schedule
+@dataclass
+class FixedSchedule(Schedule):
+    value: float = 1e-3
+
+    def __call__(self, step):
+        return self.value
+
+
+@register_schedule
+@dataclass
+class StepSchedule(Schedule):
+    """lr * decay^floor(step / interval) (reference StepSchedule)."""
+    initial: float = 1e-3
+    decay_rate: float = 0.5
+    step: int = 1000
+
+    def __call__(self, step):
+        return self.initial * self.decay_rate ** jnp.floor(step / self.step)
+
+
+@register_schedule
+@dataclass
+class ExponentialSchedule(Schedule):
+    initial: float = 1e-3
+    gamma: float = 0.99
+
+    def __call__(self, step):
+        return self.initial * self.gamma ** step
+
+
+@register_schedule
+@dataclass
+class InverseSchedule(Schedule):
+    initial: float = 1e-3
+    gamma: float = 0.99
+    power: float = 1.0
+
+    def __call__(self, step):
+        return self.initial / (1 + self.gamma * step) ** self.power
+
+
+@register_schedule
+@dataclass
+class PolySchedule(Schedule):
+    initial: float = 1e-3
+    power: float = 2.0
+    max_iter: int = 10000
+
+    def __call__(self, step):
+        frac = jnp.clip(step / self.max_iter, 0.0, 1.0)
+        return self.initial * (1 - frac) ** self.power
+
+
+@register_schedule
+@dataclass
+class SigmoidSchedule(Schedule):
+    initial: float = 1e-3
+    gamma: float = 0.01
+    step_center: int = 1000
+
+    def __call__(self, step):
+        return self.initial / (1 + jnp.exp(
+            self.gamma * (step - self.step_center)))
+
+
+@register_schedule
+@dataclass
+class CosineSchedule(Schedule):
+    """Cosine decay (modern addition; reference has CycleSchedule)."""
+    initial: float = 1e-3
+    max_iter: int = 10000
+    final_fraction: float = 0.0
+
+    def __call__(self, step):
+        frac = jnp.clip(step / self.max_iter, 0.0, 1.0)
+        cos = 0.5 * (1 + jnp.cos(jnp.pi * frac))
+        return self.initial * (self.final_fraction +
+                               (1 - self.final_fraction) * cos)
+
+
+@register_schedule
+@dataclass
+class WarmupSchedule(Schedule):
+    """Linear warmup into another schedule (transformer-era addition)."""
+    warmup_steps: int = 1000
+    base: Any = None
+
+    def __call__(self, step):
+        base = (self.base(step) if callable(self.base)
+                else float(self.base))
+        return base * jnp.minimum(1.0, (step + 1) / self.warmup_steps)
+
+    def to_dict(self):
+        d = super().to_dict()
+        if isinstance(self.base, Schedule):
+            d["base"] = self.base.to_dict()
+        return d
+
+
+# ---------------------------------------------------------------------------
+# Updater beans
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Updater:
+    learning_rate: float = 1e-3
+    schedule: Optional[Schedule] = None
+
+    def _lr(self):
+        if self.schedule is not None:
+            return lambda step: self.schedule(step)
+        return self.learning_rate
+
+    def to_optax(self) -> optax.GradientTransformation:
+        raise NotImplementedError
+
+    def to_dict(self):
+        import dataclasses as dc
+        out = {"@class": type(self).__name__}
+        for f in dc.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, Schedule):
+                v = v.to_dict()
+            out[f.name] = v
+        return out
+
+
+@register_updater
+@dataclass
+class Sgd(Updater):
+    def to_optax(self):
+        return optax.sgd(self._lr())
+
+
+@register_updater
+@dataclass
+class Adam(Updater):
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.adam(self._lr(), b1=self.beta1, b2=self.beta2,
+                          eps=self.epsilon)
+
+
+@register_updater
+@dataclass
+class AdamW(Adam):
+    weight_decay: float = 0.01
+
+    def to_optax(self):
+        return optax.adamw(self._lr(), b1=self.beta1, b2=self.beta2,
+                           eps=self.epsilon, weight_decay=self.weight_decay)
+
+
+@register_updater
+@dataclass
+class Nadam(Adam):
+    def to_optax(self):
+        return optax.nadam(self._lr(), b1=self.beta1, b2=self.beta2,
+                           eps=self.epsilon)
+
+
+@register_updater
+@dataclass
+class AMSGrad(Adam):
+    def to_optax(self):
+        return optax.amsgrad(self._lr(), b1=self.beta1, b2=self.beta2,
+                             eps=self.epsilon)
+
+
+@register_updater
+@dataclass
+class Nesterovs(Updater):
+    momentum: float = 0.9
+
+    def to_optax(self):
+        return optax.sgd(self._lr(), momentum=self.momentum, nesterov=True)
+
+
+@register_updater
+@dataclass
+class Momentum(Updater):
+    momentum: float = 0.9
+
+    def to_optax(self):
+        return optax.sgd(self._lr(), momentum=self.momentum)
+
+
+@register_updater
+@dataclass
+class RmsProp(Updater):
+    decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def to_optax(self):
+        return optax.rmsprop(self._lr(), decay=self.decay,
+                             eps=self.epsilon)
+
+
+@register_updater
+@dataclass
+class AdaGrad(Updater):
+    epsilon: float = 1e-6
+
+    def to_optax(self):
+        return optax.adagrad(self._lr(), eps=self.epsilon)
+
+
+@register_updater
+@dataclass
+class AdaDelta(Updater):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def to_optax(self):
+        return optax.adadelta(learning_rate=1.0, rho=self.rho,
+                              eps=self.epsilon)
+
+
+@register_updater
+@dataclass
+class AdaMax(Adam):
+    def to_optax(self):
+        return optax.adamax(self._lr(), b1=self.beta1, b2=self.beta2,
+                            eps=self.epsilon)
+
+
+@register_updater
+@dataclass
+class NoOp(Updater):
+    def to_optax(self):
+        return optax.set_to_zero()
+
+
+# ---------------------------------------------------------------------------
+# Gradient normalization — reference GradientNormalization enum
+# (BaseLayer.gradientNormalization): RenormalizeL2PerLayer/PerParamType,
+# ClipElementWiseAbsoluteValue, ClipL2PerLayer, ClipL2PerParamType.
+# ---------------------------------------------------------------------------
+
+def gradient_normalization(mode: Optional[str], threshold: float = 1.0):
+    """Returns an optax transform implementing the reference modes."""
+    if mode is None or mode == "None":
+        return optax.identity()
+    mode_l = str(mode).lower()
+    if mode_l == "clipelementwiseabsolutevalue":
+        return optax.clip(threshold)
+    if mode_l == "clipl2perlayer":
+        def clip_leaf(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g)) + 1e-12)
+            return g * jnp.minimum(1.0, threshold / n)
+        return optax.stateless(lambda g, p: jax.tree.map(clip_leaf, g))
+    if mode_l == "clipl2perparamtype":
+        return optax.clip_by_global_norm(threshold)
+    if mode_l == "renormalizel2perlayer":
+        def renorm(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g)) + 1e-12)
+            return g / n
+        return optax.stateless(lambda g, p: jax.tree.map(renorm, g))
+    if mode_l == "renormalizel2perparamtype":
+        def renorm_all(g, p):
+            n = optax.global_norm(g)
+            return jax.tree.map(lambda x: x / (n + 1e-12), g)
+        return optax.stateless(renorm_all)
+    raise ValueError(f"unknown gradient normalization {mode!r}")
+
+
+def l1_l2_regularization(l1: float = 0.0, l2: float = 0.0,
+                         weight_decay: float = 0.0):
+    """Reference semantics: l1/l2 penalties added to gradients inside the
+    updater block (Regularization.applyStep BEFORE_UPDATER); weight decay
+    applied decoupled."""
+    transforms = []
+    if l1 or l2:
+        def add_reg(g, p):
+            def leaf(gi, pi):
+                out = gi
+                if l2:
+                    out = out + l2 * pi
+                if l1:
+                    out = out + l1 * jnp.sign(pi)
+                return out
+            return jax.tree.map(leaf, g, p)
+        transforms.append(optax.stateless(add_reg))
+    if weight_decay:
+        transforms.append(optax.add_decayed_weights(weight_decay))
+    return optax.chain(*transforms) if transforms else optax.identity()

@@ -1,0 +1,116 @@
+"""Weight initialization — reference: ``org.deeplearning4j.nn.weights.WeightInit``
+enum + ``WeightInitUtil`` (deeplearning4j-nn).
+
+Fan-in/fan-out conventions match the reference: XAVIER = glorot normal,
+RELU = He normal, etc. All initializers take a jax PRNG key.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape):
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels [*spatial, in, out] (channels-last layout)
+    receptive = math.prod(shape[:-2])
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def xavier(key, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    std = math.sqrt(2.0 / (fan_in + fan_out))
+    return jax.random.normal(key, shape, dtype) * std
+
+
+def xavier_uniform(key, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    limit = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+def xavier_fan_in(key, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+
+
+def relu_init(key, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / fan_in)
+
+
+def relu_uniform(key, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    limit = math.sqrt(6.0 / fan_in)
+    return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+def lecun_normal(key, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+
+
+def lecun_uniform(key, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    limit = math.sqrt(3.0 / fan_in)
+    return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+def normal(key, shape, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype) / math.sqrt(shape[-1])
+
+
+def uniform(key, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    a = 1.0 / math.sqrt(fan_in)
+    return jax.random.uniform(key, shape, dtype, -a, a)
+
+
+def zero(key, shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+def ones_(key, shape, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+def identity(key, shape, dtype=jnp.float32):
+    if len(shape) != 2 or shape[0] != shape[1]:
+        raise ValueError("IDENTITY init needs square 2-D shape")
+    return jnp.eye(shape[0], dtype=dtype)
+
+
+_REGISTRY: Dict[str, Callable] = {
+    "xavier": xavier,
+    "xavier_uniform": xavier_uniform,
+    "xavier_fan_in": xavier_fan_in,
+    "glorot_normal": xavier,
+    "glorot_uniform": xavier_uniform,
+    "relu": relu_init,
+    "he_normal": relu_init,
+    "relu_uniform": relu_uniform,
+    "he_uniform": relu_uniform,
+    "lecun_normal": lecun_normal,
+    "lecun_uniform": lecun_uniform,
+    "normal": normal,
+    "uniform": uniform,
+    "zero": zero,
+    "ones": ones_,
+    "identity": identity,
+}
+
+
+def get(name_or_fn) -> Callable:
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown weight init {name_or_fn!r}; known: "
+                         f"{sorted(_REGISTRY)}")
+    return _REGISTRY[key]

@@ -1,0 +1,10 @@
+"""Op surface: activations, losses, attention, compression, random.
+
+Reference: libnd4j declarable ops (~500, ``include/ops/declarable/generic``)
++ nd4j op class hierarchy. On TPU nearly all of this surface is XLA via
+jax.numpy/lax; this package holds the framework-level ops (activations,
+losses, attention, gradient compression) with reference-parity names.
+"""
+from deeplearning4j_tpu.ops import activations, losses
+
+__all__ = ["activations", "losses"]

@@ -1,0 +1,152 @@
+"""Activation functions — reference: ``org.nd4j.linalg.activations.Activation``
+enum + ``IActivation`` impls (~20 activations; nd4j-api
+``org.nd4j.linalg.activations.impl.*``).
+
+Each entry is a pure elementwise jnp function (XLA fuses these into the
+surrounding matmul — no hand kernels needed on TPU). Gradients come from
+jax autodiff; there is no per-activation ``backprop`` method as in the
+reference.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def identity(x):
+    return x
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x):
+    return jax.nn.relu6(x)
+
+
+def leakyrelu(x, alpha: float = 0.01):
+    return jax.nn.leaky_relu(x, alpha)
+
+
+def elu(x, alpha: float = 1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def selu(x):
+    return jax.nn.selu(x)
+
+
+def celu(x, alpha: float = 1.0):
+    return jax.nn.celu(x, alpha)
+
+
+def gelu(x):
+    return jax.nn.gelu(x, approximate=False)
+
+
+def gelu_tanh(x):
+    return jax.nn.gelu(x, approximate=True)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def hardsigmoid(x):
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def hardtanh(x):
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def rationaltanh(x):
+    # Reference RationalTanh: 1.7159 * tanh(2x/3) approximation family
+    return 1.7159 * jnp.tanh(2.0 * x / 3.0)
+
+
+def rectifiedtanh(x):
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def logsoftmax(x, axis: int = -1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def cube(x):
+    return x ** 3
+
+
+def swish(x):
+    return jax.nn.silu(x)
+
+
+def mish(x):
+    return jax.nn.mish(x)
+
+
+def thresholdedrelu(x, theta: float = 1.0):
+    return jnp.where(x > theta, x, 0.0)
+
+
+_REGISTRY: Dict[str, Callable] = {
+    "identity": identity,
+    "linear": identity,
+    "relu": relu,
+    "relu6": relu6,
+    "leakyrelu": leakyrelu,
+    "elu": elu,
+    "selu": selu,
+    "celu": celu,
+    "gelu": gelu,
+    "gelu_tanh": gelu_tanh,
+    "sigmoid": sigmoid,
+    "hardsigmoid": hardsigmoid,
+    "tanh": tanh,
+    "hardtanh": hardtanh,
+    "rationaltanh": rationaltanh,
+    "rectifiedtanh": rectifiedtanh,
+    "softmax": softmax,
+    "logsoftmax": logsoftmax,
+    "softplus": softplus,
+    "softsign": softsign,
+    "cube": cube,
+    "swish": swish,
+    "silu": swish,
+    "mish": mish,
+    "thresholdedrelu": thresholdedrelu,
+}
+
+
+def get(name_or_fn) -> Callable:
+    """Resolve an activation by reference enum name (case-insensitive)."""
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown activation {name_or_fn!r}; "
+                         f"known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def names():
+    return sorted(_REGISTRY)

@@ -1,0 +1,246 @@
+"""Loss functions — reference: ``org.nd4j.linalg.lossfunctions.ILossFunction``
+impls (~20; ``org.nd4j.linalg.lossfunctions.impl.LossMCXENT``, ``LossMSE``,
+``LossBinaryXENT``, ``LossHinge``, …) and the ``LossFunctions.LossFunction``
+enum.
+
+API shape (functional, autodiff-friendly):
+ - every loss is ``fn(labels, preds, mask=None, weights=None) -> scalar``
+   (mean over batch, mask-weighted), where ``preds`` are *post-activation*
+   outputs, mirroring ILossFunction.computeScore.
+ - ``score_array(name, labels, preds, ...)`` gives per-example scores
+   (ILossFunction.computeScoreArray) for evaluation/listeners.
+ - gradients come from jax autodiff, not hand-written computeGradient.
+
+Numerical-stability notes: cross-entropy losses offer ``from_logits`` so a
+fused logsumexp path is used under jit (the reference instead pairs
+LossMCXENT with a softmax activation and clips probabilities).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+def _per_example(raw, mask):
+    """Reduce feature axes to per-example scores, applying a mask.
+
+    ``raw``: [batch, ...features] elementwise loss values.
+    ``mask``: broadcastable to raw's leading axes (time-step masks in RNNs).
+    """
+    if mask is not None:
+        m = jnp.reshape(mask, mask.shape + (1,) * (raw.ndim - mask.ndim))
+        raw = raw * m
+    axes = tuple(range(1, raw.ndim))
+    return jnp.sum(raw, axis=axes) if axes else raw
+
+
+def _mean(raw, mask):
+    """Mean-over-batch of per-example (mask-weighted) summed scores.
+
+    Reference semantics (BaseOutputLayer.computeScore): per-example score
+    sums over features/timesteps (masked steps contribute 0); the batch
+    score divides by minibatch size — so an all-ones mask is identical to
+    no mask, and longer active sequences weigh more.
+    """
+    return jnp.mean(_per_example(raw, mask))
+
+
+def _apply_weights(raw, weights):
+    if weights is not None:
+        raw = raw * jnp.asarray(weights, raw.dtype)
+    return raw
+
+
+# -- regression ------------------------------------------------------------
+
+def mse(labels, preds, mask=None, weights=None):
+    raw = _apply_weights(jnp.square(preds - labels), weights)
+    return _mean(raw, mask)
+
+
+def mae(labels, preds, mask=None, weights=None):
+    raw = _apply_weights(jnp.abs(preds - labels), weights)
+    return _mean(raw, mask)
+
+
+l2 = mse
+l1 = mae
+
+
+def msle(labels, preds, mask=None, weights=None):
+    raw = jnp.square(jnp.log1p(jnp.maximum(preds, -1 + _EPS))
+                     - jnp.log1p(jnp.maximum(labels, -1 + _EPS)))
+    return _mean(_apply_weights(raw, weights), mask)
+
+
+def poisson(labels, preds, mask=None, weights=None):
+    raw = preds - labels * jnp.log(jnp.maximum(preds, _EPS))
+    return _mean(_apply_weights(raw, weights), mask)
+
+
+def cosine_proximity(labels, preds, mask=None, weights=None):
+    ln = labels / jnp.maximum(jnp.linalg.norm(labels, axis=-1,
+                                              keepdims=True), _EPS)
+    pn = preds / jnp.maximum(jnp.linalg.norm(preds, axis=-1,
+                                             keepdims=True), _EPS)
+    raw = -jnp.sum(_apply_weights(ln * pn, weights), axis=-1,
+                   keepdims=True)
+    return _mean(raw, mask)
+
+
+# -- classification --------------------------------------------------------
+
+def mcxent(labels, preds, mask=None, weights=None, from_logits=False):
+    """Multi-class cross-entropy (reference LossMCXENT).
+
+    ``labels`` one-hot (or soft). With ``from_logits`` the stable
+    log_softmax path is used — preferred under jit on TPU.
+    """
+    if from_logits:
+        logp = jax.nn.log_softmax(preds, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(preds, _EPS, 1.0))
+    raw = _apply_weights(-labels * logp, weights)
+    return _mean(raw, mask)
+
+
+def sparse_mcxent(labels, preds, mask=None, weights=None, from_logits=False):
+    """Integer-label cross-entropy (reference LossSparseMCXENT)."""
+    if from_logits:
+        logp = jax.nn.log_softmax(preds, axis=-1)
+    else:
+        logp = jnp.log(jnp.clip(preds, _EPS, 1.0))
+    lab = labels.astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, lab[..., None], axis=-1)
+    raw = -picked
+    if weights is not None:
+        raw = raw * jnp.take(jnp.asarray(weights, raw.dtype), lab)[..., None]
+    return _mean(raw, mask)
+
+
+negativeloglikelihood = mcxent
+
+
+def binary_xent(labels, preds, mask=None, weights=None, from_logits=False):
+    """Binary cross-entropy (reference LossBinaryXENT / XENT)."""
+    if from_logits:
+        # log-sigmoid formulation: max(x,0) - x*z + log1p(exp(-|x|))
+        x = preds
+        raw = jnp.maximum(x, 0) - x * labels + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    else:
+        p = jnp.clip(preds, _EPS, 1.0 - _EPS)
+        raw = -(labels * jnp.log(p) + (1.0 - labels) * jnp.log1p(-p))
+    return _mean(_apply_weights(raw, weights), mask)
+
+
+def hinge(labels, preds, mask=None, weights=None):
+    """labels in {-1, +1} or {0,1} (converted) — reference LossHinge."""
+    y = jnp.where(labels > 0, 1.0, -1.0)
+    raw = jnp.maximum(0.0, 1.0 - y * preds)
+    return _mean(_apply_weights(raw, weights), mask)
+
+
+def squared_hinge(labels, preds, mask=None, weights=None):
+    y = jnp.where(labels > 0, 1.0, -1.0)
+    raw = jnp.square(jnp.maximum(0.0, 1.0 - y * preds))
+    return _mean(_apply_weights(raw, weights), mask)
+
+
+def kl_divergence(labels, preds, mask=None, weights=None):
+    p = jnp.clip(labels, _EPS, 1.0)
+    q = jnp.clip(preds, _EPS, 1.0)
+    raw = p * (jnp.log(p) - jnp.log(q))
+    return _mean(_apply_weights(raw, weights), mask)
+
+
+def wasserstein(labels, preds, mask=None, weights=None):
+    return _mean(_apply_weights(labels * preds, weights), mask)
+
+
+def fmeasure(labels, preds, mask=None, weights=None, beta: float = 1.0):
+    """Differentiable F-beta surrogate (reference LossFMeasure, binary)."""
+    w = jnp.ones_like(preds)
+    if weights is not None:
+        w = w * jnp.asarray(weights, preds.dtype)
+    if mask is not None:
+        m = jnp.reshape(mask, mask.shape + (1,) * (preds.ndim - mask.ndim))
+        w = w * m
+    tp = jnp.sum(w * labels * preds)
+    fp = jnp.sum(w * (1 - labels) * preds)
+    fn = jnp.sum(w * labels * (1 - preds))
+    b2 = beta * beta
+    f = ((1 + b2) * tp) / jnp.maximum((1 + b2) * tp + b2 * fn + fp, _EPS)
+    return 1.0 - f
+
+
+def ctc_loss(labels, logits, label_lengths, logit_lengths, blank_id: int = 0):
+    """CTC loss (reference libnd4j ``ctc_loss`` declarable op).
+
+    logits: [B, T, C] unnormalized; labels: [B, S] int32 padded.
+    Uses optax's CTC implementation (forward-backward in log space via
+    lax.scan — jit/TPU friendly).
+    """
+    import optax
+    logit_pad = (jnp.arange(logits.shape[1])[None, :]
+                 >= logit_lengths[:, None]).astype(logits.dtype)
+    label_pad = (jnp.arange(labels.shape[1])[None, :]
+                 >= label_lengths[:, None]).astype(logits.dtype)
+    per = optax.ctc_loss(logits, logit_pad, labels.astype(jnp.int32),
+                         label_pad, blank_id=blank_id)
+    return jnp.mean(per)
+
+
+_REGISTRY: Dict[str, Callable] = {
+    "mse": mse,
+    "l2": l2,
+    "mae": mae,
+    "l1": l1,
+    "msle": msle,
+    "mean_squared_logarithmic_error": msle,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+    "mcxent": mcxent,
+    "negativeloglikelihood": negativeloglikelihood,
+    "sparse_mcxent": sparse_mcxent,
+    "xent": binary_xent,
+    "binary_xent": binary_xent,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "kl_divergence": kl_divergence,
+    "reconstruction_crossentropy": binary_xent,
+    "wasserstein": wasserstein,
+    "fmeasure": fmeasure,
+}
+
+
+def get(name_or_fn) -> Callable:
+    if callable(name_or_fn):
+        return name_or_fn
+    key = str(name_or_fn).lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown loss {name_or_fn!r}; known: "
+                         f"{sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def score_array(name_or_fn, labels, preds, mask=None, weights=None,
+                **kw):
+    """Per-example scores (reference ILossFunction.computeScoreArray)."""
+    fn = get(name_or_fn)
+    # Recompute elementwise raw values by vmapping the scalar loss over
+    # the batch axis.
+    def one(l, p, m):
+        return fn(l[None], p[None], None if m is None else m[None],
+                  weights, **kw)
+    if mask is None:
+        return jax.vmap(lambda l, p: fn(l[None], p[None], None,
+                                        weights, **kw))(labels, preds)
+    return jax.vmap(one)(labels, preds, mask)
+
+
+def names():
+    return sorted(_REGISTRY)

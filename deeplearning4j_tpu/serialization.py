@@ -1,0 +1,109 @@
+"""Model serialization — reference:
+``org.deeplearning4j.util.ModelSerializer`` (zip of configuration.json +
+coefficients.bin + updaterState.bin + normalizer.bin).
+
+TPU-native format: a zip of
+  configuration.json   — full MultiLayerConfiguration JSON
+  params.npz           — one entry per param leaf (path-keyed). The
+                         reference's single flattened coefficient buffer
+                         deliberately does NOT carry over: sharded
+                         checkpointing wants per-leaf arrays (SURVEY §5).
+  state.npz            — non-trainable state (BN running stats, centers)
+  updater.npz          — optax state leaves (resume-exact)
+  normalizer.json      — optional fitted normalizer statistics
+  meta.json            — iteration/epoch counters
+"""
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def _flatten_with_paths(tree):
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in flat:
+        key = "/".join(str(p) for p in path)
+        out[key] = np.asarray(leaf)
+    return out
+
+
+def _save_npz(zf: zipfile.ZipFile, name: str, tree) -> None:
+    buf = io.BytesIO()
+    np.savez(buf, **_flatten_with_paths(tree))
+    zf.writestr(name, buf.getvalue())
+
+
+def _load_npz_into(zf: zipfile.ZipFile, name: str, tree):
+    """Restore leaves into an existing pytree structure (template from a
+    freshly init()ed model — mirrors the reference's approach of
+    building the net from config then setting params)."""
+    with zf.open(name) as f:
+        data = np.load(io.BytesIO(f.read()))
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        leaves = []
+        for path, leaf in flat:
+            key = "/".join(str(p) for p in path)
+            if key not in data:
+                raise ValueError(f"checkpoint missing leaf {key}")
+            import jax.numpy as jnp
+            leaves.append(jnp.asarray(data[key]))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class ModelSerializer:
+    @staticmethod
+    def write_model(net, path, save_updater: bool = True,
+                    normalizer=None) -> None:
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("configuration.json", net.conf.to_json())
+            _save_npz(zf, "params.npz", net.params)
+            _save_npz(zf, "state.npz", net.state)
+            if save_updater and net.opt_state is not None:
+                _save_npz(zf, "updater.npz", net.opt_state)
+            if normalizer is not None:
+                zf.writestr("normalizer.json",
+                            json.dumps(normalizer.state_dict()))
+            zf.writestr("meta.json", json.dumps(
+                {"iteration": net.iteration, "epoch": net.epoch,
+                 "input_shape": list(getattr(net, "_input_shape", []) or []),
+                 "format_version": 1}))
+
+    @staticmethod
+    def restore_multi_layer_network(path, load_updater: bool = True):
+        from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        path = Path(path)
+        with zipfile.ZipFile(path) as zf:
+            conf = MultiLayerConfiguration.from_json(
+                zf.read("configuration.json").decode())
+            meta = json.loads(zf.read("meta.json").decode())
+            net = MultiLayerNetwork(conf)
+            ishape = tuple(meta.get("input_shape") or ()) or None
+            net.init(input_shape=ishape)
+            net.params = _load_npz_into(zf, "params.npz", net.params)
+            net.state = _load_npz_into(zf, "state.npz", net.state)
+            if load_updater and "updater.npz" in zf.namelist():
+                net.opt_state = _load_npz_into(zf, "updater.npz",
+                                               net.opt_state)
+            net.iteration = meta.get("iteration", 0)
+            net.epoch = meta.get("epoch", 0)
+        return net
+
+    @staticmethod
+    def restore_normalizer(path):
+        from deeplearning4j_tpu.data.normalizers import \
+            normalizer_from_state
+        with zipfile.ZipFile(Path(path)) as zf:
+            if "normalizer.json" not in zf.namelist():
+                return None
+            return normalizer_from_state(
+                json.loads(zf.read("normalizer.json").decode()))

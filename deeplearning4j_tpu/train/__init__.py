@@ -1,0 +1,8 @@
+from deeplearning4j_tpu.train.listeners import (
+    TrainingListener, ScoreIterationListener, PerformanceListener,
+    CheckpointListener, EvaluativeListener,
+)
+
+__all__ = ["TrainingListener", "ScoreIterationListener",
+           "PerformanceListener", "CheckpointListener",
+           "EvaluativeListener"]

@@ -1,0 +1,134 @@
+"""Training listeners — reference:
+``org.deeplearning4j.optimize.api.TrainingListener`` SPI and impls
+(ScoreIterationListener, PerformanceListener, CheckpointListener,
+EvaluativeListener — SURVEY §5 metrics/observability).
+
+The listener SPI is the universal hook point around the jitted train
+step: iteration_done / on_epoch_start / on_epoch_end.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from pathlib import Path
+from typing import Optional
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class TrainingListener:
+    def iteration_done(self, net, iteration: int, epoch: int):
+        pass
+
+    def on_epoch_start(self, net):
+        pass
+
+    def on_epoch_end(self, net):
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Logs score every N iterations (reference ScoreIterationListener)."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.n = print_iterations
+
+    def iteration_done(self, net, iteration, epoch):
+        if iteration % self.n == 0:
+            logger.info("Score at iteration %d is %s", iteration,
+                        net.score())
+
+
+class PerformanceListener(TrainingListener):
+    """Throughput/ETL timing (reference PerformanceListener)."""
+
+    def __init__(self, frequency: int = 10, report=None):
+        self.frequency = frequency
+        self._last_time = None
+        self._last_iter = None
+        self.samples_per_sec = None
+        self._report = report or (lambda msg: logger.info("%s", msg))
+        self._batch = None
+
+    def iteration_done(self, net, iteration, epoch):
+        now = time.perf_counter()
+        if self._last_time is not None and \
+                iteration % self.frequency == 0:
+            dt = now - self._last_time
+            iters = iteration - self._last_iter
+            if dt > 0 and iters > 0:
+                self._report(
+                    f"iter {iteration}: {iters / dt:.1f} iter/sec, "
+                    f"score {net.score():.5f}")
+        if iteration % self.frequency == 0:
+            self._last_time = now
+            self._last_iter = iteration
+
+
+class CheckpointListener(TrainingListener):
+    """Periodic checkpoints with keep-last-K (reference
+    CheckpointListener: every N iters/epochs, keepLast policies)."""
+
+    def __init__(self, save_dir, save_every_n_iterations: Optional[int]
+                 = None, save_every_n_epochs: Optional[int] = None,
+                 keep_last: int = 3):
+        self.dir = Path(save_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.every_iter = save_every_n_iterations
+        self.every_epoch = save_every_n_epochs
+        self.keep_last = keep_last
+
+    def _save(self, net, tag: str):
+        from deeplearning4j_tpu.serialization import ModelSerializer
+        path = self.dir / f"checkpoint_{tag}.zip"
+        ModelSerializer.write_model(net, path)
+        ckpts = sorted(self.dir.glob("checkpoint_*.zip"),
+                       key=lambda p: p.stat().st_mtime)
+        for old in ckpts[:-self.keep_last]:
+            old.unlink()
+
+    def iteration_done(self, net, iteration, epoch):
+        if self.every_iter and iteration % self.every_iter == 0:
+            self._save(net, f"iter_{iteration}")
+
+    def on_epoch_end(self, net):
+        if self.every_epoch and (net.epoch + 1) % self.every_epoch == 0:
+            self._save(net, f"epoch_{net.epoch}")
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic eval during training (reference EvaluativeListener)."""
+
+    def __init__(self, iterator, frequency_iters: int = 0,
+                 frequency_epochs: int = 1, callback=None):
+        self.iterator = iterator
+        self.frequency_iters = frequency_iters
+        self.frequency_epochs = frequency_epochs
+        self.callback = callback or (
+            lambda e: logger.info("\n%s", e.stats()))
+        self.last_evaluation = None
+
+    def _eval(self, net):
+        e = net.evaluate(self.iterator)
+        self.last_evaluation = e
+        self.callback(e)
+
+    def iteration_done(self, net, iteration, epoch):
+        if self.frequency_iters and iteration % self.frequency_iters == 0:
+            self._eval(net)
+
+    def on_epoch_end(self, net):
+        if self.frequency_epochs and \
+                (net.epoch + 1) % self.frequency_epochs == 0:
+            self._eval(net)
+
+
+class CollectScoresListener(TrainingListener):
+    """Collects (iteration, score) pairs (reference
+    CollectScoresIterationListener)."""
+
+    def __init__(self):
+        self.scores = []
+
+    def iteration_done(self, net, iteration, epoch):
+        self.scores.append((iteration, net.score()))

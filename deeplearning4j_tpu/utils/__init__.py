@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.utils.gradcheck import check_gradients
+
+__all__ = ["check_gradients"]

@@ -1,0 +1,11 @@
+"""Model zoo — reference: ``deeplearning4j-zoo``
+(``org.deeplearning4j.zoo.model.*``: LeNet, AlexNet, VGG16/19, ResNet50,
+SqueezeNet, Darknet19, TinyYOLO, UNet, Xception, SimpleCNN,
+TextGenerationLSTM). Pretrained-weight download is not reproducible here
+(no egress); architectures + init are.
+"""
+from deeplearning4j_tpu.zoo.lenet import LeNet
+from deeplearning4j_tpu.zoo.simple_cnn import SimpleCNN
+from deeplearning4j_tpu.zoo.textgen_lstm import TextGenerationLSTM
+
+__all__ = ["LeNet", "SimpleCNN", "TextGenerationLSTM"]

@@ -1,0 +1,50 @@
+"""LeNet — reference: ``org.deeplearning4j.zoo.model.LeNet``
+(deeplearning4j-zoo), the BASELINE.json config #1 model.
+
+Classic conv(20,5x5) → pool → conv(50,5x5) → pool → dense(500) →
+softmax(10) on 28×28×1, per the dl4j-examples LeNetMnistExample.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.config import (InputType,
+                                          NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DenseLayer,
+                                          OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn import updaters as upd
+
+
+class LeNet:
+    def __init__(self, num_classes: int = 10, seed: int = 123,
+                 updater=None, input_shape=(28, 28, 1)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or upd.Nesterovs(learning_rate=0.01,
+                                                momentum=0.9)
+        self.input_shape = input_shape
+
+    def conf(self):
+        h, w, c = self.input_shape
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed)
+                .updater(self.updater)
+                .weight_init_fn("xavier")
+                .list()
+                .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5),
+                                        stride=(1, 1), padding="SAME",
+                                        activation="identity"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                        pooling_type="max"))
+                .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5),
+                                        stride=(1, 1), padding="SAME",
+                                        activation="identity"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                        pooling_type="max"))
+                .layer(DenseLayer(n_out=500, activation="relu"))
+                .layer(OutputLayer(n_out=self.num_classes,
+                                   activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()

@@ -1,0 +1,41 @@
+"""SimpleCNN — reference: ``org.deeplearning4j.zoo.model.SimpleCNN``."""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.config import (InputType,
+                                          NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers import (BatchNormalization,
+                                          ConvolutionLayer, DenseLayer,
+                                          OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn import updaters as upd
+
+
+class SimpleCNN:
+    def __init__(self, num_classes: int = 10, seed: int = 123,
+                 input_shape=(48, 48, 3)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.input_shape = input_shape
+
+    def conf(self):
+        h, w, c = self.input_shape
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .updater(upd.AdaDelta())
+             .weight_init_fn("xavier")
+             .activation_fn("relu")
+             .list())
+        for n_out in (16, 32):
+            b = (b.layer(ConvolutionLayer(n_out=n_out, kernel_size=(3, 3),
+                                          padding="SAME"))
+                  .layer(BatchNormalization())
+                  .layer(SubsamplingLayer(kernel_size=(2, 2),
+                                          stride=(2, 2))))
+        return (b.layer(DenseLayer(n_out=128))
+                 .layer(OutputLayer(n_out=self.num_classes,
+                                    activation="softmax", loss="mcxent"))
+                 .set_input_type(InputType.convolutional(h, w, c))
+                 .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()

@@ -1,0 +1,41 @@
+"""Char-RNN LSTM — reference: ``org.deeplearning4j.zoo.model
+.TextGenerationLSTM`` + the GravesLSTM char-modelling example named in
+BASELINE.json config #3 (cuDNN RNN helper path → here lax.scan LSTM)."""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.config import (InputType,
+                                          NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.layers import GravesLSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn import updaters as upd
+
+
+class TextGenerationLSTM:
+    def __init__(self, vocab_size: int = 77, hidden: int = 256,
+                 layers: int = 2, seed: int = 123, tbptt: int = 50):
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.layers = layers
+        self.seed = seed
+        self.tbptt = tbptt
+
+    def conf(self):
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .updater(upd.Adam(learning_rate=1e-3))
+             .weight_init_fn("xavier")
+             .list())
+        for _ in range(self.layers):
+            b = b.layer(GravesLSTM(n_out=self.hidden, activation="tanh"))
+        b = b.layer(RnnOutputLayer(n_out=self.vocab_size,
+                                   activation="softmax", loss="mcxent"))
+        b = (b.backprop_type("TruncatedBPTT")
+              .tbptt_fwd_length(self.tbptt)
+              .tbptt_back_length(self.tbptt)
+              .set_input_type(InputType.recurrent(self.vocab_size)))
+        return b.build()
+
+    def init(self) -> MultiLayerNetwork:
+        net = MultiLayerNetwork(self.conf())
+        net.init(input_shape=(None, self.vocab_size))
+        return net

@@ -1,0 +1,34 @@
+"""Test config: force CPU with 8 virtual devices BEFORE jax backends
+initialize.
+
+Mirrors the reference test strategy (SURVEY §4): same suite over every
+backend — here the suite runs on CPU (x8 virtual devices for SPMD
+tests); the driver separately compile-checks the TPU path.
+
+NOTE on this environment: a sitecustomize hook registers the 'axon' TPU
+plugin at interpreter startup and calls
+``jax.config.update("jax_platforms", "axon,cpu")``, overriding any
+JAX_PLATFORMS env var. Re-update the config here (backends are not yet
+initialized when conftest loads) so tests never touch the TPU tunnel —
+axon init is slow, serializes across processes, and would make every op
+a remote dispatch.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12345)

@@ -1,0 +1,138 @@
+"""Activation/loss tests w/ finite-difference gradient checks.
+
+Reference analogs: ActivationFunctionTests, LossFunctionGradientCheck
+(deeplearning4j-core gradientcheck suite).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops import activations, losses
+from deeplearning4j_tpu.utils import check_gradients
+
+
+def test_activation_registry_resolves_all():
+    for name in activations.names():
+        fn = activations.get(name)
+        out = fn(jnp.linspace(-2, 2, 8))
+        assert out.shape == (8,)
+
+
+def test_activation_known_values():
+    x = jnp.array([-1.0, 0.0, 2.0])
+    np.testing.assert_allclose(activations.get("relu")(x), [0, 0, 2])
+    np.testing.assert_allclose(activations.get("sigmoid")(x),
+                               1 / (1 + np.exp([1.0, 0.0, -2.0])), rtol=1e-6)
+    np.testing.assert_allclose(
+        activations.get("softmax")(x).sum(), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(activations.get("hardtanh")(x), [-1, 0, 1])
+    np.testing.assert_allclose(activations.get("cube")(x), [-1, 0, 8])
+
+
+def test_unknown_activation_raises():
+    with pytest.raises(ValueError):
+        activations.get("nope")
+
+
+@pytest.mark.parametrize("name", ["mse", "mae", "mcxent", "xent", "hinge",
+                                  "squared_hinge", "kl_divergence",
+                                  "poisson", "cosine_proximity"])
+def test_loss_gradients_finite_difference(name, rng):
+    n, k = 3, 4
+    preds = jnp.asarray(rng.uniform(0.05, 0.95, (n, k)))
+    if name in ("mcxent", "kl_divergence"):
+        lab = rng.uniform(size=(n, k))
+        labels = jnp.asarray(lab / lab.sum(-1, keepdims=True))
+        preds = preds / preds.sum(-1, keepdims=True)
+    elif name in ("xent",):
+        labels = jnp.asarray(rng.integers(0, 2, (n, k)).astype(float))
+    elif name in ("hinge", "squared_hinge"):
+        labels = jnp.asarray(rng.choice([-1.0, 1.0], (n, k)))
+        preds = jnp.asarray(rng.normal(size=(n, k)))
+    else:
+        labels = jnp.asarray(rng.normal(size=(n, k)))
+        if name == "poisson":
+            labels = jnp.abs(labels)
+    fn = losses.get(name)
+    check_gradients(lambda p, l: fn(l, p), preds, labels)
+
+
+def test_mcxent_from_logits_matches_softmax_path(rng):
+    logits = jnp.asarray(rng.normal(size=(5, 7)))
+    lab = jax.nn.one_hot(jnp.asarray(rng.integers(0, 7, 5)), 7)
+    a = losses.mcxent(lab, jax.nn.softmax(logits), from_logits=False)
+    b = losses.mcxent(lab, logits, from_logits=True)
+    np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+def test_sparse_mcxent_matches_dense(rng):
+    logits = jnp.asarray(rng.normal(size=(5, 7)))
+    idx = jnp.asarray(rng.integers(0, 7, 5))
+    dense = losses.mcxent(jax.nn.one_hot(idx, 7), logits, from_logits=True)
+    sparse = losses.sparse_mcxent(idx, logits, from_logits=True)
+    np.testing.assert_allclose(dense, sparse, rtol=1e-6)
+
+
+def test_binary_xent_logits_stable():
+    big = jnp.array([[100.0, -100.0]])
+    lab = jnp.array([[1.0, 0.0]])
+    val = losses.binary_xent(lab, big, from_logits=True)
+    assert jnp.isfinite(val) and val < 1e-3
+
+
+def test_loss_masking():
+    labels = jnp.ones((2, 3, 4))
+    preds = jnp.zeros((2, 3, 4))
+    mask = jnp.array([[1.0, 1.0, 0.0], [1.0, 0.0, 0.0]])  # [B,T]
+    full = losses.mse(labels, preds)
+    masked = losses.mse(labels, preds, mask=mask)
+    assert full > 0 and masked > 0
+    # all-ones mask must be identical to no mask (reference semantics)
+    np.testing.assert_allclose(
+        losses.mse(labels, preds, mask=jnp.ones((2, 3))), full, rtol=1e-6)
+    # masked steps contribute 0: (2 active + 1 active) steps * 4 feats / 2
+    np.testing.assert_allclose(masked, (2 * 4 + 1 * 4) / 2, rtol=1e-6)
+    # all-masked timesteps contribute nothing
+    zero_mask = jnp.zeros((2, 3))
+    assert losses.mse(labels, preds, mask=zero_mask) == 0
+
+
+def test_ndarray_unhashable_and_eval_shape():
+    import jax
+    from deeplearning4j_tpu import Nd4j
+    a = Nd4j.create([1.0])
+    with pytest.raises(TypeError):
+        hash(a)
+    out = jax.eval_shape(lambda d: d["w"].add(1.0),
+                         {"w": Nd4j.create([1.0, 2.0])})
+    assert out.shape == (2,)
+
+
+def test_fmeasure_mask_and_default_dtype_guard():
+    from deeplearning4j_tpu import dtypes
+    labels = jnp.array([[1.0, 0.0], [1.0, 1.0]])
+    preds = jnp.array([[0.9, 0.1], [0.2, 0.8]])
+    m = jnp.array([[1.0, 1.0], [0.0, 0.0]])
+    masked = losses.fmeasure(labels, preds, mask=m)
+    only_first = losses.fmeasure(labels[:1], preds[:1])
+    np.testing.assert_allclose(masked, only_first, rtol=1e-6)
+    with pytest.raises(ValueError):
+        dtypes.set_default_dtype("int32")
+
+
+def test_score_array_per_example(rng):
+    labels = jnp.asarray(rng.normal(size=(6, 3)))
+    preds = jnp.asarray(rng.normal(size=(6, 3)))
+    per = losses.score_array("mse", labels, preds)
+    assert per.shape == (6,)
+    np.testing.assert_allclose(per.mean(), losses.mse(labels, preds),
+                               rtol=1e-5)
+
+
+def test_ctc_loss_runs(rng):
+    logits = jnp.asarray(rng.normal(size=(2, 10, 6)))
+    labels = jnp.asarray(rng.integers(1, 6, (2, 4)))
+    val = losses.ctc_loss(labels, logits,
+                          jnp.array([4, 3]), jnp.array([10, 8]))
+    assert jnp.isfinite(val)

@@ -1,0 +1,266 @@
+"""Layer-level tests w/ finite-difference gradient checks.
+
+Reference analog: org.deeplearning4j.gradientcheck.GradientCheckTests,
+CNNGradientCheckTest, LSTMGradientCheckTests (SURVEY §4).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer, BatchNormalization, Bidirectional, ConvolutionLayer,
+    Convolution1DLayer, DenseLayer, DepthwiseConvolution2DLayer,
+    DropoutLayer, EmbeddingLayer, GlobalPoolingLayer, GravesLSTM, GRU,
+    LastTimeStep, LayerNormalization, LSTM, MultiHeadAttention,
+    PReLULayer, SelfAttentionLayer, SeparableConvolution2DLayer,
+    SimpleRnn, SubsamplingLayer, TransformerEncoderBlock,
+    LocalResponseNormalization, Upsampling2DLayer, SpaceToDepthLayer,
+    DepthToSpaceLayer,
+)
+from deeplearning4j_tpu.utils import check_gradients
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _gradcheck_layer(layer, input_shape, batch=2, train=False, mask=None,
+                     tol=1e-4):
+    params, state, out_shape = layer.init(KEY, input_shape)
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch,) + input_shape)
+
+    def loss(p, xx):
+        y, _ = layer.apply(p, state, xx, train=train, mask=mask)
+        return jnp.sum(jnp.sin(y))  # nonlinear reduction exercises grads
+
+    if params:
+        check_gradients(loss, params, x, max_rel_error=tol)
+    # also check input gradients
+    check_gradients(lambda xx, p: loss(p, xx), x, params,
+                    max_rel_error=tol)
+    return out_shape
+
+
+def test_dense_gradcheck():
+    out = _gradcheck_layer(DenseLayer(n_out=3, activation="tanh"), (4,))
+    assert out == (3,)
+
+
+def test_dense_layernorm_gradcheck():
+    _gradcheck_layer(DenseLayer(n_out=3, activation="sigmoid",
+                                has_layer_norm=True), (4,))
+
+
+def test_conv2d_gradcheck():
+    out = _gradcheck_layer(
+        ConvolutionLayer(n_out=2, kernel_size=(2, 2), padding="VALID",
+                         activation="tanh"), (4, 4, 2))
+    assert out == (3, 3, 2)
+
+
+def test_conv2d_same_shape():
+    layer = ConvolutionLayer(n_out=3, kernel_size=(3, 3), padding="SAME",
+                             stride=(2, 2))
+    _, _, out = layer.init(KEY, (8, 8, 1))
+    assert out == (4, 4, 3)
+
+
+def test_conv1d_gradcheck():
+    _gradcheck_layer(Convolution1DLayer(n_out=2, kernel_size=(2,),
+                                        activation="tanh"), (5, 3))
+
+
+def test_depthwise_separable():
+    _gradcheck_layer(DepthwiseConvolution2DLayer(
+        kernel_size=(2, 2), depth_multiplier=2), (3, 3, 2))
+    _gradcheck_layer(SeparableConvolution2DLayer(
+        n_out=3, kernel_size=(2, 2)), (3, 3, 2))
+
+
+def test_pooling_types():
+    for pt in ("max", "avg", "pnorm", "sum"):
+        layer = SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                 pooling_type=pt)
+        _, _, out = layer.init(KEY, (4, 4, 3))
+        assert out == (2, 2, 3)
+        x = jax.random.normal(KEY, (2, 4, 4, 3))
+        y, _ = layer.apply({}, {}, x)
+        assert y.shape == (2, 2, 2, 3)
+
+
+def test_avg_pool_matches_numpy():
+    layer = SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                             pooling_type="avg")
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    y, _ = layer.apply({}, {}, x)
+    expect = np.asarray(x).reshape(2, 2, 2, 2, 1).mean(axis=(1, 3))
+    np.testing.assert_allclose(np.asarray(y)[0, ..., 0],
+                               expect[..., 0].reshape(2, 2), rtol=1e-6)
+
+
+def test_batchnorm_train_and_infer():
+    layer = BatchNormalization()
+    params, state, _ = layer.init(KEY, (3,))
+    x = jax.random.normal(KEY, (16, 3)) * 5 + 2
+    y, new_state = layer.apply(params, state, x, train=True)
+    np.testing.assert_allclose(np.mean(np.asarray(y), axis=0), 0,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.std(np.asarray(y), axis=0), 1,
+                               atol=1e-2)
+    assert not np.allclose(np.asarray(new_state["mean"]), 0)
+    # inference path uses running stats (different result)
+    y2, s2 = layer.apply(params, new_state, x, train=False)
+    assert s2 is new_state
+
+
+def test_batchnorm_gradcheck():
+    _gradcheck_layer(BatchNormalization(), (3,), batch=4, train=True,
+                     tol=5e-4)
+
+
+def test_layernorm_lrn():
+    _gradcheck_layer(LayerNormalization(), (5,), tol=5e-4)
+    layer = LocalResponseNormalization()
+    x = jax.random.normal(KEY, (2, 3, 3, 8))
+    y, _ = layer.apply({}, {}, x)
+    assert y.shape == x.shape
+
+
+def test_lstm_gradcheck():
+    _gradcheck_layer(LSTM(n_out=3), (4, 2), tol=5e-4)
+
+
+def test_graves_lstm_peephole_gradcheck():
+    _gradcheck_layer(GravesLSTM(n_out=2), (3, 2), tol=5e-4)
+
+
+def test_gru_simplernn():
+    _gradcheck_layer(GRU(n_out=3), (3, 2), tol=5e-4)
+    _gradcheck_layer(SimpleRnn(n_out=3), (3, 2), tol=5e-4)
+
+
+def test_lstm_masking_holds_state():
+    layer = LSTM(n_out=4)
+    params, state, _ = layer.init(KEY, (5, 3))
+    x = jax.random.normal(KEY, (2, 5, 3))
+    mask = jnp.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], jnp.float32)
+    y, s = layer.apply(params, state, x, mask=mask)
+    # masked outputs zero
+    np.testing.assert_allclose(np.asarray(y[0, 3:]), 0, atol=1e-7)
+    # state for example 0 equals state after step 2 (held)
+    y2, s2 = layer.apply(params, state, x[:, :3], mask=mask[:, :3])
+    np.testing.assert_allclose(np.asarray(s["h"][0]),
+                               np.asarray(s2["h"][0]), rtol=1e-5)
+
+
+def test_lstm_stored_state_continuation():
+    layer = LSTM(n_out=3)
+    params, state, _ = layer.init(KEY, (6, 2))
+    x = jax.random.normal(KEY, (1, 6, 2))
+    y_full, _ = layer.apply(params, state, x)
+    y1, s1 = layer.apply(params, state, x[:, :3])
+    y2, _ = layer.apply(params, state, x[:, 3:], initial_state=s1)
+    np.testing.assert_allclose(np.asarray(y_full[:, 3:]),
+                               np.asarray(y2), rtol=1e-5, atol=1e-6)
+
+
+def test_bidirectional_modes():
+    for mode in ("concat", "add", "mul", "average"):
+        layer = Bidirectional(fwd=LSTM(n_out=3), mode=mode)
+        params, state, out = layer.init(KEY, (4, 2))
+        x = jax.random.normal(KEY, (2, 4, 2))
+        y, _ = layer.apply(params, state, x)
+        want = 6 if mode == "concat" else 3
+        assert y.shape == (2, 4, want)
+        assert out[-1] == want
+
+
+def test_last_time_step_masked():
+    layer = LastTimeStep(underlying=LSTM(n_out=3))
+    params, state, out = layer.init(KEY, (5, 2))
+    assert out == (3,)
+    x = jax.random.normal(KEY, (2, 5, 2))
+    mask = jnp.array([[1, 1, 0, 0, 0], [1, 1, 1, 1, 1]], jnp.float32)
+    y, _ = layer.apply(params, state, x, mask=mask)
+    # example 0's output equals running only 2 steps
+    yfull, s2 = layer.apply(params, state, x[:, :2], mask=mask[:, :2])
+    np.testing.assert_allclose(np.asarray(y[0]), np.asarray(yfull[0]),
+                               rtol=1e-5)
+
+
+def test_embedding():
+    layer = EmbeddingLayer(n_in=10, n_out=4)
+    params, state, _ = layer.init(KEY, (1,))
+    idx = jnp.array([1, 5, 9])
+    y, _ = layer.apply(params, state, idx)
+    assert y.shape == (3, 4)
+    np.testing.assert_allclose(np.asarray(y[0]),
+                               np.asarray(params["W"][1]))
+
+
+def test_attention_layers():
+    _gradcheck_layer(MultiHeadAttention(n_out=4, n_heads=2), (3, 4),
+                     tol=5e-4)
+    layer = SelfAttentionLayer(n_out=4, n_heads=2)
+    params, state, out = layer.init(KEY, (5, 4))
+    assert out == (5, 4)
+    x = jax.random.normal(KEY, (2, 5, 4))
+    mask = jnp.array([[1, 1, 1, 0, 0], [1] * 5], jnp.float32)
+    y, _ = layer.apply(params, state, x, mask=mask)
+    np.testing.assert_allclose(np.asarray(y[0, 3:]), 0, atol=1e-6)
+
+
+def test_attention_mask_invariance():
+    """Masked-out keys must not affect unmasked outputs."""
+    layer = MultiHeadAttention(n_out=4, n_heads=2, project_out=False)
+    params, state, _ = layer.init(KEY, (5, 4))
+    x = jax.random.normal(KEY, (1, 5, 4))
+    mask = jnp.array([[1, 1, 1, 0, 0]], jnp.float32)
+    y1, _ = layer.apply(params, state, x, mask=mask)
+    x2 = x.at[:, 3:].set(99.0)  # garbage in masked positions
+    y2, _ = layer.apply(params, state, x2, mask=mask)
+    np.testing.assert_allclose(np.asarray(y1[:, :3]),
+                               np.asarray(y2[:, :3]), rtol=1e-4)
+
+
+def test_transformer_block():
+    layer = TransformerEncoderBlock(n_heads=2)
+    params, state, out = layer.init(KEY, (4, 8))
+    x = jax.random.normal(KEY, (2, 4, 8))
+    y, _ = layer.apply(params, state, x)
+    assert y.shape == (2, 4, 8)
+
+
+def test_global_pooling_masked():
+    layer = GlobalPoolingLayer(pooling_type="avg")
+    x = jnp.stack([jnp.ones((4, 3)), 2 * jnp.ones((4, 3))])
+    mask = jnp.array([[1, 1, 0, 0], [1, 1, 1, 1]], jnp.float32)
+    y, _ = layer.apply({}, {}, x, mask=mask)
+    np.testing.assert_allclose(np.asarray(y), [[1] * 3, [2] * 3])
+
+
+def test_spatial_utils():
+    x = jax.random.normal(KEY, (1, 4, 4, 4))
+    y, _ = SpaceToDepthLayer(block_size=2).apply({}, {}, x)
+    assert y.shape == (1, 2, 2, 16)
+    z, _ = DepthToSpaceLayer(block_size=2).apply({}, {}, y)
+    np.testing.assert_allclose(np.asarray(z), np.asarray(x), rtol=1e-6)
+    u, _ = Upsampling2DLayer(size=(2, 2)).apply({}, {}, x)
+    assert u.shape == (1, 8, 8, 4)
+
+
+def test_dropout_train_vs_infer():
+    layer = DropoutLayer(dropout=0.5)
+    x = jnp.ones((4, 100))
+    y_inf, _ = layer.apply({}, {}, x, train=False)
+    np.testing.assert_allclose(np.asarray(y_inf), 1.0)
+    y_tr, _ = layer.apply({}, {}, x, train=True,
+                          rng=jax.random.PRNGKey(3))
+    arr = np.asarray(y_tr)
+    assert ((arr == 0) | (arr == 2)).all()
+    assert 0.3 < (arr == 0).mean() < 0.7
+    # inverted dropout preserves expectation roughly
+    assert 0.8 < arr.mean() < 1.2
+
+
+def test_prelu():
+    _gradcheck_layer(PReLULayer(), (4,))

@@ -1,0 +1,214 @@
+"""MultiLayerNetwork tests: config round-trip, fit convergence, masks,
+tBPTT, checkpointing. Reference analogs: MultiLayerTest,
+MultiLayerNetworkFitTests, TestRnnLayers (deeplearning4j-core).
+"""
+import tempfile
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.config import InputType, \
+    MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers import (DenseLayer, LSTM, OutputLayer,
+                                          RnnOutputLayer)
+from deeplearning4j_tpu.nn import updaters as upd
+from deeplearning4j_tpu.serialization import ModelSerializer
+
+
+def _xor_net(updater=None):
+    return (NeuralNetConfiguration.builder()
+            .seed(42)
+            .updater(updater or upd.Adam(learning_rate=0.05))
+            .weight_init_fn("xavier")
+            .list()
+            .layer(DenseLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(2))
+            .build())
+
+
+XOR_X = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32)
+XOR_Y = np.array([[1, 0], [0, 1], [0, 1], [1, 0]], np.float32)
+
+
+def test_fit_learns_xor():
+    net = MultiLayerNetwork(_xor_net()).init()
+    first = None
+    for _ in range(300):
+        net.fit(XOR_X, XOR_Y)
+        if first is None:
+            first = net.score()
+    assert net.score() < 0.05 < first
+    preds = np.asarray(net.output(XOR_X))
+    assert (preds.argmax(1) == XOR_Y.argmax(1)).all()
+    np.testing.assert_allclose(preds.sum(1), 1.0, rtol=1e-5)
+
+
+def test_config_json_roundtrip():
+    conf = _xor_net()
+    s = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(s)
+    assert conf2.to_json() == s
+    net = MultiLayerNetwork(conf2).init()
+    assert net.num_params() == (2 * 8 + 8) + (8 * 2 + 2)
+
+
+def test_summary_and_num_params():
+    net = MultiLayerNetwork(_xor_net()).init()
+    s = net.summary()
+    assert "DenseLayer" in s and "Total params" in s
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    net = MultiLayerNetwork(_xor_net()).init()
+    for _ in range(20):
+        net.fit(XOR_X, XOR_Y)
+    path = tmp_path / "model.zip"
+    ModelSerializer.write_model(net, path)
+    net2 = ModelSerializer.restore_multi_layer_network(path)
+    np.testing.assert_allclose(np.asarray(net.output(XOR_X)),
+                               np.asarray(net2.output(XOR_X)), rtol=1e-6)
+    assert net2.iteration == net.iteration
+    # resume training exactly: updater state restored
+    net.fit(XOR_X, XOR_Y)
+    net2.fit(XOR_X, XOR_Y)
+    np.testing.assert_allclose(np.asarray(net.output(XOR_X)),
+                               np.asarray(net2.output(XOR_X)), rtol=1e-5)
+
+
+def test_fit_iterator_and_evaluate():
+    ds = DataSet(XOR_X.repeat(8, 0), XOR_Y.repeat(8, 0))
+    it = ListDataSetIterator(ds, batch_size=8, shuffle=True)
+    net = MultiLayerNetwork(_xor_net()).init()
+    net.fit(it, epochs=60)
+    e = net.evaluate(it)
+    assert e.accuracy() == 1.0
+    assert "Accuracy" in e.stats()
+
+
+def test_rnn_fit_and_tbptt():
+    t, f, k = 8, 3, 2
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater(upd.Adam(learning_rate=0.02))
+            .list()
+            .layer(LSTM(n_out=6))
+            .layer(RnnOutputLayer(n_out=k, activation="softmax",
+                                  loss="mcxent"))
+            .backprop_type("TruncatedBPTT")
+            .tbptt_fwd_length(4)
+            .set_input_type(InputType.recurrent(f))
+            .build())
+    net = MultiLayerNetwork(conf).init(input_shape=(t, f))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, t, f)).astype(np.float32)
+    # task: label = sign of first feature at each step
+    y = np.stack([(x[..., 0] > 0), (x[..., 0] <= 0)], -1).astype(
+        np.float32)
+    first = None
+    for _ in range(60):
+        net.fit(x, y)
+        if first is None:
+            first = net.score()
+    assert net.score() < first * 0.5
+
+
+def test_rnn_time_step_stateful():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(3)
+            .list()
+            .layer(LSTM(n_out=4))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(3))
+            .build())
+    net = MultiLayerNetwork(conf).init(input_shape=(None, 3))
+    x = np.random.default_rng(1).normal(size=(1, 6, 3)).astype(np.float32)
+    full = np.asarray(net.output(x))
+    net.rnn_clear_previous_state()
+    step_outs = [np.asarray(net.rnn_time_step(x[:, i])) for i in range(6)]
+    np.testing.assert_allclose(full[0, -1], step_outs[-1][0], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_per_layer_updater_and_frozen():
+    from deeplearning4j_tpu.nn.layers.special import FrozenLayer
+    frozen_dense = FrozenLayer(underlying=DenseLayer(n_out=8,
+                                                     activation="tanh"))
+    conf = (NeuralNetConfiguration.builder()
+            .seed(42)
+            .updater(upd.Adam(learning_rate=0.05))
+            .list()
+            .layer(frozen_dense)
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    w_before = np.asarray(net.params["layer_0"]["W"]).copy()
+    for _ in range(5):
+        net.fit(XOR_X, XOR_Y)
+    np.testing.assert_array_equal(
+        w_before, np.asarray(net.params["layer_0"]["W"]))
+    assert not np.allclose(0, np.asarray(net.params["layer_1"]["W"]))
+
+
+def test_l2_regularization_affects_score():
+    conf_plain = _xor_net()
+    b = NeuralNetConfiguration.builder().seed(42) \
+        .updater(upd.Adam(learning_rate=0.05)).l2_(0.1).list() \
+        .layer(DenseLayer(n_out=8, activation="tanh")) \
+        .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+    b.set_input_type(InputType.feed_forward(2))
+    conf_l2 = b.build()
+    n1 = MultiLayerNetwork(conf_plain).init()
+    n2 = MultiLayerNetwork(conf_l2).init()
+    n1.fit(XOR_X, XOR_Y)
+    n2.fit(XOR_X, XOR_Y)
+    assert n2.score() > n1.score()  # includes penalty
+
+
+def test_gradient_normalization_modes():
+    for mode in ("ClipL2PerLayer", "ClipElementWiseAbsoluteValue",
+                 "ClipL2PerParamType"):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1)
+                .updater(upd.Sgd(learning_rate=0.1))
+                .gradient_normalization(mode, 0.5)
+                .list()
+                .layer(DenseLayer(n_out=4, activation="tanh"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(XOR_X, XOR_Y)
+        assert np.isfinite(net.score())
+
+
+def test_masked_sequence_fit():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(5)
+            .updater(upd.Adam(learning_rate=0.05))
+            .list()
+            .layer(LSTM(n_out=4))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(2))
+            .build())
+    net = MultiLayerNetwork(conf).init(input_shape=(5, 2))
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(8, 5, 2)).astype(np.float32)
+    y = np.stack([(x[..., 0] > 0), (x[..., 0] <= 0)], -1).astype(
+        np.float32)
+    mask = (rng.uniform(size=(8, 5)) > 0.3).astype(np.float32)
+    mask[:, 0] = 1
+    net.fit(x, y, features_mask=mask, labels_mask=mask)
+    assert np.isfinite(net.score())

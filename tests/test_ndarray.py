@@ -1,0 +1,149 @@
+"""NDArray façade tests — reference analog: org.nd4j.linalg.Nd4jTestsC."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import NDArray, Nd4j
+
+
+def test_create_and_shape():
+    a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+    assert a.shape == (2, 2)
+    assert a.rank() == 2
+    assert a.length() == 4
+    assert a.is_matrix()
+
+
+def test_zeros_ones_full():
+    assert Nd4j.zeros((2, 3)).sum().item() == 0
+    assert Nd4j.ones((2, 3)).sum().item() == 6
+    assert Nd4j.full((2, 2), 7).mean().item() == 7
+
+
+def test_arithmetic():
+    a = Nd4j.create([1.0, 2.0, 3.0])
+    b = Nd4j.create([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).numpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((a * 2 + 1).numpy(), [3, 5, 7])
+    np.testing.assert_allclose((1 - a).numpy(), [0, -1, -2])
+
+
+def test_inplace_spellings():
+    a = Nd4j.create([1.0, 2.0])
+    a.addi(1).muli(2)
+    np.testing.assert_allclose(a.numpy(), [4, 6])
+
+
+def test_mmul():
+    a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+    b = Nd4j.eye(2)
+    assert a.mmul(b).equals(a)
+    c = a @ a
+    np.testing.assert_allclose(c.numpy(), [[7, 10], [15, 22]])
+
+
+def test_eq_elementwise_and_traced():
+    import jax
+    import jax.numpy as jnp
+    a = Nd4j.create([1.0, 2.0, 3.0])
+    b = Nd4j.create([1.0, 0.0, 3.0])
+    np.testing.assert_array_equal((a == b).numpy(), [True, False, True])
+    assert a.equals(a.dup()) and not a.equals(b)
+    out = jax.jit(lambda x: Nd4j.where(x == x, x, x * 0))(a)
+    np.testing.assert_allclose(out.numpy(), a.numpy())
+
+
+def test_rand_advances_and_seeds():
+    r1, r2 = Nd4j.rand((2, 2)), Nd4j.rand((2, 2))
+    assert not r1.equals(r2)  # global stream advances
+    s1, s2 = Nd4j.randn((2, 2), seed=7), Nd4j.randn((2, 2), seed=7)
+    assert s1.equals(s2)
+    Nd4j.set_random_seed(0)
+    a = Nd4j.rand((2,))
+    Nd4j.set_random_seed(0)
+    assert a.equals(Nd4j.rand((2,)))
+
+
+def test_put_with_ndarray_index():
+    a = Nd4j.arange(5.0)
+    idx = Nd4j.create([0, 2], dtype="int32")
+    out = a.put(idx, 9.0)
+    np.testing.assert_allclose(out.numpy(), [9, 1, 9, 3, 4])
+
+
+def test_reductions():
+    a = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+    assert a.sum().item() == 10
+    assert a.mean().item() == 2.5
+    assert a.max().item() == 4
+    assert a.min().item() == 1
+    np.testing.assert_allclose(a.sum(axis=0).numpy(), [4, 6])
+    np.testing.assert_allclose(a.argmax(axis=1).numpy(), [1, 1])
+    assert a.norm1().item() == 10
+    np.testing.assert_allclose(a.norm2().item(), np.sqrt(30), rtol=1e-6)
+
+
+def test_std_matches_reference_ddof1():
+    # nd4j std defaults to Bessel-corrected (population=false)
+    a = Nd4j.create([1.0, 2.0, 3.0, 4.0])
+    np.testing.assert_allclose(a.std().item(),
+                               np.std([1, 2, 3, 4], ddof=1), rtol=1e-6)
+
+
+def test_reshape_transpose_views():
+    a = Nd4j.arange(6).reshape(2, 3)
+    assert a.T.shape == (3, 2)
+    assert a.ravel().shape == (6,)
+    assert a.permute(1, 0).shape == (3, 2)
+    assert a.expand_dims(0).shape == (1, 2, 3)
+
+
+def test_indexing_and_put():
+    a = Nd4j.arange(10.0)
+    assert a[3].item() == 3
+    b = a.put(0, 99.0)
+    assert b[0].item() == 99 and a[0].item() == 0  # functional put
+
+
+def test_dup_immutable():
+    a = Nd4j.create([1.0])
+    b = a.dup()
+    b.addi(5)
+    assert a[0].item() == 1
+
+
+def test_concat_stack():
+    a, b = Nd4j.ones((2, 2)), Nd4j.zeros((2, 2))
+    assert Nd4j.concat(0, a, b).shape == (4, 2)
+    assert Nd4j.stack(0, a, b).shape == (2, 2, 2)
+
+
+def test_dtype_cast():
+    a = Nd4j.create([1.5, 2.5])
+    assert str(a.cast("int32").dtype) == "int32"
+    assert str(a.cast("bfloat16").dtype) == "bfloat16"
+
+
+def test_comparisons_and_where():
+    a = Nd4j.create([1.0, 5.0, 3.0])
+    m = a > 2
+    np.testing.assert_array_equal(m.numpy(), [False, True, True])
+    w = Nd4j.where(m, a, a * 0)
+    np.testing.assert_allclose(w.numpy(), [0, 5, 3])
+
+
+def test_elementwise_math():
+    a = Nd4j.create([0.0, 1.0])
+    np.testing.assert_allclose(a.exp().numpy(), np.exp([0, 1]), rtol=1e-6)
+    np.testing.assert_allclose(a.tanh().numpy(), np.tanh([0, 1]), rtol=1e-5)
+    np.testing.assert_allclose(a.sigmoid().numpy(),
+                               1 / (1 + np.exp([0.0, -1.0])), rtol=1e-6)
+
+
+def test_pytree_registration():
+    import jax
+    a = Nd4j.create([1.0, 2.0])
+    out = jax.tree.map(lambda x: x, {"w": a})
+    assert isinstance(out["w"], NDArray)

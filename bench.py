@@ -1,0 +1,75 @@
+"""Benchmark — BASELINE.md config #1 (LeNet MNIST throughput).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Protocol (BASELINE.md): steady-state throughput, warmup excluded,
+median of 3 runs. Runs on whatever the default JAX platform is (the
+real TPU chip under the driver; CPU in dev).
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md).
+We use the conventional figure for DL4J's CPU LeNet MNIST training
+(~2,500 images/sec, dl4j-examples era hardware) as the denominator so
+the ratio is meaningful until real reference measurements exist.
+"""
+import json
+import time
+
+import numpy as np
+
+REFERENCE_LENET_IMAGES_PER_SEC = 2500.0  # nominal DL4J CPU baseline
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.zoo import LeNet
+    from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
+
+    batch = 512
+    net = LeNet(num_classes=10, seed=123).init()
+
+    it = MnistDataSetIterator(batch_size=batch, train=True,
+                              n_examples=batch * 4)
+    batches = [(jnp.asarray(ds.features), jnp.asarray(ds.labels))
+               for ds in it]
+
+    step = net._make_train_step()
+    if net._train_step_fn is None:
+        net._train_step_fn = step
+
+    params, opt_state, state = net.params, net.opt_state, net.state
+    rng = jax.random.PRNGKey(0)
+
+    # warmup: compile + 20 steps (BASELINE.md protocol)
+    for i in range(20):
+        x, y = batches[i % len(batches)]
+        params, opt_state, state, loss = step(params, opt_state, state,
+                                              x, y, None, None, rng)
+    jax.block_until_ready(params)
+
+    def timed_run(n_steps=30):
+        t0 = time.perf_counter()
+        nonlocal params, opt_state, state
+        for i in range(n_steps):
+            x, y = batches[i % len(batches)]
+            params, opt_state, state, loss = step(
+                params, opt_state, state, x, y, None, None, rng)
+        jax.block_until_ready(params)
+        dt = time.perf_counter() - t0
+        return n_steps * batch / dt
+
+    runs = sorted(timed_run() for _ in range(3))
+    images_per_sec = runs[1]  # median of 3
+
+    print(json.dumps({
+        "metric": "lenet_mnist_train_images_per_sec",
+        "value": round(images_per_sec, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(
+            images_per_sec / REFERENCE_LENET_IMAGES_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,175 @@
+"""Record readers — reference: datavec-api
+``org.datavec.api.records.reader.RecordReader`` SPI and impls
+(CSVRecordReader, LineRecordReader, RegexLineRecordReader,
+CSVSequenceRecordReader, CollectionRecordReader) + ``Writable`` types.
+
+Writables collapse to plain Python/numpy values (str/float/int/ndarray);
+records are lists of values; sequence records are lists of records.
+"""
+from __future__ import annotations
+
+import csv
+import io
+import re
+from pathlib import Path
+from typing import Any, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class RecordReader:
+    """Iterable over records (list of values)."""
+
+    def __iter__(self) -> Iterator[List[Any]]:
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class CollectionRecordReader(RecordReader):
+    """In-memory records (reference CollectionRecordReader)."""
+
+    def __init__(self, records: Sequence[Sequence[Any]]):
+        self._records = [list(r) for r in records]
+
+    def __iter__(self):
+        return iter(self._records)
+
+
+class CSVRecordReader(RecordReader):
+    """CSV file/str reader (reference CSVRecordReader): skip lines,
+    custom delimiter, numeric auto-parse."""
+
+    def __init__(self, path_or_text, skip_lines: int = 0,
+                 delimiter: str = ",", parse_numbers: bool = True):
+        self.path_or_text = path_or_text
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+        self.parse_numbers = parse_numbers
+
+    def _lines(self):
+        p = Path(str(self.path_or_text))
+        if p.exists():
+            with open(p, newline="") as f:
+                yield from f
+        else:
+            yield from io.StringIO(str(self.path_or_text))
+
+    @staticmethod
+    def _parse(v: str):
+        v = v.strip()
+        try:
+            f = float(v)
+            return int(f) if f.is_integer() and "." not in v and \
+                "e" not in v.lower() else f
+        except ValueError:
+            return v
+
+    def __iter__(self):
+        reader = csv.reader(self._lines(), delimiter=self.delimiter)
+        for i, row in enumerate(reader):
+            if i < self.skip_lines or not row:
+                continue
+            yield ([self._parse(v) for v in row] if self.parse_numbers
+                   else [v.strip() for v in row])
+
+
+class LineRecordReader(RecordReader):
+    """One record per line (reference LineRecordReader)."""
+
+    def __init__(self, path_or_text):
+        self.path_or_text = path_or_text
+
+    def __iter__(self):
+        p = Path(str(self.path_or_text))
+        lines = (open(p).read() if p.exists()
+                 else str(self.path_or_text)).splitlines()
+        for line in lines:
+            yield [line]
+
+
+class RegexLineRecordReader(RecordReader):
+    """Regex-group splitting per line (reference RegexLineRecordReader)."""
+
+    def __init__(self, path_or_text, regex: str, skip_lines: int = 0):
+        self.base = LineRecordReader(path_or_text)
+        self.pattern = re.compile(regex)
+        self.skip_lines = skip_lines
+
+    def __iter__(self):
+        for i, (line,) in enumerate(self.base):
+            if i < self.skip_lines:
+                continue
+            m = self.pattern.match(line)
+            if m is None:
+                raise ValueError(f"line {i} does not match: {line!r}")
+            yield list(m.groups())
+
+
+class CSVSequenceRecordReader(RecordReader):
+    """One sequence per file/blob; steps are CSV rows (reference
+    CSVSequenceRecordReader)."""
+
+    def __init__(self, sources: Sequence, skip_lines: int = 0,
+                 delimiter: str = ","):
+        self.sources = sources
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+
+    def __iter__(self):
+        for src in self.sources:
+            rr = CSVRecordReader(src, self.skip_lines, self.delimiter)
+            yield [rec for rec in rr]
+
+
+class RecordReaderDataSetIterator:
+    """Bridges a RecordReader into DataSet batches (reference
+    org.deeplearning4j.datasets.datavec.RecordReaderDataSetIterator):
+    label column index + one-hot for classification, or regression mode.
+    """
+
+    def __init__(self, reader: RecordReader, batch_size: int,
+                 label_index: int, num_classes: Optional[int] = None,
+                 regression: bool = False):
+        self.reader = reader
+        self.batch_size = batch_size
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.regression = regression
+        self.pre_processor = None
+
+    def reset(self):
+        self.reader.reset()
+
+    def set_pre_processor(self, p):
+        self.pre_processor = p
+
+    def __iter__(self):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        feats, labels = [], []
+
+        def flush():
+            x = np.asarray(feats, np.float32)
+            if self.regression:
+                y = np.asarray(labels, np.float32).reshape(len(labels),
+                                                           -1)
+            else:
+                y = np.eye(self.num_classes, dtype=np.float32)[
+                    np.asarray(labels, np.int64)]
+            ds = DataSet(x, y)
+            if self.pre_processor is not None:
+                ds = self.pre_processor.transform_dataset(ds)
+            return ds
+
+        for rec in self.reader:
+            lab = rec[self.label_index]
+            row = [float(v) for j, v in enumerate(rec)
+                   if j != self.label_index]
+            feats.append(row)
+            labels.append(float(lab) if self.regression else int(lab))
+            if len(feats) == self.batch_size:
+                yield flush()
+                feats, labels = [], []
+        if feats:
+            yield flush()

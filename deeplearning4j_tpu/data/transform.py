@@ -1,0 +1,273 @@
+"""Declarative column transforms — reference: datavec-api
+``org.datavec.api.transform.TransformProcess`` + ``schema.Schema``
+(+LocalTransformExecutor): typed column schema, chained transforms,
+filters, categorical↔integer/one-hot conversion, normalization steps,
+reducers — executed locally (the reference's Spark executor maps to the
+same pure-python pipeline over any iterable; scale-out belongs to the
+data-loading host layer, not the device path).
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class Schema:
+    """Typed column schema (reference transform.schema.Schema)."""
+
+    def __init__(self):
+        self.columns: List[tuple] = []  # (name, type, meta)
+
+    class Builder:
+        def __init__(self):
+            self._s = Schema()
+
+        def add_column_double(self, name):
+            self._s.columns.append((name, "double", None))
+            return self
+
+        def add_column_integer(self, name):
+            self._s.columns.append((name, "integer", None))
+            return self
+
+        def add_column_long(self, name):
+            self._s.columns.append((name, "long", None))
+            return self
+
+        def add_column_string(self, name):
+            self._s.columns.append((name, "string", None))
+            return self
+
+        def add_column_categorical(self, name, categories: Sequence[str]):
+            self._s.columns.append((name, "categorical",
+                                    list(categories)))
+            return self
+
+        def add_column_time(self, name):
+            self._s.columns.append((name, "time", None))
+            return self
+
+        def build(self):
+            return self._s
+
+    @staticmethod
+    def builder() -> "Schema.Builder":
+        return Schema.Builder()
+
+    def names(self) -> List[str]:
+        return [c[0] for c in self.columns]
+
+    def index_of(self, name: str) -> int:
+        return self.names().index(name)
+
+    def type_of(self, name: str) -> str:
+        return self.columns[self.index_of(name)][1]
+
+    def categories_of(self, name: str):
+        return self.columns[self.index_of(name)][2]
+
+    def copy(self) -> "Schema":
+        s = Schema()
+        s.columns = list(self.columns)
+        return s
+
+
+class TransformProcess:
+    """Chained schema-aware record transforms (reference
+    TransformProcess + .Builder). ``execute`` maps any iterable of
+    records; the final schema is available for downstream vectorization.
+    """
+
+    def __init__(self, initial_schema: Schema, steps: List[tuple]):
+        self.initial_schema = initial_schema
+        self.steps = steps
+
+    class Builder:
+        def __init__(self, schema: Schema):
+            self._schema = schema
+            self._steps: List[tuple] = []
+
+        # -- transforms (reference names) -------------------------------
+        def remove_columns(self, *names):
+            self._steps.append(("remove", names))
+            return self
+
+        def remove_all_columns_except_for(self, *names):
+            self._steps.append(("keep", names))
+            return self
+
+        def rename_column(self, old, new):
+            self._steps.append(("rename", (old, new)))
+            return self
+
+        def categorical_to_integer(self, *names):
+            self._steps.append(("cat2int", names))
+            return self
+
+        def categorical_to_one_hot(self, *names):
+            self._steps.append(("cat2onehot", names))
+            return self
+
+        def integer_to_categorical(self, name, categories):
+            self._steps.append(("int2cat", (name, list(categories))))
+            return self
+
+        def string_to_categorical(self, name, categories):
+            self._steps.append(("str2cat", (name, list(categories))))
+            return self
+
+        def double_math_op(self, name, op: str, value: float):
+            self._steps.append(("math", (name, op, value)))
+            return self
+
+        def double_column_math_op(self, new_name, op, *names):
+            self._steps.append(("colmath", (new_name, op, names)))
+            return self
+
+        def normalize(self, name, kind: str, stat1: float, stat2: float):
+            """kind: 'minmax' (stat1=min, stat2=max) or 'standardize'
+            (stat1=mean, stat2=std)."""
+            self._steps.append(("normalize", (name, kind, stat1, stat2)))
+            return self
+
+        def filter_by(self, predicate: Callable[[Dict[str, Any]], bool]):
+            """Keep records where predicate(row_dict) is True (reference
+            FilterInvalidValues / ConditionFilter, inverted sense)."""
+            self._steps.append(("filter", predicate))
+            return self
+
+        def transform_column(self, name,
+                             fn: Callable[[Any], Any]):
+            self._steps.append(("apply", (name, fn)))
+            return self
+
+        def build(self):
+            return TransformProcess(self._schema, self._steps)
+
+    @staticmethod
+    def builder(schema: Schema) -> "TransformProcess.Builder":
+        return TransformProcess.Builder(schema)
+
+    # -- schema evolution ------------------------------------------------
+    def final_schema(self) -> Schema:
+        schema = self.initial_schema.copy()
+        for kind, arg in self.steps:
+            cols = schema.columns
+            if kind == "remove":
+                schema.columns = [c for c in cols if c[0] not in arg]
+            elif kind == "keep":
+                schema.columns = [c for c in cols if c[0] in arg]
+            elif kind == "rename":
+                old, new = arg
+                schema.columns = [(new if c[0] == old else c[0], c[1],
+                                   c[2]) for c in cols]
+            elif kind == "cat2int":
+                schema.columns = [
+                    (c[0], "integer" if c[0] in arg else c[1],
+                     None if c[0] in arg else c[2]) for c in cols]
+            elif kind == "cat2onehot":
+                out = []
+                for c in cols:
+                    if c[0] in arg:
+                        for cat in c[2]:
+                            out.append((f"{c[0]}[{cat}]", "integer",
+                                        None))
+                    else:
+                        out.append(c)
+                schema.columns = out
+            elif kind in ("int2cat", "str2cat"):
+                name, cats = arg
+                schema.columns = [
+                    (c[0], "categorical" if c[0] == name else c[1],
+                     cats if c[0] == name else c[2]) for c in cols]
+            elif kind == "colmath":
+                new_name, _, _ = arg
+                schema.columns = cols + [(new_name, "double", None)]
+        return schema
+
+    # -- execution -------------------------------------------------------
+    def execute(self, records) -> List[List[Any]]:
+        """Reference: LocalTransformExecutor.execute."""
+        schema = self.initial_schema.copy()
+        rows = [list(r) for r in records]
+        for kind, arg in self.steps:
+            names = schema.names()
+            if kind == "remove":
+                idx = [i for i, n in enumerate(names) if n not in arg]
+                rows = [[r[i] for i in idx] for r in rows]
+            elif kind == "keep":
+                idx = [i for i, n in enumerate(names) if n in arg]
+                rows = [[r[i] for i in idx] for r in rows]
+            elif kind == "cat2int":
+                for nm in arg:
+                    i = schema.index_of(nm)
+                    cats = schema.categories_of(nm)
+                    lut = {c: j for j, c in enumerate(cats)}
+                    for r in rows:
+                        r[i] = lut[r[i]]
+            elif kind == "cat2onehot":
+                for nm in arg:
+                    i = schema.index_of(nm)
+                    cats = schema.categories_of(nm)
+                    lut = {c: j for j, c in enumerate(cats)}
+                    for r in rows:
+                        v = r.pop(i)
+                        onehot = [0] * len(cats)
+                        onehot[lut[v]] = 1
+                        r[i:i] = onehot
+            elif kind == "int2cat":
+                nm, cats = arg
+                i = schema.index_of(nm)
+                for r in rows:
+                    r[i] = cats[int(r[i])]
+            elif kind == "str2cat":
+                nm, cats = arg
+                i = schema.index_of(nm)
+                for r in rows:
+                    if r[i] not in cats:
+                        raise ValueError(f"value {r[i]!r} not in "
+                                         f"categories of {nm}")
+            elif kind == "rename":
+                pass  # schema-only
+            elif kind == "math":
+                nm, op, val = arg
+                i = schema.index_of(nm)
+                fn = {"add": lambda x: x + val,
+                      "subtract": lambda x: x - val,
+                      "multiply": lambda x: x * val,
+                      "divide": lambda x: x / val,
+                      "pow": lambda x: x ** val}[op.lower()]
+                for r in rows:
+                    r[i] = fn(float(r[i]))
+            elif kind == "colmath":
+                new_name, op, cols_ = arg
+                idx = [schema.index_of(n) for n in cols_]
+                red = {"add": sum,
+                       "multiply": lambda vs: math.prod(vs),
+                       "max": max, "min": min}[op.lower()]
+                for r in rows:
+                    r.append(red([float(r[i]) for i in idx]))
+            elif kind == "normalize":
+                nm, how, s1, s2 = arg
+                i = schema.index_of(nm)
+                for r in rows:
+                    v = float(r[i])
+                    if how == "minmax":
+                        r[i] = (v - s1) / max(s2 - s1, 1e-12)
+                    else:
+                        r[i] = (v - s1) / max(s2, 1e-12)
+            elif kind == "filter":
+                pred = arg
+                rows = [r for r in rows
+                        if pred(dict(zip(names, r)))]
+            elif kind == "apply":
+                nm, fn = arg
+                i = schema.index_of(nm)
+                for r in rows:
+                    r[i] = fn(r[i])
+            # evolve schema stepwise (reuse final_schema logic per step)
+            schema = TransformProcess(schema, [(kind, arg)]
+                                      ).final_schema()
+        return rows

@@ -241,3 +241,8 @@ class NeuralNetConfiguration:
 
     def list(self) -> ListBuilder:
         return ListBuilder(self)
+
+    def graph_builder(self):
+        """Reference: NeuralNetConfiguration.Builder.graphBuilder()."""
+        from deeplearning4j_tpu.nn.graph import GraphBuilder
+        return GraphBuilder(self)

@@ -1,0 +1,426 @@
+"""ComputationGraph — DAG models.
+
+Reference: ``org.deeplearning4j.nn.graph.ComputationGraph`` +
+``ComputationGraphConfiguration.GraphBuilder`` (SURVEY §2.3):
+multi-input/multi-output networks of layers and vertices.
+
+TPU-native: the DAG is walked once at trace time (plain Python in
+topological order) — XLA sees a single fused computation; there is no
+per-vertex dispatch at runtime. One jitted train step covers all
+outputs and losses.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu import dtypes
+from deeplearning4j_tpu.nn import updaters as upd
+from deeplearning4j_tpu.nn.config import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict
+from deeplearning4j_tpu.nn.layers.core import OutputLayer, LossLayer
+from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
+from deeplearning4j_tpu.nn.layers.special import FrozenLayer
+from deeplearning4j_tpu.nn.multilayer import _FUSABLE
+from deeplearning4j_tpu.nn.vertices import (GraphVertex, vertex_from_dict)
+from deeplearning4j_tpu.ops import losses as losses_mod
+
+
+@dataclass
+class _Node:
+    name: str
+    kind: str                  # "layer" | "vertex"
+    obj: Any
+    inputs: List[str]
+
+
+class ComputationGraphConfiguration:
+    def __init__(self, inputs: List[str], outputs: List[str],
+                 nodes: List[_Node], seed: int = 12345,
+                 updater=None, dtype: str = "float32",
+                 input_types: Optional[Dict[str, InputType]] = None,
+                 gradient_normalization: Optional[str] = None,
+                 gradient_normalization_threshold: float = 1.0):
+        self.inputs = inputs
+        self.outputs = outputs
+        self.nodes = nodes
+        self.seed = seed
+        self.updater = updater or upd.Sgd(learning_rate=1e-2)
+        self.dtype = dtype
+        self.input_types = input_types or {}
+        self.gradient_normalization = gradient_normalization
+        self.gradient_normalization_threshold = \
+            gradient_normalization_threshold
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "inputs": self.inputs,
+            "outputs": self.outputs,
+            "nodes": [{"name": n.name, "kind": n.kind,
+                       "inputs": n.inputs, "conf": n.obj.to_dict()}
+                      for n in self.nodes],
+            "seed": self.seed,
+            "updater": self.updater.to_dict(),
+            "dtype": self.dtype,
+            "input_types": {k: v.to_dict()
+                            for k, v in self.input_types.items()},
+            "gradient_normalization": self.gradient_normalization,
+            "gradient_normalization_threshold":
+                self.gradient_normalization_threshold,
+        }, indent=2)
+
+    @staticmethod
+    def from_json(s: str) -> "ComputationGraphConfiguration":
+        d = json.loads(s)
+        nodes = []
+        for nd in d["nodes"]:
+            obj = (layer_from_dict(nd["conf"]) if nd["kind"] == "layer"
+                   else vertex_from_dict(nd["conf"]))
+            nodes.append(_Node(nd["name"], nd["kind"], obj, nd["inputs"]))
+        return ComputationGraphConfiguration(
+            inputs=d["inputs"], outputs=d["outputs"], nodes=nodes,
+            seed=d.get("seed", 12345),
+            updater=upd.updater_from_dict(d["updater"]),
+            dtype=d.get("dtype", "float32"),
+            input_types={k: InputType.from_dict(v)
+                         for k, v in d.get("input_types", {}).items()},
+            gradient_normalization=d.get("gradient_normalization"),
+            gradient_normalization_threshold=d.get(
+                "gradient_normalization_threshold", 1.0))
+
+
+class GraphBuilder:
+    """Reference: ComputationGraphConfiguration.GraphBuilder."""
+
+    def __init__(self, global_conf=None):
+        self._g = global_conf
+        self._inputs: List[str] = []
+        self._outputs: List[str] = []
+        self._nodes: List[_Node] = []
+        self._input_types: Dict[str, InputType] = {}
+
+    def add_inputs(self, *names: str) -> "GraphBuilder":
+        self._inputs.extend(names)
+        return self
+
+    def add_layer(self, name: str, layer: Layer, *inputs: str
+                  ) -> "GraphBuilder":
+        if self._g is not None:
+            from deeplearning4j_tpu.nn.config import _GLOBAL_DEFAULTS
+            for attr in _GLOBAL_DEFAULTS:
+                if getattr(layer, attr, None) is None:
+                    gv = getattr(self._g, attr, None)
+                    if gv is not None:
+                        setattr(layer, attr, gv)
+        layer.name = name
+        self._nodes.append(_Node(name, "layer", layer, list(inputs)))
+        return self
+
+    def add_vertex(self, name: str, vertex: GraphVertex, *inputs: str
+                   ) -> "GraphBuilder":
+        self._nodes.append(_Node(name, "vertex", vertex, list(inputs)))
+        return self
+
+    def set_outputs(self, *names: str) -> "GraphBuilder":
+        self._outputs.extend(names)
+        return self
+
+    def set_input_types(self, **types: InputType) -> "GraphBuilder":
+        self._input_types.update(types)
+        return self
+
+    def build(self) -> ComputationGraphConfiguration:
+        g = self._g
+        return ComputationGraphConfiguration(
+            inputs=self._inputs, outputs=self._outputs, nodes=self._nodes,
+            seed=g.seed_ if g else 12345,
+            updater=g.updater_ if g else None,
+            dtype=g.dtype_ if g else "float32",
+            input_types=self._input_types,
+            gradient_normalization=g.grad_norm_ if g else None,
+            gradient_normalization_threshold=(
+                g.grad_norm_threshold_ if g else 1.0))
+
+
+def _toposort(nodes: List[_Node], inputs: List[str]) -> List[_Node]:
+    done = set(inputs)
+    ordered: List[_Node] = []
+    pending = list(nodes)
+    while pending:
+        progressed = False
+        for n in list(pending):
+            if all(i in done for i in n.inputs):
+                ordered.append(n)
+                done.add(n.name)
+                pending.remove(n)
+                progressed = True
+        if not progressed:
+            missing = {i for n in pending for i in n.inputs} - done
+            raise ValueError(f"graph has cycle or missing inputs: "
+                             f"{sorted(missing)}")
+    return ordered
+
+
+class ComputationGraph:
+    """DAG network (reference ComputationGraph)."""
+
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.order = _toposort(conf.nodes, conf.inputs)
+        self.params: Dict[str, Any] = {}
+        self.state: Dict[str, Any] = {}
+        self.opt_state = None
+        self.listeners: List[Any] = []
+        self.iteration = 0
+        self.epoch = 0
+        self.score_ = float("nan")
+        self._train_step_fn = None
+        self._output_fn = None
+        self._optimizer = None
+        self._shapes: Dict[str, tuple] = {}
+
+    # ------------------------------------------------------------------
+    def init(self, input_shapes: Optional[Dict[str, tuple]] = None):
+        shapes: Dict[str, tuple] = {}
+        for name in self.conf.inputs:
+            if input_shapes and name in input_shapes:
+                shapes[name] = tuple(input_shapes[name])
+            elif name in self.conf.input_types:
+                shapes[name] = self.conf.input_types[name].shape
+            else:
+                raise ValueError(f"no input shape for {name!r}")
+        dtype = dtypes.resolve(self.conf.dtype)
+        key = jax.random.PRNGKey(self.conf.seed)
+        for node in self.order:
+            in_shapes = [shapes[i] for i in node.inputs]
+            if node.kind == "layer":
+                key, sub = jax.random.split(key)
+                p, s, out = node.obj.init(sub, in_shapes[0], dtype)
+                self.params[node.name] = p
+                self.state[node.name] = s
+            else:
+                out = node.obj.output_shape(in_shapes)
+            shapes[node.name] = out
+        self._shapes = shapes
+        self._build_optimizer()
+        return self
+
+    def _build_optimizer(self):
+        transforms, labels = {}, {}
+        for node in self.order:
+            if node.kind != "layer":
+                continue
+            layer = node.obj
+            frozen = isinstance(layer, FrozenLayer) or not layer.trainable
+            if frozen:
+                transforms[node.name] = optax.set_to_zero()
+            else:
+                chain = [upd.gradient_normalization(
+                    self.conf.gradient_normalization,
+                    self.conf.gradient_normalization_threshold)]
+                if layer.weight_decay:
+                    chain.append(optax.add_decayed_weights(
+                        layer.weight_decay))
+                u = layer.updater or self.conf.updater
+                chain.append(u.to_optax())
+                transforms[node.name] = optax.chain(*chain)
+            labels[node.name] = node.name
+        self._optimizer = optax.multi_transform(transforms,
+                                                param_labels=labels)
+        self.opt_state = self._optimizer.init(self.params)
+
+    # ------------------------------------------------------------------
+    def _forward(self, params, state, inputs: Dict[str, jax.Array], *,
+                 train: bool, rng, masks=None,
+                 pre_output: bool = False):
+        acts: Dict[str, jax.Array] = dict(inputs)
+        new_state = {}
+        masks = dict(masks or {})
+        out_set = set(self.conf.outputs)
+        for node in self.order:
+            xs = [acts[i] for i in node.inputs]
+            m = next((masks.get(i) for i in node.inputs
+                      if masks.get(i) is not None), None)
+            if node.kind == "vertex":
+                acts[node.name] = node.obj.apply(xs)
+                masks[node.name] = m
+                continue
+            layer = node.obj
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            if (pre_output and node.name in out_set
+                    and isinstance(layer, OutputLayer)):
+                x = xs[0]
+                if x.ndim > 2 and not hasattr(layer, "loss_rnn"):
+                    x = x.reshape(x.shape[0], -1) if x.ndim == 2 else x
+                z = x @ params[node.name]["W"]
+                if layer.has_bias:
+                    z = z + params[node.name]["b"]
+                acts[node.name] = z
+                new_state[node.name] = state.get(node.name, {})
+                masks[node.name] = m
+                continue
+            y, s = layer.apply(params.get(node.name, {}),
+                               state.get(node.name, {}), xs[0],
+                               train=train, rng=sub, mask=m)
+            acts[node.name] = y
+            new_state[node.name] = (state.get(node.name, {})
+                                    if isinstance(layer,
+                                                  BaseRecurrentLayer)
+                                    else s)
+            masks[node.name] = layer.propagate_mask(m, None)
+        return acts, new_state
+
+    def _out_loss(self, name):
+        node = next(n for n in self.order if n.name == name)
+        layer = node.obj
+        loss_name = getattr(layer, "loss", None)
+        if loss_name is None:
+            raise ValueError(f"output {name!r} has no loss")
+        act = (layer.activation or "identity").lower()
+        fused = (act, loss_name.lower()) in _FUSABLE and \
+            isinstance(layer, OutputLayer)
+        return loss_name, fused
+
+    def _loss_fn(self, params, state, inputs, labels, masks, lmasks, rng):
+        any_fused = any(self._out_loss(o)[1] for o in self.conf.outputs)
+        acts, new_state = self._forward(params, state, inputs, train=True,
+                                        rng=rng, masks=masks,
+                                        pre_output=any_fused)
+        total = 0.0
+        for name, y in zip(self.conf.outputs, labels):
+            loss_name, fused = self._out_loss(name)
+            fn = losses_mod.get(loss_name)
+            kw = {"from_logits": True} if fused else {}
+            lm = lmasks.get(name) if lmasks else None
+            total = total + fn(y, acts[name], mask=lm, **kw)
+        return total, new_state
+
+    # ------------------------------------------------------------------
+    def _make_train_step(self):
+        optimizer = self._optimizer
+
+        def step(params, opt_state, state, inputs, labels, masks,
+                 lmasks, rng):
+            (loss, new_state), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True)(params, state, inputs,
+                                             labels, masks, lmasks, rng)
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, new_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def fit(self, features, labels=None, *, epochs: int = 1):
+        """fit(MultiDataSet iterator) | fit([x...], [y...]) | fit(x, y)."""
+        if labels is not None:
+            xs = features if isinstance(features, (list, tuple)) \
+                else [features]
+            ys = labels if isinstance(labels, (list, tuple)) else [labels]
+            self._fit_batch(xs, ys)
+            return self
+        it = features
+        for _ in range(epochs):
+            for l in self.listeners:
+                l.on_epoch_start(self)
+            if hasattr(it, "reset"):
+                it.reset()
+            for mds in it:
+                if hasattr(mds, "features"):
+                    xs = (mds.features
+                          if isinstance(mds.features, list)
+                          else [mds.features])
+                    ys = (mds.labels if isinstance(mds.labels, list)
+                          else [mds.labels])
+                else:
+                    xs, ys = mds
+                    xs = xs if isinstance(xs, list) else [xs]
+                    ys = ys if isinstance(ys, list) else [ys]
+                self._fit_batch(xs, ys)
+            for l in self.listeners:
+                l.on_epoch_end(self)
+            self.epoch += 1
+        return self
+
+    def _fit_batch(self, xs, ys):
+        if self._train_step_fn is None:
+            self._train_step_fn = self._make_train_step()
+        inputs = {n: jnp.asarray(np.asarray(x))
+                  for n, x in zip(self.conf.inputs, xs)}
+        labels = [jnp.asarray(np.asarray(y)) for y in ys]
+        rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed),
+                                 self.iteration)
+        self.params, self.opt_state, self.state, loss = \
+            self._train_step_fn(self.params, self.opt_state, self.state,
+                                inputs, labels, None, None, rng)
+        self.score_ = float(loss)
+        self.iteration += 1
+        for l in self.listeners:
+            l.iteration_done(self, self.iteration, self.epoch)
+
+    # ------------------------------------------------------------------
+    def output(self, *features, train: bool = False):
+        """Returns a list of output activations (reference
+        ComputationGraph.output)."""
+        if self._output_fn is None:
+            def infer(params, state, inputs):
+                acts, _ = self._forward(params, state, inputs,
+                                        train=False, rng=None)
+                return [acts[o] for o in self.conf.outputs]
+            self._output_fn = jax.jit(infer)
+        inputs = {n: jnp.asarray(np.asarray(x))
+                  for n, x in zip(self.conf.inputs, features)}
+        return self._output_fn(self.params, self.state, inputs)
+
+    def output_single(self, *features):
+        return self.output(*features)[0]
+
+    def score(self, dataset=None) -> float:
+        return self.score_
+
+    def evaluate(self, iterator):
+        from deeplearning4j_tpu.eval_.evaluation import Evaluation
+        e = Evaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            x, y = (ds.features, ds.labels) if hasattr(ds, "features") \
+                else ds
+            out = self.output(x)[0]
+            e.eval(np.asarray(y), np.asarray(out))
+        return e
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(np.shape(l)))
+                   for l in jax.tree.leaves(self.params))
+
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def summary(self) -> str:
+        lines = ["=" * 76,
+                 f"{'Node':<24}{'Type':<26}{'Output':<16}{'Params':>8}",
+                 "=" * 76]
+        total = 0
+        for node in self.order:
+            n = 0
+            if node.kind == "layer":
+                n = sum(int(np.prod(np.shape(l))) for l in
+                        jax.tree.leaves(self.params[node.name]))
+            total += n
+            lines.append(
+                f"{node.name:<24}{type(node.obj).__name__:<26}"
+                f"{str(self._shapes.get(node.name)):<16}{n:>8,}")
+        lines.append("=" * 76)
+        lines.append(f"Total params: {total:,}")
+        return "\n".join(lines)

@@ -1,0 +1,229 @@
+"""Graph vertices — reference: ``org.deeplearning4j.nn.conf.graph.*`` /
+``org.deeplearning4j.nn.graph.vertex.impl.*`` (MergeVertex,
+ElementWiseVertex, SubsetVertex, StackVertex, UnstackVertex, ScaleVertex,
+ShiftVertex, L2NormalizeVertex, ReshapeVertex, AttentionVertex).
+
+A vertex is a paramless (or small-param) multi-input op in a
+ComputationGraph; one dataclass per vertex with ``apply(inputs)``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+_VERTEX_REGISTRY: Dict[str, type] = {}
+
+
+def register_vertex(cls):
+    _VERTEX_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def vertex_from_dict(d: Dict[str, Any]):
+    d = dict(d)
+    cls = _VERTEX_REGISTRY[d.pop("@class")]
+    return cls(**{k: v for k, v in d.items()
+                  if k in {f.name for f in dataclasses.fields(cls)}})
+
+
+@dataclass
+class GraphVertex:
+    def apply(self, inputs: List[jax.Array]) -> jax.Array:
+        raise NotImplementedError
+
+    def output_shape(self, input_shapes: List[tuple]) -> tuple:
+        raise NotImplementedError
+
+    def to_dict(self):
+        out = {"@class": type(self).__name__}
+        for f in dataclasses.fields(self):
+            out[f.name] = getattr(self, f.name)
+        return out
+
+
+@register_vertex
+@dataclass
+class MergeVertex(GraphVertex):
+    """Concatenate along the feature axis (reference MergeVertex)."""
+    axis: int = -1
+
+    def apply(self, inputs):
+        return jnp.concatenate(inputs, axis=self.axis)
+
+    def output_shape(self, shapes):
+        out = list(shapes[0])
+        out[-1] = sum(s[-1] for s in shapes)
+        return tuple(out)
+
+
+@register_vertex
+@dataclass
+class ElementWiseVertex(GraphVertex):
+    """Elementwise add/sub/mul/avg/max (reference ElementWiseVertex.Op)."""
+    op: str = "add"
+
+    def apply(self, inputs):
+        op = self.op.lower()
+        out = inputs[0]
+        if op == "add":
+            for x in inputs[1:]:
+                out = out + x
+        elif op in ("sub", "subtract"):
+            for x in inputs[1:]:
+                out = out - x
+        elif op in ("mul", "product"):
+            for x in inputs[1:]:
+                out = out * x
+        elif op in ("avg", "average"):
+            out = sum(inputs) / len(inputs)
+        elif op == "max":
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+        else:
+            raise ValueError(f"unknown elementwise op {self.op!r}")
+        return out
+
+    def output_shape(self, shapes):
+        return tuple(shapes[0])
+
+
+@register_vertex
+@dataclass
+class SubsetVertex(GraphVertex):
+    """Feature-range slice [from, to] inclusive (reference SubsetVertex)."""
+    from_: int = 0
+    to: int = 0
+
+    def apply(self, inputs):
+        return inputs[0][..., self.from_:self.to + 1]
+
+    def output_shape(self, shapes):
+        s = list(shapes[0])
+        s[-1] = self.to - self.from_ + 1
+        return tuple(s)
+
+
+@register_vertex
+@dataclass
+class StackVertex(GraphVertex):
+    """Stack along batch axis (reference StackVertex)."""
+
+    def apply(self, inputs):
+        return jnp.concatenate(inputs, axis=0)
+
+    def output_shape(self, shapes):
+        return tuple(shapes[0])
+
+
+@register_vertex
+@dataclass
+class UnstackVertex(GraphVertex):
+    """Take slice ``index`` of ``num`` along batch (reference
+    UnstackVertex)."""
+    index: int = 0
+    num: int = 2
+
+    def apply(self, inputs):
+        x = inputs[0]
+        n = x.shape[0] // self.num
+        return x[self.index * n:(self.index + 1) * n]
+
+    def output_shape(self, shapes):
+        return tuple(shapes[0])
+
+
+@register_vertex
+@dataclass
+class ScaleVertex(GraphVertex):
+    scale: float = 1.0
+
+    def apply(self, inputs):
+        return inputs[0] * self.scale
+
+    def output_shape(self, shapes):
+        return tuple(shapes[0])
+
+
+@register_vertex
+@dataclass
+class ShiftVertex(GraphVertex):
+    shift: float = 0.0
+
+    def apply(self, inputs):
+        return inputs[0] + self.shift
+
+    def output_shape(self, shapes):
+        return tuple(shapes[0])
+
+
+@register_vertex
+@dataclass
+class L2NormalizeVertex(GraphVertex):
+    eps: float = 1e-8
+
+    def apply(self, inputs):
+        x = inputs[0]
+        n = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+        return x / jnp.maximum(n, self.eps)
+
+    def output_shape(self, shapes):
+        return tuple(shapes[0])
+
+
+@register_vertex
+@dataclass
+class ReshapeVertex(GraphVertex):
+    """Reshape trailing dims, batch preserved (reference ReshapeVertex)."""
+    shape: Sequence[int] = ()
+
+    def apply(self, inputs):
+        x = inputs[0]
+        return x.reshape((x.shape[0],) + tuple(self.shape))
+
+    def output_shape(self, shapes):
+        return tuple(self.shape)
+
+
+@register_vertex
+@dataclass
+class PoolHelperVertex(GraphVertex):
+    """Strips first row/col (reference PoolHelperVertex, googlenet shim)."""
+
+    def apply(self, inputs):
+        return inputs[0][:, 1:, 1:, :]
+
+    def output_shape(self, shapes):
+        s = shapes[0]
+        return (s[0] - 1, s[1] - 1, s[2])
+
+
+@register_vertex
+@dataclass
+class AttentionVertex(GraphVertex):
+    """Cross-attention vertex (reference AttentionVertex over
+    multi_head_dot_product_attention): inputs [queries, keys, values]
+    (or [q, kv]). Paramless scaled dot-product here; for projected
+    attention use nn.layers.attention.MultiHeadAttention."""
+    n_heads: int = 1
+
+    def apply(self, inputs):
+        from deeplearning4j_tpu.nn.layers.attention import (
+            scaled_dot_attention)
+        q = inputs[0]
+        k = inputs[1]
+        v = inputs[2] if len(inputs) > 2 else inputs[1]
+
+        def split(x):
+            b, t, f = x.shape
+            return x.reshape(b, t, self.n_heads, f // self.n_heads)
+
+        out = scaled_dot_attention(split(q), split(k), split(v))
+        b, t, h, d = out.shape
+        return out.reshape(b, t, h * d)
+
+    def output_shape(self, shapes):
+        return tuple(shapes[0])

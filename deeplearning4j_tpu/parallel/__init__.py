@@ -1,0 +1,26 @@
+"""Distributed training & inference — reference:
+``deeplearning4j-scaleout`` (ParallelWrapper, ParallelInference, Spark
+training masters) + ``nd4j-parameter-server`` (Aeron mesh transport).
+
+TPU-native redesign (SURVEY §2.5): the entire hand-written transport
+stack (Aeron UDP mesh, MeshOrganizer, chunked reassembly, AtomicAllocator
+device copies) is replaced by XLA collectives over ICI/DCN emitted by the
+SPMD partitioner — the "communication backend" is a device mesh plus
+sharding annotations. ``jax.distributed`` replaces Spark/Aeron mesh
+formation for multi-host.
+"""
+from deeplearning4j_tpu.parallel.mesh import (make_mesh, data_parallel_mesh,
+                                              initialize_distributed)
+from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.compression import (
+    EncodedGradientsAccumulator, encode_threshold, decode_threshold,
+    encode_bitmap, decode_bitmap, AdaptiveThresholdAlgorithm,
+)
+
+__all__ = [
+    "make_mesh", "data_parallel_mesh", "initialize_distributed",
+    "ParallelWrapper", "ParallelInference",
+    "EncodedGradientsAccumulator", "encode_threshold", "decode_threshold",
+    "encode_bitmap", "decode_bitmap", "AdaptiveThresholdAlgorithm",
+]

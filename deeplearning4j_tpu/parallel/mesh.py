@@ -1,0 +1,74 @@
+"""Device mesh construction + multi-host bring-up.
+
+Reference mapping:
+ - ``CudaAffinityManager`` device lists / ``ParallelWrapper`` worker
+   placement → a ``jax.sharding.Mesh`` with named axes.
+ - Spark/Aeron cluster formation (``SharedTrainingMaster``,
+   ``MeshOrganizer``) → ``jax.distributed.initialize`` (coordination
+   service) + one mesh spanning all hosts; ICI inside a slice, DCN
+   across slices, chosen by XLA from device topology.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None
+              ) -> Mesh:
+    """Build a mesh with named axes, e.g. {"data": 4, "model": 2}.
+
+    An axis size of -1 absorbs the remaining devices (like a reshape).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    n = len(devices)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError(f"mesh needs {total} devices, have {n}")
+    arr = np.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+def data_parallel_mesh(n: Optional[int] = None) -> Mesh:
+    """All (or first n) devices on one 'data' axis — the ParallelWrapper
+    topology."""
+    return make_mesh({"data": n if n else -1})
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    return NamedSharding(mesh, P(axis))
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up (reference: SharedTrainingMaster's Spark+Aeron
+    bootstrap → jax coordination service). No-op when single-process.
+
+    Example launcher (replaces spark-submit):
+        DL4J_TPU_COORD=host0:1234 DL4J_TPU_NPROC=4 DL4J_TPU_PROC_ID=$i \
+            python train.py
+    """
+    import os
+    coordinator_address = coordinator_address or os.environ.get(
+        "DL4J_TPU_COORD")
+    if coordinator_address is None:
+        return  # single process
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes or int(os.environ["DL4J_TPU_NPROC"]),
+        process_id=process_id or int(os.environ["DL4J_TPU_PROC_ID"]))

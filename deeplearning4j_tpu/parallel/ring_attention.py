@@ -1,0 +1,144 @@
+"""Ring attention — sequence/context parallelism over the ICI ring.
+
+NEW capability vs the reference (SURVEY §5 long-context: the reference's
+longest-sequence story is truncated BPTT; its attention ops are
+single-device). Required by the rebuild spec for modern sequence
+scaling.
+
+Design (blockwise/ring attention à la Liu et al.): the sequence axis is
+sharded over the mesh's 'seq' axis. Each device holds a Q block and a
+KV block. Over ``n_seq`` ring steps, every device computes attention of
+its Q block against the KV block it currently holds, accumulating a
+numerically-stable online softmax (running max + weighted sums), then
+rotates the KV block to its ring neighbor with ``jax.lax.ppermute``
+(pure ICI traffic, overlapped by XLA with the block matmuls). Memory is
+O(T/N) per device; no device ever materialises the full [T,T] score
+matrix.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+
+def _block_attn_accum(q, k, v, m_prev, num_prev, den_prev, kmask=None):
+    """One KV-block contribution with online-softmax accumulation.
+
+    q: [B,Tq,H,D]; k,v: [B,Tk,H,D]; running (m, num, den).
+    """
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(d, q.dtype))
+    if kmask is not None:
+        s = jnp.where(kmask[:, None, None, :] > 0, s, -1e9)
+    m_blk = jnp.max(s, axis=-1)                      # [B,H,Tq]
+    m_new = jnp.maximum(m_prev, m_blk)
+    p = jnp.exp(s - m_new[..., None])                # [B,H,Tq,Tk]
+    scale = jnp.exp(m_prev - m_new)                  # rescale old accum
+    num = num_prev * scale[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v)
+    den = den_prev * scale + jnp.sum(p, axis=-1)
+    return m_new, num, den
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
+                        mask: Optional[jax.Array] = None):
+    """Distributed attention: inputs [B, T, H, D] sharded on T over
+    ``axis_name``; returns [B, T, H, D] with identical sharding.
+
+    ``mask``: [B, T] key mask, sharded the same way.
+    """
+    def local(q, k, v, kmask):
+        n = lax.psum(1, axis_name)
+        b, tq, h, d = q.shape
+        m0 = jnp.full((b, h, tq), -jnp.inf, q.dtype)
+        num0 = jnp.zeros((b, h, tq, d), q.dtype)
+        den0 = jnp.zeros((b, h, tq), q.dtype)
+
+        def body(i, carry):
+            m, num, den, k_cur, v_cur, km_cur = carry
+            m, num, den = _block_attn_accum(q, k_cur, v_cur, m, num, den,
+                                            km_cur)
+            # rotate KV (+mask) around the ring
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            k_nxt = lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = lax.ppermute(v_cur, axis_name, perm)
+            km_nxt = lax.ppermute(km_cur, axis_name, perm)
+            return m, num, den, k_nxt, v_nxt, km_nxt
+
+        km = (jnp.ones(k.shape[:2], q.dtype) if kmask is None else kmask)
+        m, num, den, _, _, _ = lax.fori_loop(
+            0, n, body, (m0, num0, den0, k, v, km))
+        out = num / jnp.maximum(den[..., None], 1e-30)  # [B,H,Tq,D]
+        return jnp.transpose(out, (0, 2, 1, 3))         # [B,Tq,H,D]
+
+    spec = P(None, axis_name, None, None)
+    mspec = P(None, axis_name)
+    if mask is None:
+        fn = shard_map(lambda q, k, v: local(q, k, v, None), mesh=mesh,
+                       in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+        return fn(q, k, v)
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(spec, spec, spec, mspec),
+                   out_specs=spec, check_vma=False)
+    return fn(q, k, v, mask)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
+                      mask: Optional[jax.Array] = None):
+    """Ulysses-style (DeepSpeed) sequence parallelism: all-to-all swaps
+    the sharded axis from sequence to heads, each device computes FULL
+    attention for its head subset, then swaps back. One all-to-all pair
+    instead of N ring hops — better when heads ≥ devices and ICI
+    all-to-all bandwidth is plentiful.
+
+    q,k,v: [B, T, H, D] sharded on T. H must be divisible by the axis
+    size.
+    """
+    def local(q, k, v, kmask):
+        n = lax.psum(1, axis_name)
+
+        def seq_to_heads(x):
+            # [B, T/n, H, D] -> all_to_all -> [B, T, H/n, D]
+            b, tl, h, d = x.shape
+            x = x.reshape(b, tl, n, h // n, d)
+            x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                               tiled=False)
+            return x.reshape(b, tl * n, h // n, d)
+
+        def heads_to_seq(x):
+            b, t, hl, d = x.shape
+            x = x.reshape(b, n, t // n, hl, d)
+            x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3,
+                               tiled=False)
+            return x.reshape(b, t // n, hl * n, d)
+
+        qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+        kmf = lax.all_gather(kmask, axis_name, axis=1, tiled=True) \
+            if kmask is not None else None
+        dd = qf.shape[-1]
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) / jnp.sqrt(
+            jnp.asarray(dd, qf.dtype))
+        if kmf is not None:
+            s = jnp.where(kmf[:, None, None, :] > 0, s, -1e9)
+        w = jax.nn.softmax(s, axis=-1)
+        of = jnp.einsum("bhqk,bkhd->bqhd", w, vf)
+        return heads_to_seq(of)
+
+    spec = P(None, axis_name, None, None)
+    mspec = P(None, axis_name)
+    if mask is None:
+        fn = shard_map(lambda q, k, v: local(q, k, v, None), mesh=mesh,
+                       in_specs=(spec, spec, spec), out_specs=spec,
+                       check_vma=False)
+        return fn(q, k, v)
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec, mspec),
+                   out_specs=spec, check_vma=False)
+    return fn(q, k, v, mask)

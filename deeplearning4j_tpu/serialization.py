@@ -72,31 +72,58 @@ class ModelSerializer:
             if normalizer is not None:
                 zf.writestr("normalizer.json",
                             json.dumps(normalizer.state_dict()))
-            zf.writestr("meta.json", json.dumps(
-                {"iteration": net.iteration, "epoch": net.epoch,
-                 "input_shape": list(getattr(net, "_input_shape", []) or []),
-                 "format_version": 1}))
+            meta = {"iteration": net.iteration, "epoch": net.epoch,
+                    "format_version": 1}
+            ishape = getattr(net, "_input_shape", None)
+            if ishape:
+                meta["input_shape"] = list(ishape)
+            # ComputationGraph: persist per-input shapes so restore can
+            # init() graphs built without input_types
+            shapes = getattr(net, "_shapes", None)
+            if shapes and hasattr(net.conf, "inputs"):
+                meta["input_shapes"] = {
+                    n: list(shapes[n]) for n in net.conf.inputs}
+            zf.writestr("meta.json", json.dumps(meta))
+
+    @staticmethod
+    def _restore(zf: zipfile.ZipFile, net, meta: dict,
+                 load_updater: bool):
+        net.params = _load_npz_into(zf, "params.npz", net.params)
+        net.state = _load_npz_into(zf, "state.npz", net.state)
+        if load_updater and "updater.npz" in zf.namelist():
+            net.opt_state = _load_npz_into(zf, "updater.npz",
+                                           net.opt_state)
+        net.iteration = meta.get("iteration", 0)
+        net.epoch = meta.get("epoch", 0)
+        return net
 
     @staticmethod
     def restore_multi_layer_network(path, load_updater: bool = True):
         from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
-        path = Path(path)
-        with zipfile.ZipFile(path) as zf:
+        with zipfile.ZipFile(Path(path)) as zf:
             conf = MultiLayerConfiguration.from_json(
                 zf.read("configuration.json").decode())
             meta = json.loads(zf.read("meta.json").decode())
             net = MultiLayerNetwork(conf)
             ishape = tuple(meta.get("input_shape") or ()) or None
             net.init(input_shape=ishape)
-            net.params = _load_npz_into(zf, "params.npz", net.params)
-            net.state = _load_npz_into(zf, "state.npz", net.state)
-            if load_updater and "updater.npz" in zf.namelist():
-                net.opt_state = _load_npz_into(zf, "updater.npz",
-                                               net.opt_state)
-            net.iteration = meta.get("iteration", 0)
-            net.epoch = meta.get("epoch", 0)
-        return net
+            return ModelSerializer._restore(zf, net, meta, load_updater)
+
+    @staticmethod
+    def restore_computation_graph(path, load_updater: bool = True):
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration)
+        with zipfile.ZipFile(Path(path)) as zf:
+            conf = ComputationGraphConfiguration.from_json(
+                zf.read("configuration.json").decode())
+            meta = json.loads(zf.read("meta.json").decode())
+            net = ComputationGraph(conf)
+            ishapes = meta.get("input_shapes")
+            net.init(input_shapes={k: tuple(v)
+                                   for k, v in ishapes.items()}
+                     if ishapes else None)
+            return ModelSerializer._restore(zf, net, meta, load_updater)
 
     @staticmethod
     def restore_normalizer(path):

@@ -1,0 +1,96 @@
+"""ResNet-50 — reference: ``org.deeplearning4j.zoo.model.ResNet50``
+(ComputationGraph + cuDNN ConvolutionHelper path; BASELINE config #2).
+
+TPU-native: NHWC, conv+BN+relu blocks fuse under XLA; identity/conv
+shortcuts via ElementWiseVertex(add). The bench path runs this graph as
+ONE jitted train step (vs the reference's per-layer cuDNN calls).
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.config import (InputType,
+                                          NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import (BatchNormalization,
+                                          ConvolutionLayer, DenseLayer,
+                                          GlobalPoolingLayer, OutputLayer,
+                                          SubsamplingLayer,
+                                          ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.vertices import ElementWiseVertex
+from deeplearning4j_tpu.nn import updaters as upd
+
+
+class ResNet50:
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 input_shape=(224, 224, 3), updater=None):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.input_shape = input_shape
+        self.updater = updater or upd.Nesterovs(learning_rate=0.1,
+                                                momentum=0.9)
+
+    # -- blocks ----------------------------------------------------------
+    def _conv_bn(self, b, name, inp, n_out, kernel, stride=(1, 1),
+                 padding="SAME", act="relu"):
+        b.add_layer(f"{name}_conv",
+                    ConvolutionLayer(n_out=n_out, kernel_size=kernel,
+                                     stride=stride, padding=padding,
+                                     has_bias=False,
+                                     activation="identity"), inp)
+        b.add_layer(f"{name}_bn",
+                    BatchNormalization(activation=act), f"{name}_conv")
+        return f"{name}_bn"
+
+    def _bottleneck(self, b, name, inp, filters, stride=(1, 1),
+                    downsample=False):
+        f1, f2, f3 = filters
+        x = self._conv_bn(b, f"{name}_a", inp, f1, (1, 1), stride)
+        x = self._conv_bn(b, f"{name}_b", x, f2, (3, 3))
+        x = self._conv_bn(b, f"{name}_c", x, f3, (1, 1), act="identity")
+        if downsample:
+            sc = self._conv_bn(b, f"{name}_sc", inp, f3, (1, 1), stride,
+                               act="identity")
+        else:
+            sc = inp
+        b.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), x, sc)
+        from deeplearning4j_tpu.nn.layers import ActivationLayer
+        b.add_layer(f"{name}_out", ActivationLayer(activation="relu"),
+                    f"{name}_add")
+        return f"{name}_out"
+
+    # -- graph -----------------------------------------------------------
+    def conf(self):
+        h, w, c = self.input_shape
+        builder = (NeuralNetConfiguration.builder()
+                   .seed(self.seed)
+                   .updater(self.updater)
+                   .weight_init_fn("relu")
+                   .graph_builder()
+                   .add_inputs("input"))
+        b = builder
+        x = self._conv_bn(b, "stem", "input", 64, (7, 7), (2, 2))
+        b.add_layer("stem_pool",
+                    SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2),
+                                     padding="SAME",
+                                     pooling_type="max"), x)
+        x = "stem_pool"
+        stages = [
+            ("res2", [64, 64, 256], 3, (1, 1)),
+            ("res3", [128, 128, 512], 4, (2, 2)),
+            ("res4", [256, 256, 1024], 6, (2, 2)),
+            ("res5", [512, 512, 2048], 3, (2, 2)),
+        ]
+        for sname, filters, blocks, stride in stages:
+            x = self._bottleneck(b, f"{sname}_0", x, filters,
+                                 stride=stride, downsample=True)
+            for i in range(1, blocks):
+                x = self._bottleneck(b, f"{sname}_{i}", x, filters)
+        b.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+        b.add_layer("fc", OutputLayer(n_out=self.num_classes,
+                                      activation="softmax",
+                                      loss="mcxent"), "avgpool")
+        b.set_outputs("fc")
+        b.set_input_types(input=InputType.convolutional(h, w, c))
+        return b.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()

@@ -1,0 +1,165 @@
+"""Data pipeline tests. Reference analogs: CSVRecordReaderTest,
+TestTransformProcess (datavec), NormalizerStandardizeTest (nd4j).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import (AsyncDataSetIterator, DataSet,
+                                     ListDataSetIterator,
+                                     NormalizerMinMaxScaler,
+                                     NormalizerStandardize,
+                                     ImagePreProcessingScaler)
+from deeplearning4j_tpu.data.records import (
+    CSVRecordReader, CSVSequenceRecordReader, CollectionRecordReader,
+    LineRecordReader, RecordReaderDataSetIterator, RegexLineRecordReader)
+from deeplearning4j_tpu.data.transform import Schema, TransformProcess
+
+
+CSV = "1.0,2.0,cat,0\n3.5,4.0,dog,1\n5.0,6.5,cat,0\n"
+
+
+def test_csv_record_reader_parses():
+    rr = CSVRecordReader(CSV)
+    recs = list(rr)
+    assert recs[0] == [1.0, 2.0, "cat", 0]
+    assert recs[1][3] == 1
+
+
+def test_csv_reader_from_file(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("h1,h2\n1,2\n3,4\n")
+    rr = CSVRecordReader(p, skip_lines=1)
+    assert list(rr) == [[1, 2], [3, 4]]
+
+
+def test_line_and_regex_readers():
+    assert list(LineRecordReader("a\nb"))[1] == ["b"]
+    rr = RegexLineRecordReader("2024-01-01 INFO hello\n"
+                               "2024-01-02 WARN bye",
+                               r"(\S+) (\S+) (.*)")
+    recs = list(rr)
+    assert recs[0] == ["2024-01-01", "INFO", "hello"]
+    assert recs[1][1] == "WARN"
+
+
+def test_sequence_reader():
+    seqs = list(CSVSequenceRecordReader(["1,2\n3,4", "5,6"]))
+    assert seqs[0] == [[1, 2], [3, 4]]
+    assert seqs[1] == [[5, 6]]
+
+
+def test_record_reader_dataset_iterator_classification():
+    rr = CollectionRecordReader([[0.1, 0.2, 0], [0.3, 0.4, 1],
+                                 [0.5, 0.6, 2]])
+    it = RecordReaderDataSetIterator(rr, batch_size=2, label_index=2,
+                                     num_classes=3)
+    batches = list(it)
+    assert batches[0].features.shape == (2, 2)
+    np.testing.assert_allclose(batches[0].labels,
+                               [[1, 0, 0], [0, 1, 0]])
+    assert batches[1].features.shape == (1, 2)
+
+
+def test_record_reader_dataset_iterator_regression():
+    rr = CollectionRecordReader([[0.1, 0.2, 1.5], [0.3, 0.4, 2.5]])
+    it = RecordReaderDataSetIterator(rr, batch_size=2, label_index=2,
+                                     regression=True)
+    b = next(iter(it))
+    np.testing.assert_allclose(b.labels, [[1.5], [2.5]])
+
+
+def test_transform_process():
+    schema = (Schema.builder()
+              .add_column_double("a")
+              .add_column_double("b")
+              .add_column_categorical("animal", ["cat", "dog"])
+              .add_column_integer("label")
+              .build())
+    tp = (TransformProcess.builder(schema)
+          .categorical_to_one_hot("animal")
+          .double_math_op("a", "multiply", 2.0)
+          .double_column_math_op("ab", "add", "a", "b")
+          .filter_by(lambda row: row["label"] == 0)
+          .build())
+    rows = tp.execute(list(CSVRecordReader(CSV)))
+    # label==1 row filtered out
+    assert len(rows) == 2
+    # a doubled; one-hot expanded; ab appended
+    assert rows[0] == [2.0, 2.0, 1, 0, 0, 4.0]
+    fs = tp.final_schema()
+    assert fs.names() == ["a", "b", "animal[cat]", "animal[dog]",
+                          "label", "ab"]
+
+
+def test_transform_normalize_and_remove():
+    schema = (Schema.builder().add_column_double("x")
+              .add_column_string("junk").build())
+    tp = (TransformProcess.builder(schema)
+          .remove_columns("junk")
+          .normalize("x", "minmax", 0.0, 10.0)
+          .build())
+    rows = tp.execute([[5.0, "z"], [10.0, "y"]])
+    np.testing.assert_allclose([r[0] for r in rows], [0.5, 1.0])
+
+
+def test_normalizer_standardize_fit_transform_revert():
+    rng = np.random.default_rng(0)
+    x = rng.normal(5.0, 3.0, (500, 4)).astype(np.float32)
+    ds = DataSet(x, np.zeros((500, 1)))
+    n = NormalizerStandardize().fit(ds)
+    t = n.transform(x)
+    np.testing.assert_allclose(t.mean(0), 0, atol=1e-4)
+    np.testing.assert_allclose(t.std(0), 1, atol=1e-3)
+    np.testing.assert_allclose(n.revert(t), x, rtol=1e-4)
+    # streaming fit over batches gives same stats
+    n2 = NormalizerStandardize().fit(
+        iter(ListDataSetIterator(ds, batch_size=100)))
+    np.testing.assert_allclose(n.mean, n2.mean, rtol=1e-5)
+
+
+def test_normalizer_minmax_and_image():
+    x = np.array([[0.0, 5.0], [10.0, 15.0]], np.float32)
+    n = NormalizerMinMaxScaler().fit(DataSet(x, x))
+    t = n.transform(x)
+    assert t.min() == 0 and t.max() == 1
+    np.testing.assert_allclose(n.revert(t), x)
+    img = ImagePreProcessingScaler()
+    np.testing.assert_allclose(
+        img.transform(np.array([0, 255], np.uint8)), [0.0, 1.0])
+
+
+def test_normalizer_serialization_roundtrip():
+    from deeplearning4j_tpu.data.normalizers import normalizer_from_state
+    x = np.random.default_rng(1).normal(size=(50, 3)).astype(np.float32)
+    n = NormalizerStandardize().fit(DataSet(x, x))
+    n2 = normalizer_from_state(n.state_dict())
+    np.testing.assert_allclose(n.transform(x), n2.transform(x))
+
+
+def test_async_iterator_matches_sync():
+    ds = DataSet(np.arange(40, dtype=np.float32).reshape(10, 4),
+                 np.zeros((10, 2), np.float32))
+    base = ListDataSetIterator(ds, batch_size=3)
+    sync = [b.features.sum() for b in base]
+    async_it = AsyncDataSetIterator(ListDataSetIterator(ds, batch_size=3))
+    asy = [b.features.sum() for b in async_it]
+    assert sync == asy
+
+
+def test_async_iterator_propagates_errors():
+    class Bad(ListDataSetIterator):
+        def __iter__(self):
+            yield DataSet(np.ones((2, 2)), np.ones((2, 1)))
+            raise RuntimeError("boom")
+    with pytest.raises(RuntimeError, match="boom"):
+        list(AsyncDataSetIterator(Bad(None)))
+
+
+def test_dataset_ops():
+    ds = DataSet(np.arange(20).reshape(10, 2), np.arange(10))
+    tr, te = ds.split_test_and_train(8)
+    assert tr.num_examples() == 8 and te.num_examples() == 2
+    sh = ds.shuffle(0)
+    assert sorted(sh.labels.tolist()) == list(range(10))
+    m = DataSet.merge([tr, te])
+    assert m.num_examples() == 10

@@ -1,0 +1,162 @@
+"""ComputationGraph tests. Reference analogs: ComputationGraphTestRNN,
+TestComputationGraphNetwork (deeplearning4j-core).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.config import InputType
+from deeplearning4j_tpu.nn.graph import (ComputationGraph,
+                                         ComputationGraphConfiguration)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.vertices import (ElementWiseVertex,
+                                            L2NormalizeVertex,
+                                            MergeVertex, ScaleVertex,
+                                            StackVertex, SubsetVertex,
+                                            UnstackVertex)
+from deeplearning4j_tpu.nn import updaters as upd
+from deeplearning4j_tpu.serialization import ModelSerializer
+
+XOR_X = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32)
+XOR_Y = np.array([[1, 0], [0, 1], [0, 1], [1, 0]], np.float32)
+
+
+def _two_branch_graph():
+    return (NeuralNetConfiguration.builder()
+            .seed(42)
+            .updater(upd.Adam(learning_rate=0.05))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=8, activation="tanh"), "in")
+            .add_layer("d2", DenseLayer(n_out=8, activation="relu"), "in")
+            .add_vertex("merge", MergeVertex(), "d1", "d2")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                          loss="mcxent"), "merge")
+            .set_outputs("out")
+            .set_input_types(**{"in": InputType.feed_forward(2)})
+            .build())
+
+
+def test_graph_fit_learns_xor():
+    g = ComputationGraph(_two_branch_graph()).init()
+    for _ in range(300):
+        g.fit(XOR_X, XOR_Y)
+    preds = np.asarray(g.output(XOR_X)[0])
+    assert (preds.argmax(1) == XOR_Y.argmax(1)).all()
+    assert g.score() < 0.05
+
+
+def test_graph_json_roundtrip():
+    conf = _two_branch_graph()
+    s = conf.to_json()
+    conf2 = ComputationGraphConfiguration.from_json(s)
+    assert conf2.to_json() == s
+    g = ComputationGraph(conf2).init()
+    assert g.num_params() > 0
+
+
+def test_graph_checkpoint_roundtrip(tmp_path):
+    g = ComputationGraph(_two_branch_graph()).init()
+    for _ in range(10):
+        g.fit(XOR_X, XOR_Y)
+    p = tmp_path / "graph.zip"
+    ModelSerializer.write_model(g, p)
+    g2 = ModelSerializer.restore_computation_graph(p)
+    np.testing.assert_allclose(np.asarray(g.output(XOR_X)[0]),
+                               np.asarray(g2.output(XOR_X)[0]),
+                               rtol=1e-6)
+
+
+def test_multi_input_multi_output():
+    conf = (NeuralNetConfiguration.builder()
+            .seed(1)
+            .updater(upd.Adam(learning_rate=0.03))
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_layer("da", DenseLayer(n_out=4, activation="tanh"), "a")
+            .add_layer("db", DenseLayer(n_out=4, activation="tanh"), "b")
+            .add_vertex("sum", ElementWiseVertex(op="add"), "da", "db")
+            .add_layer("out1", OutputLayer(n_out=2, activation="softmax",
+                                           loss="mcxent"), "sum")
+            .add_layer("out2", OutputLayer(n_out=1, activation="identity",
+                                           loss="mse"), "sum")
+            .set_outputs("out1", "out2")
+            .set_input_types(a=InputType.feed_forward(3),
+                             b=InputType.feed_forward(3))
+            .build())
+    g = ComputationGraph(conf).init()
+    rng = np.random.default_rng(0)
+    xa = rng.normal(size=(16, 3)).astype(np.float32)
+    xb = rng.normal(size=(16, 3)).astype(np.float32)
+    y1 = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+    y2 = rng.normal(size=(16, 1)).astype(np.float32)
+    g.fit([xa, xb], [y1, y2])
+    assert np.isfinite(g.score())
+    o1, o2 = g.output(xa, xb)
+    assert o1.shape == (16, 2) and o2.shape == (16, 1)
+
+
+def test_vertices_math():
+    import jax.numpy as jnp
+    a = jnp.ones((2, 4))
+    b = 2 * jnp.ones((2, 4))
+    assert MergeVertex().apply([a, b]).shape == (2, 8)
+    np.testing.assert_allclose(
+        np.asarray(ElementWiseVertex(op="max").apply([a, b])), 2.0)
+    np.testing.assert_allclose(
+        np.asarray(ElementWiseVertex(op="average").apply([a, b])), 1.5)
+    s = SubsetVertex(from_=1, to=2).apply([jnp.arange(8.0).reshape(2, 4)])
+    np.testing.assert_allclose(np.asarray(s), [[1, 2], [5, 6]])
+    st = StackVertex().apply([a, b])
+    assert st.shape == (4, 4)
+    un = UnstackVertex(index=1, num=2).apply([st])
+    np.testing.assert_allclose(np.asarray(un), 2.0)
+    n = L2NormalizeVertex().apply([a])
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(n), axis=-1),
+                               1.0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(ScaleVertex(scale=3.0)
+                                          .apply([a])), 3.0)
+
+
+def test_graph_cycle_detection():
+    from deeplearning4j_tpu.nn.graph import _Node, _toposort
+    nodes = [_Node("x", "vertex", ScaleVertex(), ["y"]),
+             _Node("y", "vertex", ScaleVertex(), ["x"])]
+    with pytest.raises(ValueError):
+        _toposort(nodes, ["in"])
+
+
+def test_resnet50_builds_and_runs_tiny():
+    from deeplearning4j_tpu.zoo.resnet import ResNet50
+    # tiny input for CI speed; full 224 shape exercised in bench
+    model = ResNet50(num_classes=10, input_shape=(32, 32, 3))
+    g = model.init()
+    assert g.num_params() > 20_000_000  # ~23.5M backbone+head
+    x = np.random.default_rng(0).normal(size=(2, 32, 32, 3)).astype(
+        np.float32)
+    out = g.output(x)[0]
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(np.asarray(out).sum(1), 1.0, rtol=1e-4)
+    y = np.eye(10, dtype=np.float32)[[0, 1]]
+    g.fit(x, y)
+    assert np.isfinite(g.score())
+
+
+def test_graph_checkpoint_without_input_types(tmp_path):
+    """Graphs initialized via explicit input_shapes must restore."""
+    conf = (NeuralNetConfiguration.builder().seed(9)
+            .updater(upd.Adam(learning_rate=0.05))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=4, activation="tanh"), "in")
+            .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                          loss="mcxent"), "d")
+            .set_outputs("out").build())
+    g = ComputationGraph(conf).init(input_shapes={"in": (2,)})
+    g.fit(XOR_X, XOR_Y)
+    p = tmp_path / "g.zip"
+    ModelSerializer.write_model(g, p)
+    g2 = ModelSerializer.restore_computation_graph(p)
+    np.testing.assert_allclose(np.asarray(g.output(XOR_X)[0]),
+                               np.asarray(g2.output(XOR_X)[0]),
+                               rtol=1e-6)

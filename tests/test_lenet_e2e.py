@@ -1,0 +1,30 @@
+"""End-to-end slice (SURVEY §7 step 3 / BASELINE config #1):
+MNIST reader → LeNet config → fit → Evaluation → checkpoint/resume.
+Reference analog: dl4j-examples LeNetMnistExample + IntegrationTestsDL4J.
+"""
+import numpy as np
+
+from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.serialization import ModelSerializer
+from deeplearning4j_tpu.zoo import LeNet
+
+
+def test_lenet_mnist_end_to_end(tmp_path):
+    train_it = MnistDataSetIterator(batch_size=64, train=True,
+                                    n_examples=2048)
+    test_it = MnistDataSetIterator(batch_size=256, train=False,
+                                   n_examples=512)
+    net = LeNet(num_classes=10, seed=123).init()
+    assert net.num_params() > 100_000
+
+    net.fit(train_it, epochs=2)
+    e = net.evaluate(test_it)
+    # synthetic digits are separable; LeNet should nail them quickly
+    assert e.accuracy() > 0.97, e.stats()
+
+    path = tmp_path / "lenet.zip"
+    ModelSerializer.write_model(net, path)
+    net2 = ModelSerializer.restore_multi_layer_network(path)
+    x = next(iter(test_it)).features[:8]
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(net2.output(x)), rtol=1e-5)

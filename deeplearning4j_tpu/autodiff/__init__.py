@@ -1,0 +1,9 @@
+"""Graph-building autodiff frontend (reference: org.nd4j.autodiff).
+
+See :mod:`deeplearning4j_tpu.autodiff.samediff`.
+"""
+from deeplearning4j_tpu.autodiff.samediff import (SameDiff, SDVariable,
+                                                  TrainingConfig)
+from deeplearning4j_tpu.autodiff.ops_registry import OPS
+
+__all__ = ["SameDiff", "SDVariable", "TrainingConfig", "OPS"]

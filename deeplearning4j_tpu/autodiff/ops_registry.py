@@ -1,0 +1,310 @@
+"""Op registry for the graph-building autodiff frontend.
+
+Reference: the ``DifferentialFunction`` op factories reachable from
+``org.nd4j.autodiff.samediff.SameDiff`` (``sd.math()``, ``sd.nn()``,
+``sd.loss()``, ``sd.cnn()`` namespaces) and the op classes under
+``org.nd4j.linalg.api.ops.impl.*``.
+
+TPU-native design: each op is a **named, pure, jax-traceable function**.
+Recording ops by registry name (plus static kwargs) instead of closures
+makes the graph serializable (reference: FlatBuffers graph format) while
+the whole graph still traces into ONE ``jax.jit`` program — XLA replaces
+the reference's per-op JNI dispatch (`InferenceSession.doExec`).
+Gradients come from ``jax.grad`` over the traced graph instead of
+per-op ``doDiff`` reverse-graph construction.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+OPS: Dict[str, Callable] = {}
+
+
+def op(name: str):
+    def deco(fn):
+        OPS[name] = fn
+        return fn
+    return deco
+
+
+def get_op(name: str) -> Callable:
+    if name not in OPS:
+        raise KeyError(f"Unknown samediff op {name!r}; known: "
+                       f"{sorted(OPS)[:20]}…")
+    return OPS[name]
+
+
+# --- arithmetic / math (reference sd.math()) -------------------------------
+op("add")(lambda a, b: a + b)
+op("sub")(lambda a, b: a - b)
+op("mul")(lambda a, b: a * b)
+op("div")(lambda a, b: a / b)
+op("rsub")(lambda a, b: b - a)
+op("rdiv")(lambda a, b: b / a)
+op("pow")(lambda a, b: a ** b)
+op("neg")(lambda a: -a)
+op("abs")(jnp.abs)
+op("exp")(jnp.exp)
+op("log")(jnp.log)
+op("log1p")(jnp.log1p)
+op("sqrt")(jnp.sqrt)
+op("square")(jnp.square)
+op("reciprocal")(lambda a: 1.0 / a)
+op("sign")(jnp.sign)
+op("floor")(jnp.floor)
+op("ceil")(jnp.ceil)
+op("round")(jnp.round)
+op("clip_by_value")(lambda a, *, min, max: jnp.clip(a, min, max))
+op("sin")(jnp.sin)
+op("cos")(jnp.cos)
+op("tan")(jnp.tan)
+op("asin")(jnp.arcsin)
+op("acos")(jnp.arccos)
+op("atan")(jnp.arctan)
+op("sinh")(jnp.sinh)
+op("cosh")(jnp.cosh)
+op("tanh")(jnp.tanh)
+op("erf")(jax.scipy.special.erf)
+op("maximum")(jnp.maximum)
+op("minimum")(jnp.minimum)
+op("floormod")(jnp.mod)
+op("squared_difference")(lambda a, b: jnp.square(a - b))
+
+
+@op("matmul")
+def _matmul(a, b, *, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+op("tensordot")(lambda a, b, *, axes: jnp.tensordot(a, b, axes=axes))
+op("dot")(lambda a, b: jnp.dot(a, b))
+
+# --- comparisons / logical --------------------------------------------------
+op("eq")(lambda a, b: (a == b))
+op("neq")(lambda a, b: (a != b))
+op("gt")(lambda a, b: (a > b))
+op("gte")(lambda a, b: (a >= b))
+op("lt")(lambda a, b: (a < b))
+op("lte")(lambda a, b: (a <= b))
+op("logical_and")(jnp.logical_and)
+op("logical_or")(jnp.logical_or)
+op("logical_not")(jnp.logical_not)
+op("where")(jnp.where)
+op("is_nan")(jnp.isnan)
+op("is_inf")(jnp.isinf)
+
+
+# --- reductions -------------------------------------------------------------
+def _red(fn):
+    def run(a, *, axis=None, keepdims=False):
+        if isinstance(axis, list):
+            axis = tuple(axis)
+        return fn(a, axis=axis, keepdims=keepdims)
+    return run
+
+
+op("sum")(_red(jnp.sum))
+op("mean")(_red(jnp.mean))
+op("max")(_red(jnp.max))
+op("min")(_red(jnp.min))
+op("prod")(_red(jnp.prod))
+op("std")(_red(jnp.std))
+op("variance")(_red(jnp.var))
+op("norm1")(_red(lambda a, axis, keepdims: jnp.sum(jnp.abs(a), axis=axis,
+                                                   keepdims=keepdims)))
+op("norm2")(_red(lambda a, axis, keepdims: jnp.sqrt(
+    jnp.sum(jnp.square(a), axis=axis, keepdims=keepdims))))
+op("argmax")(lambda a, *, axis=-1: jnp.argmax(a, axis=axis))
+op("argmin")(lambda a, *, axis=-1: jnp.argmin(a, axis=axis))
+op("cumsum")(lambda a, *, axis=0: jnp.cumsum(a, axis=axis))
+op("cumprod")(lambda a, *, axis=0: jnp.cumprod(a, axis=axis))
+op("logsumexp")(lambda a, *, axis=None, keepdims=False:
+                jax.scipy.special.logsumexp(a, axis=axis, keepdims=keepdims))
+
+
+# --- shape ops --------------------------------------------------------------
+op("reshape")(lambda a, *, shape: jnp.reshape(a, shape))
+op("transpose")(lambda a, *, axes=None: jnp.transpose(a, axes))
+op("permute")(lambda a, *, axes: jnp.transpose(a, axes))
+op("expand_dims")(lambda a, *, axis: jnp.expand_dims(a, axis))
+op("squeeze")(lambda a, *, axis=None: jnp.squeeze(a, axis))
+op("concat")(lambda *arrs, axis: jnp.concatenate(arrs, axis=axis))
+op("stack")(lambda *arrs, axis=0: jnp.stack(arrs, axis=axis))
+op("unstack")(lambda a, *, axis=0, num: tuple(
+    jnp.squeeze(s, axis) for s in jnp.split(a, num, axis)))
+op("split")(lambda a, *, num, axis=0: tuple(jnp.split(a, num, axis)))
+op("tile")(lambda a, *, reps: jnp.tile(a, reps))
+op("gather")(lambda a, idx, *, axis=0: jnp.take(a, idx.astype(jnp.int32),
+                                                axis=axis))
+op("slice")(lambda a, *, begin, size: jax.lax.dynamic_slice(
+    a, begin, size))
+op("strided_slice")(lambda a, *, begin, end, strides=None: a[tuple(
+    slice(b, e, s) for b, e, s in zip(begin, end,
+                                      strides or [1] * len(begin)))])
+
+
+@op("getitem")
+def _getitem(a, *, spec):
+    idx = []
+    for s in spec:
+        if s["t"] == "int":
+            idx.append(s["v"])
+        else:
+            idx.append(slice(s["start"], s["stop"], s["step"]))
+    return a[tuple(idx)]
+op("cast")(lambda a, *, dtype: a.astype(dtype))
+op("shape_of")(lambda a: jnp.asarray(a.shape, jnp.int32))
+op("one_hot")(lambda a, *, depth: jax.nn.one_hot(a.astype(jnp.int32), depth))
+op("reverse")(lambda a, *, axis: jnp.flip(a, axis))
+op("pad")(lambda a, *, paddings, mode="constant", value=0.0:
+          jnp.pad(a, paddings, mode=mode,
+                  **({"constant_values": value} if mode == "constant"
+                     else {})))
+
+
+# --- activations / nn (reference sd.nn()) ----------------------------------
+op("sigmoid")(jax.nn.sigmoid)
+op("softmax")(lambda a, *, axis=-1: jax.nn.softmax(a, axis=axis))
+op("log_softmax")(lambda a, *, axis=-1: jax.nn.log_softmax(a, axis=axis))
+op("relu")(jax.nn.relu)
+op("relu6")(jax.nn.relu6)
+op("leaky_relu")(lambda a, *, alpha=0.01: jax.nn.leaky_relu(a, alpha))
+op("elu")(jax.nn.elu)
+op("selu")(jax.nn.selu)
+op("gelu")(jax.nn.gelu)
+op("softplus")(jax.nn.softplus)
+op("softsign")(jax.nn.soft_sign)
+op("swish")(jax.nn.swish)
+op("hard_sigmoid")(jax.nn.hard_sigmoid)
+op("hard_tanh")(lambda a: jnp.clip(a, -1.0, 1.0))
+op("linear")(lambda x, w, b: jnp.matmul(x, w) + b)      # xwPlusB
+op("bias_add")(lambda x, b: x + b)
+
+
+@op("layer_norm")
+def _layer_norm(x, gain, bias, *, axis=-1, eps=1e-5):
+    mu = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    return gain * (x - mu) / jnp.sqrt(var + eps) + bias
+
+
+@op("batch_norm")
+def _batch_norm(x, mean, var, gamma, beta, *, eps=1e-5):
+    return gamma * (x - mean) / jnp.sqrt(var + eps) + beta
+
+
+@op("dropout")
+def _dropout(x, *, rate, seed, deterministic=True):
+    if deterministic or rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    m = jax.random.bernoulli(jax.random.PRNGKey(seed), keep, x.shape)
+    return jnp.where(m, x / keep, 0.0).astype(x.dtype)
+
+
+@op("conv2d")
+def _conv2d(x, w, *, strides=(1, 1), padding="SAME"):
+    # x: NHWC, w: HWIO — TPU-native layouts
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=tuple(strides), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+@op("max_pooling2d")
+def _maxpool2d(x, *, kernel=(2, 2), strides=(2, 2), padding="VALID"):
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1,) + tuple(kernel) + (1,),
+        (1,) + tuple(strides) + (1,), padding)
+
+
+@op("avg_pooling2d")
+def _avgpool2d(x, *, kernel=(2, 2), strides=(2, 2), padding="VALID"):
+    s = jax.lax.reduce_window(
+        x, 0.0, jax.lax.add, (1,) + tuple(kernel) + (1,),
+        (1,) + tuple(strides) + (1,), padding)
+    ones = jnp.ones_like(x)
+    cnt = jax.lax.reduce_window(
+        ones, 0.0, jax.lax.add, (1,) + tuple(kernel) + (1,),
+        (1,) + tuple(strides) + (1,), padding)
+    return s / cnt
+
+
+@op("dot_product_attention")
+def _dpa(q, k, v, *, scale=None):
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d)
+    a = jax.nn.softmax(jnp.einsum("...qd,...kd->...qk", q, k) * scale, -1)
+    return jnp.einsum("...qk,...kd->...qd", a, v)
+
+
+# --- losses (reference sd.loss()) ------------------------------------------
+@op("loss_mse")
+def _loss_mse(labels, preds):
+    return jnp.mean(jnp.square(labels - preds))
+
+
+@op("loss_mae")
+def _loss_mae(labels, preds):
+    return jnp.mean(jnp.abs(labels - preds))
+
+
+@op("loss_softmax_cross_entropy")
+def _loss_smce(labels, logits):
+    return -jnp.mean(jnp.sum(labels * jax.nn.log_softmax(logits, -1), -1))
+
+
+@op("loss_sparse_softmax_cross_entropy")
+def _loss_ssmce(labels, logits):
+    ll = jax.nn.log_softmax(logits, -1)
+    return -jnp.mean(jnp.take_along_axis(
+        ll, labels.astype(jnp.int32)[..., None], -1))
+
+
+@op("loss_sigmoid_cross_entropy")
+def _loss_sigce(labels, logits):
+    return jnp.mean(jnp.maximum(logits, 0) - logits * labels
+                    + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+@op("loss_log")
+def _loss_log(labels, preds, *, eps=1e-7):
+    p = jnp.clip(preds, eps, 1 - eps)
+    return -jnp.mean(labels * jnp.log(p) + (1 - labels) * jnp.log1p(-p))
+
+
+@op("loss_huber")
+def _loss_huber(labels, preds, *, delta=1.0):
+    err = jnp.abs(labels - preds)
+    quad = jnp.minimum(err, delta)
+    return jnp.mean(0.5 * quad ** 2 + delta * (err - quad))
+
+
+@op("loss_cosine_distance")
+def _loss_cosd(labels, preds, *, axis=-1):
+    return jnp.mean(1.0 - jnp.sum(labels * preds, axis=axis))
+
+
+# --- random (seeded per-node: deterministic under retrace) ------------------
+@op("random_normal")
+def _random_normal(*, shape, seed, mean=0.0, stddev=1.0):
+    return mean + stddev * jax.random.normal(jax.random.PRNGKey(seed),
+                                             tuple(shape))
+
+
+@op("random_uniform")
+def _random_uniform(*, shape, seed, minval=0.0, maxval=1.0):
+    return jax.random.uniform(jax.random.PRNGKey(seed), tuple(shape),
+                              minval=minval, maxval=maxval)
+
+
+@op("random_bernoulli")
+def _random_bernoulli(*, shape, seed, p=0.5):
+    return jax.random.bernoulli(jax.random.PRNGKey(seed), p,
+                                tuple(shape)).astype(jnp.float32)

@@ -1,0 +1,578 @@
+"""Graph-building autodiff frontend (reference: SameDiff).
+
+Reference classes: ``org.nd4j.autodiff.samediff.SameDiff``,
+``SDVariable``, ``internal.InferenceSession`` (topological op-by-op
+executor), ``internal.TrainingSession`` (adds updater application),
+``TrainingConfig``, and FlatBuffers serialization (``sd.asFlatFile``).
+
+TPU-native redesign: the graph records **registry op names + static
+kwargs** (serializable like the FlatBuffers format), but execution does
+NOT walk the graph op-by-op through an executioner. Instead the whole
+requested subgraph is replayed inside one ``jax.jit`` trace, so XLA
+sees a single fused program — the reference's per-op JNI dispatch
+(`InferenceSession.doExec` → `NativeOpExecutioner.exec`) has no
+equivalent cost here. Gradients: ``jax.grad`` over the same trace
+replaces reverse-graph construction (`SameDiff.createGradFunction` /
+per-op `doDiff`). Training: optax replaces `TrainingSession`'s
+updater application, still inside the one jitted step.
+"""
+from __future__ import annotations
+
+import json
+import zipfile
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.autodiff.ops_registry import OPS, get_op
+from deeplearning4j_tpu.nn import updaters as upd
+
+VARIABLE = "VARIABLE"
+CONSTANT = "CONSTANT"
+PLACEHOLDER = "PLACEHOLDER"
+ARRAY = "ARRAY"          # op output
+
+
+@dataclass
+class _Node:
+    op: str                      # registry name, or "_lambda"
+    inputs: List[str]
+    outputs: List[str]
+    kwargs: Dict[str, Any] = field(default_factory=dict)
+    fn: Optional[Callable] = None    # only for _lambda (control flow)
+
+
+class SDVariable:
+    """Symbolic variable handle (reference: ``SDVariable``)."""
+
+    def __init__(self, sd: "SameDiff", name: str, vtype: str,
+                 shape=None, dtype=None):
+        self.sd = sd
+        self.name = name
+        self.vtype = vtype
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+
+    def __repr__(self):
+        return (f"SDVariable(name={self.name!r}, type={self.vtype}, "
+                f"shape={self.shape})")
+
+    # -- evaluation --------------------------------------------------------
+    def eval(self, feed: Optional[Dict[str, Any]] = None) -> np.ndarray:
+        return self.sd.output(feed or {}, [self.name])[self.name]
+
+    def get_arr(self) -> Optional[np.ndarray]:
+        return self.sd._arrays.get(self.name)
+
+    def set_arr(self, arr) -> None:
+        self.sd._arrays[self.name] = np.asarray(arr)
+        # constants are baked into traced programs as literals — any
+        # compiled fn is stale now
+        self.sd._fn_cache.clear()
+        self.sd._grad_cache.clear()
+        self.sd._train_step = None
+
+    # -- operator sugar ----------------------------------------------------
+    def _lift(self, other) -> "SDVariable":
+        if isinstance(other, SDVariable):
+            return other
+        return self.sd.constant(None, np.asarray(other, dtype=np.float32))
+
+    def __add__(self, o): return self.sd._rec("add", [self, self._lift(o)])
+    def __radd__(self, o): return self.sd._rec("add", [self._lift(o), self])
+    def __sub__(self, o): return self.sd._rec("sub", [self, self._lift(o)])
+    def __rsub__(self, o): return self.sd._rec("sub", [self._lift(o), self])
+    def __mul__(self, o): return self.sd._rec("mul", [self, self._lift(o)])
+    def __rmul__(self, o): return self.sd._rec("mul", [self._lift(o), self])
+    def __truediv__(self, o): return self.sd._rec("div",
+                                                  [self, self._lift(o)])
+    def __rtruediv__(self, o): return self.sd._rec("div",
+                                                   [self._lift(o), self])
+    def __pow__(self, o): return self.sd._rec("pow", [self, self._lift(o)])
+    def __neg__(self): return self.sd._rec("neg", [self])
+    def __matmul__(self, o): return self.mmul(o)
+
+    # -- fluent math (subset of the reference's ~400 SDVariable methods) ---
+    def add(self, o, name=None):
+        return self.sd._rec("add", [self, self._lift(o)], name=name)
+
+    def sub(self, o, name=None):
+        return self.sd._rec("sub", [self, self._lift(o)], name=name)
+
+    def mul(self, o, name=None):
+        return self.sd._rec("mul", [self, self._lift(o)], name=name)
+
+    def div(self, o, name=None):
+        return self.sd._rec("div", [self, self._lift(o)], name=name)
+
+    def mmul(self, o, name=None, transpose_a=False, transpose_b=False):
+        return self.sd._rec("matmul", [self, self._lift(o)], name=name,
+                            kwargs=dict(transpose_a=transpose_a,
+                                        transpose_b=transpose_b))
+
+    def dot(self, o, name=None):
+        return self.sd._rec("dot", [self, self._lift(o)], name=name)
+
+    def sum(self, axis=None, keepdims=False, name=None):
+        return self.sd._rec("sum", [self], name=name,
+                            kwargs=dict(axis=axis, keepdims=keepdims))
+
+    def mean(self, axis=None, keepdims=False, name=None):
+        return self.sd._rec("mean", [self], name=name,
+                            kwargs=dict(axis=axis, keepdims=keepdims))
+
+    def max(self, axis=None, keepdims=False, name=None):
+        return self.sd._rec("max", [self], name=name,
+                            kwargs=dict(axis=axis, keepdims=keepdims))
+
+    def min(self, axis=None, keepdims=False, name=None):
+        return self.sd._rec("min", [self], name=name,
+                            kwargs=dict(axis=axis, keepdims=keepdims))
+
+    def std(self, axis=None, keepdims=False, name=None):
+        return self.sd._rec("std", [self], name=name,
+                            kwargs=dict(axis=axis, keepdims=keepdims))
+
+    def norm2(self, axis=None, keepdims=False, name=None):
+        return self.sd._rec("norm2", [self], name=name,
+                            kwargs=dict(axis=axis, keepdims=keepdims))
+
+    def argmax(self, axis=-1, name=None):
+        return self.sd._rec("argmax", [self], name=name,
+                            kwargs=dict(axis=axis))
+
+    def reshape(self, *shape, name=None):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return self.sd._rec("reshape", [self], name=name,
+                            kwargs=dict(shape=list(shape)))
+
+    def transpose(self, *axes, name=None):
+        return self.sd._rec("transpose", [self], name=name,
+                            kwargs=dict(axes=list(axes) or None))
+
+    def permute(self, *axes, name=None):
+        return self.sd._rec("permute", [self], name=name,
+                            kwargs=dict(axes=list(axes)))
+
+    def cast(self, dtype, name=None):
+        return self.sd._rec("cast", [self], name=name,
+                            kwargs=dict(dtype=str(dtype)))
+
+    def __getitem__(self, idx):
+        # static basic indexing only (jit-friendly); serialized as a
+        # spec list so save/load round-trips
+        if not isinstance(idx, tuple):
+            idx = (idx,)
+        spec = []
+        for s in idx:
+            if isinstance(s, int):
+                spec.append({"t": "int", "v": s})
+            elif isinstance(s, slice):
+                spec.append({"t": "slice", "start": s.start,
+                             "stop": s.stop, "step": s.step})
+            else:
+                raise TypeError("only int/slice indexing supported")
+        return self.sd._rec("getitem", [self], kwargs=dict(spec=spec))
+
+
+class _Namespace:
+    """sd.math / sd.nn / sd.loss / sd.random namespaces.
+
+    Reference: ``SDMath``, ``SDNN``, ``SDLoss``, ``SDRandom`` op
+    namespace classes. Every registry op is exposed as a method taking
+    SDVariables (positional) + static kwargs.
+    """
+
+    def __init__(self, sd: "SameDiff", prefix: str = ""):
+        self._sd = sd
+        self._prefix = prefix
+
+    def __getattr__(self, opname):
+        full = (self._prefix + opname) if self._prefix else opname
+        if full not in OPS:
+            raise AttributeError(f"no op {full!r}")
+
+        def call(*args, name=None, **kwargs):
+            vars_, rest = [], list(args)
+            while rest and isinstance(rest[0], (SDVariable, np.ndarray,
+                                                float, int)):
+                a = rest.pop(0)
+                if not isinstance(a, SDVariable):
+                    a = self._sd.constant(
+                        None, np.asarray(a, dtype=np.float32))
+                vars_.append(a)
+            if rest:
+                raise TypeError(f"trailing positional args for {full}: "
+                                f"{rest} — pass them as keywords")
+            return self._sd._rec(full, vars_, name=name, kwargs=kwargs)
+        return call
+
+
+@dataclass
+class TrainingConfig:
+    """Reference: ``org.nd4j.autodiff.samediff.TrainingConfig``."""
+    updater: Any = None                       # nn.updaters bean or optax tx
+    data_set_feature_mapping: List[str] = field(default_factory=list)
+    data_set_label_mapping: List[str] = field(default_factory=list)
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    loss_variables: Optional[List[str]] = None
+
+
+class SameDiff:
+    """Define-by-run graph builder + jit executor."""
+
+    def __init__(self):
+        self._vars: Dict[str, SDVariable] = {}
+        self._arrays: Dict[str, np.ndarray] = {}
+        self._nodes: List[_Node] = []
+        self._producer: Dict[str, _Node] = {}
+        self._loss_names: List[str] = []
+        self._counter = 0
+        self._fn_cache: Dict[Tuple, Callable] = {}
+        self._grad_cache: Dict[Tuple, Callable] = {}
+        self._train_step = None
+        self._opt_state = None
+        self._training_config: Optional[TrainingConfig] = None
+        self.math = _Namespace(self)
+        self.nn = _Namespace(self)
+        self.loss = _Namespace(self, prefix="loss_")
+        self.random = _Namespace(self, prefix="random_")
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def create(cls) -> "SameDiff":
+        return cls()
+
+    def _unique(self, base: str) -> str:
+        self._counter += 1
+        name = f"{base}_{self._counter}"
+        while name in self._vars:
+            self._counter += 1
+            name = f"{base}_{self._counter}"
+        return name
+
+    def _new_var(self, name, vtype, shape=None, dtype=None) -> SDVariable:
+        if name is None:
+            name = self._unique(vtype.lower())
+        if name in self._vars:
+            raise ValueError(f"variable {name!r} already exists")
+        v = SDVariable(self, name, vtype, shape, dtype)
+        self._vars[name] = v
+        return v
+
+    def var(self, name=None, arr=None, shape=None,
+            dtype=jnp.float32) -> SDVariable:
+        """Trainable variable (reference sd.var)."""
+        if isinstance(name, (np.ndarray, jnp.ndarray)) and arr is None:
+            name, arr = None, name
+        if arr is not None:
+            arr = np.asarray(arr)
+            v = self._new_var(name, VARIABLE, arr.shape, arr.dtype)
+            self._arrays[v.name] = arr
+        else:
+            if shape is None:
+                raise ValueError("var needs an array or a shape")
+            # crc32 (not hash()) so init is reproducible across
+            # processes; counter so unnamed same-shape vars differ
+            import zlib
+            seed = zlib.crc32((name or f"v{self._counter}").encode()) \
+                + self._counter
+            rng = np.random.default_rng(seed % (2**31))
+            fan_in = shape[0] if len(shape) >= 1 else 1
+            arr = (rng.standard_normal(shape)
+                   / np.sqrt(max(fan_in, 1))).astype(np.float32)
+            v = self._new_var(name, VARIABLE, shape, dtype)
+            self._arrays[v.name] = arr
+        return v
+
+    def constant(self, name=None, arr=None) -> SDVariable:
+        if isinstance(name, (np.ndarray, jnp.ndarray, float, int)) \
+                and arr is None:
+            name, arr = None, name
+        arr = np.asarray(arr)
+        v = self._new_var(name, CONSTANT, arr.shape, arr.dtype)
+        self._arrays[v.name] = arr
+        return v
+
+    def placeholder(self, name, dtype=jnp.float32, *shape) -> SDVariable:
+        return self._new_var(name, PLACEHOLDER,
+                             shape if shape else None, dtype)
+
+    place_holder = placeholder      # reference spelling: sd.placeHolder
+
+    def variables(self) -> List[SDVariable]:
+        return [v for v in self._vars.values() if v.vtype == VARIABLE]
+
+    def get_variable(self, name) -> SDVariable:
+        return self._vars[name]
+
+    # -- recording ---------------------------------------------------------
+    def _rec(self, opname: str, inputs: Sequence[SDVariable], name=None,
+             kwargs=None, n_out: int = 1, fn=None):
+        kwargs = {k: v for k, v in (kwargs or {}).items() if v is not None
+                  or k in ("axis",)}
+        if opname.startswith("random_") or opname == "dropout":
+            kwargs.setdefault("seed", self._counter + 7919)
+        outs = []
+        for i in range(n_out):
+            nm = name if (name and n_out == 1) else \
+                self._unique(name or opname)
+            outs.append(self._new_var(nm, ARRAY))
+        node = _Node(op=opname, inputs=[v.name for v in inputs],
+                     outputs=[v.name for v in outs], kwargs=kwargs, fn=fn)
+        self._nodes.append(node)
+        for o in outs:
+            self._producer[o.name] = node
+        self._fn_cache.clear()
+        self._grad_cache.clear()
+        self._train_step = None
+        return outs[0] if n_out == 1 else tuple(outs)
+
+    # -- control flow (reference: sd.ifCond / sd.whileLoop) -----------------
+    def while_loop(self, cond_fn, body_fn, loop_vars, name=None):
+        """lax.while_loop over SDVariables. cond_fn/body_fn take and
+        return raw jax arrays (traced); recorded as a non-serializable
+        lambda node."""
+        n = len(loop_vars)
+
+        def run(*arrs):
+            out = jax.lax.while_loop(lambda vs: cond_fn(*vs),
+                                     lambda vs: tuple(body_fn(*vs)),
+                                     tuple(arrs))
+            return out if n > 1 else out[0]
+        return self._rec("_lambda", list(loop_vars), name=name,
+                         n_out=n, fn=run)
+
+    def if_cond(self, pred, true_fn, false_fn, operands, name=None):
+        def run(p, *arrs):
+            return jax.lax.cond(p.astype(bool).reshape(()),
+                                lambda vs: true_fn(*vs),
+                                lambda vs: false_fn(*vs), tuple(arrs))
+        return self._rec("_lambda", [pred] + list(operands), name=name,
+                         fn=run)
+
+    # -- execution ---------------------------------------------------------
+    def _ancestors(self, out_names: Sequence[str]) -> List[_Node]:
+        needed, order, seen = set(out_names), [], set()
+
+        def visit(name):
+            node = self._producer.get(name)
+            if node is None or id(node) in seen:
+                return
+            seen.add(id(node))
+            for i in node.inputs:
+                visit(i)
+            order.append(node)
+        for n in out_names:
+            visit(n)
+        return order
+
+    def _replay(self, values: Dict[str, Any],
+                out_names: Sequence[str]) -> Tuple:
+        for node in self._ancestors(out_names):
+            args = [values[i] for i in node.inputs]
+            fn = node.fn if node.op == "_lambda" else get_op(node.op)
+            res = fn(*args, **node.kwargs)
+            if len(node.outputs) == 1:
+                values[node.outputs[0]] = res
+            else:
+                for o, r in zip(node.outputs, res):
+                    values[o] = r
+        return tuple(values[n] for n in out_names)
+
+    def _build_fn(self, out_names: Tuple[str, ...]) -> Callable:
+        if out_names not in self._fn_cache:
+            def fn(variables, placeholders):
+                values = dict(self._const_values())
+                values.update(variables)
+                values.update(placeholders)
+                return self._replay(values, out_names)
+            self._fn_cache[out_names] = jax.jit(fn)
+        return self._fn_cache[out_names]
+
+    def _const_values(self):
+        return {n: self._arrays[n] for n, v in self._vars.items()
+                if v.vtype == CONSTANT}
+
+    def _var_values(self):
+        return {n: self._arrays[n] for n, v in self._vars.items()
+                if v.vtype == VARIABLE}
+
+    def output(self, feed: Dict[str, Any],
+               outputs: Sequence[str]) -> Dict[str, np.ndarray]:
+        """Execute the subgraph for ``outputs`` (reference
+        InferenceSession.output), whole-graph jitted."""
+        out_names = tuple(o.name if isinstance(o, SDVariable) else o
+                          for o in outputs)
+        fn = self._build_fn(out_names)
+        feed = {k: jnp.asarray(v) for k, v in feed.items()}
+        res = fn(self._var_values(), feed)
+        return {n: np.asarray(r) for n, r in zip(out_names, res)}
+
+    exec = output
+
+    # -- autodiff ----------------------------------------------------------
+    def set_loss_variables(self, *names) -> None:
+        self._loss_names = [n.name if isinstance(n, SDVariable) else n
+                            for n in names]
+        self._train_step = None
+
+    def _loss_fn(self, out: Tuple[str, ...]) -> Callable:
+        def loss_fn(variables, placeholders):
+            vals = self._replay({**self._const_values(), **variables,
+                                 **placeholders}, out)
+            return sum(jnp.sum(v) for v in vals)
+        return loss_fn
+
+    def calculate_gradients(self, feed: Dict[str, Any],
+                            wrt: Sequence[str]) -> Dict[str, np.ndarray]:
+        """d(sum of loss variables)/d(wrt) (reference
+        sd.calculateGradients; the reverse graph is jax.grad)."""
+        if not self._loss_names:
+            raise ValueError("call set_loss_variables first")
+        wrt = tuple(w.name if isinstance(w, SDVariable) else w for w in wrt)
+        out = tuple(self._loss_names)
+        key = (out, wrt)
+        if key not in self._grad_cache:
+            def loss_fn(wrt_vals, rest_vals, placeholders):
+                vals = {**self._const_values(), **rest_vals,
+                        **placeholders, **wrt_vals}
+                res = self._replay(vals, out)
+                return sum(jnp.sum(v) for v in res)
+            self._grad_cache[key] = jax.jit(jax.grad(loss_fn, argnums=0))
+        feed = {k: jnp.asarray(v) for k, v in feed.items()}
+        var_vals = self._var_values()
+        wrt_vals = {}
+        for n in wrt:
+            if n in var_vals:
+                wrt_vals[n] = var_vals.pop(n)
+            elif n in feed:
+                wrt_vals[n] = feed.pop(n)
+            else:
+                raise ValueError(
+                    f"wrt {n!r} is not a variable and not in the feed")
+        grads = self._grad_cache[key](wrt_vals, var_vals, feed)
+        return {n: np.asarray(g) for n, g in grads.items()}
+
+    # -- training ----------------------------------------------------------
+    def set_training_config(self, cfg: TrainingConfig) -> None:
+        self._training_config = cfg
+        self._train_step = None
+        self._opt_state = None
+
+    def _make_train_step(self):
+        cfg = self._training_config
+        loss_names = tuple(cfg.loss_variables or self._loss_names)
+        if not loss_names:
+            raise ValueError("no loss variables: set_loss_variables or "
+                             "TrainingConfig.loss_variables")
+        updater = cfg.updater or upd.Adam(learning_rate=1e-3)
+        tx = updater.to_optax() if hasattr(updater, "to_optax") else updater
+        loss_fn = self._loss_fn(loss_names)
+
+        def reg(variables):
+            r = 0.0
+            if cfg.l2:
+                r = r + cfg.l2 * sum(jnp.sum(jnp.square(v))
+                                     for v in variables.values())
+            if cfg.l1:
+                r = r + cfg.l1 * sum(jnp.sum(jnp.abs(v))
+                                     for v in variables.values())
+            return r
+
+        def step(variables, opt_state, placeholders):
+            def total(vs):
+                return loss_fn(vs, placeholders) + reg(vs)
+            loss, grads = jax.value_and_grad(total)(variables)
+            updates, opt_state = tx.update(grads, opt_state, variables)
+            variables = optax.apply_updates(variables, updates)
+            return variables, opt_state, loss
+        return jax.jit(step), tx
+
+    def fit(self, iterator, epochs: int = 1) -> List[float]:
+        """Train (reference SameDiff.fit → TrainingSession)."""
+        cfg = self._training_config
+        if cfg is None:
+            raise ValueError("set_training_config first")
+        if self._train_step is None:
+            self._train_step, tx = self._make_train_step()
+            self._opt_state = tx.init(
+                {k: jnp.asarray(v) for k, v in self._var_values().items()})
+        variables = {k: jnp.asarray(v)
+                     for k, v in self._var_values().items()}
+        losses = []
+        for _ in range(epochs):
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for ds in iterator:
+                feats = ds.features if hasattr(ds, "features") else ds[0]
+                labs = ds.labels if hasattr(ds, "labels") else ds[1]
+                feats = feats if isinstance(feats, (list, tuple)) \
+                    else [feats]
+                labs = labs if isinstance(labs, (list, tuple)) else [labs]
+                feed = {n: jnp.asarray(a) for n, a in
+                        list(zip(cfg.data_set_feature_mapping, feats)) +
+                        list(zip(cfg.data_set_label_mapping, labs))}
+                variables, self._opt_state, loss = self._train_step(
+                    variables, self._opt_state, feed)
+                losses.append(float(loss))
+        for k, v in variables.items():
+            self._arrays[k] = np.asarray(v)
+        return losses
+
+    # -- serialization (reference: sd.asFlatFile / fromFlatFile) -----------
+    def save(self, path: str) -> None:
+        if any(n.op == "_lambda" for n in self._nodes):
+            raise ValueError("graphs with python control-flow lambdas "
+                             "are not serializable")
+        meta = {
+            "vars": [{"name": v.name, "type": v.vtype,
+                      "shape": list(v.shape) if v.shape else None}
+                     for v in self._vars.values()],
+            "nodes": [{"op": n.op, "inputs": n.inputs,
+                       "outputs": n.outputs, "kwargs": n.kwargs}
+                      for n in self._nodes],
+            "loss": self._loss_names,
+        }
+        with zipfile.ZipFile(path, "w") as zf:
+            zf.writestr("graph.json", json.dumps(meta))
+            import io
+            buf = io.BytesIO()
+            np.savez(buf, **self._arrays)
+            zf.writestr("arrays.npz", buf.getvalue())
+
+    @classmethod
+    def load(cls, path: str) -> "SameDiff":
+        sd = cls()
+        with zipfile.ZipFile(path) as zf:
+            meta = json.loads(zf.read("graph.json"))
+            import io
+            arrs = np.load(io.BytesIO(zf.read("arrays.npz")))
+            for vd in meta["vars"]:
+                v = SDVariable(sd, vd["name"], vd["type"],
+                               vd["shape"])
+                sd._vars[v.name] = v
+            for name in arrs.files:
+                sd._arrays[name] = arrs[name]
+            for nd in meta["nodes"]:
+                node = _Node(op=nd["op"], inputs=nd["inputs"],
+                             outputs=nd["outputs"], kwargs=nd["kwargs"])
+                sd._nodes.append(node)
+                for o in node.outputs:
+                    sd._producer[o] = node
+            sd._loss_names = meta["loss"]
+        return sd
+
+    def summary(self) -> str:
+        lines = [f"SameDiff: {len(self._vars)} vars, "
+                 f"{len(self._nodes)} ops"]
+        for n in self._nodes:
+            lines.append(f"  {','.join(n.outputs)} = {n.op}"
+                         f"({','.join(n.inputs)})")
+        return "\n".join(lines)

@@ -282,6 +282,9 @@ class ComputationGraph:
     def _out_loss(self, name):
         node = next(n for n in self.order if n.name == name)
         layer = node.obj
+        if hasattr(layer, "compute_loss_fn"):
+            # layer-defined loss (e.g. Yolo2OutputLayer) — never fused
+            return layer.compute_loss_fn(), False
         loss_name = getattr(layer, "loss", None)
         if loss_name is None:
             raise ValueError(f"output {name!r} has no loss")

@@ -36,5 +36,6 @@ from deeplearning4j_tpu.nn.layers.special import (
     AutoEncoder, VariationalAutoencoder, CenterLossOutputLayer,
     FrozenLayer, LambdaLayer, CapsuleLayer, PReLULayer,
 )
+from deeplearning4j_tpu.nn.layers.objdetect import Yolo2OutputLayer
 
 __all__ = [n for n in dir() if not n.startswith("_")]

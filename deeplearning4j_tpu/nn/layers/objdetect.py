@@ -1,0 +1,130 @@
+"""Object-detection output layer (reference:
+``org.deeplearning4j.nn.layers.objdetect.Yolo2OutputLayer`` +
+``conf.layers.objdetect.Yolo2OutputLayer`` config bean and
+``YoloUtils``).
+
+TPU-native layout: activations are NHWC ``[B, H, W, A*(5+C)]`` (the
+reference uses NCHW ``[B, A*(5+C), H, W]``). Labels are
+``[B, H, W, 4+C]``: per grid cell a box (cx, cy, w, h) in *grid units*
+plus a one-hot class; cells with no object have w == h == 0. The
+responsible anchor per object cell is chosen by max IOU of (w, h)
+against the anchor priors — the YOLOv2 training rule.
+
+The whole loss is one fused XLA program inside the network's jitted
+train step (the reference computes it op-by-op through libnd4j).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+def _iou_wh(wh1, wh2):
+    """IOU of two boxes sharing a center, given (w, h) only."""
+    inter = jnp.minimum(wh1[..., 0], wh2[..., 0]) * \
+        jnp.minimum(wh1[..., 1], wh2[..., 1])
+    union = wh1[..., 0] * wh1[..., 1] + wh2[..., 0] * wh2[..., 1] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def _iou_xywh(xy1, wh1, xy2, wh2):
+    """Full IOU of center-format boxes (positions included)."""
+    lo = jnp.maximum(xy1 - wh1 / 2, xy2 - wh2 / 2)
+    hi = jnp.minimum(xy1 + wh1 / 2, xy2 + wh2 / 2)
+    inter = jnp.prod(jnp.maximum(hi - lo, 0.0), -1)
+    union = wh1[..., 0] * wh1[..., 1] + wh2[..., 0] * wh2[..., 1] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+@register_layer
+@dataclass
+class Yolo2OutputLayer(Layer):
+    """YOLOv2 loss head. No params; input [B,H,W,A*(5+C)]."""
+    anchors: Sequence[Sequence[float]] = \
+        field(default_factory=lambda: [[1.0, 1.0], [2.0, 2.0]])
+    num_classes: int = 1
+    lambda_coord: float = 5.0
+    lambda_no_obj: float = 0.5
+
+    # -- Layer interface ---------------------------------------------------
+    def init(self, key, input_shape, dtype=jnp.float32):
+        a, c = len(self.anchors), self.num_classes
+        expect = a * (5 + c)
+        if input_shape[-1] != expect:
+            raise ValueError(
+                f"Yolo2OutputLayer needs {expect} channels "
+                f"(A={a} × (5+C={5 + c})), got {input_shape[-1]}")
+        return {}, {}, tuple(input_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return x, state
+
+    def has_params(self):
+        return False
+
+    # -- decoding (reference YoloUtils.getPredictedObjects) ---------------
+    def activate_predictions(self, x):
+        """Raw logits [B,H,W,A*(5+C)] → dict of activated tensors in
+        grid units: xy [B,H,W,A,2], wh, conf [B,H,W,A], cls
+        [B,H,W,A,C]."""
+        b, h, w, _ = x.shape
+        a, c = len(self.anchors), self.num_classes
+        x = x.reshape(b, h, w, a, 5 + c)
+        anchors = jnp.asarray(self.anchors, x.dtype)
+        cell_x = jnp.arange(w, dtype=x.dtype)[None, None, :, None]
+        cell_y = jnp.arange(h, dtype=x.dtype)[None, :, None, None]
+        xy = jax.nn.sigmoid(x[..., 0:2])
+        xy = xy.at[..., 0].add(cell_x).at[..., 1].add(cell_y)
+        wh = anchors * jnp.exp(x[..., 2:4])
+        conf = jax.nn.sigmoid(x[..., 4])
+        cls = jax.nn.softmax(x[..., 5:], axis=-1)
+        return {"xy": xy, "wh": wh, "conf": conf, "cls": cls}
+
+    # -- loss (reference Yolo2OutputLayer.computeScore) --------------------
+    def compute_loss_fn(self):
+        anchors = jnp.asarray(self.anchors, jnp.float32)
+        a = len(self.anchors)
+        lc, ln = self.lambda_coord, self.lambda_no_obj
+
+        def loss(labels, preds, mask=None, weights=None):
+            p = self.activate_predictions(preds)
+            obj = (labels[..., 2] > 0).astype(preds.dtype)   # [B,H,W]
+            # responsible anchor per object cell: max IOU vs priors
+            lab_wh = labels[..., 2:4]                        # [B,H,W,2]
+            ious = _iou_wh(lab_wh[..., None, :],
+                           anchors[None, None, None, :, :])  # [B,H,W,A]
+            resp = jax.nn.one_hot(jnp.argmax(ious, -1), a,
+                                  dtype=preds.dtype)         # [B,H,W,A]
+            resp = resp * obj[..., None]
+            n_obj = jnp.maximum(jnp.sum(obj), 1.0)
+
+            # coord loss (responsible anchors only); sqrt-wh as in YOLO
+            xy_err = jnp.sum(jnp.square(
+                p["xy"] - labels[..., None, 0:2]), -1)
+            wh_err = jnp.sum(jnp.square(
+                jnp.sqrt(jnp.maximum(p["wh"], 1e-9)) -
+                jnp.sqrt(jnp.maximum(labels[..., None, 2:4], 0.0))), -1)
+            coord = lc * jnp.sum(resp * (xy_err + wh_err)) / n_obj
+
+            # confidence: responsible → full IOU with truth (position
+            # included, the YOLOv2 target); others → 0
+            pred_iou = _iou_xywh(p["xy"], p["wh"],
+                                 labels[..., None, 0:2],
+                                 labels[..., None, 2:4])
+            conf_obj = jnp.sum(resp * jnp.square(
+                p["conf"] - jax.lax.stop_gradient(pred_iou))) / n_obj
+            conf_noobj = ln * jnp.sum(
+                (1.0 - resp) * jnp.square(p["conf"])) / \
+                jnp.maximum(jnp.sum(1.0 - resp), 1.0)
+
+            # class cross-entropy on object cells
+            cls_ce = -jnp.sum(
+                resp * jnp.sum(labels[..., None, 4:] *
+                               jnp.log(p["cls"] + 1e-9), -1)) / n_obj
+            return coord + conf_obj + conf_noobj + cls_ce
+        return loss

@@ -172,12 +172,18 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     def _last_loss(self):
         last = self.layers[-1]
+        if hasattr(last, "compute_loss_fn"):
+            # layer-defined loss (e.g. Yolo2OutputLayer) — never fused
+            return last.compute_loss_fn(), False
         loss_name = getattr(last, "loss", None)
         if loss_name is None:
             raise ValueError("last layer has no loss; use an OutputLayer/"
                              "LossLayer variant for fit()")
         act = (last.activation or "identity").lower()
-        fused = (act, loss_name.lower()) in _FUSABLE
+        # the fused pre-activation shortcut in _forward only handles
+        # OutputLayer — a LossLayer applies its activation in-layer
+        fused = (act, loss_name.lower()) in _FUSABLE and \
+            isinstance(last, OutputLayer)
         return loss_name, fused
 
     def _reg_score(self, params):

@@ -1,11 +1,25 @@
 """Model zoo — reference: ``deeplearning4j-zoo``
 (``org.deeplearning4j.zoo.model.*``: LeNet, AlexNet, VGG16/19, ResNet50,
-SqueezeNet, Darknet19, TinyYOLO, UNet, Xception, SimpleCNN,
-TextGenerationLSTM). Pretrained-weight download is not reproducible here
-(no egress); architectures + init are.
+SqueezeNet, InceptionResNetV1, Darknet19, TinyYOLO/YOLO2, UNet,
+Xception, NASNet, SimpleCNN, TextGenerationLSTM). Pretrained-weight
+download is not reproducible here (no egress); architectures + init are.
 """
 from deeplearning4j_tpu.zoo.lenet import LeNet
+from deeplearning4j_tpu.zoo.alexnet import AlexNet
+from deeplearning4j_tpu.zoo.vgg import VGG16, VGG19
+from deeplearning4j_tpu.zoo.resnet import ResNet50
+from deeplearning4j_tpu.zoo.squeezenet import SqueezeNet
+from deeplearning4j_tpu.zoo.darknet import (Darknet19, TinyYOLO, YOLO2,
+                                            TINY_YOLO_ANCHORS,
+                                            YOLO2_ANCHORS)
+from deeplearning4j_tpu.zoo.unet import UNet
+from deeplearning4j_tpu.zoo.xception import Xception
+from deeplearning4j_tpu.zoo.inception_resnet import InceptionResNetV1
+from deeplearning4j_tpu.zoo.nasnet import NASNet
 from deeplearning4j_tpu.zoo.simple_cnn import SimpleCNN
 from deeplearning4j_tpu.zoo.textgen_lstm import TextGenerationLSTM
 
-__all__ = ["LeNet", "SimpleCNN", "TextGenerationLSTM"]
+__all__ = ["LeNet", "AlexNet", "VGG16", "VGG19", "ResNet50",
+           "SqueezeNet", "Darknet19", "TinyYOLO", "YOLO2", "UNet",
+           "Xception", "InceptionResNetV1", "NASNet", "SimpleCNN",
+           "TextGenerationLSTM", "TINY_YOLO_ANCHORS", "YOLO2_ANCHORS"]

@@ -1,0 +1,147 @@
+"""Inception-ResNet v1 — reference:
+``org.deeplearning4j.zoo.model.InceptionResNetV1`` (the FaceNet
+backbone: stem → 5×block35 → reduction-A → 10×block17 → reduction-B →
+5×block8 → avgpool → dropout → bottleneck embedding → softmax).
+
+ComputationGraph; residual branches concat then 1×1-project then add
+(scaled) to the shortcut, as in Szegedy et al. 2016.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.config import (InputType,
+                                          NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import (ActivationLayer,
+                                          BatchNormalization,
+                                          ConvolutionLayer, DenseLayer,
+                                          DropoutLayer,
+                                          GlobalPoolingLayer, OutputLayer,
+                                          SubsamplingLayer)
+from deeplearning4j_tpu.nn.vertices import (ElementWiseVertex, MergeVertex,
+                                            ScaleVertex)
+from deeplearning4j_tpu.nn import updaters as upd
+
+
+class InceptionResNetV1:
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 updater=None, input_shape=(160, 160, 3),
+                 embedding_size: int = 128,
+                 n35: int = 5, n17: int = 10, n8: int = 5):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or upd.RmsProp(learning_rate=0.1)
+        self.input_shape = input_shape
+        self.embedding_size = embedding_size
+        self.n35, self.n17, self.n8 = n35, n17, n8
+
+    def _cb(self, b, name, inp, n_out, kernel, stride=(1, 1),
+            padding="SAME", act="relu"):
+        b.add_layer(f"{name}_c",
+                    ConvolutionLayer(n_out=n_out, kernel_size=kernel,
+                                     stride=stride, padding=padding,
+                                     has_bias=False,
+                                     activation="identity"), inp)
+        b.add_layer(f"{name}_bn", BatchNormalization(activation=act),
+                    f"{name}_c")
+        return f"{name}_bn"
+
+    def _residual(self, b, name, inp, branches, channels, scale):
+        """concat(branches) → 1×1 project to `channels` → scale → add."""
+        b.add_vertex(f"{name}_cat", MergeVertex(), *branches)
+        b.add_layer(f"{name}_proj",
+                    ConvolutionLayer(n_out=channels, kernel_size=(1, 1),
+                                     activation="identity"),
+                    f"{name}_cat")
+        b.add_vertex(f"{name}_scale", ScaleVertex(scale=scale),
+                     f"{name}_proj")
+        b.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), inp,
+                     f"{name}_scale")
+        b.add_layer(f"{name}_out", ActivationLayer(activation="relu"),
+                    f"{name}_add")
+        return f"{name}_out"
+
+    def _block35(self, b, name, inp):
+        b1 = self._cb(b, f"{name}_b1", inp, 32, (1, 1))
+        b2 = self._cb(b, f"{name}_b2a", inp, 32, (1, 1))
+        b2 = self._cb(b, f"{name}_b2b", b2, 32, (3, 3))
+        b3 = self._cb(b, f"{name}_b3a", inp, 32, (1, 1))
+        b3 = self._cb(b, f"{name}_b3b", b3, 32, (3, 3))
+        b3 = self._cb(b, f"{name}_b3c", b3, 32, (3, 3))
+        return self._residual(b, name, inp, [b1, b2, b3], 256, 0.17)
+
+    def _block17(self, b, name, inp):
+        b1 = self._cb(b, f"{name}_b1", inp, 128, (1, 1))
+        b2 = self._cb(b, f"{name}_b2a", inp, 128, (1, 1))
+        b2 = self._cb(b, f"{name}_b2b", b2, 128, (1, 7))
+        b2 = self._cb(b, f"{name}_b2c", b2, 128, (7, 1))
+        return self._residual(b, name, inp, [b1, b2], 896, 0.10)
+
+    def _block8(self, b, name, inp):
+        b1 = self._cb(b, f"{name}_b1", inp, 192, (1, 1))
+        b2 = self._cb(b, f"{name}_b2a", inp, 192, (1, 1))
+        b2 = self._cb(b, f"{name}_b2b", b2, 192, (1, 3))
+        b2 = self._cb(b, f"{name}_b2c", b2, 192, (3, 1))
+        return self._residual(b, name, inp, [b1, b2], 1792, 0.20)
+
+    def conf(self):
+        h, w, c = self.input_shape
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(self.updater)
+             .weight_init_fn("relu")
+             .graph_builder().add_inputs("input"))
+        # stem
+        x = self._cb(b, "stem1", "input", 32, (3, 3), (2, 2))
+        x = self._cb(b, "stem2", x, 32, (3, 3))
+        x = self._cb(b, "stem3", x, 64, (3, 3))
+        b.add_layer("stem_pool",
+                    SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2),
+                                     padding="SAME",
+                                     pooling_type="max"), x)
+        x = self._cb(b, "stem4", "stem_pool", 80, (1, 1))
+        x = self._cb(b, "stem5", x, 192, (3, 3))
+        x = self._cb(b, "stem6", x, 256, (3, 3), (2, 2))
+        for i in range(self.n35):
+            x = self._block35(b, f"b35_{i}", x)
+        # reduction-A → 896 channels
+        ra1 = self._cb(b, "ra_b1", x, 384, (3, 3), (2, 2))
+        ra2 = self._cb(b, "ra_b2a", x, 192, (1, 1))
+        ra2 = self._cb(b, "ra_b2b", ra2, 192, (3, 3))
+        ra2 = self._cb(b, "ra_b2c", ra2, 256, (3, 3), (2, 2))
+        b.add_layer("ra_pool",
+                    SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2),
+                                     padding="SAME",
+                                     pooling_type="max"), x)
+        b.add_vertex("ra_cat", MergeVertex(), ra1, ra2, "ra_pool")
+        x = self._cb(b, "ra_proj", "ra_cat", 896, (1, 1))
+        for i in range(self.n17):
+            x = self._block17(b, f"b17_{i}", x)
+        # reduction-B → 1792 channels
+        rb1 = self._cb(b, "rb_b1a", x, 256, (1, 1))
+        rb1 = self._cb(b, "rb_b1b", rb1, 384, (3, 3), (2, 2))
+        rb2 = self._cb(b, "rb_b2a", x, 256, (1, 1))
+        rb2 = self._cb(b, "rb_b2b", rb2, 256, (3, 3), (2, 2))
+        rb3 = self._cb(b, "rb_b3a", x, 256, (1, 1))
+        rb3 = self._cb(b, "rb_b3b", rb3, 256, (3, 3))
+        rb3 = self._cb(b, "rb_b3c", rb3, 256, (3, 3), (2, 2))
+        b.add_layer("rb_pool",
+                    SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2),
+                                     padding="SAME",
+                                     pooling_type="max"), x)
+        b.add_vertex("rb_cat", MergeVertex(), rb1, rb2, rb3, "rb_pool")
+        x = self._cb(b, "rb_proj", "rb_cat", 1792, (1, 1))
+        for i in range(self.n8):
+            x = self._block8(b, f"b8_{i}", x)
+        b.add_layer("gap", GlobalPoolingLayer(pooling_type="avg"), x)
+        b.add_layer("drop", DropoutLayer(dropout=0.2), "gap")
+        b.add_layer("bottleneck",
+                    DenseLayer(n_out=self.embedding_size,
+                               activation="identity"), "drop")
+        b.add_layer("out", OutputLayer(n_out=self.num_classes,
+                                       activation="softmax",
+                                       loss="mcxent"), "bottleneck")
+        b.set_outputs("out")
+        b.set_input_types(input=InputType.convolutional(h, w, c))
+        return b.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()

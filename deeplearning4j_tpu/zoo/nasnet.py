@@ -1,0 +1,139 @@
+"""NASNet-A (mobile) — reference: ``org.deeplearning4j.zoo.model.NASNet``.
+
+Normal cell: 5 branch pairs over (current, previous) feature maps —
+separable 3×3/5×5 convs, avg/max pools, identities — summed pairwise
+and concatenated. Reduction cell: strided variants. This follows the
+reference zoo's simplified cell wiring (the full NASNet search-space
+graph is not reproduced there either); previous-layer inputs are taken
+post-adjustment so shapes line up.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.config import (InputType,
+                                          NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import (ActivationLayer,
+                                          BatchNormalization,
+                                          ConvolutionLayer,
+                                          GlobalPoolingLayer, OutputLayer,
+                                          SeparableConvolution2DLayer,
+                                          SubsamplingLayer)
+from deeplearning4j_tpu.nn.vertices import ElementWiseVertex, MergeVertex
+from deeplearning4j_tpu.nn import updaters as upd
+
+
+class NASNet:
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 updater=None, input_shape=(224, 224, 3),
+                 penultimate_filters: int = 1056, n_cells: int = 4):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or upd.RmsProp(learning_rate=1e-3)
+        self.input_shape = input_shape
+        # filters per normal cell, as in NASNet-A (N @ penultimate)
+        self.filters = penultimate_filters // 24
+        self.n_cells = n_cells
+
+    def _sep(self, b, name, inp, n_out, kernel, stride=(1, 1)):
+        b.add_layer(f"{name}_relu", ActivationLayer(activation="relu"),
+                    inp)
+        b.add_layer(f"{name}_s",
+                    SeparableConvolution2DLayer(
+                        n_out=n_out, kernel_size=kernel, stride=stride,
+                        padding="SAME", has_bias=False,
+                        activation="identity"), f"{name}_relu")
+        b.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_s")
+        return f"{name}_bn"
+
+    def _adjust(self, b, name, inp, n_out, stride=(1, 1)):
+        """1×1 conv-BN to align channel counts (reference adjust block)."""
+        b.add_layer(f"{name}_relu", ActivationLayer(activation="relu"),
+                    inp)
+        b.add_layer(f"{name}_c",
+                    ConvolutionLayer(n_out=n_out, kernel_size=(1, 1),
+                                     stride=stride, has_bias=False,
+                                     activation="identity"),
+                    f"{name}_relu")
+        b.add_layer(f"{name}_bn", BatchNormalization(), f"{name}_c")
+        return f"{name}_bn"
+
+    def _normal_cell(self, b, name, cur, prev, f):
+        h = self._adjust(b, f"{name}_adj_cur", cur, f)
+        hp = self._adjust(b, f"{name}_adj_prev", prev, f)
+        # branch pairs (NASNet-A normal cell)
+        y1a = self._sep(b, f"{name}_y1a", h, f, (3, 3))
+        b.add_vertex(f"{name}_add1", ElementWiseVertex(op="add"), y1a, h)
+        y2a = self._sep(b, f"{name}_y2a", hp, f, (3, 3))
+        y2b = self._sep(b, f"{name}_y2b", h, f, (5, 5))
+        b.add_vertex(f"{name}_add2", ElementWiseVertex(op="add"), y2a, y2b)
+        b.add_layer(f"{name}_p3",
+                    SubsamplingLayer(kernel_size=(3, 3), stride=(1, 1),
+                                     padding="SAME",
+                                     pooling_type="avg"), h)
+        b.add_vertex(f"{name}_add3", ElementWiseVertex(op="add"),
+                     f"{name}_p3", hp)
+        y4a = self._sep(b, f"{name}_y4a", hp, f, (5, 5))
+        y4b = self._sep(b, f"{name}_y4b", hp, f, (3, 3))
+        b.add_vertex(f"{name}_add4", ElementWiseVertex(op="add"), y4a, y4b)
+        b.add_vertex(f"{name}_cat", MergeVertex(), f"{name}_add1",
+                     f"{name}_add2", f"{name}_add3", f"{name}_add4", h)
+        return f"{name}_cat", h
+
+    def _reduction_cell(self, b, name, cur, prev, f):
+        h = self._adjust(b, f"{name}_adj_cur", cur, f)
+        hp = self._adjust(b, f"{name}_adj_prev", prev, f)
+        y1a = self._sep(b, f"{name}_y1a", h, f, (5, 5), (2, 2))
+        y1b = self._sep(b, f"{name}_y1b", hp, f, (7, 7), (2, 2))
+        b.add_vertex(f"{name}_add1", ElementWiseVertex(op="add"), y1a, y1b)
+        b.add_layer(f"{name}_mp",
+                    SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2),
+                                     padding="SAME",
+                                     pooling_type="max"), h)
+        y2b = self._sep(b, f"{name}_y2b", hp, f, (7, 7), (2, 2))
+        b.add_vertex(f"{name}_add2", ElementWiseVertex(op="add"),
+                     f"{name}_mp", y2b)
+        b.add_layer(f"{name}_ap",
+                    SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2),
+                                     padding="SAME",
+                                     pooling_type="avg"), h)
+        y3b = self._sep(b, f"{name}_y3b", hp, f, (5, 5), (2, 2))
+        b.add_vertex(f"{name}_add3", ElementWiseVertex(op="add"),
+                     f"{name}_ap", y3b)
+        b.add_vertex(f"{name}_cat", MergeVertex(), f"{name}_add1",
+                     f"{name}_add2", f"{name}_add3")
+        return f"{name}_cat", f"{name}_mp"
+
+    def conf(self):
+        h, w, c = self.input_shape
+        f = self.filters
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(self.updater)
+             .weight_init_fn("relu")
+             .graph_builder().add_inputs("input"))
+        b.add_layer("stem_c",
+                    ConvolutionLayer(n_out=32, kernel_size=(3, 3),
+                                     stride=(2, 2), padding="SAME",
+                                     has_bias=False,
+                                     activation="identity"), "input")
+        b.add_layer("stem_bn", BatchNormalization(), "stem_c")
+        cur, prev = "stem_bn", "stem_bn"
+        for i in range(self.n_cells):
+            cur, prev = self._normal_cell(b, f"n1_{i}", cur, prev, f)
+        cur, prev = self._reduction_cell(b, "r1", cur, prev, f * 2)
+        for i in range(self.n_cells):
+            cur, prev = self._normal_cell(b, f"n2_{i}", cur, prev, f * 2)
+        cur, prev = self._reduction_cell(b, "r2", cur, prev, f * 4)
+        for i in range(self.n_cells):
+            cur, prev = self._normal_cell(b, f"n3_{i}", cur, prev, f * 4)
+        b.add_layer("head_relu", ActivationLayer(activation="relu"), cur)
+        b.add_layer("gap", GlobalPoolingLayer(pooling_type="avg"),
+                    "head_relu")
+        b.add_layer("out", OutputLayer(n_out=self.num_classes,
+                                       activation="softmax",
+                                       loss="mcxent"), "gap")
+        b.set_outputs("out")
+        b.set_input_types(input=InputType.convolutional(h, w, c))
+        return b.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()

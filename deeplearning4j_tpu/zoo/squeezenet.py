@@ -1,0 +1,83 @@
+"""SqueezeNet v1.1 — reference: ``org.deeplearning4j.zoo.model.SqueezeNet``.
+
+Fire module = squeeze 1×1 conv → parallel expand 1×1 + 3×3 convs →
+channel concat (MergeVertex). ComputationGraph model.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.config import (InputType,
+                                          NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DropoutLayer,
+                                          GlobalPoolingLayer, LossLayer,
+                                          SubsamplingLayer)
+from deeplearning4j_tpu.nn.vertices import MergeVertex
+from deeplearning4j_tpu.nn import updaters as upd
+
+
+class SqueezeNet:
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 updater=None, input_shape=(224, 224, 3)):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or upd.Adam(learning_rate=1e-3)
+        self.input_shape = input_shape
+
+    def _fire(self, b, name, inp, squeeze, expand):
+        b.add_layer(f"{name}_sq",
+                    ConvolutionLayer(n_out=squeeze, kernel_size=(1, 1),
+                                     activation="relu"), inp)
+        b.add_layer(f"{name}_e1",
+                    ConvolutionLayer(n_out=expand, kernel_size=(1, 1),
+                                     activation="relu"), f"{name}_sq")
+        b.add_layer(f"{name}_e3",
+                    ConvolutionLayer(n_out=expand, kernel_size=(3, 3),
+                                     padding="SAME", activation="relu"),
+                    f"{name}_sq")
+        b.add_vertex(f"{name}_cat", MergeVertex(), f"{name}_e1",
+                     f"{name}_e3")
+        return f"{name}_cat"
+
+    def conf(self):
+        h, w, c = self.input_shape
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .updater(self.updater)
+             .weight_init_fn("relu")
+             .graph_builder()
+             .add_inputs("input"))
+        b.add_layer("stem", ConvolutionLayer(n_out=64, kernel_size=(3, 3),
+                                             stride=(2, 2), padding="SAME",
+                                             activation="relu"), "input")
+        b.add_layer("pool1", SubsamplingLayer(kernel_size=(3, 3),
+                                              stride=(2, 2),
+                                              pooling_type="max"), "stem")
+        x = self._fire(b, "fire2", "pool1", 16, 64)
+        x = self._fire(b, "fire3", x, 16, 64)
+        b.add_layer("pool3", SubsamplingLayer(kernel_size=(3, 3),
+                                              stride=(2, 2),
+                                              pooling_type="max"), x)
+        x = self._fire(b, "fire4", "pool3", 32, 128)
+        x = self._fire(b, "fire5", x, 32, 128)
+        b.add_layer("pool5", SubsamplingLayer(kernel_size=(3, 3),
+                                              stride=(2, 2),
+                                              pooling_type="max"), x)
+        x = self._fire(b, "fire6", "pool5", 48, 192)
+        x = self._fire(b, "fire7", x, 48, 192)
+        x = self._fire(b, "fire8", x, 64, 256)
+        x = self._fire(b, "fire9", x, 64, 256)
+        b.add_layer("drop", DropoutLayer(dropout=0.5), x)
+        b.add_layer("conv10",
+                    ConvolutionLayer(n_out=self.num_classes,
+                                     kernel_size=(1, 1),
+                                     activation="relu"), "drop")
+        b.add_layer("gap", GlobalPoolingLayer(pooling_type="avg"),
+                    "conv10")
+        b.add_layer("out", LossLayer(activation="softmax", loss="mcxent"),
+                    "gap")
+        b.set_outputs("out")
+        b.set_input_types(input=InputType.convolutional(h, w, c))
+        return b.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()

@@ -1,0 +1,86 @@
+"""U-Net — reference: ``org.deeplearning4j.zoo.model.UNet``
+(Ronneberger et al., segmentation).
+
+ComputationGraph: contracting path, then expanding path with
+skip-connection channel concats (MergeVertex) after each upsample.
+Output: per-pixel sigmoid (binary mask), xent loss.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.config import (InputType,
+                                          NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DropoutLayer,
+                                          LossLayer, SubsamplingLayer,
+                                          Upsampling2DLayer)
+from deeplearning4j_tpu.nn.vertices import MergeVertex
+from deeplearning4j_tpu.nn import updaters as upd
+
+
+class UNet:
+    def __init__(self, n_channels_out: int = 1, seed: int = 123,
+                 updater=None, input_shape=(128, 128, 3),
+                 base_filters: int = 64, depth: int = 4):
+        self.n_channels_out = n_channels_out
+        self.seed = seed
+        self.updater = updater or upd.Adam(learning_rate=1e-4)
+        self.input_shape = input_shape
+        self.base_filters = base_filters
+        self.depth = depth
+
+    def _double_conv(self, b, name, inp, filters, dropout=None):
+        b.add_layer(f"{name}_c1",
+                    ConvolutionLayer(n_out=filters, kernel_size=(3, 3),
+                                     padding="SAME", activation="relu"),
+                    inp)
+        b.add_layer(f"{name}_c2",
+                    ConvolutionLayer(n_out=filters, kernel_size=(3, 3),
+                                     padding="SAME", activation="relu"),
+                    f"{name}_c1")
+        out = f"{name}_c2"
+        if dropout:
+            b.add_layer(f"{name}_drop", DropoutLayer(dropout=dropout),
+                        out)
+            out = f"{name}_drop"
+        return out
+
+    def conf(self):
+        h, w, c = self.input_shape
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(self.updater)
+             .weight_init_fn("relu")
+             .graph_builder().add_inputs("input"))
+        skips = []
+        x = "input"
+        f = self.base_filters
+        for d in range(self.depth):
+            x = self._double_conv(b, f"down{d}", x, f * (2 ** d))
+            skips.append(x)
+            b.add_layer(f"pool{d}",
+                        SubsamplingLayer(kernel_size=(2, 2),
+                                         stride=(2, 2),
+                                         pooling_type="max"), x)
+            x = f"pool{d}"
+        x = self._double_conv(b, "bottom", x,
+                              f * (2 ** self.depth), dropout=0.5)
+        for d in reversed(range(self.depth)):
+            b.add_layer(f"up{d}", Upsampling2DLayer(size=(2, 2)), x)
+            b.add_layer(f"upc{d}",
+                        ConvolutionLayer(n_out=f * (2 ** d),
+                                         kernel_size=(2, 2),
+                                         padding="SAME",
+                                         activation="relu"), f"up{d}")
+            b.add_vertex(f"cat{d}", MergeVertex(), skips[d], f"upc{d}")
+            x = self._double_conv(b, f"dec{d}", f"cat{d}", f * (2 ** d))
+        b.add_layer("head",
+                    ConvolutionLayer(n_out=self.n_channels_out,
+                                     kernel_size=(1, 1),
+                                     activation="identity"), x)
+        b.add_layer("out", LossLayer(activation="sigmoid",
+                                     loss="binary_xent"), "head")
+        b.set_outputs("out")
+        b.set_input_types(input=InputType.convolutional(h, w, c))
+        return b.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()

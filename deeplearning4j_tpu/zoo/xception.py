@@ -1,0 +1,106 @@
+"""Xception — reference: ``org.deeplearning4j.zoo.model.Xception``
+(Chollet: depthwise-separable convs + residual connections).
+
+Entry flow → middle flow (8 identical residual sep-conv blocks) → exit
+flow. ComputationGraph with strided 1×1 conv shortcuts.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.config import (InputType,
+                                          NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import (ActivationLayer,
+                                          BatchNormalization,
+                                          ConvolutionLayer,
+                                          GlobalPoolingLayer, LossLayer,
+                                          OutputLayer,
+                                          SeparableConvolution2DLayer,
+                                          SubsamplingLayer)
+from deeplearning4j_tpu.nn.vertices import ElementWiseVertex
+from deeplearning4j_tpu.nn import updaters as upd
+
+
+class Xception:
+    def __init__(self, num_classes: int = 1000, seed: int = 123,
+                 updater=None, input_shape=(299, 299, 3),
+                 middle_blocks: int = 8):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or upd.Nesterovs(learning_rate=1e-2,
+                                                momentum=0.9)
+        self.input_shape = input_shape
+        self.middle_blocks = middle_blocks
+
+    def _conv_bn(self, b, name, inp, n_out, kernel, stride=(1, 1),
+                 act="relu"):
+        b.add_layer(f"{name}_c",
+                    ConvolutionLayer(n_out=n_out, kernel_size=kernel,
+                                     stride=stride, padding="SAME",
+                                     has_bias=False,
+                                     activation="identity"), inp)
+        b.add_layer(f"{name}_bn", BatchNormalization(activation=act),
+                    f"{name}_c")
+        return f"{name}_bn"
+
+    def _sep_bn(self, b, name, inp, n_out, act="identity"):
+        b.add_layer(f"{name}_s",
+                    SeparableConvolution2DLayer(
+                        n_out=n_out, kernel_size=(3, 3), padding="SAME",
+                        has_bias=False, activation="identity"), inp)
+        b.add_layer(f"{name}_bn", BatchNormalization(activation=act),
+                    f"{name}_s")
+        return f"{name}_bn"
+
+    def _entry_block(self, b, name, inp, n_out, relu_first=True):
+        x = inp
+        if relu_first:
+            b.add_layer(f"{name}_pre", ActivationLayer(activation="relu"),
+                        x)
+            x = f"{name}_pre"
+        x = self._sep_bn(b, f"{name}_s1", x, n_out, act="relu")
+        x = self._sep_bn(b, f"{name}_s2", x, n_out)
+        b.add_layer(f"{name}_pool",
+                    SubsamplingLayer(kernel_size=(3, 3), stride=(2, 2),
+                                     padding="SAME",
+                                     pooling_type="max"), x)
+        sc = self._conv_bn(b, f"{name}_sc", inp, n_out, (1, 1), (2, 2),
+                           act="identity")
+        b.add_vertex(f"{name}_add", ElementWiseVertex(op="add"),
+                     f"{name}_pool", sc)
+        return f"{name}_add"
+
+    def conf(self):
+        h, w, c = self.input_shape
+        b = (NeuralNetConfiguration.builder()
+             .seed(self.seed).updater(self.updater)
+             .weight_init_fn("relu")
+             .graph_builder().add_inputs("input"))
+        x = self._conv_bn(b, "stem1", "input", 32, (3, 3), (2, 2))
+        x = self._conv_bn(b, "stem2", x, 64, (3, 3))
+        x = self._entry_block(b, "entry1", x, 128, relu_first=False)
+        x = self._entry_block(b, "entry2", x, 256)
+        x = self._entry_block(b, "entry3", x, 728)
+        for i in range(self.middle_blocks):
+            inp = x
+            y = inp
+            for j in range(3):
+                b.add_layer(f"mid{i}_relu{j}",
+                            ActivationLayer(activation="relu"), y)
+                y = self._sep_bn(b, f"mid{i}_s{j}", f"mid{i}_relu{j}",
+                                 728)
+            b.add_vertex(f"mid{i}_add", ElementWiseVertex(op="add"), y,
+                         inp)
+            x = f"mid{i}_add"
+        x = self._entry_block(b, "exit1", x, 1024)
+        x = self._sep_bn(b, "exit_s1", x, 1536, act="relu")
+        x = self._sep_bn(b, "exit_s2", x, 2048, act="relu")
+        b.add_layer("gap", GlobalPoolingLayer(pooling_type="avg"), x)
+        b.add_layer("out", OutputLayer(n_out=self.num_classes,
+                                       activation="softmax",
+                                       loss="mcxent"), "gap")
+        b.set_outputs("out")
+        b.set_input_types(input=InputType.convolutional(h, w, c))
+        return b.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()

@@ -1,0 +1,108 @@
+"""Zoo architecture tests — tiny inputs, forward-shape + one train
+step (reference: ``deeplearning4j-zoo`` TestInstantiation suites, which
+also instantiate each model and run a forward pass).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import zoo
+
+
+def _fwd(net, shape):
+    x = np.random.default_rng(0).normal(size=shape).astype(np.float32)
+    return net.output(x)
+
+
+@pytest.mark.parametrize("cls,in_shape,classes", [
+    (zoo.AlexNet, (64, 64, 3), 10),
+    (zoo.VGG16, (32, 32, 3), 10),
+    (zoo.VGG19, (32, 32, 3), 10),
+])
+def test_sequential_zoo_forward(cls, in_shape, classes):
+    net = cls(num_classes=classes, input_shape=in_shape).init()
+    out = _fwd(net, (2,) + in_shape)
+    assert out.shape == (2, classes)
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-4)
+
+
+@pytest.mark.parametrize("cls,in_shape,classes", [
+    (zoo.SqueezeNet, (64, 64, 3), 10),
+    (zoo.Xception, (71, 71, 3), 10),
+])
+def test_graph_zoo_forward(cls, in_shape, classes):
+    net = cls(num_classes=classes, input_shape=in_shape).init()
+    x = np.random.default_rng(0).normal(
+        size=(2,) + in_shape).astype(np.float32)
+    out = net.output(x)
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    assert out.shape == (2, classes)
+    np.testing.assert_allclose(np.asarray(out).sum(1), 1.0, rtol=1e-4)
+
+
+def test_darknet19_forward():
+    net = zoo.Darknet19(num_classes=10, input_shape=(64, 64, 3)).init()
+    out = _fwd(net, (2, 64, 64, 3))
+    assert out.shape == (2, 10)
+
+
+def test_inception_resnet_v1_small():
+    net = zoo.InceptionResNetV1(num_classes=8, input_shape=(80, 80, 3),
+                                n35=1, n17=1, n8=1,
+                                embedding_size=32).init()
+    out = net.output(np.random.default_rng(0).normal(
+        size=(1, 80, 80, 3)).astype(np.float32))
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    assert np.asarray(out).shape == (1, 8)
+
+
+def test_nasnet_small():
+    net = zoo.NASNet(num_classes=6, input_shape=(32, 32, 3),
+                     penultimate_filters=96, n_cells=1).init()
+    out = net.output(np.random.default_rng(0).normal(
+        size=(1, 32, 32, 3)).astype(np.float32))
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    assert np.asarray(out).shape == (1, 6)
+
+
+def test_unet_forward_shape():
+    net = zoo.UNet(n_channels_out=1, input_shape=(32, 32, 3),
+                   base_filters=8, depth=2).init()
+    out = net.output(np.random.default_rng(0).normal(
+        size=(1, 32, 32, 3)).astype(np.float32))
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    out = np.asarray(out)
+    assert out.shape == (1, 32, 32, 1)
+    assert (out >= 0).all() and (out <= 1).all()      # sigmoid mask
+
+
+def test_tiny_yolo_forward_and_loss_step():
+    C, A = 3, 5
+    net = zoo.TinyYOLO(num_classes=C, input_shape=(64, 64, 3)).init()
+    x = np.random.default_rng(0).normal(
+        size=(2, 64, 64, 3)).astype(np.float32)
+    out = net.output(x)
+    gh = gw = 64 // 32       # 5 stride-2 pools
+    assert out.shape == (2, gh, gw, A * (5 + C))
+
+    # labels: one object in cell (0,1) of each image
+    labels = np.zeros((2, gh, gw, 4 + C), np.float32)
+    labels[:, 0, 1, 0:4] = [1.5, 0.5, 1.2, 2.0]   # cx, cy, w, h
+    labels[:, 0, 1, 4] = 1.0                       # class 0
+    from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+    it = ListDataSetIterator(DataSet(x, labels), batch_size=2)
+    net.fit(it, epochs=1)
+    assert np.isfinite(net.score())
+
+
+def test_yolo2_output_layer_decode():
+    from deeplearning4j_tpu.nn.layers import Yolo2OutputLayer
+    lay = Yolo2OutputLayer(anchors=[[1., 1.], [2., 2.]], num_classes=2)
+    x = np.zeros((1, 4, 4, 2 * 7), np.float32)
+    p = lay.activate_predictions(x)
+    assert p["xy"].shape == (1, 4, 4, 2, 2)
+    # sigmoid(0)=0.5 + cell offset
+    np.testing.assert_allclose(np.asarray(p["xy"])[0, 0, 0, 0], [0.5, 0.5])
+    np.testing.assert_allclose(np.asarray(p["xy"])[0, 2, 3, 0], [3.5, 2.5])
+    np.testing.assert_allclose(np.asarray(p["wh"])[0, 0, 0, 1], [2., 2.])
+    np.testing.assert_allclose(np.asarray(p["cls"]).sum(-1), 1.0,
+                               rtol=1e-5)

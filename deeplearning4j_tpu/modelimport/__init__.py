@@ -1,0 +1,9 @@
+"""Model import (reference: ``deeplearning4j-modelimport`` and
+``nd4j/samediff-import``).
+
+``keras_import``  — Keras h5 / .keras archives → MultiLayerNetwork /
+                    ComputationGraph (reference KerasModelImport).
+"""
+from deeplearning4j_tpu.modelimport.keras_import import KerasModelImport
+
+__all__ = ["KerasModelImport"]

@@ -1,0 +1,765 @@
+"""Keras model import.
+
+Reference: ``deeplearning4j-modelimport`` —
+``org.deeplearning4j.nn.modelimport.keras.KerasModelImport`` /
+``KerasModel`` / ``KerasLayer`` (+ ~60 per-layer mappers under
+``layers/``), reading HDF5 archives via ``Hdf5Archive``.
+
+This implementation reads the archive directly with ``h5py`` (no Keras
+runtime needed, mirroring the reference's Keras-free reader): the
+``model_config`` JSON attribute plus the ``model_weights`` groups of a
+legacy ``.h5`` file, or ``config.json`` + ``model.weights.h5`` inside a
+Keras-3 ``.keras`` zip. Sequential configs become
+:class:`MultiLayerNetwork`; Functional configs become
+:class:`ComputationGraph` (reference: KerasSequentialModel vs
+KerasModel).
+
+Weight layout notes (Keras → ours):
+  Dense kernel (in,out)            → ``W`` unchanged
+  Conv kernel HWIO                 → ``W`` unchanged (we are NHWC/HWIO)
+  LSTM gates [i,f,c,o]             → ours [i,f,o,g]: block-permute
+  GRU gates [z,r,h]                → ours [r,z,n]: block-permute
+  BatchNorm [γ,β,μ,σ²]             → params γ/β + running state μ/σ²
+"""
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.config import (InputType, MultiLayerConfiguration,
+                                          NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer,
+    Convolution1DLayer, CroppingLayer, DenseLayer, DepthwiseConvolution2DLayer,
+    DropoutLayer, EmbeddingSequenceLayer, GlobalPoolingLayer, GRU,
+    LastTimeStep, LayerNormalization, LSTM, PReLULayer, TimeDistributed,
+    SeparableConvolution2DLayer, SimpleRnn, Subsampling1DLayer,
+    SubsamplingLayer, Upsampling2DLayer, ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.layers.recurrent import Bidirectional
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.vertices import (ElementWiseVertex, FlattenVertex,
+                                            MergeVertex)
+
+# ---------------------------------------------------------------------------
+# archive reading
+
+
+def _read_archive(path: str) -> Tuple[dict, Dict[str, List[np.ndarray]]]:
+    """Returns (model_config dict, {layer_name: [weights in keras order]})."""
+    if zipfile.is_zipfile(path):
+        return _read_keras_v3_zip(path)
+    return _read_legacy_h5(path)
+
+
+def _read_legacy_h5(path: str):
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        raw = f.attrs["model_config"]
+        if isinstance(raw, bytes):
+            raw = raw.decode("utf-8")
+        config = json.loads(raw)
+        tc = f.attrs.get("training_config")
+        if tc is not None:
+            if isinstance(tc, bytes):
+                tc = tc.decode("utf-8")
+            config["__training_config__"] = json.loads(tc)
+        weights: Dict[str, List[np.ndarray]] = {}
+        mw = f["model_weights"] if "model_weights" in f else f
+        layer_names = [n.decode() if isinstance(n, bytes) else n
+                       for n in mw.attrs.get("layer_names", list(mw.keys()))]
+        for lname in layer_names:
+            g = mw[lname]
+            wnames = [n.decode() if isinstance(n, bytes) else n
+                      for n in g.attrs.get("weight_names", [])]
+            weights[lname] = [np.asarray(g[n]) for n in wnames]
+    return config, weights
+
+
+def _snake(name: str) -> str:
+    # exact mirror of keras.src.utils.naming.to_snake_case, which
+    # generates the v3 weight-file group keys (Conv2D -> "conv2d",
+    # MaxPooling2D -> "max_pooling2d")
+    import re
+    name = re.sub(r"\W+", "", name)
+    name = re.sub(r"(.)([A-Z][a-z]+)", r"\1_\2", name)
+    return re.sub(r"([a-z])([A-Z])", r"\1_\2", name).lower()
+
+
+def _read_keras_v3_zip(path: str):
+    """Keras-3 ``.keras`` zip: ``config.json`` + ``model.weights.h5``.
+
+    The weights file keys layers by canonical snake-cased class name
+    re-indexed per file ("dense", "dense_1", ...) in model layer order —
+    NOT by the config's layer names — so remap onto config names here.
+    """
+    import h5py
+
+    with zipfile.ZipFile(path) as zf:
+        config = json.loads(zf.read("config.json"))
+        blob = zf.read("model.weights.h5")
+    cc = config.get("compile_config")
+    if cc:
+        config["__training_config__"] = cc
+
+    by_file_key: Dict[str, List[np.ndarray]] = {}
+
+    def collect(group, out):
+        if "vars" in group and hasattr(group["vars"], "keys"):
+            vs = group["vars"]
+            out.extend(np.asarray(vs[k])
+                       for k in sorted(vs.keys(), key=int))
+        # h5py iterates alphabetically, which would put backward_layer
+        # before forward_layer — keras weight order is forward first
+        keys = sorted((k for k in group.keys() if k != "vars"),
+                      key=lambda k: (k == "backward_layer", k))
+        for k in keys:
+            if hasattr(group[k], "keys"):
+                collect(group[k], out)
+
+    with h5py.File(io.BytesIO(blob), "r") as f:
+        root = f["layers"] if "layers" in f else f
+        for k in root.keys():
+            arrs: List[np.ndarray] = []
+            collect(root[k], arrs)
+            by_file_key[k] = arrs
+
+    weights: Dict[str, List[np.ndarray]] = {}
+    counters: Dict[str, int] = {}
+    layer_cfgs = config.get("config", {}).get("layers", [])
+    for lc in layer_cfgs:
+        cn = lc["class_name"]
+        if cn == "InputLayer":
+            continue
+        base = _snake(cn)
+        n = counters.get(base, 0)
+        counters[base] = n + 1
+        fkey = base if n == 0 else f"{base}_{n}"
+        cname = lc["config"].get("name") or lc.get("name")
+        if fkey in by_file_key:
+            weights[cname] = by_file_key[fkey]
+    return config, weights
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+_ACT_MAP = {
+    "linear": "identity", "relu": "relu", "relu6": "relu6",
+    "sigmoid": "sigmoid", "tanh": "tanh", "softmax": "softmax",
+    "softplus": "softplus", "softsign": "softsign", "elu": "elu",
+    "selu": "selu", "gelu": "gelu", "swish": "swish", "silu": "silu",
+    "leaky_relu": "leakyrelu",
+    "hard_sigmoid": "hardsigmoid_keras",   # Keras-3: relu6(x+3)/6
+    "mish": "mish",
+}
+
+
+def _act(name: Optional[str]) -> Optional[str]:
+    if name is None:
+        return None
+    if isinstance(name, dict):      # serialized Activation object
+        name = name.get("class_name", "linear").lower()
+    if name not in _ACT_MAP:
+        raise ValueError(f"unsupported Keras activation {name!r}")
+    return _ACT_MAP[name]
+
+
+def _pad(p: str) -> str:
+    if p not in ("same", "valid"):
+        raise ValueError(f"unsupported Keras padding mode {p!r} "
+                         "(only 'same'/'valid' are importable)")
+    return {"same": "SAME", "valid": "VALID"}[p]
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+def _input_shape_of(cfg: dict):
+    shape = cfg.get("batch_shape") or cfg.get("batch_input_shape")
+    if shape is None:
+        return None
+    return tuple(shape[1:])       # drop batch axis
+
+
+def _input_type_for(shape: Tuple[Optional[int], ...]) -> InputType:
+    dims = [d for d in shape]
+    if len(dims) == 1:
+        return InputType.feed_forward(dims[0] or 1)
+    if len(dims) == 2:
+        t, f = dims
+        return InputType("rnn", (t if t else -1, f))
+    if len(dims) == 3:
+        h, w, c = dims
+        return InputType.convolutional(h, w, c)
+    if len(dims) == 4:
+        return InputType.convolutional_3d(*dims)
+    raise ValueError(f"cannot infer InputType from shape {shape}")
+
+
+# ---------------------------------------------------------------------------
+# per-layer config mappers: keras config dict -> our Layer (or None = skip)
+
+
+def _map_layer(class_name: str, cfg: dict):
+    """Returns (layer_or_None, follow_up_layer_or_None)."""
+    cn = class_name
+    if cn in ("InputLayer", "Flatten", "Reshape"):
+        # Flatten is absorbed by our Dense auto-flattening; InputLayer
+        # contributes only the InputType.
+        if cn == "Reshape":
+            raise ValueError("Keras Reshape import is not supported in a "
+                             "Sequential stack")
+        return None, None
+    if cn == "Dense":
+        return DenseLayer(name=cfg.get("name"), n_out=cfg["units"],
+                          activation=_act(cfg.get("activation")),
+                          has_bias=cfg.get("use_bias", True)), None
+    if cn in ("Conv2D", "Convolution2D"):
+        return ConvolutionLayer(
+            name=cfg.get("name"), n_out=cfg["filters"],
+            kernel_size=_pair(cfg["kernel_size"]),
+            stride=_pair(cfg.get("strides", 1)),
+            dilation=_pair(cfg.get("dilation_rate", 1)),
+            padding=_pad(cfg.get("padding", "valid")),
+            groups=cfg.get("groups", 1),
+            activation=_act(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", True)), None
+    if cn in ("Conv1D", "Convolution1D"):
+        return Convolution1DLayer(
+            name=cfg.get("name"), n_out=cfg["filters"],
+            kernel_size=(int(np.ravel(cfg["kernel_size"])[0]),),
+            stride=(int(np.ravel(cfg.get("strides", 1))[0]),),
+            dilation=(int(np.ravel(cfg.get("dilation_rate", 1))[0]),),
+            padding=_pad(cfg.get("padding", "valid")),
+            activation=_act(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", True)), None
+    if cn == "DepthwiseConv2D":
+        return DepthwiseConvolution2DLayer(
+            name=cfg.get("name"),
+            kernel_size=_pair(cfg["kernel_size"]),
+            stride=_pair(cfg.get("strides", 1)),
+            padding=_pad(cfg.get("padding", "valid")),
+            depth_multiplier=cfg.get("depth_multiplier", 1),
+            activation=_act(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", True)), None
+    if cn == "SeparableConv2D":
+        return SeparableConvolution2DLayer(
+            name=cfg.get("name"), n_out=cfg["filters"],
+            kernel_size=_pair(cfg["kernel_size"]),
+            stride=_pair(cfg.get("strides", 1)),
+            padding=_pad(cfg.get("padding", "valid")),
+            depth_multiplier=cfg.get("depth_multiplier", 1),
+            activation=_act(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", True)), None
+    if cn in ("MaxPooling2D", "AveragePooling2D"):
+        return SubsamplingLayer(
+            name=cfg.get("name"),
+            kernel_size=_pair(cfg.get("pool_size", 2)),
+            stride=_pair(cfg.get("strides") or cfg.get("pool_size", 2)),
+            padding=_pad(cfg.get("padding", "valid")),
+            pooling_type="max" if cn.startswith("Max") else "avg"), None
+    if cn in ("MaxPooling1D", "AveragePooling1D"):
+        ps = int(np.ravel(cfg.get("pool_size", 2))[0])
+        st = cfg.get("strides")
+        return Subsampling1DLayer(
+            name=cfg.get("name"), kernel_size=(ps,),
+            stride=(int(np.ravel(st)[0]) if st else ps,),
+            padding=_pad(cfg.get("padding", "valid")),
+            pooling_type="max" if cn.startswith("Max") else "avg"), None
+    if cn in ("GlobalMaxPooling2D", "GlobalAveragePooling2D",
+              "GlobalMaxPooling1D", "GlobalAveragePooling1D"):
+        return GlobalPoolingLayer(
+            name=cfg.get("name"),
+            pooling_type="max" if "Max" in cn else "avg",
+            collapse_dimensions=not cfg.get("keepdims", False)), None
+    if cn == "BatchNormalization":
+        return BatchNormalization(name=cfg.get("name"),
+                                  decay=cfg.get("momentum", 0.99),
+                                  eps=cfg.get("epsilon", 1e-3)), None
+    if cn == "LayerNormalization":
+        return LayerNormalization(name=cfg.get("name"),
+                                  eps=cfg.get("epsilon", 1e-3)), None
+    if cn == "Dropout":
+        return DropoutLayer(name=cfg.get("name"),
+                            dropout=cfg.get("rate", 0.5)), None
+    if cn == "Activation":
+        return ActivationLayer(name=cfg.get("name"),
+                               activation=_act(cfg["activation"])), None
+    if cn == "ReLU":
+        mv = cfg.get("max_value")
+        slope = cfg.get("negative_slope", 0.0) or 0.0
+        if slope:
+            raise ValueError("Keras ReLU with negative_slope is not "
+                             "importable")
+        if mv is None:
+            act = "relu"
+        elif float(mv) == 6.0:
+            act = "relu6"
+        else:
+            raise ValueError(f"Keras ReLU(max_value={mv}) is not "
+                             "importable (only None or 6)")
+        return ActivationLayer(name=cfg.get("name"), activation=act), None
+    if cn == "LeakyReLU":
+        slope = cfg.get("negative_slope", cfg.get("alpha", 0.3))
+        return ActivationLayer(name=cfg.get("name"),
+                               activation=f"leakyrelu:{slope}"), None
+    if cn == "PReLU":
+        return PReLULayer(name=cfg.get("name")), None
+    if cn == "Embedding":
+        return EmbeddingSequenceLayer(
+            name=cfg.get("name"), n_in=cfg["input_dim"],
+            n_out=cfg["output_dim"]), None
+    if cn in ("LSTM", "GRU", "SimpleRNN"):
+        inner = _map_rnn(cn, cfg)
+        if not cfg.get("return_sequences", False):
+            return LastTimeStep(name=cfg.get("name"), underlying=inner), None
+        return inner, None
+    if cn == "Bidirectional":
+        bwd = cfg.get("backward_layer")
+        if bwd:
+            # keras serializes the auto-mirrored backward layer too;
+            # only a genuinely different config is unsupported
+            fw, bw = cfg["layer"], bwd
+            keys = ("units", "activation", "recurrent_activation",
+                    "reset_after", "use_bias")
+            if (bw.get("class_name") != fw.get("class_name") or any(
+                    bw["config"].get(k) != fw["config"].get(k)
+                    for k in keys)):
+                raise ValueError(
+                    "Keras Bidirectional with a custom backward_layer is "
+                    "not importable (both directions must share the "
+                    "forward config)")
+        wrapped = cfg["layer"]
+        wcn, wcfg = wrapped["class_name"], wrapped["config"]
+        inner = _map_rnn(wcn, wcfg)
+        mode = {"concat": "concat", "sum": "add", "mul": "mul",
+                "ave": "average"}[cfg.get("merge_mode", "concat")]
+        if not wcfg.get("return_sequences", False):
+            # Keras: each direction independently emits its own final
+            # step (backward's final step has consumed the whole
+            # sequence) — so the LastTimeStep goes INSIDE the wrapper.
+            inner = LastTimeStep(underlying=inner)
+        return Bidirectional(name=cfg.get("name"), fwd=inner,
+                             mode=mode), None
+    if cn == "ZeroPadding2D":
+        p = cfg.get("padding", 1)
+        if isinstance(p, int):
+            pads = (p, p, p, p)
+        else:
+            (t, b), (l, r) = [_pair(x) for x in p]
+            pads = (t, b, l, r)
+        return ZeroPaddingLayer(name=cfg.get("name"), padding=pads), None
+    if cn == "Cropping2D":
+        c = cfg.get("cropping", 0)
+        if isinstance(c, int):
+            crops = (c, c, c, c)
+        else:
+            (t, b), (l, r) = [_pair(x) for x in c]
+            crops = (t, b, l, r)
+        return CroppingLayer(name=cfg.get("name"), cropping=crops), None
+    if cn == "UpSampling2D":
+        return Upsampling2DLayer(name=cfg.get("name"),
+                                 size=_pair(cfg.get("size", 2))), None
+    raise ValueError(f"unsupported Keras layer class {class_name!r}")
+
+
+def _map_rnn(cn: str, cfg: dict):
+    if cfg.get("go_backwards", False):
+        raise ValueError(f"Keras {cn}(go_backwards=True) is not "
+                         "importable outside a Bidirectional wrapper")
+    common = dict(name=cfg.get("name"), n_out=cfg["units"],
+                  activation=_act(cfg.get("activation", "tanh")))
+    if cn == "LSTM":
+        return LSTM(gate_activation=_act(
+            cfg.get("recurrent_activation", "sigmoid")), **common)
+    if cn == "GRU":
+        return GRU(gate_activation=_act(
+            cfg.get("recurrent_activation", "sigmoid")),
+            reset_after=cfg.get("reset_after", False), **common)
+    if cn == "SimpleRNN":
+        return SimpleRnn(**common)
+    raise ValueError(cn)
+
+
+# ---------------------------------------------------------------------------
+# weight mapping: keras weight list -> (params, state) for our layer
+
+
+def _perm_gates(w: np.ndarray, order: List[int], h: int) -> np.ndarray:
+    blocks = [w[..., i * h:(i + 1) * h] for i in order]
+    return np.concatenate(blocks, axis=-1)
+
+
+def _map_weights(layer, kcfg: dict, w: List[np.ndarray]):
+    """Returns (params, state) matching our layer's init() structure."""
+    if isinstance(layer, (LastTimeStep, TimeDistributed)):
+        return _map_weights(layer.underlying, kcfg, w)
+    if isinstance(layer, Bidirectional):
+        half = len(w) // 2
+        inner_cfg = kcfg.get("layer", {}).get("config", kcfg)
+        pf, sf = _map_weights(layer.fwd, inner_cfg, w[:half])
+        pb, sb = _map_weights(layer.fwd, inner_cfg, w[half:])
+        return {"fwd": pf, "bwd": pb}, {"fwd": sf, "bwd": sb}
+    if isinstance(layer, SeparableConvolution2DLayer):
+        kh, kw, c, m = w[0].shape
+        params = {"depthW": w[0].reshape(kh, kw, 1, c * m), "pointW": w[1]}
+        if layer.has_bias:
+            params["b"] = w[2]
+        return params, {}
+    if isinstance(layer, DepthwiseConvolution2DLayer):
+        kh, kw, c, m = w[0].shape
+        params = {"W": w[0].reshape(kh, kw, 1, c * m)}
+        if layer.has_bias:
+            params["b"] = w[1]
+        return params, {}
+    if isinstance(layer, LSTM):
+        h = layer.n_out
+        order = [0, 1, 3, 2]                       # [i,f,c,o] -> [i,f,o,g]
+        params = {"W": _perm_gates(w[0], order, h),
+                  "U": _perm_gates(w[1], order, h),
+                  "b": _perm_gates(w[2].reshape(-1), order, h)
+                  if len(w) > 2 else np.zeros(4 * h, np.float32)}
+        return params, {}
+    if isinstance(layer, GRU):
+        h = layer.n_out
+        order = [1, 0, 2]                          # [z,r,h] -> [r,z,n]
+        params = {"W": _perm_gates(w[0], order, h),
+                  "U": _perm_gates(w[1], order, h)}
+        if len(w) > 2:
+            bias = w[2]
+            if layer.reset_after:
+                # keras bias shape (2, 3h): [input bias, recurrent bias]
+                params["b"] = _perm_gates(bias[0], order, h)
+                params["rb"] = _perm_gates(bias[1], order, h)
+            else:
+                params["b"] = _perm_gates(bias.reshape(-1)[:3 * h], order, h)
+        else:
+            params["b"] = np.zeros(3 * h, np.float32)
+            if layer.reset_after:
+                params["rb"] = np.zeros(3 * h, np.float32)
+        return params, {}
+    if isinstance(layer, SimpleRnn):
+        params = {"W": w[0], "U": w[1],
+                  "b": w[2] if len(w) > 2
+                  else np.zeros(layer.n_out, np.float32)}
+        return params, {}
+    if isinstance(layer, BatchNormalization):
+        scale = kcfg.get("scale", True)
+        center = kcfg.get("center", True)
+        i = 0
+        params = {}
+        gamma = beta = None
+        if scale:
+            gamma = w[i]; i += 1
+        if center:
+            beta = w[i]; i += 1
+        mean, var = w[i], w[i + 1]
+        c = mean.shape[0]
+        params["gamma"] = gamma if gamma is not None else np.ones(c,
+                                                                  np.float32)
+        params["beta"] = beta if beta is not None else np.zeros(c, np.float32)
+        return params, {"mean": mean, "var": var}
+    if isinstance(layer, LayerNormalization):
+        scale = kcfg.get("scale", True)
+        center = kcfg.get("center", True)
+        i = 0
+        gamma = beta = None
+        if scale:
+            gamma = w[i]; i += 1
+        if center:
+            beta = w[i]; i += 1
+        c = (gamma if gamma is not None else beta).shape[0]
+        return {"gamma": gamma if gamma is not None
+                else np.ones(c, np.float32),
+                "beta": beta if beta is not None
+                else np.zeros(c, np.float32)}, {}
+    if isinstance(layer, PReLULayer):
+        return {"alpha": np.ravel(w[0])}, {}
+    if isinstance(layer, EmbeddingSequenceLayer):
+        return {"W": w[0]}, {}
+    if isinstance(layer, (ConvolutionLayer, DenseLayer)):
+        params = {"W": w[0]}
+        if layer.has_bias and len(w) > 1:
+            params["b"] = w[1]
+        return params, {}
+    if not w:
+        return {}, {}
+    raise ValueError(f"no weight mapping for {type(layer).__name__}")
+
+
+_LOSS_MAP = {
+    "categorical_crossentropy": "mcxent",
+    "sparse_categorical_crossentropy": "sparse_mcxent",
+    "binary_crossentropy": "xent",
+    "mean_squared_error": "mse", "mse": "mse",
+    "mean_absolute_error": "mae", "mae": "mae",
+    "mean_squared_logarithmic_error": "msle", "msle": "msle",
+    "hinge": "hinge", "squared_hinge": "squared_hinge",
+    "kl_divergence": "kl_divergence", "kld": "kl_divergence",
+    "poisson": "poisson", "cosine_similarity": "cosine_proximity",
+    "huber": "huber", "log_cosh": "logcosh", "logcosh": "logcosh",
+}
+
+
+def _keras_loss(config: dict) -> Optional[str]:
+    tc = config.get("__training_config__")
+    if not tc:
+        return None
+    loss = tc.get("loss")
+    delta = None
+    if isinstance(loss, dict):
+        lcfg = loss.get("config", {}) or {}
+        if "delta" in lcfg:
+            delta = lcfg["delta"]
+        loss = lcfg.get("name") or loss.get("class_name")
+    if isinstance(loss, str):
+        key = _snake(loss) if any(c.isupper() for c in loss) else loss
+        mapped = _LOSS_MAP.get(key)
+        if mapped == "huber" and delta is not None and float(delta) != 1.0:
+            return f"huber:{float(delta)}"
+        return mapped
+    return None
+
+
+def _to_output_layer(layer, loss: Optional[str]):
+    """Give the network head a loss so fit()/score() work after import
+    (reference: KerasModel reads the h5 training_config; falls back to
+    an activation-derived default, import-for-inference otherwise)."""
+    from deeplearning4j_tpu.nn.layers import OutputLayer
+    import dataclasses as _dc
+
+    if isinstance(layer, OutputLayer) or not isinstance(layer, DenseLayer):
+        return layer
+    if loss is None:
+        loss = {"softmax": "mcxent", "sigmoid": "xent"}.get(
+            layer.activation or "", "mse")
+    fields = {f.name: getattr(layer, f.name)
+              for f in _dc.fields(DenseLayer)}
+    return OutputLayer(loss=loss, **fields)
+
+
+# ---------------------------------------------------------------------------
+# inbound-node parsing (functional models; Keras 2 and Keras 3 formats)
+
+
+def _inbound_names(node_entry: Any) -> List[str]:
+    names: List[str] = []
+
+    def rec(x):
+        if isinstance(x, dict):
+            hist = None
+            if x.get("class_name") == "__keras_tensor__":
+                hist = x.get("config", {}).get("keras_history")
+            elif "keras_history" in x:
+                hist = x["keras_history"]
+            if hist:
+                names.append(hist[0])
+                return
+            for v in x.values():
+                rec(v)
+        elif isinstance(x, (list, tuple)):
+            # Keras-2 legacy triple ["name", node_idx, tensor_idx, {...}]
+            if (len(x) >= 3 and isinstance(x[0], str)
+                    and isinstance(x[1], int) and isinstance(x[2], int)):
+                names.append(x[0])
+                return
+            for v in x:
+                rec(v)
+
+    rec(node_entry)
+    return names
+
+
+_MERGE_VERTICES = {
+    "Add": lambda cfg: ElementWiseVertex(op="add"),
+    "Subtract": lambda cfg: ElementWiseVertex(op="sub"),
+    "Multiply": lambda cfg: ElementWiseVertex(op="mul"),
+    "Average": lambda cfg: ElementWiseVertex(op="average"),
+    "Maximum": lambda cfg: ElementWiseVertex(op="max"),
+    "Concatenate": lambda cfg: MergeVertex(axis=cfg.get("axis", -1)),
+}
+
+
+# ---------------------------------------------------------------------------
+# public API
+
+
+class KerasModelImport:
+    """Reference: org.deeplearning4j.nn.modelimport.keras.KerasModelImport."""
+
+    @staticmethod
+    def import_keras_sequential_model_and_weights(
+            path: str) -> MultiLayerNetwork:
+        config, weights = _read_archive(path)
+        if config.get("class_name") != "Sequential":
+            raise ValueError("not a Sequential model; use "
+                             "import_keras_model_and_weights")
+        return _build_sequential(config, weights)
+
+    @staticmethod
+    def import_keras_model_and_weights(path: str) -> ComputationGraph:
+        config, weights = _read_archive(path)
+        if config.get("class_name") == "Sequential":
+            raise ValueError("Sequential model; use "
+                             "import_keras_sequential_model_and_weights")
+        return _build_functional(config, weights)
+
+    @staticmethod
+    def import_model(path: str):
+        config, weights = _read_archive(path)
+        if config.get("class_name") == "Sequential":
+            return _build_sequential(config, weights)
+        return _build_functional(config, weights)
+
+
+def _build_sequential(config: dict, weights) -> MultiLayerNetwork:
+    layer_cfgs = config["config"]["layers"] \
+        if isinstance(config["config"], dict) else config["config"]
+    input_type = None
+    builder = NeuralNetConfiguration.builder().list()
+    imported: List[Tuple[int, dict, Any]] = []   # (our_index, kcfg, layer)
+    idx = 0
+    seq = False          # does the running activation have a time axis?
+    for lc in layer_cfgs:
+        cn, cfg = lc["class_name"], lc["config"]
+        shape = _input_shape_of(cfg)
+        if shape is not None and input_type is None:
+            input_type = _input_type_for(shape)
+            seq = input_type.kind == "rnn"
+        layer, _ = _map_layer(cn, cfg)
+        # track sequence-ness so Dense-on-[B,T,F] matches Keras's
+        # per-timestep semantics (our DenseLayer flattens >2D input)
+        if cn == "Embedding":
+            seq = True
+        elif cn in ("LSTM", "GRU", "SimpleRNN", "Bidirectional"):
+            wcfg = cfg["layer"]["config"] if cn == "Bidirectional" else cfg
+            seq = wcfg.get("return_sequences", False)
+        elif cn in ("Flatten", "GlobalMaxPooling1D",
+                    "GlobalAveragePooling1D", "GlobalMaxPooling2D",
+                    "GlobalAveragePooling2D"):
+            seq = False
+        elif seq and isinstance(layer, DenseLayer):
+            layer = TimeDistributed(name=cfg.get("name"), underlying=layer)
+        if layer is None:
+            continue
+        builder.layer(layer)
+        imported.append((idx, cfg, layer))
+        idx += 1
+    if input_type is None:
+        raise ValueError("model config carries no input shape; pass an "
+                         "explicit Input layer before saving")
+    if imported:
+        idx_last, cfg_last, last = imported[-1]
+        out_layer = _to_output_layer(last, _keras_loss(config))
+        if out_layer is not last:
+            builder._layers[idx_last] = out_layer
+            imported[-1] = (idx_last, cfg_last, out_layer)
+    conf = builder.set_input_type(input_type).build()
+    net = MultiLayerNetwork(conf).init()
+    for our_idx, kcfg, layer in imported:
+        w = weights.get(kcfg.get("name"), [])
+        if not w and not layer.has_params():
+            continue
+        params, lstate = _map_weights(layer, kcfg, w)
+        key = f"layer_{our_idx}"
+        net.params[key] = _cast_like(params, net.params.get(key, {}))
+        if lstate:
+            net.state[key] = _cast_like(lstate, net.state.get(key, {}))
+    net.opt_state = net._optimizer.init(net.params)
+    return net
+
+
+def _build_functional(config: dict, weights) -> ComputationGraph:
+    cfg = config["config"]
+    layer_cfgs = cfg["layers"]
+    builder = NeuralNetConfiguration.builder().graph_builder()
+    input_types: Dict[str, InputType] = {}
+    imported: Dict[str, Tuple[dict, Any]] = {}
+
+    for lc in layer_cfgs:
+        cn, lcfg = lc["class_name"], lc["config"]
+        name = lc.get("name") or lcfg.get("name")
+        inbound = _inbound_names(lc.get("inbound_nodes", []))
+        if cn == "InputLayer":
+            shape = _input_shape_of(lcfg)
+            builder.add_inputs(name)
+            if shape is not None:
+                input_types[name] = _input_type_for(shape)
+            continue
+        if cn in _MERGE_VERTICES:
+            builder.add_vertex(name, _MERGE_VERTICES[cn](lcfg), *inbound)
+            continue
+        layer, _ = _map_layer(cn, lcfg)
+        if layer is None:
+            if cn == "Flatten":
+                builder.add_vertex(name, FlattenVertex(), *inbound)
+                continue
+            raise ValueError(
+                f"Keras layer {cn!r} has no functional-graph mapping")
+        builder.add_layer(name, layer, *inbound)
+        imported[name] = (lcfg, layer)
+
+    outs = _inbound_names(cfg.get("output_layers", []))
+    loss = _keras_loss(config)
+    for name in outs:
+        if name in imported:
+            lcfg, layer = imported[name]
+            out_layer = _to_output_layer(layer, loss)
+            if out_layer is not layer:
+                imported[name] = (lcfg, out_layer)
+                for node in builder._nodes:
+                    if node.name == name:
+                        node.obj = out_layer
+                        break
+    builder.set_outputs(*outs)
+    builder.set_input_types(**input_types)
+    graph = ComputationGraph(builder.build()).init()
+    for name, (lcfg, layer) in imported.items():
+        w = weights.get(name, [])
+        if not w and not layer.has_params():
+            continue
+        params, lstate = _map_weights(layer, lcfg, w)
+        graph.params[name] = _cast_like(params, graph.params.get(name, {}))
+        if lstate:
+            graph.state[name] = _cast_like(lstate, graph.state.get(name, {}))
+    graph.opt_state = graph._optimizer.init(graph.params)
+    return graph
+
+
+def _cast_like(new_tree, ref_tree):
+    """Cast imported numpy weights to the dtype/device of the initialized
+    params (also validates shapes against init-time shapes)."""
+    import jax
+    import jax.numpy as jnp
+
+    def cast(path, arr):
+        ref = ref_tree
+        try:
+            for p in path:
+                ref = ref[p]
+        except (KeyError, TypeError):
+            ref = None
+        a = jnp.asarray(arr)
+        if ref is not None:
+            if tuple(ref.shape) != tuple(a.shape):
+                raise ValueError(
+                    f"imported weight {'/'.join(path)} has shape "
+                    f"{a.shape}, expected {tuple(ref.shape)}")
+            a = a.astype(ref.dtype)
+        return a
+
+    def rec(tree, path):
+        if isinstance(tree, dict):
+            return {k: rec(v, path + (k,)) for k, v in tree.items()}
+        return cast(path, tree)
+
+    return rec(new_tree, ())

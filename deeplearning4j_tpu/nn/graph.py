@@ -249,7 +249,7 @@ class ComputationGraph:
                       if masks.get(i) is not None), None)
             if node.kind == "vertex":
                 acts[node.name] = node.obj.apply(xs)
-                masks[node.name] = m
+                masks[node.name] = node.obj.propagate_mask(m)
                 continue
             layer = node.obj
             if rng is not None:

@@ -169,10 +169,17 @@ class SimpleRnn(BaseRecurrentLayer):
 @register_layer
 @dataclass
 class GRU(BaseRecurrentLayer):
-    """GRU (reference libnd4j ``gruCell`` op / samediff GRU)."""
+    """GRU (reference libnd4j ``gruCell`` op / samediff GRU).
+
+    ``reset_after=False`` (default, the paper/libnd4j formulation):
+    ``n = act(x·Wn + (r ⊙ h)·Un + bn)``. ``reset_after=True`` (the
+    cuDNN-compatible variant, Keras default): ``n = act(x·Wn +
+    r ⊙ (h·Un + rbn))`` with a separate recurrent bias ``rb``.
+    """
     n_in: Optional[int] = None
     n_out: int = 0
     gate_activation: str = "sigmoid"
+    reset_after: bool = False
 
     def init(self, key, input_shape, dtype=jnp.float32):
         n_in = self.n_in or input_shape[-1]
@@ -182,6 +189,8 @@ class GRU(BaseRecurrentLayer):
         params = {"W": wi(kW, (n_in, 3 * h), dtype),
                   "U": wi(kU, (h, 3 * h), dtype),
                   "b": jnp.zeros((3 * h,), dtype)}
+        if self.reset_after:
+            params["rb"] = jnp.zeros((3 * h,), dtype)
         t = input_shape[0] if len(input_shape) == 2 else None
         return params, {}, (t, h)
 
@@ -201,13 +210,23 @@ class GRU(BaseRecurrentLayer):
              else jnp.swapaxes(mask, 0, 1)[..., None].astype(dt))
         U = params["U"]
 
+        rb = params["rb"] if self.reset_after else None
+        Urz, Un = U[:, :2 * h], U[:, 2 * h:]
+
         def step(hp, inp):
             g, mt = inp
             xr, xz, xn = jnp.split(g, 3, axis=-1)
-            hr, hz, hn_ = jnp.split(hp @ U, 3, axis=-1)
-            r = gact(xr + hr)
-            z = gact(xz + hz)
-            n = act(xn + r * hn_)
+            if self.reset_after:
+                hg = hp @ U + rb
+                hr, hz, hn_ = jnp.split(hg, 3, axis=-1)
+                r = gact(xr + hr)
+                z = gact(xz + hz)
+                n = act(xn + r * hn_)
+            else:
+                hr, hz = jnp.split(hp @ Urz, 2, axis=-1)
+                r = gact(xr + hr)
+                z = gact(xz + hz)
+                n = act(xn + (r * hp) @ Un)
             hh = (1 - z) * n + z * hp
             hn = mt * hh + (1 - mt) * hp
             return hn, hh * mt
@@ -249,10 +268,13 @@ class Bidirectional(Layer):
             xr = jnp.flip(x, axis=1)
         yb, sb = self.fwd.apply(params["bwd"], state.get("bwd", {}), xr,
                                 train=train, rng=r2, mask=mask)
-        if mask is not None:
-            yb = _reverse_padded(yb, lengths)
-        else:
-            yb = jnp.flip(yb, axis=1)
+        # re-align backward outputs to forward time — unless the wrapped
+        # layer collapsed the time axis (e.g. LastTimeStep)
+        if yb.ndim >= 3:
+            if mask is not None:
+                yb = _reverse_padded(yb, lengths)
+            else:
+                yb = jnp.flip(yb, axis=1)
         if self.mode == "concat":
             y = jnp.concatenate([yf, yb], axis=-1)
         elif self.mode == "add":

@@ -44,6 +44,11 @@ class GraphVertex:
             out[f.name] = getattr(self, f.name)
         return out
 
+    def propagate_mask(self, mask):
+        """Transform the incoming [B,T] mask for downstream nodes
+        (mirrors Layer.propagate_mask). Default: unchanged."""
+        return mask
+
 
 @register_vertex
 @dataclass
@@ -186,6 +191,31 @@ class ReshapeVertex(GraphVertex):
 
     def output_shape(self, shapes):
         return tuple(self.shape)
+
+
+@register_vertex
+@dataclass
+class FlattenVertex(GraphVertex):
+    """Collapse all trailing dims to one feature axis (Keras-import shim
+    for Flatten feeding non-Dense consumers; reference PreprocessorVertex
+    + CnnToFeedForwardPreProcessor)."""
+
+    def apply(self, inputs):
+        x = inputs[0]
+        return x.reshape(x.shape[0], -1)
+
+    def output_shape(self, shapes):
+        n = 1
+        for d in shapes[0]:
+            if d is None or int(d) < 0:
+                raise ValueError(
+                    "FlattenVertex needs fully-known input dims; got "
+                    f"{shapes[0]} (dynamic time axes cannot be flattened)")
+            n *= int(d)
+        return (n,)
+
+    def propagate_mask(self, mask):
+        return None          # time axis is gone
 
 
 @register_vertex

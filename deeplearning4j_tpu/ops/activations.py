@@ -59,6 +59,11 @@ def hardsigmoid(x):
     return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
 
 
+def hardsigmoid_keras(x):
+    # Keras-3 definition: relu6(x+3)/6 (slope 1/6, not the legacy 0.2)
+    return jax.nn.relu6(x + 3.0) / 6.0
+
+
 def tanh(x):
     return jnp.tanh(x)
 
@@ -121,6 +126,7 @@ _REGISTRY: Dict[str, Callable] = {
     "gelu_tanh": gelu_tanh,
     "sigmoid": sigmoid,
     "hardsigmoid": hardsigmoid,
+    "hardsigmoid_keras": hardsigmoid_keras,
     "tanh": tanh,
     "hardtanh": hardtanh,
     "rationaltanh": rationaltanh,
@@ -138,10 +144,22 @@ _REGISTRY: Dict[str, Callable] = {
 
 
 def get(name_or_fn) -> Callable:
-    """Resolve an activation by reference enum name (case-insensitive)."""
+    """Resolve an activation by reference enum name (case-insensitive).
+
+    A ``name:param`` suffix parametrizes alpha-style activations
+    (``"leakyrelu:0.3"``, ``"elu:0.5"``) — serializable in layer
+    configs, used by the Keras importer.
+    """
     if callable(name_or_fn):
         return name_or_fn
     key = str(name_or_fn).lower()
+    if ":" in key:
+        base, _, arg = key.partition(":")
+        alpha = float(arg)
+        if base in ("leakyrelu", "elu", "celu", "thresholdedrelu"):
+            fn = _REGISTRY[base]
+            return lambda x: fn(x, alpha)
+        raise ValueError(f"activation {base!r} takes no parameter")
     if key not in _REGISTRY:
         raise ValueError(f"Unknown activation {name_or_fn!r}; "
                          f"known: {sorted(_REGISTRY)}")

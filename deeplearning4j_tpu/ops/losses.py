@@ -161,6 +161,19 @@ def wasserstein(labels, preds, mask=None, weights=None):
     return _mean(_apply_weights(labels * preds, weights), mask)
 
 
+def huber(labels, preds, mask=None, weights=None, delta: float = 1.0):
+    d = jnp.abs(preds - labels)
+    raw = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+    return _mean(_apply_weights(raw, weights), mask)
+
+
+def logcosh(labels, preds, mask=None, weights=None):
+    d = preds - labels
+    # numerically stable log(cosh(d)) = d + softplus(-2d) - log 2
+    raw = d + jax.nn.softplus(-2.0 * d) - jnp.log(2.0)
+    return _mean(_apply_weights(raw, weights), mask)
+
+
 def fmeasure(labels, preds, mask=None, weights=None, beta: float = 1.0):
     """Differentiable F-beta surrogate (reference LossFMeasure, binary)."""
     w = jnp.ones_like(preds)
@@ -211,6 +224,9 @@ _REGISTRY: Dict[str, Callable] = {
     "hinge": hinge,
     "squared_hinge": squared_hinge,
     "kl_divergence": kl_divergence,
+    "kld": kl_divergence,
+    "huber": huber,
+    "logcosh": logcosh,
     "reconstruction_crossentropy": binary_xent,
     "wasserstein": wasserstein,
     "fmeasure": fmeasure,
@@ -218,9 +234,22 @@ _REGISTRY: Dict[str, Callable] = {
 
 
 def get(name_or_fn) -> Callable:
+    """Resolve a loss by name. A ``name:param`` suffix parametrizes
+    losses with a scalar knob (``"huber:2.0"`` → delta,
+    ``"fmeasure:2.0"`` → beta); serializable in layer configs."""
     if callable(name_or_fn):
         return name_or_fn
     key = str(name_or_fn).lower()
+    if ":" in key:
+        base, _, arg = key.partition(":")
+        val = float(arg)
+        if base == "huber":
+            return lambda l, p, mask=None, weights=None: \
+                huber(l, p, mask, weights, delta=val)
+        if base == "fmeasure":
+            return lambda l, p, mask=None, weights=None: \
+                fmeasure(l, p, mask, weights, beta=val)
+        raise ValueError(f"loss {base!r} takes no parameter")
     if key not in _REGISTRY:
         raise ValueError(f"Unknown loss {name_or_fn!r}; known: "
                          f"{sorted(_REGISTRY)}")

@@ -1,0 +1,233 @@
+"""Keras import conformance (reference: KerasModelEndToEndTest —
+import → forward → compare to Keras-produced activations)."""
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+
+from deeplearning4j_tpu.modelimport import KerasModelImport  # noqa: E402
+
+
+def _save(model, tmp_path, name="m.h5"):
+    p = str(tmp_path / name)
+    model.save(p)
+    return p
+
+
+def _keras_out(model, x):
+    return np.asarray(model(x, training=False))
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(7)
+
+
+def test_sequential_mlp(tmp_path, rng):
+    model = keras.Sequential([
+        keras.layers.Input((12,)),
+        keras.layers.Dense(16, activation="relu"),
+        keras.layers.Dense(8, activation="tanh"),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    path = _save(model, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(4, 12)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               _keras_out(model, x), rtol=1e-4, atol=1e-5)
+
+
+def test_sequential_cnn(tmp_path, rng):
+    model = keras.Sequential([
+        keras.layers.Input((16, 16, 3)),
+        keras.layers.Conv2D(8, 3, activation="relu", padding="same"),
+        keras.layers.BatchNormalization(),
+        keras.layers.MaxPooling2D(2),
+        keras.layers.Conv2D(12, 3, activation="relu", padding="valid"),
+        keras.layers.GlobalAveragePooling2D(),
+        keras.layers.Dropout(0.25),
+        keras.layers.Dense(5, activation="softmax"),
+    ])
+    path = _save(model, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(2, 16, 16, 3)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               _keras_out(model, x), rtol=1e-4, atol=1e-5)
+
+
+def test_sequential_depthwise_separable(tmp_path, rng):
+    model = keras.Sequential([
+        keras.layers.Input((10, 10, 4)),
+        keras.layers.DepthwiseConv2D(3, depth_multiplier=2,
+                                     activation="relu"),
+        keras.layers.SeparableConv2D(6, 3, activation="relu"),
+        keras.layers.Flatten(),
+        keras.layers.Dense(4),
+    ])
+    path = _save(model, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(2, 10, 10, 4)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               _keras_out(model, x), rtol=1e-4, atol=1e-5)
+
+
+def test_sequential_lstm(tmp_path, rng):
+    model = keras.Sequential([
+        keras.layers.Input((7, 5)),
+        keras.layers.LSTM(9, return_sequences=True),
+        keras.layers.LSTM(6),        # return last step
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    path = _save(model, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(3, 7, 5)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               _keras_out(model, x), rtol=1e-4, atol=1e-5)
+
+
+def test_sequential_gru_simplernn(tmp_path, rng):
+    model = keras.Sequential([
+        keras.layers.Input((6, 4)),
+        keras.layers.GRU(8, return_sequences=True, reset_after=False),
+        keras.layers.GRU(7, return_sequences=True, reset_after=True),
+        keras.layers.SimpleRNN(5),
+        keras.layers.Dense(2),
+    ])
+    path = _save(model, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(2, 6, 4)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               _keras_out(model, x), rtol=1e-4, atol=1e-5)
+
+
+def test_sequential_bidirectional(tmp_path, rng):
+    model = keras.Sequential([
+        keras.layers.Input((5, 3)),
+        keras.layers.Bidirectional(keras.layers.LSTM(4,
+                                                     return_sequences=True)),
+        keras.layers.Bidirectional(keras.layers.LSTM(3)),
+        keras.layers.Dense(2),
+    ])
+    path = _save(model, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(2, 5, 3)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               _keras_out(model, x), rtol=1e-4, atol=1e-5)
+
+
+def test_sequential_embedding(tmp_path, rng):
+    model = keras.Sequential([
+        keras.layers.Input((6,)),
+        keras.layers.Embedding(20, 8),
+        keras.layers.LSTM(5),
+        keras.layers.Dense(2, activation="softmax"),
+    ])
+    path = _save(model, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.integers(0, 20, size=(3, 6)).astype(np.int32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               _keras_out(model, x), rtol=1e-4, atol=1e-5)
+
+
+def test_functional_graph(tmp_path, rng):
+    inp = keras.layers.Input((10,), name="in0")
+    a = keras.layers.Dense(8, activation="relu", name="branch_a")(inp)
+    b = keras.layers.Dense(8, activation="tanh", name="branch_b")(inp)
+    added = keras.layers.Add(name="add")([a, b])
+    cat = keras.layers.Concatenate(name="cat")([added, a])
+    out = keras.layers.Dense(4, activation="softmax", name="head")(cat)
+    model = keras.Model(inp, out)
+    path = _save(model, tmp_path)
+    graph = KerasModelImport.import_keras_model_and_weights(path)
+    x = rng.normal(size=(5, 10)).astype(np.float32)
+    ours = np.asarray(graph.output_single(x))
+    np.testing.assert_allclose(ours, _keras_out(model, x),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_keras_v3_archive(tmp_path, rng):
+    model = keras.Sequential([
+        keras.layers.Input((9,)),
+        keras.layers.Dense(6, activation="relu"),
+        keras.layers.Dense(2),
+    ])
+    p = str(tmp_path / "m.keras")
+    model.save(p)
+    net = KerasModelImport.import_model(p)
+    x = rng.normal(size=(4, 9)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               _keras_out(model, x), rtol=1e-4, atol=1e-5)
+
+
+def test_keras_v3_archive_cnn(tmp_path, rng):
+    """v3 weight-group keys are snake-cased class names (conv2d,
+    max_pooling2d) — regression for the name-matching path."""
+    model = keras.Sequential([
+        keras.layers.Input((12, 12, 2)),
+        keras.layers.Conv2D(4, 3, activation="relu"),
+        keras.layers.MaxPooling2D(2),
+        keras.layers.LayerNormalization(),
+        keras.layers.Flatten(),
+        keras.layers.Dense(3),
+    ])
+    p = str(tmp_path / "m.keras")
+    model.save(p)
+    net = KerasModelImport.import_model(p)
+    x = rng.normal(size=(2, 12, 12, 2)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               _keras_out(model, x), rtol=1e-4, atol=1e-5)
+
+
+def test_keras_v3_bidirectional(tmp_path, rng):
+    """v3 weights nest under forward_layer/backward_layer subgroups —
+    forward must come first despite alphabetical h5 iteration."""
+    model = keras.Sequential([
+        keras.layers.Input((5, 3)),
+        keras.layers.Bidirectional(keras.layers.LSTM(4,
+                                                     return_sequences=True)),
+        keras.layers.Dense(2),
+    ])
+    p = str(tmp_path / "m.keras")
+    model.save(p)
+    net = KerasModelImport.import_model(p)
+    x = rng.normal(size=(2, 5, 3)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               _keras_out(model, x), rtol=1e-4, atol=1e-5)
+
+
+def test_activation_layers_and_loss(tmp_path, rng):
+    model = keras.Sequential([
+        keras.layers.Input((6,)),
+        keras.layers.Dense(8),
+        keras.layers.LeakyReLU(negative_slope=0.3),
+        keras.layers.Dense(4),
+        keras.layers.ReLU(max_value=6.0),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy")
+    path = _save(model, tmp_path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = rng.normal(size=(4, 6)).astype(np.float32) * 3
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               _keras_out(model, x), rtol=1e-4, atol=1e-5)
+    from deeplearning4j_tpu.nn.layers import OutputLayer
+    assert isinstance(net.conf.layers[-1], OutputLayer)
+    assert net.conf.layers[-1].loss == "sparse_mcxent"
+
+
+def test_functional_flatten_concat(tmp_path, rng):
+    """Flatten feeding a Concatenate must flatten for real (not pass
+    through) or element order diverges from Keras."""
+    inp = keras.layers.Input((6, 6, 2), name="img")
+    a = keras.layers.Conv2D(3, 3, activation="relu", name="ca")(inp)
+    fa = keras.layers.Flatten(name="fa")(a)
+    b = keras.layers.Conv2D(2, 3, activation="tanh", name="cb")(inp)
+    fb = keras.layers.Flatten(name="fb")(b)
+    cat = keras.layers.Concatenate(name="cat")([fa, fb])
+    out = keras.layers.Dense(4, name="head")(cat)
+    model = keras.Model(inp, out)
+    path = _save(model, tmp_path)
+    graph = KerasModelImport.import_keras_model_and_weights(path)
+    x = rng.normal(size=(3, 6, 6, 2)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(graph.output_single(x)),
+                               _keras_out(model, x), rtol=1e-4, atol=1e-5)

@@ -68,6 +68,7 @@ op("sinh")(jnp.sinh)
 op("cosh")(jnp.cosh)
 op("tanh")(jnp.tanh)
 op("erf")(jax.scipy.special.erf)
+op("erfc")(jax.scipy.special.erfc)
 op("maximum")(jnp.maximum)
 op("minimum")(jnp.minimum)
 op("floormod")(jnp.mod)
@@ -209,12 +210,26 @@ def _dropout(x, *, rate, seed, deterministic=True):
     return jnp.where(m, x / keep, 0.0).astype(x.dtype)
 
 
+op("rsqrt")(jax.lax.rsqrt)
+
+
 @op("conv2d")
-def _conv2d(x, w, *, strides=(1, 1), padding="SAME"):
+def _conv2d(x, w, *, strides=(1, 1), padding="SAME", dilations=(1, 1)):
     # x: NHWC, w: HWIO — TPU-native layouts
     return jax.lax.conv_general_dilated(
         x, w, window_strides=tuple(strides), padding=padding,
+        rhs_dilation=tuple(dilations),
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+@op("depthwise_conv2d")
+def _depthwise_conv2d(x, w, *, strides=(1, 1), padding="SAME"):
+    # w: (H, W, C, M) TF layout → (H, W, 1, C*M) grouped conv
+    kh, kw, c, m = w.shape
+    return jax.lax.conv_general_dilated(
+        x, w.reshape(kh, kw, 1, c * m), window_strides=tuple(strides),
+        padding=padding, dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=c)
 
 
 @op("max_pooling2d")

@@ -3,7 +3,11 @@
 
 ``keras_import``  — Keras h5 / .keras archives → MultiLayerNetwork /
                     ComputationGraph (reference KerasModelImport).
+``tf_import``     — frozen TensorFlow GraphDef → SameDiff graph
+                    (reference samediff-import-tensorflow ImportGraph).
 """
 from deeplearning4j_tpu.modelimport.keras_import import KerasModelImport
+from deeplearning4j_tpu.modelimport.tf_import import (TFImporter,
+                                                      import_frozen_graph)
 
-__all__ = ["KerasModelImport"]
+__all__ = ["KerasModelImport", "TFImporter", "import_frozen_graph"]

@@ -1,0 +1,231 @@
+"""TF GraphDef import conformance (reference: TFGraphTestAllSameDiff —
+import a TF graph, execute, compare to TF-produced outputs)."""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.modelimport.tf_import import TFImporter  # noqa: E402
+
+
+def _freeze(fn, *specs):
+    """Concrete-trace fn, fold variables to constants, return
+    (graph_def, input names, output names)."""
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+
+    conc = tf.function(fn).get_concrete_function(*specs)
+    frozen = convert_variables_to_constants_v2(conc)
+    gd = frozen.graph.as_graph_def()
+    in_names = [t.name.split(":")[0] for t in frozen.inputs]
+    out_names = [t.name.split(":")[0] for t in frozen.outputs]
+    return gd, in_names, out_names
+
+
+def _check(fn, args, rtol=1e-4, atol=1e-5):
+    specs = [tf.TensorSpec(a.shape, a.dtype) for a in args]
+    gd, in_names, out_names = _freeze(fn, *specs)
+    ref = fn(*[tf.constant(a) for a in args])
+    if not isinstance(ref, (list, tuple)):
+        ref = [ref]
+    sd, vars_ = TFImporter.import_graph_def(gd, out_names)
+    feed = {n: a for n, a in zip(in_names, args)}
+    out_vars = [vars_[n] for n in out_names]
+    res = sd.output(feed, out_vars)
+    for o, r in zip(out_vars, ref):
+        np.testing.assert_allclose(res[o.name], np.asarray(r),
+                                   rtol=rtol, atol=atol)
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(3)
+
+
+def test_mlp(rng):
+    w1 = tf.Variable(rng.normal(size=(10, 16)).astype(np.float32) * 0.3)
+    b1 = tf.Variable(np.zeros(16, np.float32))
+    w2 = tf.Variable(rng.normal(size=(16, 4)).astype(np.float32) * 0.3)
+
+    def fn(x):
+        h = tf.nn.relu(tf.matmul(x, w1) + b1)
+        return tf.nn.softmax(tf.matmul(h, w2))
+
+    _check(fn, [rng.normal(size=(5, 10)).astype(np.float32)])
+
+
+def test_elementwise_chain(rng):
+    def fn(a, b):
+        c = tf.exp(tf.minimum(a, 2.0)) / (tf.abs(b) + 1.0)
+        d = tf.sqrt(tf.square(a) + 1e-3) - tf.tanh(b)
+        return c * d + tf.math.erf(a) - tf.math.rsqrt(tf.abs(b) + 1.0)
+
+    x = rng.normal(size=(3, 7)).astype(np.float32)
+    y = rng.normal(size=(3, 7)).astype(np.float32)
+    _check(fn, [x, y])
+
+
+def test_reductions_and_shapes(rng):
+    def fn(x):
+        m = tf.reduce_mean(x, axis=[1, 2], keepdims=True)
+        s = tf.reduce_sum(x - m, axis=-1)
+        r = tf.reshape(s, [-1, 4])
+        t = tf.transpose(r, [1, 0])
+        return tf.concat([t, t * 2.0], axis=0)
+
+    _check(fn, [rng.normal(size=(2, 4, 6)).astype(np.float32)])
+
+
+def test_cnn(rng):
+    k1 = tf.Variable(rng.normal(size=(3, 3, 2, 8)).astype(np.float32) * 0.2)
+    gamma = tf.Variable(np.ones(8, np.float32))
+    beta = tf.Variable(np.zeros(8, np.float32))
+    mean = tf.Variable(rng.normal(size=8).astype(np.float32) * 0.1)
+    var = tf.Variable(np.abs(rng.normal(size=8)).astype(np.float32) + 0.5)
+
+    def fn(x):
+        y = tf.nn.conv2d(x, k1, strides=[1, 1, 1, 1], padding="SAME")
+        y, _, _ = tf.compat.v1.nn.fused_batch_norm(
+            y, gamma, beta, mean, var, epsilon=1e-3, is_training=False)
+        y = tf.nn.relu6(y)
+        y = tf.nn.max_pool2d(y, 2, 2, padding="VALID")
+        return tf.reduce_mean(y, axis=[1, 2])
+
+    _check(fn, [rng.normal(size=(2, 8, 8, 2)).astype(np.float32)])
+
+
+def test_depthwise_conv(rng):
+    k = tf.Variable(rng.normal(size=(3, 3, 4, 2)).astype(np.float32) * 0.2)
+
+    def fn(x):
+        return tf.nn.depthwise_conv2d(x, k, strides=[1, 1, 1, 1],
+                                      padding="VALID")
+
+    _check(fn, [rng.normal(size=(2, 6, 6, 4)).astype(np.float32)])
+
+
+def test_attention_like(rng):
+    wq = tf.Variable(rng.normal(size=(8, 8)).astype(np.float32) * 0.3)
+    wk = tf.Variable(rng.normal(size=(8, 8)).astype(np.float32) * 0.3)
+
+    def fn(x):
+        q = tf.matmul(x, wq)
+        k = tf.matmul(x, wk)
+        scores = tf.matmul(q, k, transpose_b=True) / 8.0 ** 0.5
+        attn = tf.nn.softmax(scores)
+        return tf.matmul(attn, x)
+
+    _check(fn, [rng.normal(size=(4, 6, 8)).astype(np.float32)])
+
+
+def test_slicing_padding(rng):
+    def fn(x):
+        a = x[:, 1:5:2, :]
+        b = tf.pad(a, [[0, 0], [1, 1], [0, 0]])
+        c = tf.stack([b, b * 2.0], axis=1)
+        d = tf.squeeze(tf.expand_dims(c, -1), axis=-1)
+        return tf.tile(d[:, 0], [1, 2, 1])
+
+    _check(fn, [rng.normal(size=(2, 6, 3)).astype(np.float32)])
+
+
+def test_gather_argmax_cast(rng):
+    table = tf.Variable(rng.normal(size=(12, 5)).astype(np.float32))
+
+    def fn(idx):
+        e = tf.gather(table, idx, axis=0)
+        am = tf.argmax(e, axis=-1)
+        return tf.cast(am, tf.float32) + tf.reduce_max(e, axis=-1)
+
+    _check(fn, [rng.integers(0, 12, size=(3, 4)).astype(np.int32)])
+
+
+def test_finetune_trainable_consts(rng):
+    """Frozen weights marked trainable become VARIABLEs and receive
+    gradients (the BERT-fine-tune import pattern)."""
+    w = tf.Variable(rng.normal(size=(6, 3)).astype(np.float32) * 0.4)
+
+    def fn(x):
+        return tf.reduce_sum(tf.nn.softmax(tf.matmul(x, w)) ** 2)
+
+    x = rng.normal(size=(4, 6)).astype(np.float32)
+    gd, in_names, out_names = _freeze(fn, tf.TensorSpec(x.shape, x.dtype))
+    wname = next(n.name for n in gd.node if n.op == "Const"
+                 and _np_shape(n) == (6, 3))
+    sd, vars_ = TFImporter.import_graph_def(gd, trainable=[wname])
+    assert vars_[wname].vtype == "VARIABLE"
+    sd.set_loss_variables(vars_[out_names[0]])
+    grads = sd.calculate_gradients({in_names[0]: x}, [wname])
+    assert grads[wname].shape == (6, 3)
+    assert np.abs(grads[wname]).sum() > 0
+
+
+def _np_shape(node):
+    from tensorflow.python.framework import tensor_util
+    return tensor_util.MakeNdarray(node.attr["value"].tensor).shape
+
+
+def test_prunes_unreachable_unsupported_branch(rng):
+    """Side branches not feeding the requested outputs must not abort
+    the import (reference ImportGraph prunes to outputs)."""
+    from tensorflow.core.framework import graph_pb2
+
+    def fn(x):
+        return tf.nn.relu(x) + 1.0
+
+    x = rng.normal(size=(3, 4)).astype(np.float32)
+    gd, in_names, out_names = _freeze(fn, tf.TensorSpec(x.shape, x.dtype))
+    # splice in an unreachable dynamic-shape side branch (the freezer
+    # dead-code-eliminates one written in the fn itself)
+    dead = gd.node.add()
+    dead.name = "dead/Shape"
+    dead.op = "Shape"
+    dead.input.append(in_names[0])
+    assert any(n.op == "Shape" for n in gd.node)
+    with pytest.raises(ValueError, match="unsupported TF op"):
+        TFImporter.import_graph_def(gd)            # unpruned: fails
+    sd, vars_ = TFImporter.import_graph_def(gd, out_names)
+    res = sd.output({in_names[0]: x}, [vars_[out_names[0]]])
+    np.testing.assert_allclose(list(res.values())[0],
+                               np.maximum(x, 0) + 1.0, rtol=1e-6)
+
+
+def test_deep_chain_no_recursion_limit(rng):
+    """Sequential chains far deeper than the Python recursion limit
+    import fine (iterative toposort)."""
+    def fn(x):
+        y = x
+        for _ in range(1500):
+            y = y + 1.0
+        return y
+
+    x = rng.normal(size=(2, 3)).astype(np.float32)
+    gd, in_names, out_names = _freeze(fn, tf.TensorSpec(x.shape, x.dtype))
+    sd, vars_ = TFImporter.import_graph_def(gd, out_names)
+    res = sd.output({in_names[0]: x}, [vars_[out_names[0]]])
+    np.testing.assert_allclose(list(res.values())[0], x + 1500.0,
+                               rtol=1e-4)
+
+
+def test_gradients_through_imported_graph(rng):
+    """Imported graphs are differentiable (the reference needed explicit
+    doDiff per imported op; here jax.grad covers the whole trace)."""
+    w = tf.Variable(rng.normal(size=(6, 3)).astype(np.float32) * 0.4)
+
+    def fn(x):
+        return tf.reduce_sum(tf.nn.softmax(tf.matmul(x, w)) ** 2)
+
+    x = rng.normal(size=(4, 6)).astype(np.float32)
+    specs = [tf.TensorSpec(x.shape, x.dtype)]
+    gd, in_names, out_names = _freeze(fn, *specs)
+    sd, vars_ = TFImporter.import_graph_def(gd)
+    sd.set_loss_variables(vars_[out_names[0]])
+    grads = sd.calculate_gradients({in_names[0]: x}, [in_names[0]])
+
+    with tf.GradientTape() as tape:
+        xt = tf.constant(x)
+        tape.watch(xt)
+        loss = fn(xt)
+    ref = tape.gradient(loss, xt)
+    np.testing.assert_allclose(grads[in_names[0]], np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)

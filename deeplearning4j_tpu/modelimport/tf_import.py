@@ -460,7 +460,11 @@ class TFImporter:
                 state[name] = 1
                 stack.append((name, True))
                 for inp in nodes[name].input:
-                    stack.append((_ref(inp)[0], False))
+                    src_name, idx = _ref(inp)
+                    if idx < 0:
+                        continue   # control edges carry no value — an
+                        # unimportable Assert guard must not abort import
+                    stack.append((src_name, False))
 
         for name in order:
             node = nodes[name]

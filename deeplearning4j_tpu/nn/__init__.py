@@ -9,9 +9,14 @@ from deeplearning4j_tpu.nn.config import (
     MultiLayerConfiguration,
 )
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration, TransferLearning, TransferLearningHelper)
 
 __all__ = [
     "NeuralNetConfiguration",
     "MultiLayerConfiguration",
     "MultiLayerNetwork",
+    "FineTuneConfiguration",
+    "TransferLearning",
+    "TransferLearningHelper",
 ]

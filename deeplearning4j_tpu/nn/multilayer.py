@@ -236,6 +236,13 @@ class MultiLayerNetwork:
         if labels is not None:
             self._fit_batch(features, labels, features_mask, labels_mask)
             return self
+        if hasattr(features, "features") and not hasattr(features,
+                                                         "__iter__"):
+            ds = features           # fit(DataSet) — reference API
+            self._fit_batch(ds.features, ds.labels,
+                            getattr(ds, "features_mask", None),
+                            getattr(ds, "labels_mask", None))
+            return self
         it = features
         for _ in range(epochs):
             for l in self.listeners:
@@ -265,10 +272,20 @@ class MultiLayerNetwork:
             self._train_step_fn = self._make_train_step()
         rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed),
                                  self.iteration)
-        self.params, self.opt_state, self.state, loss = \
-            self._train_step_fn(self.params, self.opt_state, self.state,
-                                x, y, fmask, lmask, rng)
-        self.score_ = float(loss)
+        try:
+            self.params, self.opt_state, self.state, loss = \
+                self._train_step_fn(self.params, self.opt_state,
+                                    self.state, x, y, fmask, lmask, rng)
+            self.score_ = float(loss)
+        except Exception as e:       # HBM OOM → diagnostic dump
+            from deeplearning4j_tpu.utils import crashreport
+            if crashreport.is_oom(e):
+                path = crashreport.write_memory_crash_dump(self, e)
+                if path:
+                    raise RuntimeError(
+                        f"training step ran out of device memory; "
+                        f"crash dump written to {path}") from e
+            raise
         self.iteration += 1
         for l in self.listeners:
             l.iteration_done(self, self.iteration, self.epoch)

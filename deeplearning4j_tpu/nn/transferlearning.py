@@ -1,0 +1,241 @@
+"""Transfer learning (reference: ``deeplearning4j-nn``
+``org.deeplearning4j.nn.transferlearning.TransferLearning`` (+``.Builder``
+and ``.GraphBuilder``), ``FineTuneConfiguration``,
+``TransferLearningHelper``).
+
+Builds a NEW network from a trained one: freeze a feature-extractor
+prefix, swap/replace output heads, append layers — keeping trained
+params for retained layers and re-initializing new/modified ones. The
+pytree param structure makes the surgery trivial compared to the
+reference's flattened-view bookkeeping.
+"""
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.nn.layers import FrozenLayer
+from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, _lname
+from deeplearning4j_tpu import dtypes
+
+
+@dataclass
+class FineTuneConfiguration:
+    """Overrides applied to every *unfrozen* layer of the new net
+    (reference FineTuneConfiguration)."""
+    updater: Any = None
+    learning_rate: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    weight_decay: Optional[float] = None
+    dropout: Optional[float] = None
+    seed: Optional[int] = None
+
+    def _apply(self, conf, layers: List[Layer]):
+        if self.updater is not None:
+            conf.updater = self.updater
+        if self.seed is not None:
+            conf.seed = self.seed
+        for layer in layers:
+            if isinstance(layer, FrozenLayer):
+                continue
+            if self.learning_rate is not None:
+                layer.learning_rate = self.learning_rate
+            for f in ("l1", "l2", "weight_decay", "dropout"):
+                v = getattr(self, f)
+                if v is not None:
+                    setattr(layer, f, v)
+
+
+class TransferLearning:
+    """Reference: TransferLearning.Builder (MultiLayerNetwork flavor)."""
+
+    class Builder:
+        def __init__(self, net: MultiLayerNetwork):
+            if not net.params:
+                raise ValueError("source network is not initialized")
+            self._net = net
+            self._ftc: Optional[FineTuneConfiguration] = None
+            self._freeze_until: Optional[int] = None
+            self._n_removed = 0
+            self._appended: List[Layer] = []
+            self._replacements: Dict[int, Layer] = {}
+            self._nout_replace: Dict[int, tuple] = {}
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._ftc = ftc
+            return self
+
+        def set_feature_extractor(self, layer_idx: int):
+            """Freeze layers [0..layer_idx] (reference
+            setFeatureExtractor: 'frozen up to and including')."""
+            self._freeze_until = layer_idx
+            return self
+
+        def remove_output_layer(self):
+            self._n_removed += 1
+            return self
+
+        def remove_layers_from_output(self, n: int):
+            self._n_removed += int(n)
+            return self
+
+        def add_layer(self, layer: Layer):
+            self._appended.append(layer)
+            return self
+
+        def replace_layer(self, idx: int, layer: Layer):
+            self._replacements[idx] = layer
+            return self
+
+        def n_out_replace(self, idx: int, n_out: int,
+                          weight_init: Optional[str] = None):
+            """Change layer idx's output width, re-initializing it AND
+            the next layer's input side (reference nOutReplace)."""
+            self._nout_replace[idx] = (int(n_out), weight_init)
+            return self
+
+        def build(self) -> MultiLayerNetwork:
+            src = self._net
+            n_keep = len(src.layers) - self._n_removed
+            if n_keep < 0:
+                raise ValueError("removed more layers than the net has")
+
+            layers: List[Layer] = [copy.deepcopy(l)
+                                   for l in src.layers[:n_keep]]
+            # carry trained params/state for kept layers — as COPIES:
+            # the new net's jitted step donates its buffers, which must
+            # not delete the source net's arrays out from under it
+            import jax.numpy as jnp
+            params = {_lname(i): jax.tree.map(jnp.array,
+                                              src.params[_lname(i)])
+                      for i in range(n_keep)}
+            state = {_lname(i): jax.tree.map(jnp.array,
+                                             src.state[_lname(i)])
+                     for i in range(n_keep)}
+            reinit = set()        # our indices needing fresh params
+
+            for idx, layer in self._replacements.items():
+                if idx >= n_keep:
+                    raise ValueError(f"replace_layer({idx}) out of range")
+                layers[idx] = copy.deepcopy(layer)
+                reinit.add(idx)
+
+            for idx, (n_out, winit) in self._nout_replace.items():
+                if idx >= n_keep:
+                    raise ValueError(f"n_out_replace({idx}) out of range")
+                layers[idx] = copy.deepcopy(layers[idx])
+                layers[idx].n_out = n_out
+                if winit:
+                    layers[idx].weight_init = winit
+                reinit.add(idx)
+                if idx + 1 < n_keep:
+                    reinit.add(idx + 1)     # input side changed
+
+            base = len(layers)
+            layers.extend(copy.deepcopy(l) for l in self._appended)
+            reinit.update(range(base, len(layers)))
+
+            if self._freeze_until is not None:
+                for i in range(min(self._freeze_until + 1, len(layers))):
+                    if not isinstance(layers[i], FrozenLayer):
+                        layers[i] = FrozenLayer(underlying=layers[i])
+
+            conf = copy.deepcopy(src.conf)
+            conf.layers = layers
+            if self._ftc is not None:
+                self._ftc._apply(conf, layers)
+
+            new = MultiLayerNetwork(conf)
+            # shape-infer through the stack, initializing only what needs
+            # fresh params
+            dtype = dtypes.resolve(conf.dtype)
+            key = jax.random.PRNGKey(conf.seed + 1)
+            shape = src._input_shape
+            new._input_shape = shape
+            new._layer_shapes = []
+            for i, layer in enumerate(layers):
+                key, sub = jax.random.split(key)
+                p, s, shape = layer.init(sub, shape, dtype)
+                if i in reinit or _lname(i) not in params:
+                    new.params[_lname(i)] = p
+                    new.state[_lname(i)] = s
+                else:
+                    new.params[_lname(i)] = params[_lname(i)]
+                    new.state[_lname(i)] = state[_lname(i)]
+                new._layer_shapes.append(shape)
+            new._output_shape = shape
+            new._build_optimizer()
+            return new
+
+    @staticmethod
+    def builder(net: MultiLayerNetwork) -> "TransferLearning.Builder":
+        return TransferLearning.Builder(net)
+
+
+class TransferLearningHelper:
+    """Featurize-once training on the unfrozen tail (reference
+    TransferLearningHelper: featurize(DataSet) + fitFeaturized)."""
+
+    def __init__(self, net: MultiLayerNetwork,
+                 frozen_until: Optional[int] = None):
+        if frozen_until is not None:
+            net = (TransferLearning.builder(net)
+                   .set_feature_extractor(frozen_until).build())
+        self.net = net
+        idx = -1
+        for i, layer in enumerate(net.layers):
+            if isinstance(layer, FrozenLayer):
+                idx = i
+        self._split = idx + 1        # first unfrozen layer index
+        if self._split == 0:
+            raise ValueError("network has no frozen prefix")
+        # tail-only network with COPIES of the tail params — its jitted
+        # step donates buffers, which must not delete the full net's
+        # arrays (fit_featurized copies results back)
+        import jax.numpy as jnp
+        tail_conf = copy.deepcopy(net.conf)
+        tail_conf.layers = net.layers[self._split:]
+        self._tail = MultiLayerNetwork(tail_conf)
+        for i in range(self._split, len(net.layers)):
+            self._tail.params[_lname(i - self._split)] = \
+                jax.tree.map(jnp.array, net.params[_lname(i)])
+            self._tail.state[_lname(i - self._split)] = \
+                jax.tree.map(jnp.array, net.state[_lname(i)])
+        self._tail._input_shape = net._layer_shapes[self._split - 1]
+        self._tail._layer_shapes = net._layer_shapes[self._split:]
+        self._tail._output_shape = net._output_shape
+        self._tail._build_optimizer()
+
+    def unfrozen_mln(self) -> MultiLayerNetwork:
+        return self._tail
+
+    def featurize(self, dataset):
+        """Run the frozen prefix once; returns a DataSet of features
+        (reference featurize)."""
+        from deeplearning4j_tpu.data import DataSet
+
+        feats = self.net.activate_selected_layers(
+            0, self._split - 1, np.asarray(dataset.features))
+        return DataSet(np.asarray(feats), dataset.labels)
+
+    def fit_featurized(self, dataset_or_iter, epochs: int = 1):
+        import jax.numpy as jnp
+
+        self._tail.fit(dataset_or_iter, epochs=epochs)
+        # propagate tail params back into the full net — as copies, so a
+        # later fit_featurized's donation can't delete the full net's view
+        for i in range(self._split, len(self.net.layers)):
+            self.net.params[_lname(i)] = jax.tree.map(
+                jnp.array, self._tail.params[_lname(i - self._split)])
+            self.net.state[_lname(i)] = jax.tree.map(
+                jnp.array, self._tail.state[_lname(i - self._split)])
+        return self
+
+    def output(self, x):
+        return self.net.output(x)

@@ -1,8 +1,28 @@
 from deeplearning4j_tpu.train.listeners import (
     TrainingListener, ScoreIterationListener, PerformanceListener,
-    CheckpointListener, EvaluativeListener,
+    CheckpointListener, EvaluativeListener, CollectScoresListener,
+)
+from deeplearning4j_tpu.train.earlystopping import (
+    EarlyStoppingConfiguration, EarlyStoppingTrainer, EarlyStoppingResult,
+    MaxEpochsTerminationCondition, ScoreImprovementEpochTerminationCondition,
+    MaxTimeIterationTerminationCondition, MaxScoreIterationTerminationCondition,
+    DataSetLossCalculator, ClassificationScoreCalculator,
+    InMemoryModelSaver, LocalFileModelSaver,
+)
+from deeplearning4j_tpu.train.stats import (
+    StatsListener, StatsStorage, InMemoryStatsStorage, FileStatsStorage,
+    UIServer,
 )
 
-__all__ = ["TrainingListener", "ScoreIterationListener",
-           "PerformanceListener", "CheckpointListener",
-           "EvaluativeListener"]
+__all__ = [
+    "TrainingListener", "ScoreIterationListener", "PerformanceListener",
+    "CheckpointListener", "EvaluativeListener", "CollectScoresListener",
+    "EarlyStoppingConfiguration", "EarlyStoppingTrainer",
+    "EarlyStoppingResult", "MaxEpochsTerminationCondition",
+    "ScoreImprovementEpochTerminationCondition",
+    "MaxTimeIterationTerminationCondition",
+    "MaxScoreIterationTerminationCondition", "DataSetLossCalculator",
+    "ClassificationScoreCalculator", "InMemoryModelSaver",
+    "LocalFileModelSaver", "StatsListener", "StatsStorage",
+    "InMemoryStatsStorage", "FileStatsStorage", "UIServer",
+]

@@ -1,0 +1,282 @@
+"""Early stopping (reference: ``deeplearning4j-core``
+``org.deeplearning4j.earlystopping``: ``EarlyStoppingConfiguration``,
+``EarlyStoppingTrainer``, termination conditions
+(``MaxEpochsTerminationCondition``, ``MaxTimeIterationTerminationCondition``,
+``ScoreImprovementEpochTerminationCondition``, ``MaxScoreIterationTerminationCondition``),
+savers (``InMemoryModelSaver``, ``LocalFileModelSaver``),
+``EarlyStoppingResult``).
+"""
+from __future__ import annotations
+
+import copy
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+
+# --- termination conditions -------------------------------------------------
+
+class EpochTerminationCondition:
+    def initialize(self):
+        pass
+
+    def terminate(self, epoch: int, score: float) -> bool:
+        raise NotImplementedError
+
+
+class IterationTerminationCondition:
+    def initialize(self):
+        pass
+
+    def terminate(self, iteration: int, score: float) -> bool:
+        raise NotImplementedError
+
+
+@dataclass
+class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    max_epochs: int = 10
+
+    def terminate(self, epoch, score):
+        return epoch + 1 >= self.max_epochs
+
+
+@dataclass
+class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
+    """Stop after ``patience`` epochs without ≥``min_improvement`` gain."""
+    patience: int = 5
+    min_improvement: float = 0.0
+
+    def initialize(self):
+        self._best = float("inf")
+        self._bad = 0
+
+    def terminate(self, epoch, score):
+        if score < self._best - self.min_improvement:
+            self._best = score
+            self._bad = 0
+        else:
+            self._bad += 1
+        return self._bad > self.patience
+
+
+@dataclass
+class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
+    max_seconds: float = 3600.0
+
+    def initialize(self):
+        self._t0 = time.time()
+
+    def terminate(self, iteration, score):
+        return time.time() - self._t0 > self.max_seconds
+
+
+@dataclass
+class MaxScoreIterationTerminationCondition(IterationTerminationCondition):
+    """Abort when the score explodes past a bound (diverged run)."""
+    max_score: float = 1e9
+
+    def terminate(self, iteration, score):
+        return score > self.max_score or not np.isfinite(score)
+
+
+# --- score calculators ------------------------------------------------------
+
+class ScoreCalculator:
+    def calculate_score(self, net) -> float:
+        raise NotImplementedError
+
+    # reference: minimizeScore() — False for accuracy-like scores
+    minimize = True
+
+
+class DataSetLossCalculator(ScoreCalculator):
+    """Average loss over a held-out iterator (reference
+    DataSetLossCalculator)."""
+
+    def __init__(self, iterator):
+        self.iterator = iterator
+
+    def calculate_score(self, net):
+        total, n = 0.0, 0
+        self.iterator.reset()
+        for ds in self.iterator:
+            b = len(np.asarray(ds.features))
+            total += net.score(ds) * b
+            n += b
+        return total / max(n, 1)
+
+
+class ClassificationScoreCalculator(ScoreCalculator):
+    """Held-out accuracy/F1 (reference ClassificationScoreCalculator)."""
+    minimize = False
+
+    def __init__(self, iterator, metric: str = "accuracy"):
+        self.iterator = iterator
+        self.metric = metric
+
+    def calculate_score(self, net):
+        self.iterator.reset()
+        ev = net.evaluate(self.iterator)
+        return getattr(ev, self.metric)()
+
+
+# --- model savers -----------------------------------------------------------
+
+class InMemoryModelSaver:
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    def save_best_model(self, net, score):
+        self._best = (net.clone(), score)
+
+    def save_latest_model(self, net, score):
+        self._latest = (net.clone(), score)
+
+    def get_best_model(self):
+        return self._best[0] if self._best else None
+
+    def get_latest_model(self):
+        return self._latest[0] if self._latest else None
+
+
+class LocalFileModelSaver:
+    """Zip-format persistence of best/latest (reference
+    LocalFileModelSaver + ModelSerializer)."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, kind):
+        return os.path.join(self.dir, f"{kind}Model.zip")
+
+    def save_best_model(self, net, score):
+        from deeplearning4j_tpu.serialization import ModelSerializer
+        ModelSerializer.write_model(net, self._path("best"))
+
+    def save_latest_model(self, net, score):
+        from deeplearning4j_tpu.serialization import ModelSerializer
+        ModelSerializer.write_model(net, self._path("latest"))
+
+    def get_best_model(self):
+        from deeplearning4j_tpu.serialization import ModelSerializer
+        p = self._path("best")
+        return ModelSerializer.restore_multi_layer_network(p) \
+            if os.path.exists(p) else None
+
+    def get_latest_model(self):
+        from deeplearning4j_tpu.serialization import ModelSerializer
+        p = self._path("latest")
+        return ModelSerializer.restore_multi_layer_network(p) \
+            if os.path.exists(p) else None
+
+
+# --- configuration / result / trainer --------------------------------------
+
+@dataclass
+class EarlyStoppingConfiguration:
+    score_calculator: Optional[ScoreCalculator] = None
+    epoch_terminations: List[EpochTerminationCondition] = field(
+        default_factory=list)
+    iteration_terminations: List[IterationTerminationCondition] = field(
+        default_factory=list)
+    model_saver: Any = None
+    evaluate_every_n_epochs: int = 1
+    save_last_model: bool = False
+
+    def __post_init__(self):
+        if self.model_saver is None:
+            self.model_saver = InMemoryModelSaver()
+
+
+@dataclass
+class EarlyStoppingResult:
+    termination_reason: str          # "EpochTermination" | ...
+    termination_details: str
+    best_model_epoch: int
+    best_model_score: float
+    total_epochs: int
+    best_model: Any
+    score_vs_epoch: dict = field(default_factory=dict)
+
+
+class EarlyStoppingTrainer:
+    """Reference: EarlyStoppingTrainer (BaseEarlyStoppingTrainer.fit)."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, net,
+                 train_iterator):
+        self.config = config
+        self.net = net
+        self.iterator = train_iterator
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        if not cfg.epoch_terminations and not cfg.iteration_terminations:
+            raise ValueError(
+                "EarlyStoppingConfiguration has no termination "
+                "conditions — training would never stop; add e.g. "
+                "MaxEpochsTerminationCondition")
+        for c in cfg.epoch_terminations + cfg.iteration_terminations:
+            c.initialize()
+        sign = 1.0 if (cfg.score_calculator is None
+                       or cfg.score_calculator.minimize) else -1.0
+        best_score, best_epoch = float("inf"), -1
+        scores = {}
+        epoch = 0
+        reason, details = "EpochTermination", "no condition fired"
+
+        while True:
+            self.iterator.reset()
+            aborted = False
+            for ds in self.iterator:
+                self.net.fit(ds)
+                it_score = self.net.score_
+                for c in cfg.iteration_terminations:
+                    if c.terminate(self.net.iteration, it_score):
+                        reason = "IterationTermination"
+                        details = f"{type(c).__name__} at iteration " \
+                                  f"{self.net.iteration}"
+                        aborted = True
+                        break
+                if aborted:
+                    break
+
+            if not aborted:
+                # score calculation is throttled; termination checks run
+                # EVERY epoch with the latest score (reference
+                # BaseEarlyStoppingTrainer semantics — MaxEpochs must
+                # not overshoot when evaluation is infrequent)
+                if epoch % cfg.evaluate_every_n_epochs == 0:
+                    score = (cfg.score_calculator.calculate_score(self.net)
+                             if cfg.score_calculator else self.net.score_)
+                    scores[epoch] = score
+                    if sign * score < best_score:
+                        best_score = sign * score
+                        best_epoch = epoch
+                        cfg.model_saver.save_best_model(self.net, score)
+                    if cfg.save_last_model:
+                        cfg.model_saver.save_latest_model(self.net, score)
+                last_score = scores[max(scores)] if scores \
+                    else self.net.score_
+                for c in cfg.epoch_terminations:
+                    if c.terminate(epoch, sign * last_score):
+                        reason = "EpochTermination"
+                        details = f"{type(c).__name__} at epoch {epoch}"
+                        aborted = True
+                        break
+
+            epoch += 1
+            if aborted:
+                break
+
+        best = cfg.model_saver.get_best_model() or self.net
+        return EarlyStoppingResult(
+            termination_reason=reason, termination_details=details,
+            best_model_epoch=best_epoch,
+            best_model_score=sign * best_score if best_epoch >= 0
+            else float("nan"),
+            total_epochs=epoch, best_model=best, score_vs_epoch=scores)

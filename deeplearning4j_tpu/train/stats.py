@@ -1,0 +1,291 @@
+"""Training stats collection + storage + dashboard.
+
+Reference: ``deeplearning4j-ui-parent`` —
+``org.deeplearning4j.ui.model.stats.StatsListener`` (per-iteration score,
+param/update histograms & ratios, system metrics) streaming into a
+``StatsStorage`` (``InMemoryStatsStorage`` / ``FileStatsStorage``), and
+``org.deeplearning4j.ui.api.UIServer`` (``VertxUIServer``) rendering
+score charts + layer histograms.
+
+TPU-native redesign: stats records are plain dicts (JSON lines on disk
+instead of the reference's custom binary + MapDB); the dashboard is a
+dependency-free stdlib ``http.server`` rendering inline SVG — no Vertx,
+no build step. Param/update norms are computed with jitted reductions
+on-device, only scalars cross to host.
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.train.listeners import TrainingListener
+
+
+# --- storage ----------------------------------------------------------------
+
+class StatsStorage:
+    """Reference: org.deeplearning4j.api.storage.StatsStorage."""
+
+    def put_record(self, session_id: str, record: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def list_session_ids(self) -> List[str]:
+        raise NotImplementedError
+
+    def get_records(self, session_id: str) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+
+class InMemoryStatsStorage(StatsStorage):
+    def __init__(self):
+        self._data: Dict[str, List[Dict[str, Any]]] = {}
+        self._lock = threading.Lock()
+
+    def put_record(self, session_id, record):
+        with self._lock:
+            self._data.setdefault(session_id, []).append(record)
+
+    def list_session_ids(self):
+        return list(self._data)
+
+    def get_records(self, session_id):
+        return list(self._data.get(session_id, []))
+
+
+class FileStatsStorage(StatsStorage):
+    """JSON-lines per session (reference FileStatsStorage/MapDB)."""
+
+    def __init__(self, path: str):
+        self.dir = Path(path)
+        self.dir.mkdir(parents=True, exist_ok=True)
+
+    def _file(self, sid):
+        return self.dir / f"{sid}.jsonl"
+
+    def put_record(self, session_id, record):
+        with open(self._file(session_id), "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+    def list_session_ids(self):
+        return [p.stem for p in self.dir.glob("*.jsonl")]
+
+    def get_records(self, session_id):
+        p = self._file(session_id)
+        if not p.exists():
+            return []
+        with open(p) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+
+# --- listener ---------------------------------------------------------------
+
+def _tree_norms(tree) -> Dict[str, float]:
+    """Per-layer L2 norms, computed on-device, scalars to host."""
+    import jax
+    import jax.numpy as jnp
+
+    out = {}
+    for name, sub in (tree or {}).items():
+        leaves = jax.tree.leaves(sub)
+        if leaves:
+            out[name] = float(jnp.sqrt(sum(jnp.sum(jnp.square(l))
+                                           for l in leaves)))
+    return out
+
+
+class StatsListener(TrainingListener):
+    """Streams per-iteration stats into a StatsStorage (reference
+    StatsListener; update:param ratios are the reference's headline
+    training-health diagnostic)."""
+
+    def __init__(self, storage: StatsStorage, frequency: int = 1,
+                 session_id: Optional[str] = None,
+                 collect_histograms: bool = False):
+        self.storage = storage
+        self.frequency = max(1, frequency)
+        self.session_id = session_id or f"session_{int(time.time())}"
+        self.collect_histograms = collect_histograms
+        self._prev_params: Optional[Dict[str, Any]] = None
+        self._t0 = time.time()
+
+    def iteration_done(self, net, iteration, epoch):
+        if iteration % self.frequency:
+            return          # keep _prev_params from the last recorded iter
+        rec: Dict[str, Any] = {
+            "iteration": iteration,
+            "epoch": epoch,
+            "time": time.time() - self._t0,
+            "score": float(net.score_)
+            if np.isfinite(net.score_) else None,
+            "param_norms": _tree_norms(net.params),
+        }
+        if self._prev_params is not None:
+            import jax
+            import jax.numpy as jnp
+            ratios = {}
+            for name, sub in net.params.items():
+                prev = self._prev_params.get(name)
+                if prev is None:
+                    continue
+                upd = jax.tree.map(lambda a, b: a - b, sub, prev)
+                un = float(jnp.sqrt(sum(jnp.sum(jnp.square(l))
+                                        for l in jax.tree.leaves(upd))))
+                pn = rec["param_norms"].get(name, 0.0)
+                ratios[name] = un / pn if pn > 0 else 0.0
+            rec["update_ratios"] = ratios
+        if self.collect_histograms:
+            rec["histograms"] = {
+                name: self._hist(sub) for name, sub in net.params.items()}
+        # keep a COPY — the net's next jitted step donates (deletes) the
+        # current param buffers
+        import jax
+        import jax.numpy as jnp
+        self._prev_params = jax.tree.map(jnp.array, net.params)
+        self.storage.put_record(self.session_id, rec)
+
+    @staticmethod
+    def _hist(sub, bins: int = 20):
+        import jax
+        leaves = [np.asarray(l).ravel() for l in jax.tree.leaves(sub)]
+        if not leaves:
+            return None
+        flat = np.concatenate(leaves)
+        counts, edges = np.histogram(flat, bins=bins)
+        return {"counts": counts.tolist(),
+                "min": float(edges[0]), "max": float(edges[-1])}
+
+
+# --- dashboard --------------------------------------------------------------
+
+def _svg_line(points, w=640, h=180, color="#2563eb"):
+    if len(points) < 2:
+        return "<svg></svg>"
+    xs = [p[0] for p in points]
+    ys = [p[1] for p in points if p[1] is not None]
+    if not ys:
+        return "<svg></svg>"
+    x0, x1 = min(xs), max(xs) or 1
+    y0, y1 = min(ys), max(ys)
+    span_x = (x1 - x0) or 1
+    span_y = (y1 - y0) or 1
+    pts = " ".join(
+        f"{(p[0]-x0)/span_x*w:.1f},{h-(p[1]-y0)/span_y*h:.1f}"
+        for p in points if p[1] is not None)
+    return (f'<svg viewBox="0 0 {w} {h}" width="{w}" height="{h}">'
+            f'<polyline fill="none" stroke="{color}" stroke-width="1.5" '
+            f'points="{pts}"/></svg>')
+
+
+class UIServer:
+    """Minimal training dashboard (reference UIServer/VertxUIServer):
+    score chart, update:param ratio chart, session picker. Stdlib-only.
+    """
+
+    _instance = None
+
+    def __init__(self, port: int = 9000):
+        self.port = port
+        self._storages: List[StatsStorage] = []
+        self._httpd = None
+        self._thread = None
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = cls(port)
+        return cls._instance
+
+    def attach(self, storage: StatsStorage):
+        self._storages.append(storage)
+        return self
+
+    # -- html --------------------------------------------------------------
+    def _render(self, session: Optional[str]) -> str:
+        sessions = [s for st in self._storages
+                    for s in st.list_session_ids()]
+        if session is None and sessions:
+            session = sessions[-1]
+        records = []
+        for st in self._storages:
+            records.extend(st.get_records(session) if session else [])
+        records.sort(key=lambda r: r.get("iteration", 0))
+        score = [(r["iteration"], r.get("score")) for r in records]
+        parts = [
+            "<html><head><title>deeplearning4j_tpu training UI</title>",
+            "<style>body{font-family:sans-serif;margin:2em;}"
+            "h2{margin-top:1.5em;}</style></head><body>",
+            "<h1>Training dashboard</h1>",
+            "<p>Sessions: " + " | ".join(
+                f'<a href="/?session={s}">{s}</a>' for s in sessions)
+            + "</p>",
+        ]
+        if records:
+            parts.append(f"<h2>Score — {session}</h2>")
+            parts.append(_svg_line(score))
+            last = records[-1]
+            if "update_ratios" in last:
+                parts.append("<h2>update:param ratio (last iter, "
+                             "log10)</h2><ul>")
+                for name, v in last["update_ratios"].items():
+                    lg = math.log10(v) if v > 0 else float("-inf")
+                    parts.append(f"<li>{name}: {lg:.2f}</li>")
+                parts.append("</ul>")
+            parts.append("<h2>param norms (last iter)</h2><ul>")
+            for name, v in last.get("param_norms", {}).items():
+                parts.append(f"<li>{name}: {v:.4f}</li>")
+            parts.append("</ul>")
+        else:
+            parts.append("<p>No records yet.</p>")
+        parts.append("</body></html>")
+        return "".join(parts)
+
+    # -- server ------------------------------------------------------------
+    def start(self):
+        import http.server
+        import urllib.parse
+
+        ui = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                q = urllib.parse.urlparse(self.path)
+                qs = urllib.parse.parse_qs(q.query)
+                session = qs.get("session", [None])[0]
+                if q.path == "/json":
+                    recs = []
+                    for st in ui._storages:
+                        if session:
+                            recs.extend(st.get_records(session))
+                    body = json.dumps(recs).encode()
+                    ctype = "application/json"
+                else:
+                    body = ui._render(session).encode()
+                    ctype = "text/html"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

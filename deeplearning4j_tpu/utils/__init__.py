@@ -1,3 +1,7 @@
 from deeplearning4j_tpu.utils.gradcheck import check_gradients
+from deeplearning4j_tpu.utils.profiler import (OpProfiler,
+                                               PerformanceTracker, trace)
+from deeplearning4j_tpu.utils import crashreport
 
-__all__ = ["check_gradients"]
+__all__ = ["check_gradients", "OpProfiler", "PerformanceTracker", "trace",
+           "crashreport"]

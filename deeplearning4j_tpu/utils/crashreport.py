@@ -1,0 +1,117 @@
+"""OOM crash reporting.
+
+Reference: ``org.deeplearning4j.util.CrashReportingUtil`` — on OOM
+during fit/output, writes a full diagnostic dump (device memory,
+workspace sizes per thread, JVM heap, network config) to disk. Notable
+DX feature preserved here for HBM OOMs: XLA's RESOURCE_EXHAUSTED errors
+are caught around the train/inference step and a report with device
+memory stats, live-buffer sizes, config JSON, and the XLA allocation
+message is written.
+"""
+from __future__ import annotations
+
+import datetime
+import os
+import traceback
+from pathlib import Path
+from typing import Any, Optional
+
+_crash_dump_dir = os.environ.get("DL4J_TPU_CRASH_DUMP_DIR", ".")
+_enabled = True
+
+
+def crash_dump_output_directory(path: Optional[str] = None):
+    global _crash_dump_dir
+    if path is not None:
+        _crash_dump_dir = path
+    return _crash_dump_dir
+
+
+def crash_dump_enabled(flag: bool = True):
+    global _enabled
+    _enabled = flag
+
+
+def _device_memory_stats() -> str:
+    import jax
+
+    lines = []
+    for d in jax.devices():
+        lines.append(f"device {d.id} ({d.platform} {d.device_kind}):")
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            lines.append("  memory_stats unavailable")
+            continue
+        if not ms:
+            lines.append("  (no stats)")
+            continue
+        for k in sorted(ms):
+            v = ms[k]
+            if isinstance(v, int) and v > 1 << 20:
+                lines.append(f"  {k}: {v / (1 << 20):.1f} MiB")
+            else:
+                lines.append(f"  {k}: {v}")
+    return "\n".join(lines)
+
+
+def _live_arrays_report(limit: int = 30) -> str:
+    import jax
+
+    try:
+        arrs = jax.live_arrays()
+    except Exception:
+        return "live_arrays unavailable"
+    sized = sorted(arrs, key=lambda a: -a.nbytes)[:limit]
+    lines = [f"{len(arrs)} live arrays; top {len(sized)} by size:"]
+    for a in sized:
+        lines.append(f"  {a.nbytes / (1 << 20):8.1f} MiB  {a.dtype} "
+                     f"{a.shape}")
+    return "\n".join(lines)
+
+
+def generate_memory_status_report(net: Any = None) -> str:
+    """Reference: CrashReportingUtil.generateMemoryStatus."""
+    parts = [
+        f"=== deeplearning4j_tpu memory/crash report "
+        f"{datetime.datetime.now().isoformat()} ===",
+        "", "--- device memory (XLA allocator) ---",
+        _device_memory_stats(),
+        "", "--- live device arrays ---", _live_arrays_report(),
+    ]
+    if net is not None:
+        parts.append("")
+        parts.append("--- network ---")
+        try:
+            parts.append(net.summary())
+        except Exception:
+            parts.append(repr(net))
+        conf = getattr(net, "conf", None)
+        if conf is not None and hasattr(conf, "to_json"):
+            parts.append("--- config ---")
+            parts.append(conf.to_json())
+    return "\n".join(parts)
+
+
+def write_memory_crash_dump(net: Any, exc: BaseException) -> Optional[str]:
+    """Write the dump; returns the path (reference
+    writeMemoryCrashDump). Called by fit/output OOM handlers."""
+    if not _enabled:
+        return None
+    ts = datetime.datetime.now().strftime("%Y%m%d_%H%M%S")
+    path = Path(_crash_dump_dir) / f"dl4j_tpu_memory_crash_dump_{ts}.txt"
+    body = generate_memory_status_report(net) + (
+        "\n\n--- exception ---\n"
+        + "".join(traceback.format_exception(exc)))
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(body)
+    except OSError:
+        return None
+    return str(path)
+
+
+def is_oom(exc: BaseException) -> bool:
+    msg = str(exc)
+    return ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+            or "out of memory" in msg)

@@ -1,0 +1,129 @@
+"""Profiling + performance tracking.
+
+Reference: ``org.nd4j.linalg.profiler.OpProfiler`` (per-op wall-time
+aggregation, invocation counts, bad-access-pattern detectors, enabled
+via ``ProfilerConfig``), ``PerformanceTracker`` (memcpy bandwidth),
+``DefaultOpExecutioner.profilingHookIn/Out`` (SURVEY §5).
+
+TPU-native redesign: per-op timing inside a jitted program belongs to
+XLA (``jax.profiler`` traces → XProf/TensorBoard), so OpProfiler here
+times *step-level* sections (the units the framework controls: train
+step, ETL wait, host↔device transfer) and exposes the same
+aggregate-report surface. ``trace()`` wraps ``jax.profiler`` for the
+full XLA timeline.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class _Stat:
+    count: int = 0
+    total_s: float = 0.0
+    max_s: float = 0.0
+
+    def add(self, dt: float):
+        self.count += 1
+        self.total_s += dt
+        self.max_s = max(self.max_s, dt)
+
+
+class OpProfiler:
+    """Section timer with the reference's aggregate-report API
+    (``OpProfiler.getInstance()``, ``printOutDashboard``)."""
+
+    _instance: Optional["OpProfiler"] = None
+
+    def __init__(self):
+        self._stats: Dict[str, _Stat] = defaultdict(_Stat)
+        self.enabled = False
+
+    @classmethod
+    def get_instance(cls) -> "OpProfiler":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def reset(self):
+        self._stats.clear()
+
+    @contextlib.contextmanager
+    def section(self, name: str, sync=None):
+        """Time a section. Pass ``sync`` (an array/pytree) to block on
+        device completion — otherwise async dispatch makes wall time
+        meaningless (the JAX analog of the reference's stream sync in
+        profilingHookOut)."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if sync is not None:
+                import jax
+                jax.block_until_ready(sync)
+            self._stats[name].add(time.perf_counter() - t0)
+
+    def time_section(self, name: str, dt: float):
+        if self.enabled:
+            self._stats[name].add(dt)
+
+    def stats(self) -> Dict[str, dict]:
+        return {k: {"count": v.count, "total_ms": v.total_s * 1e3,
+                    "mean_ms": v.total_s / v.count * 1e3 if v.count else 0,
+                    "max_ms": v.max_s * 1e3}
+                for k, v in self._stats.items()}
+
+    def print_dashboard(self) -> str:
+        lines = [f"{'section':<30} {'count':>8} {'total ms':>10} "
+                 f"{'mean ms':>10} {'max ms':>10}"]
+        for k, s in sorted(self.stats().items(),
+                           key=lambda kv: -kv[1]["total_ms"]):
+            lines.append(f"{k:<30} {s['count']:>8} {s['total_ms']:>10.2f} "
+                         f"{s['mean_ms']:>10.3f} {s['max_ms']:>10.3f}")
+        report = "\n".join(lines)
+        print(report)
+        return report
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Full XLA timeline via jax.profiler (view in XProf/TensorBoard) —
+    the per-op story the reference got from native-side instrumentation.
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class PerformanceTracker:
+    """Host↔device transfer bandwidth probe (reference
+    PerformanceTracker.helper: per-device memcpy bandwidth)."""
+
+    @staticmethod
+    def measure_bandwidth(n_bytes: int = 1 << 24, device=None
+                          ) -> Dict[str, float]:
+        import jax
+        import numpy as np
+
+        device = device or jax.devices()[0]
+        host = np.ones(n_bytes // 4, np.float32)
+        t0 = time.perf_counter()
+        dev = jax.device_put(host, device)
+        dev.block_until_ready()
+        h2d = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _ = np.asarray(dev)
+        d2h = time.perf_counter() - t0
+        return {"h2d_gbps": n_bytes / h2d / 1e9,
+                "d2h_gbps": n_bytes / d2h / 1e9}

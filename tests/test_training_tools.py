@@ -1,0 +1,299 @@
+"""Transfer learning, early stopping, stats/UI, profiler, crash report
+(reference: TransferLearningTest, EarlyStoppingTest, StatsListener/UI,
+OpProfiler, CrashReportingUtil — SURVEY §2.3/§5)."""
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn import (FineTuneConfiguration,
+                                   MultiLayerNetwork,
+                                   NeuralNetConfiguration,
+                                   TransferLearning,
+                                   TransferLearningHelper)
+from deeplearning4j_tpu.nn.config import InputType
+from deeplearning4j_tpu.nn.layers import (DenseLayer, FrozenLayer,
+                                          OutputLayer)
+from deeplearning4j_tpu.nn import updaters as upd
+
+
+def _mk_net(n_in=8, hidden=16, classes=3, seed=7):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(upd.Adam(learning_rate=1e-2)).list()
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(DenseLayer(n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_out=classes, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(rng, n=64, n_in=8, classes=3):
+    x = rng.normal(size=(n, n_in)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n)]
+    return DataSet(x, y)
+
+
+# --- transfer learning ------------------------------------------------------
+
+def test_transfer_freeze_and_replace_head(rng):
+    net = _mk_net()
+    ds = _data(rng)
+    net.fit(ListDataSetIterator(ds, batch_size=32), epochs=3)
+    w0 = np.asarray(net.params["layer_0"]["W"]).copy()
+
+    new = (TransferLearning.builder(net)
+           .fine_tune_configuration(
+               FineTuneConfiguration(updater=upd.Sgd(learning_rate=1e-2)))
+           .set_feature_extractor(1)            # freeze layers 0..1
+           .remove_output_layer()
+           .add_layer(OutputLayer(n_out=5, activation="softmax",
+                                  loss="mcxent"))
+           .build())
+    assert isinstance(new.layers[0], FrozenLayer)
+    assert isinstance(new.layers[1], FrozenLayer)
+    assert new.layers[2].n_out == 5
+    # frozen weights carried over exactly
+    np.testing.assert_array_equal(
+        np.asarray(new.params["layer_0"]["W"]), w0)
+
+    y5 = np.eye(5, dtype=np.float32)[rng.integers(0, 5, 64)]
+    ds5 = DataSet(np.asarray(ds.features), y5)
+    new.fit(ListDataSetIterator(ds5, batch_size=32), epochs=3)
+    # frozen layer untouched by training, head moved
+    np.testing.assert_array_equal(
+        np.asarray(new.params["layer_0"]["W"]), w0)
+    assert new.output(np.asarray(ds.features)).shape == (64, 5)
+
+
+def test_transfer_nout_replace(rng):
+    net = _mk_net()
+    new = (TransferLearning.builder(net)
+           .n_out_replace(1, 24, weight_init="xavier")
+           .build())
+    assert new.layers[1].n_out == 24
+    assert np.asarray(new.params["layer_1"]["W"]).shape == (16, 24)
+    assert np.asarray(new.params["layer_2"]["W"]).shape == (24, 3)
+    out = new.output(rng.normal(size=(4, 8)).astype(np.float32))
+    assert out.shape == (4, 3)
+
+
+def test_transfer_helper_featurize(rng):
+    net = _mk_net()
+    ds = _data(rng)
+    helper = TransferLearningHelper(net, frozen_until=1)
+    feats = helper.featurize(ds)
+    assert np.asarray(feats.features).shape == (64, 16)
+    before = np.asarray(helper.net.params["layer_2"]["W"]).copy()
+    helper.fit_featurized(ListDataSetIterator(feats, batch_size=32),
+                          epochs=2)
+    after = np.asarray(helper.net.params["layer_2"]["W"])
+    assert np.abs(after - before).max() > 0
+    # original (pre-freeze) net is untouched and still usable
+    assert np.asarray(net.output(np.asarray(ds.features))).shape == (64, 3)
+    # frozen part unchanged; full-net output consistent with tail
+    tail_out = helper.unfrozen_mln().output(np.asarray(feats.features))
+    full_out = helper.output(np.asarray(ds.features))
+    np.testing.assert_allclose(np.asarray(tail_out),
+                               np.asarray(full_out), rtol=1e-5)
+
+
+# --- early stopping ---------------------------------------------------------
+
+def test_early_stopping_max_epochs(rng):
+    from deeplearning4j_tpu.train import (DataSetLossCalculator,
+                                          EarlyStoppingConfiguration,
+                                          EarlyStoppingTrainer,
+                                          MaxEpochsTerminationCondition)
+
+    net = _mk_net()
+    train = ListDataSetIterator(_data(rng), batch_size=32)
+    val = ListDataSetIterator(_data(rng, n=32), batch_size=32)
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(val),
+        epoch_terminations=[MaxEpochsTerminationCondition(4)])
+    result = EarlyStoppingTrainer(cfg, net, train).fit()
+    assert result.total_epochs == 4
+    assert result.best_model is not None
+    assert result.best_model_epoch >= 0
+    assert np.isfinite(result.best_model_score)
+    assert len(result.score_vs_epoch) == 4
+
+
+def test_early_stopping_patience(rng):
+    from deeplearning4j_tpu.train import (
+        DataSetLossCalculator, EarlyStoppingConfiguration,
+        EarlyStoppingTrainer, MaxEpochsTerminationCondition,
+        ScoreImprovementEpochTerminationCondition)
+
+    net = _mk_net()
+    # random labels ≠ learnable → score plateaus fast on tiny LR
+    net.conf.updater = upd.Sgd(learning_rate=1e-8)
+    net._build_optimizer()
+    train = ListDataSetIterator(_data(rng), batch_size=64)
+    val = ListDataSetIterator(_data(rng, n=32), batch_size=32)
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(val),
+        epoch_terminations=[
+            ScoreImprovementEpochTerminationCondition(
+                patience=2, min_improvement=1e-4),
+            MaxEpochsTerminationCondition(50)])
+    result = EarlyStoppingTrainer(cfg, net, train).fit()
+    assert result.total_epochs < 50
+    assert "ScoreImprovement" in result.termination_details
+
+
+def test_early_stopping_file_saver(tmp_path, rng):
+    from deeplearning4j_tpu.train import (EarlyStoppingConfiguration,
+                                          EarlyStoppingTrainer,
+                                          LocalFileModelSaver,
+                                          MaxEpochsTerminationCondition)
+
+    net = _mk_net()
+    train = ListDataSetIterator(_data(rng), batch_size=32)
+    saver = LocalFileModelSaver(str(tmp_path))
+    cfg = EarlyStoppingConfiguration(
+        model_saver=saver,
+        epoch_terminations=[MaxEpochsTerminationCondition(2)])
+    result = EarlyStoppingTrainer(cfg, net, train).fit()
+    best = saver.get_best_model()
+    assert best is not None
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    assert np.asarray(best.output(x)).shape == (4, 3)
+
+
+def test_early_stopping_requires_termination_condition(rng):
+    from deeplearning4j_tpu.train import (EarlyStoppingConfiguration,
+                                          EarlyStoppingTrainer)
+
+    net = _mk_net()
+    train = ListDataSetIterator(_data(rng), batch_size=32)
+    with pytest.raises(ValueError, match="no termination"):
+        EarlyStoppingTrainer(EarlyStoppingConfiguration(), net,
+                             train).fit()
+
+
+def test_early_stopping_throttled_eval_respects_max_epochs(rng):
+    from deeplearning4j_tpu.train import (DataSetLossCalculator,
+                                          EarlyStoppingConfiguration,
+                                          EarlyStoppingTrainer,
+                                          MaxEpochsTerminationCondition)
+
+    net = _mk_net()
+    train = ListDataSetIterator(_data(rng), batch_size=64)
+    val = ListDataSetIterator(_data(rng, n=32), batch_size=32)
+    cfg = EarlyStoppingConfiguration(
+        score_calculator=DataSetLossCalculator(val),
+        evaluate_every_n_epochs=3,
+        epoch_terminations=[MaxEpochsTerminationCondition(4)])
+    result = EarlyStoppingTrainer(cfg, net, train).fit()
+    assert result.total_epochs == 4          # no overshoot to 6
+
+
+# --- stats / UI -------------------------------------------------------------
+
+def test_stats_listener_and_storage(rng):
+    from deeplearning4j_tpu.train import InMemoryStatsStorage, StatsListener
+
+    storage = InMemoryStatsStorage()
+    net = _mk_net()
+    net.set_listeners(StatsListener(storage, frequency=1,
+                                    session_id="t1",
+                                    collect_histograms=True))
+    net.fit(ListDataSetIterator(_data(rng), batch_size=32), epochs=2)
+    recs = storage.get_records("t1")
+    assert len(recs) == 4           # 2 batches × 2 epochs
+    assert all("score" in r and "param_norms" in r for r in recs)
+    assert "update_ratios" in recs[-1]
+    assert recs[-1]["histograms"]["layer_0"]["counts"]
+
+
+def test_stats_listener_throttled_frequency_keeps_ratios(rng):
+    from deeplearning4j_tpu.train import InMemoryStatsStorage, StatsListener
+
+    storage = InMemoryStatsStorage()
+    net = _mk_net()
+    net.set_listeners(StatsListener(storage, frequency=2, session_id="f2"))
+    net.fit(ListDataSetIterator(_data(rng), batch_size=16), epochs=2)
+    recs = storage.get_records("f2")
+    assert len(recs) >= 2
+    assert any("update_ratios" in r for r in recs[1:])
+
+
+def test_file_stats_storage_roundtrip(tmp_path, rng):
+    from deeplearning4j_tpu.train import FileStatsStorage, StatsListener
+
+    storage = FileStatsStorage(str(tmp_path))
+    net = _mk_net()
+    net.set_listeners(StatsListener(storage, session_id="s1"))
+    net.fit(ListDataSetIterator(_data(rng), batch_size=64), epochs=1)
+    again = FileStatsStorage(str(tmp_path))
+    assert again.list_session_ids() == ["s1"]
+    assert again.get_records("s1")
+
+
+def test_ui_server(rng):
+    from deeplearning4j_tpu.train import (InMemoryStatsStorage,
+                                          StatsListener, UIServer)
+
+    storage = InMemoryStatsStorage()
+    net = _mk_net()
+    net.set_listeners(StatsListener(storage, session_id="ui1"))
+    net.fit(ListDataSetIterator(_data(rng), batch_size=64), epochs=1)
+    ui = UIServer(port=0).attach(storage).start()
+    try:
+        html = urllib.request.urlopen(
+            f"http://127.0.0.1:{ui.port}/?session=ui1",
+            timeout=5).read().decode()
+        assert "Training dashboard" in html and "svg" in html
+        data = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{ui.port}/json?session=ui1",
+            timeout=5).read())
+        assert data and data[0]["iteration"] >= 1
+    finally:
+        ui.stop()
+
+
+# --- profiler / crash report -----------------------------------------------
+
+def test_op_profiler(rng):
+    from deeplearning4j_tpu.utils import OpProfiler
+
+    prof = OpProfiler.get_instance()
+    prof.reset()
+    prof.enabled = True
+    net = _mk_net()
+    ds = _data(rng)
+    with prof.section("fit", sync=None):
+        net.fit(ListDataSetIterator(ds, batch_size=64), epochs=1)
+    prof.enabled = False
+    stats = prof.stats()
+    assert stats["fit"]["count"] == 1
+    assert stats["fit"]["total_ms"] > 0
+    report = prof.print_dashboard()
+    assert "fit" in report
+
+
+def test_performance_tracker():
+    from deeplearning4j_tpu.utils import PerformanceTracker
+
+    bw = PerformanceTracker.measure_bandwidth(1 << 20)
+    assert bw["h2d_gbps"] > 0 and bw["d2h_gbps"] > 0
+
+
+def test_crash_report_contents(tmp_path, rng):
+    from deeplearning4j_tpu.utils import crashreport
+
+    net = _mk_net()
+    report = crashreport.generate_memory_status_report(net)
+    assert "device memory" in report
+    assert "DenseLayer" in report or "network" in report
+    crashreport.crash_dump_output_directory(str(tmp_path))
+    path = crashreport.write_memory_crash_dump(
+        net, RuntimeError("RESOURCE_EXHAUSTED: fake"))
+    assert path is not None
+    assert "RESOURCE_EXHAUSTED" in open(path).read()
+    assert crashreport.is_oom(RuntimeError("RESOURCE_EXHAUSTED: x"))
+    assert not crashreport.is_oom(RuntimeError("bad shapes"))

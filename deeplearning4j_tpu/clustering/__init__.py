@@ -1,0 +1,10 @@
+"""Nearest-neighbor search (reference:
+``deeplearning4j-nearestneighbor-parent`` —
+``org.deeplearning4j.clustering.vptree.VPTree``,
+``kdtree.KDTree``).
+"""
+from deeplearning4j_tpu.clustering.vptree import VPTree
+from deeplearning4j_tpu.clustering.kdtree import KDTree
+from deeplearning4j_tpu.clustering.knn import BruteForceNearestNeighbors
+
+__all__ = ["VPTree", "KDTree", "BruteForceNearestNeighbors"]

@@ -1,0 +1,65 @@
+"""KD-tree (reference: ``org.deeplearning4j.clustering.kdtree.KDTree`` —
+axis-cycling split, nn/knn queries, euclidean metric).
+"""
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class _KDNode:
+    __slots__ = ("index", "axis", "left", "right")
+
+    def __init__(self, index, axis):
+        self.index = index
+        self.axis = axis
+        self.left: Optional["_KDNode"] = None
+        self.right: Optional["_KDNode"] = None
+
+
+class KDTree:
+    def __init__(self, points: np.ndarray):
+        self.items = np.asarray(points, np.float32)
+        self.dims = self.items.shape[1]
+        self.root = self._build(list(range(len(self.items))), 0)
+
+    def _build(self, idx: List[int], depth: int) -> Optional[_KDNode]:
+        if not idx:
+            return None
+        axis = depth % self.dims
+        idx.sort(key=lambda i: self.items[i, axis])
+        mid = len(idx) // 2
+        node = _KDNode(idx[mid], axis)
+        node.left = self._build(idx[:mid], depth + 1)
+        node.right = self._build(idx[mid + 1:], depth + 1)
+        return node
+
+    def nn(self, q: np.ndarray) -> Tuple[int, float]:
+        idx, dist = self.knn(q, 1)
+        return idx[0], dist[0]
+
+    def knn(self, q: np.ndarray, k: int) -> Tuple[List[int], List[float]]:
+        q = np.asarray(q, np.float32)
+        heap: List[Tuple[float, int]] = []
+
+        def visit(node: Optional[_KDNode]):
+            if node is None:
+                return
+            p = self.items[node.index]
+            d = float(np.linalg.norm(p - q))
+            if len(heap) < k:
+                heapq.heappush(heap, (-d, node.index))
+            elif d < -heap[0][0]:
+                heapq.heapreplace(heap, (-d, node.index))
+            diff = q[node.axis] - p[node.axis]
+            near, far = (node.left, node.right) if diff <= 0 \
+                else (node.right, node.left)
+            visit(near)
+            if len(heap) < k or abs(diff) < -heap[0][0]:
+                visit(far)
+
+        visit(self.root)
+        pairs = sorted((-nd, i) for nd, i in heap)
+        return [i for _, i in pairs], [d for d, _ in pairs]

@@ -1,0 +1,43 @@
+"""Brute-force k-NN on device (reference:
+``nearestneighbor-core`` ``NearestNeighbor`` exact search). One jitted
+matmul-based distance kernel — on TPU this beats tree traversal for
+most corpus sizes (the trees exist for CPU-side parity and huge
+corpora).
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+
+class BruteForceNearestNeighbors:
+    def __init__(self, points: np.ndarray, distance: str = "euclidean"):
+        import jax
+        import jax.numpy as jnp
+
+        self.distance = distance
+        self._points = jnp.asarray(np.asarray(points, np.float32))
+
+        def query(points, q, k):
+            if distance == "euclidean":
+                d2 = (jnp.sum(points * points, 1)
+                      - 2.0 * points @ q + q @ q)
+                d = jnp.sqrt(jnp.maximum(d2, 0.0))
+            elif distance == "cosine":
+                pn = jnp.linalg.norm(points, axis=1)
+                d = 1.0 - (points @ q) / jnp.maximum(
+                    pn * jnp.linalg.norm(q), 1e-12)
+            elif distance == "manhattan":
+                d = jnp.sum(jnp.abs(points - q), axis=1)
+            else:
+                raise ValueError(f"unknown metric {distance!r}")
+            neg, idx = jax.lax.top_k(-d, k)
+            return idx, -neg
+
+        self._query = jax.jit(query, static_argnums=(2,))
+
+    def knn(self, q: np.ndarray, k: int) -> Tuple[List[int], List[float]]:
+        idx, d = self._query(self._points,
+                             np.asarray(q, np.float32), int(k))
+        return list(np.asarray(idx)), list(np.asarray(d))

@@ -1,0 +1,97 @@
+"""Vantage-point tree (reference:
+``org.deeplearning4j.clustering.vptree.VPTree`` — metric-space
+nearest-neighbor search with euclidean/cosine/manhattan distances,
+``search(target, k)`` API).
+"""
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def _distances(metric: str, data: np.ndarray, q: np.ndarray) -> np.ndarray:
+    if metric == "euclidean":
+        return np.linalg.norm(data - q, axis=-1)
+    if metric == "manhattan":
+        return np.abs(data - q).sum(axis=-1)
+    if metric == "cosine":
+        dn = np.linalg.norm(data, axis=-1) * np.linalg.norm(q)
+        return 1.0 - (data @ q) / np.maximum(dn, 1e-12)
+    raise ValueError(f"unknown distance metric {metric!r}")
+
+
+class _Node:
+    __slots__ = ("index", "threshold", "inside", "outside")
+
+    def __init__(self, index: int):
+        self.index = index
+        self.threshold = 0.0
+        self.inside: Optional["_Node"] = None
+        self.outside: Optional["_Node"] = None
+
+
+class VPTree:
+    """Reference: VPTree(INDArray, String distance). O(log n) expected
+    search in metric spaces where KD-trees degrade (high dims)."""
+
+    def __init__(self, points: np.ndarray, distance: str = "euclidean",
+                 seed: int = 123):
+        self.items = np.asarray(points, np.float32)
+        self.distance = distance
+        self._rng = np.random.default_rng(seed)
+        idx = list(range(len(self.items)))
+        self.root = self._build(idx)
+
+    def _dist_one(self, i: int, q: np.ndarray) -> float:
+        return float(_distances(self.distance, self.items[i][None], q)[0])
+
+    def _build(self, idx: List[int]) -> Optional[_Node]:
+        if not idx:
+            return None
+        vp = idx[self._rng.integers(len(idx))]
+        rest = [i for i in idx if i != vp]
+        node = _Node(vp)
+        if not rest:
+            return node
+        d = _distances(self.distance, self.items[rest], self.items[vp])
+        node.threshold = float(np.median(d))
+        inside = [rest[i] for i in range(len(rest))
+                  if d[i] <= node.threshold]
+        outside = [rest[i] for i in range(len(rest))
+                   if d[i] > node.threshold]
+        node.inside = self._build(inside)
+        node.outside = self._build(outside)
+        return node
+
+    def search(self, target: np.ndarray, k: int
+               ) -> Tuple[List[int], List[float]]:
+        """k nearest (indices, distances) — reference
+        VPTree.search(target, k, results, distances)."""
+        q = np.asarray(target, np.float32)
+        heap: List[Tuple[float, int]] = []    # max-heap via negation
+        tau = [np.inf]
+
+        def visit(node: Optional[_Node]):
+            if node is None:
+                return
+            d = self._dist_one(node.index, q)
+            if d < tau[0] or len(heap) < k:
+                heapq.heappush(heap, (-d, node.index))
+                if len(heap) > k:
+                    heapq.heappop(heap)
+                if len(heap) == k:
+                    tau[0] = -heap[0][0]
+            if d <= node.threshold:
+                visit(node.inside)
+                if d + tau[0] > node.threshold:
+                    visit(node.outside)
+            else:
+                visit(node.outside)
+                if d - tau[0] <= node.threshold:
+                    visit(node.inside)
+
+        visit(self.root)
+        pairs = sorted((-nd, i) for nd, i in heap)
+        return [i for _, i in pairs], [d for d, _ in pairs]

@@ -1,0 +1,7 @@
+"""Graph embeddings (reference: ``deeplearning4j-graph`` —
+``org.deeplearning4j.graph.models.deepwalk.DeepWalk``,
+``graph.Graph``, ``iterator.RandomWalkIterator``).
+"""
+from deeplearning4j_tpu.graphnn.deepwalk import DeepWalk, Graph
+
+__all__ = ["DeepWalk", "Graph"]

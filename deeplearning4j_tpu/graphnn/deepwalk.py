@@ -1,0 +1,94 @@
+"""DeepWalk graph embeddings.
+
+Reference: ``org.deeplearning4j.graph.models.deepwalk.DeepWalk`` —
+uniform random walks from every vertex (``RandomWalkIterator``), fed to
+skip-gram with window; the reference trains a custom GraphVectors
+hierarchy, here the walks reuse the Word2Vec negative-sampling jitted
+step (same math, one code path).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+class Graph:
+    """Undirected/directed adjacency graph (reference
+    org.deeplearning4j.graph.graph.Graph)."""
+
+    def __init__(self, n_vertices: int, directed: bool = False):
+        self.n = n_vertices
+        self.directed = directed
+        self._adj: List[List[int]] = [[] for _ in range(n_vertices)]
+
+    def add_edge(self, a: int, b: int):
+        self._adj[a].append(b)
+        if not self.directed:
+            self._adj[b].append(a)
+        return self
+
+    def neighbors(self, v: int) -> List[int]:
+        return self._adj[v]
+
+    def num_vertices(self) -> int:
+        return self.n
+
+
+class DeepWalk:
+    """Reference: DeepWalk (+.Builder): windowSize/vectorSize/walkLength/
+    walksPerVertex; fit(graph) then getVertexVector/similarity."""
+
+    def __init__(self, vector_size: int = 64, window_size: int = 5,
+                 walk_length: int = 40, walks_per_vertex: int = 10,
+                 learning_rate: float = 0.025, negative: int = 5,
+                 epochs: int = 1, seed: int = 77):
+        self.vector_size = vector_size
+        self.window_size = window_size
+        self.walk_length = walk_length
+        self.walks_per_vertex = walks_per_vertex
+        self.learning_rate = learning_rate
+        self.negative = negative
+        self.epochs = epochs
+        self.seed = seed
+        self._w2v: Optional[Word2Vec] = None
+
+    def _random_walks(self, graph: Graph) -> List[List[str]]:
+        rng = np.random.default_rng(self.seed)
+        walks = []
+        for _ in range(self.walks_per_vertex):
+            for start in rng.permutation(graph.num_vertices()):
+                v = int(start)
+                walk = [str(v)]
+                for _ in range(self.walk_length - 1):
+                    nbrs = graph.neighbors(v)
+                    if not nbrs:
+                        break
+                    v = int(nbrs[rng.integers(len(nbrs))])
+                    walk.append(str(v))
+                walks.append(walk)
+        return walks
+
+    def fit(self, graph: Graph) -> "DeepWalk":
+        walks = self._random_walks(graph)
+        w2v = Word2Vec(layer_size=self.vector_size,
+                       window_size=self.window_size,
+                       min_word_frequency=1,
+                       negative=self.negative,
+                       learning_rate=self.learning_rate,
+                       epochs=self.epochs, seed=self.seed)
+        w2v.fit(" ".join(w) for w in walks)
+        self._w2v = w2v
+        return self
+
+    def get_vertex_vector(self, v: int) -> np.ndarray:
+        return self._w2v.get_word_vector(str(v))
+
+    def similarity(self, a: int, b: int) -> float:
+        return self._w2v.similarity(str(a), str(b))
+
+    def verts_nearest(self, v: int, top_n: int = 5) -> List[int]:
+        return [int(w) for w in
+                self._w2v.words_nearest(str(v), top_n)]

@@ -1,0 +1,14 @@
+"""NLP package (reference: ``deeplearning4j-nlp-parent/deeplearning4j-nlp``
+— Word2Vec/ParagraphVectors, tokenizers, vocab builders,
+InMemoryLookupTable, WordVectorSerializer).
+"""
+from deeplearning4j_tpu.nlp.tokenization import (DefaultTokenizer,
+                                                 DefaultTokenizerFactory,
+                                                 CommonPreprocessor)
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+from deeplearning4j_tpu.nlp.word2vec import (Word2Vec, ParagraphVectors,
+                                             WordVectorSerializer)
+
+__all__ = ["DefaultTokenizer", "DefaultTokenizerFactory",
+           "CommonPreprocessor", "VocabCache", "VocabWord", "Word2Vec",
+           "ParagraphVectors", "WordVectorSerializer"]

@@ -1,0 +1,82 @@
+"""Vocabulary construction (reference:
+``org.deeplearning4j.models.word2vec.wordstore.VocabCache`` /
+``inmemory.InMemoryLookupCache`` and the ``VocabConstructor`` pipeline:
+count → filter by minWordFrequency → index, plus the unigram^0.75
+noise distribution used by negative sampling).
+"""
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class VocabWord:
+    word: str
+    count: int
+    index: int
+
+
+class VocabCache:
+    """Word ↔ index with frequencies (reference VocabCache)."""
+
+    def __init__(self):
+        self._words: List[VocabWord] = []
+        self._by_word: Dict[str, VocabWord] = {}
+        self.total_count = 0
+
+    @classmethod
+    def build(cls, token_streams: Iterable[List[str]],
+              min_word_frequency: int = 1) -> "VocabCache":
+        counts = Counter()
+        for tokens in token_streams:
+            counts.update(tokens)
+        vc = cls()
+        for word, c in counts.most_common():
+            if c < min_word_frequency:
+                continue
+            vw = VocabWord(word, c, len(vc._words))
+            vc._words.append(vw)
+            vc._by_word[word] = vw
+        vc.total_count = sum(w.count for w in vc._words)
+        return vc
+
+    def __len__(self):
+        return len(self._words)
+
+    def __contains__(self, word: str):
+        return word in self._by_word
+
+    def index_of(self, word: str) -> int:
+        vw = self._by_word.get(word)
+        return vw.index if vw else -1
+
+    def word_at(self, index: int) -> str:
+        return self._words[index].word
+
+    def word_frequency(self, word: str) -> int:
+        vw = self._by_word.get(word)
+        return vw.count if vw else 0
+
+    def words(self) -> List[str]:
+        return [w.word for w in self._words]
+
+    def noise_distribution(self, power: float = 0.75) -> np.ndarray:
+        """Unigram^0.75 sampling weights (reference negative-sampling
+        table)."""
+        f = np.array([w.count for w in self._words], np.float64) ** power
+        return (f / f.sum()).astype(np.float64)
+
+    def subsample_keep_prob(self, t: float = 1e-3) -> np.ndarray:
+        """Frequent-word subsampling keep-probabilities (reference
+        ``sampling`` param, Mikolov formula)."""
+        if self.total_count == 0:
+            return np.ones(0)
+        f = np.array([w.count for w in self._words],
+                     np.float64) / self.total_count
+        with np.errstate(divide="ignore", invalid="ignore"):
+            p = np.sqrt(t / f) + t / f
+        return np.clip(np.nan_to_num(p, posinf=1.0), 0.0, 1.0)

@@ -1,0 +1,450 @@
+"""Word2Vec / ParagraphVectors.
+
+Reference: ``org.deeplearning4j.models.word2vec.Word2Vec`` (builder:
+layerSize/windowSize/minWordFrequency/negative/iterations/seed),
+``embeddings.inmemory.InMemoryLookupTable`` (syn0/syn1neg),
+``models.paragraphvectors.ParagraphVectors`` (PV-DBOW),
+``embeddings.loader.WordVectorSerializer``; libnd4j ``skipgram``/``cbow``
+declarable ops (SURVEY §2.3 NLP row).
+
+TPU-native redesign: instead of the reference's per-pair native skipgram
+op with hierarchical softmax, training batches (center, context,
+negatives) index triples into ONE jitted negative-sampling SGD step —
+embedding gathers/scatters lower to XLA dynamic-slice ops, and a whole
+epoch's pairs stream through fixed-shape batches (no retrace).
+"""
+from __future__ import annotations
+
+import io
+import zipfile
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+
+def _make_sg_step():
+    import jax
+    import jax.numpy as jnp
+
+    def step(syn0, syn1, centers, contexts, negatives, lr):
+        def loss_fn(tables):
+            s0, s1 = tables
+            c = s0[centers]                       # [B, D]
+            pos = s1[contexts]                    # [B, D]
+            neg = s1[negatives]                   # [B, K, D]
+            pos_score = jnp.sum(c * pos, axis=-1)
+            neg_score = jnp.einsum("bd,bkd->bk", c, neg)
+            # negative-sampling objective (Mikolov et al. 2013)
+            l = -jnp.mean(jax.nn.log_sigmoid(pos_score)
+                          + jnp.sum(jax.nn.log_sigmoid(-neg_score), -1))
+            return l
+
+        loss, grads = jax.value_and_grad(loss_fn)((syn0, syn1))
+        syn0 = syn0 - lr * grads[0]
+        syn1 = syn1 - lr * grads[1]
+        return syn0, syn1, loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+def _make_cbow_step():
+    import jax
+    import jax.numpy as jnp
+
+    def step(syn0, syn1, contexts, mask, targets, negatives, lr):
+        def loss_fn(tables):
+            s0, s1 = tables
+            ctx = s0[contexts]                    # [B, W, D]
+            m = mask[..., None]
+            mean = jnp.sum(ctx * m, 1) / jnp.maximum(jnp.sum(m, 1), 1.0)
+            pos = s1[targets]
+            neg = s1[negatives]
+            pos_score = jnp.sum(mean * pos, -1)
+            neg_score = jnp.einsum("bd,bkd->bk", mean, neg)
+            return -jnp.mean(jax.nn.log_sigmoid(pos_score)
+                             + jnp.sum(jax.nn.log_sigmoid(-neg_score), -1))
+
+        loss, grads = jax.value_and_grad(loss_fn)((syn0, syn1))
+        return syn0 - lr * grads[0], syn1 - lr * grads[1], loss
+
+    return jax.jit(step, donate_argnums=(0, 1))
+
+
+class Word2Vec:
+    """Reference: Word2Vec (+.Builder). Same fluent surface."""
+
+    def __init__(self, layer_size: int = 100, window_size: int = 5,
+                 min_word_frequency: int = 5, negative: int = 5,
+                 iterations: int = 1, epochs: int = 1,
+                 learning_rate: float = 0.025, min_learning_rate=1e-4,
+                 sampling: float = 0.0, batch_size: int = 512,
+                 elements_algo: str = "skipgram", seed: int = 42,
+                 tokenizer_factory=None):
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.min_word_frequency = min_word_frequency
+        self.negative = negative
+        self.iterations = iterations
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.min_learning_rate = min_learning_rate
+        self.sampling = sampling
+        self.batch_size = batch_size
+        self.elements_algo = elements_algo
+        self.seed = seed
+        self.tokenizer_factory = tokenizer_factory \
+            or DefaultTokenizerFactory()
+        self.vocab: Optional[VocabCache] = None
+        self.syn0: Optional[np.ndarray] = None
+        self._losses: List[float] = []
+
+    # -- builder-style sugar (reference Word2Vec.Builder) ------------------
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def layer_size(self, v):
+            self._kw["layer_size"] = v; return self
+
+        def window_size(self, v):
+            self._kw["window_size"] = v; return self
+
+        def min_word_frequency(self, v):
+            self._kw["min_word_frequency"] = v; return self
+
+        def negative_sample(self, v):
+            self._kw["negative"] = int(v); return self
+
+        def iterations(self, v):
+            self._kw["iterations"] = v; return self
+
+        def epochs(self, v):
+            self._kw["epochs"] = v; return self
+
+        def learning_rate(self, v):
+            self._kw["learning_rate"] = v; return self
+
+        def sampling(self, v):
+            self._kw["sampling"] = v; return self
+
+        def seed(self, v):
+            self._kw["seed"] = v; return self
+
+        def batch_size(self, v):
+            self._kw["batch_size"] = v; return self
+
+        def elements_learning_algorithm(self, name):
+            self._kw["elements_algo"] = \
+                "cbow" if "cbow" in name.lower() else "skipgram"
+            return self
+
+        def tokenizer_factory(self, tf):
+            self._kw["tokenizer_factory"] = tf; return self
+
+        def build(self):
+            return Word2Vec(**self._kw)
+
+    @staticmethod
+    def builder() -> "Word2Vec.Builder":
+        return Word2Vec.Builder()
+
+    # -- training ----------------------------------------------------------
+    def _tokenize_corpus(self, sentences: Iterable[str]) -> List[List[str]]:
+        return [self.tokenizer_factory.create(s).get_tokens()
+                for s in sentences]
+
+    def fit(self, sentences: Iterable[str]) -> "Word2Vec":
+        corpus = self._tokenize_corpus(sentences)
+        self.vocab = VocabCache.build(corpus, self.min_word_frequency)
+        if len(self.vocab) == 0:
+            raise ValueError("empty vocabulary after frequency filtering")
+        encoded = [[self.vocab.index_of(t) for t in sent
+                    if t in self.vocab] for sent in corpus]
+        self._train_elements(encoded)
+        return self
+
+    def _train_elements(self, encoded: List[List[int]],
+                        doc_labels: Optional[np.ndarray] = None):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(self.seed)
+        v, d = len(self.vocab), self.layer_size
+        syn0 = jnp.asarray(
+            (rng.random((v, d), np.float32) - 0.5) / d)
+        syn1 = jnp.zeros((v, d), jnp.float32)
+        noise = self.vocab.noise_distribution()
+        keep = (self.vocab.subsample_keep_prob(self.sampling)
+                if self.sampling > 0 else None)
+        step = (_make_sg_step() if self.elements_algo == "skipgram"
+                else _make_cbow_step())
+        total_steps = 0
+        # pre-count pairs for LR decay
+        n_epochs = self.epochs * self.iterations
+
+        for epoch in range(n_epochs):
+            centers, contexts = [], []
+            cbow_ctx, cbow_mask = [], []
+            for sent in encoded:
+                if keep is not None:
+                    sent = [w for w in sent
+                            if rng.random() < keep[w]]
+                n = len(sent)
+                for i, w in enumerate(sent):
+                    b = rng.integers(1, self.window_size + 1)
+                    lo, hi = max(0, i - b), min(n, i + b + 1)
+                    ctx = [sent[j] for j in range(lo, hi) if j != i]
+                    if not ctx:
+                        continue
+                    if self.elements_algo == "skipgram":
+                        for c in ctx:
+                            centers.append(w)
+                            contexts.append(c)
+                    else:
+                        pad = ctx[:2 * self.window_size]
+                        m = len(pad)
+                        pad = pad + [0] * (2 * self.window_size - m)
+                        cbow_ctx.append(pad)
+                        cbow_mask.append([1.0] * m + [0.0] *
+                                         (2 * self.window_size - m))
+                        centers.append(w)
+            if not centers:
+                continue
+            order = rng.permutation(len(centers))
+            centers_a = np.asarray(centers, np.int32)[order]
+            if self.elements_algo == "skipgram":
+                contexts_a = np.asarray(contexts, np.int32)[order]
+            else:
+                cbow_ctx_a = np.asarray(cbow_ctx, np.int32)[order]
+                cbow_mask_a = np.asarray(cbow_mask, np.float32)[order]
+            bs = self.batch_size
+            n_batches = (len(centers_a) + bs - 1) // bs
+            frac_per = 1.0 / max(n_epochs * n_batches, 1)
+            for bi in range(n_batches):
+                sl = slice(bi * bs, (bi + 1) * bs)
+                ce = centers_a[sl]
+                if len(ce) < bs:      # pad to fixed shape: no retrace
+                    pad = bs - len(ce)
+                    ce = np.pad(ce, (0, pad), mode="edge")
+                    if self.elements_algo == "skipgram":
+                        co = np.pad(contexts_a[sl], (0, pad), mode="edge")
+                    else:
+                        cc = np.pad(cbow_ctx_a[sl], ((0, pad), (0, 0)),
+                                    mode="edge")
+                        cm = np.pad(cbow_mask_a[sl], ((0, pad), (0, 0)),
+                                    mode="edge")
+                else:
+                    if self.elements_algo == "skipgram":
+                        co = contexts_a[sl]
+                    else:
+                        cc, cm = cbow_ctx_a[sl], cbow_mask_a[sl]
+                negs = rng.choice(len(noise), size=(bs, self.negative),
+                                  p=noise).astype(np.int32)
+                frac = total_steps * frac_per
+                lr = max(self.learning_rate * (1.0 - frac),
+                         self.min_learning_rate)
+                if self.elements_algo == "skipgram":
+                    syn0, syn1, loss = step(syn0, syn1, ce, co, negs, lr)
+                else:
+                    syn0, syn1, loss = step(syn0, syn1, cc, cm, ce,
+                                            negs, lr)
+                total_steps += 1
+            self._losses.append(float(loss))
+        self.syn0 = np.asarray(syn0)
+
+    # -- word-vector queries (reference WordVectors interface) -------------
+    def has_word(self, word: str) -> bool:
+        return self.vocab is not None and word in self.vocab
+
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        if not self.has_word(word):
+            return None
+        return self.syn0[self.vocab.index_of(word)]
+
+    def get_word_vector_matrix(self, words: Sequence[str]) -> np.ndarray:
+        return np.stack([self.get_word_vector(w) for w in words])
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        denom = np.linalg.norm(va) * np.linalg.norm(vb)
+        return float(va @ vb / denom) if denom > 0 else 0.0
+
+    def words_nearest(self, word_or_vec, top_n: int = 10) -> List[str]:
+        if isinstance(word_or_vec, str):
+            v = self.get_word_vector(word_or_vec)
+            exclude = {word_or_vec}
+        else:
+            v = np.asarray(word_or_vec)
+            exclude = set()
+        if v is None:
+            return []
+        norms = np.linalg.norm(self.syn0, axis=1) * np.linalg.norm(v)
+        sims = (self.syn0 @ v) / np.maximum(norms, 1e-12)
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_at(int(i))
+            if w in exclude:
+                continue
+            out.append(w)
+            if len(out) >= top_n:
+                break
+        return out
+
+
+class ParagraphVectors(Word2Vec):
+    """PV-DBOW doc vectors (reference ParagraphVectors; the DBOW
+    flavor = skipgram with the doc id as the center token)."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.doc_vectors: Optional[np.ndarray] = None
+        self._doc_labels: List[str] = []
+
+    def fit_documents(self, labels: Sequence[str],
+                      documents: Sequence[str]) -> "ParagraphVectors":
+        import jax.numpy as jnp
+
+        corpus = self._tokenize_corpus(documents)
+        self.vocab = VocabCache.build(corpus, self.min_word_frequency)
+        if len(self.vocab) == 0:
+            raise ValueError("empty vocabulary after frequency filtering")
+        self._doc_labels = list(labels)
+        encoded = [[self.vocab.index_of(t) for t in sent
+                    if t in self.vocab] for sent in corpus]
+
+        rng = np.random.default_rng(self.seed)
+        v, d, nd = len(self.vocab), self.layer_size, len(encoded)
+        docs = jnp.asarray((rng.random((nd, d), np.float32) - 0.5) / d)
+        syn1 = jnp.zeros((v, d), jnp.float32)
+        noise = self.vocab.noise_distribution()
+        step = _make_sg_step()
+        n_epochs = self.epochs * self.iterations
+        bs = self.batch_size
+        total = 0
+        for epoch in range(n_epochs):
+            di, wi = [], []
+            for doc_id, sent in enumerate(encoded):
+                for w in sent:
+                    di.append(doc_id)
+                    wi.append(w)
+            if not di:
+                break
+            order = rng.permutation(len(di))
+            di = np.asarray(di, np.int32)[order]
+            wi = np.asarray(wi, np.int32)[order]
+            n_batches = (len(di) + bs - 1) // bs
+            for bi in range(n_batches):
+                sl = slice(bi * bs, (bi + 1) * bs)
+                dd, ww = di[sl], wi[sl]
+                if len(dd) < bs:
+                    pad = bs - len(dd)
+                    dd = np.pad(dd, (0, pad), mode="edge")
+                    ww = np.pad(ww, (0, pad), mode="edge")
+                negs = rng.choice(len(noise), size=(bs, self.negative),
+                                  p=noise).astype(np.int32)
+                lr = max(self.learning_rate
+                         * (1 - total / (n_epochs * n_batches)),
+                         self.min_learning_rate)
+                docs, syn1, loss = step(docs, syn1, dd, ww, negs, lr)
+                total += 1
+        self.doc_vectors = np.asarray(docs)
+        self.syn0 = np.asarray(syn1)   # word side for queries
+        self._syn1 = self.syn0
+        return self
+
+    def get_doc_vector(self, label: str) -> Optional[np.ndarray]:
+        try:
+            return self.doc_vectors[self._doc_labels.index(label)]
+        except ValueError:
+            return None
+
+    def infer_vector(self, document: str, steps: int = 50,
+                     lr: float = 0.05) -> np.ndarray:
+        """Gradient-infer a vector for an unseen doc (reference
+        inferVector)."""
+        import jax
+        import jax.numpy as jnp
+
+        tokens = [t for t in
+                  self.tokenizer_factory.create(document).get_tokens()
+                  if t in self.vocab]
+        idx = np.asarray([self.vocab.index_of(t) for t in tokens],
+                         np.int32)
+        rng = np.random.default_rng(self.seed)
+        vec = jnp.asarray((rng.random(self.layer_size, np.float32) - 0.5)
+                          / self.layer_size)
+        if len(idx) == 0:
+            return np.asarray(vec)
+        syn1 = jnp.asarray(self._syn1)
+        noise = self.vocab.noise_distribution()
+
+        @jax.jit
+        def infer_step(v, words, negs):
+            def loss_fn(v):
+                pos = syn1[words] @ v
+                neg = jnp.einsum("kd,d->k", syn1[negs.ravel()], v)
+                return -(jnp.sum(jax.nn.log_sigmoid(pos))
+                         + jnp.sum(jax.nn.log_sigmoid(-neg)))
+            return v - lr * jax.grad(loss_fn)(v)
+
+        for _ in range(steps):
+            negs = rng.choice(len(noise),
+                              size=(len(idx), self.negative),
+                              p=noise).astype(np.int32)
+            vec = infer_step(vec, idx, negs)
+        return np.asarray(vec)
+
+    def similarity_to_label(self, document: str, label: str) -> float:
+        v = self.infer_vector(document)
+        d = self.get_doc_vector(label)
+        denom = np.linalg.norm(v) * np.linalg.norm(d)
+        return float(v @ d / denom) if denom > 0 else 0.0
+
+
+class WordVectorSerializer:
+    """Text + zip persistence (reference WordVectorSerializer
+    writeWord2VecModel/readWord2VecModel)."""
+
+    @staticmethod
+    def write_word2vec_model(model: Word2Vec, path: str) -> None:
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            buf = io.StringIO()
+            buf.write(f"{len(model.vocab)} {model.layer_size}\n")
+            for i, word in enumerate(model.vocab.words()):
+                vec = " ".join(f"{x:.6f}" for x in model.syn0[i])
+                buf.write(f"{word} {vec}\n")
+            zf.writestr("syn0.txt", buf.getvalue())
+            counts = "\n".join(
+                f"{w} {model.vocab.word_frequency(w)}"
+                for w in model.vocab.words())
+            zf.writestr("counts.txt", counts)
+
+    @staticmethod
+    def read_word2vec_model(path: str) -> Word2Vec:
+        with zipfile.ZipFile(path) as zf:
+            lines = zf.read("syn0.txt").decode().splitlines()
+            counts = dict(
+                line.rsplit(" ", 1)
+                for line in zf.read("counts.txt").decode().splitlines()
+                if line)
+        n, d = (int(x) for x in lines[0].split())
+        model = Word2Vec(layer_size=d, min_word_frequency=1)
+        token_streams = []
+        vecs = []
+        for line in lines[1:n + 1]:
+            parts = line.rsplit(" ", d)
+            word = parts[0]
+            token_streams.append([word] * int(counts.get(word, 1)))
+            vecs.append(np.asarray([float(x) for x in parts[1:]],
+                                   np.float32))
+        model.vocab = VocabCache.build(token_streams, 1)
+        syn0 = np.zeros((len(model.vocab), d), np.float32)
+        for stream, vec in zip(token_streams, vecs):
+            syn0[model.vocab.index_of(stream[0])] = vec
+        model.syn0 = syn0
+        return model

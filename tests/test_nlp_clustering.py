@@ -1,0 +1,185 @@
+"""Word2Vec/ParagraphVectors, tokenizers, VPTree/KDTree/kNN, DeepWalk
+(reference: Word2VecTests, VPTreeTest, DeepWalkGradientCheck)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (BruteForceNearestNeighbors,
+                                           KDTree, VPTree)
+from deeplearning4j_tpu.graphnn import DeepWalk, Graph
+from deeplearning4j_tpu.nlp import (CommonPreprocessor,
+                                    DefaultTokenizerFactory,
+                                    ParagraphVectors, VocabCache,
+                                    Word2Vec, WordVectorSerializer)
+
+
+# --- tokenization / vocab ---------------------------------------------------
+
+def test_tokenizer_preprocessor():
+    tf = DefaultTokenizerFactory().set_token_pre_processor(
+        CommonPreprocessor())
+    toks = tf.create("Hello, World! 123 foo-bar").get_tokens()
+    assert toks == ["hello", "world", "foo-bar"]
+
+
+def test_vocab_build_and_noise():
+    streams = [["a", "b", "a", "c"], ["a", "b", "rare"]]
+    vc = VocabCache.build(streams, min_word_frequency=2)
+    assert len(vc) == 2
+    assert vc.index_of("a") == 0          # most frequent first
+    assert "rare" not in vc
+    noise = vc.noise_distribution()
+    assert noise.shape == (2,)
+    np.testing.assert_allclose(noise.sum(), 1.0)
+
+
+# --- word2vec ---------------------------------------------------------------
+
+def _toy_corpus():
+    """Two topic clusters; co-occurring words should embed nearby."""
+    rng = np.random.default_rng(0)
+    animals = ["cat", "dog", "horse", "cow"]
+    foods = ["apple", "bread", "cheese", "rice"]
+    sents = []
+    for _ in range(300):
+        group = animals if rng.random() < 0.5 else foods
+        sents.append(" ".join(rng.choice(group, size=6)))
+    return sents
+
+
+def test_word2vec_skipgram_learns_clusters():
+    w2v = (Word2Vec.builder().layer_size(24).window_size(3)
+           .min_word_frequency(1).negative_sample(4).epochs(3)
+           .learning_rate(0.05).seed(1).batch_size(256).build())
+    w2v.fit(_toy_corpus())
+    assert w2v.has_word("cat") and w2v.has_word("apple")
+    assert w2v.get_word_vector("cat").shape == (24,)
+    same = w2v.similarity("cat", "dog")
+    cross = w2v.similarity("cat", "apple")
+    assert same > cross, (same, cross)
+    nearest = w2v.words_nearest("cat", top_n=3)
+    assert set(nearest) <= {"dog", "horse", "cow"}
+
+
+def test_word2vec_cbow_runs():
+    w2v = (Word2Vec.builder().layer_size(16).window_size(2)
+           .min_word_frequency(1).negative_sample(3).epochs(2)
+           .elements_learning_algorithm("CBOW").seed(2)
+           .batch_size(128).build())
+    w2v.fit(_toy_corpus()[:100])
+    assert w2v.similarity("cat", "cat") == pytest.approx(1.0)
+    assert np.isfinite(w2v.similarity("cat", "bread"))
+
+
+def test_word2vec_serializer_roundtrip(tmp_path):
+    w2v = (Word2Vec.builder().layer_size(12).min_word_frequency(1)
+           .epochs(1).seed(3).build())
+    w2v.fit(_toy_corpus()[:50])
+    p = str(tmp_path / "w2v.zip")
+    WordVectorSerializer.write_word2vec_model(w2v, p)
+    back = WordVectorSerializer.read_word2vec_model(p)
+    assert set(back.vocab.words()) == set(w2v.vocab.words())
+    for w in ("cat", "apple"):
+        if w2v.has_word(w):
+            np.testing.assert_allclose(back.get_word_vector(w),
+                                       w2v.get_word_vector(w),
+                                       atol=1e-5)
+
+
+def test_paragraph_vectors_dbow():
+    docs = {
+        "animals_1": "cat dog horse cow cat dog",
+        "animals_2": "dog cow horse cat cow horse",
+        "foods_1": "apple bread cheese rice apple bread",
+        "foods_2": "bread rice apple cheese rice cheese",
+    }
+    pv = ParagraphVectors(layer_size=16, min_word_frequency=1,
+                          negative=4, epochs=30, learning_rate=0.05,
+                          seed=4, batch_size=64)
+    pv.fit_documents(list(docs), list(docs.values()))
+    va1 = pv.get_doc_vector("animals_1")
+    assert va1.shape == (16,)
+
+    def cos(a, b):
+        return a @ b / (np.linalg.norm(a) * np.linalg.norm(b))
+
+    va2 = pv.get_doc_vector("animals_2")
+    vf1 = pv.get_doc_vector("foods_1")
+    assert cos(va1, va2) > cos(va1, vf1)
+    inferred = pv.infer_vector("cat horse dog")
+    assert inferred.shape == (16,)
+    assert np.isfinite(inferred).all()
+
+
+# --- nearest neighbors ------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cloud():
+    rng = np.random.default_rng(5)
+    return rng.normal(size=(200, 8)).astype(np.float32)
+
+
+def _exact_knn(points, q, k):
+    d = np.linalg.norm(points - q, axis=1)
+    idx = np.argsort(d)[:k]
+    return list(idx), list(d[idx])
+
+
+def test_vptree_matches_exact(cloud):
+    tree = VPTree(cloud, "euclidean")
+    rng = np.random.default_rng(6)
+    for _ in range(5):
+        q = rng.normal(size=8).astype(np.float32)
+        got_idx, got_d = tree.search(q, 7)
+        want_idx, want_d = _exact_knn(cloud, q, 7)
+        np.testing.assert_allclose(sorted(got_d), sorted(want_d),
+                                   rtol=1e-5)
+        assert set(got_idx) == set(want_idx)
+
+
+def test_vptree_cosine(cloud):
+    tree = VPTree(cloud, "cosine")
+    idx, d = tree.search(cloud[0], 1)
+    assert idx[0] == 0 and d[0] < 1e-6
+
+
+def test_kdtree_matches_exact(cloud):
+    tree = KDTree(cloud)
+    rng = np.random.default_rng(7)
+    for _ in range(5):
+        q = rng.normal(size=8).astype(np.float32)
+        got_idx, got_d = tree.knn(q, 5)
+        want_idx, want_d = _exact_knn(cloud, q, 5)
+        np.testing.assert_allclose(got_d, want_d, rtol=1e-5)
+        assert got_idx == want_idx
+    nn_i, nn_d = tree.nn(cloud[3])
+    assert nn_i == 3 and nn_d < 1e-6
+
+
+def test_bruteforce_device_knn(cloud):
+    knn = BruteForceNearestNeighbors(cloud, "euclidean")
+    q = cloud[10] + 1e-4
+    idx, d = knn.knn(q, 3)
+    assert idx[0] == 10
+    want_idx, want_d = _exact_knn(cloud, q, 3)
+    assert set(idx) == set(want_idx)
+
+
+# --- deepwalk ---------------------------------------------------------------
+
+def test_deepwalk_two_cliques():
+    """Vertices inside a clique should embed closer than across the
+    single bridge edge."""
+    g = Graph(10)
+    for i in range(5):
+        for j in range(i + 1, 5):
+            g.add_edge(i, j)
+            g.add_edge(i + 5, j + 5)
+    g.add_edge(0, 5)      # bridge
+    dw = DeepWalk(vector_size=16, window_size=3, walk_length=20,
+                  walks_per_vertex=8, epochs=2, seed=8)
+    dw.fit(g)
+    assert dw.get_vertex_vector(1).shape == (16,)
+    intra = dw.similarity(1, 2)
+    inter = dw.similarity(1, 7)
+    assert intra > inter, (intra, inter)
+    assert set(dw.verts_nearest(2, 3)) <= {0, 1, 3, 4, 5}

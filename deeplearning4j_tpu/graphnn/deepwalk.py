@@ -43,8 +43,8 @@ class DeepWalk:
 
     def __init__(self, vector_size: int = 64, window_size: int = 5,
                  walk_length: int = 40, walks_per_vertex: int = 10,
-                 learning_rate: float = 0.025, negative: int = 5,
-                 epochs: int = 1, seed: int = 77):
+                 learning_rate: float = 0.1, negative: int = 5,
+                 epochs: int = 1, iterations: int = 3, seed: int = 77):
         self.vector_size = vector_size
         self.window_size = window_size
         self.walk_length = walk_length
@@ -52,6 +52,7 @@ class DeepWalk:
         self.learning_rate = learning_rate
         self.negative = negative
         self.epochs = epochs
+        self.iterations = iterations
         self.seed = seed
         self._w2v: Optional[Word2Vec] = None
 
@@ -78,7 +79,8 @@ class DeepWalk:
                        min_word_frequency=1,
                        negative=self.negative,
                        learning_rate=self.learning_rate,
-                       epochs=self.epochs, seed=self.seed)
+                       epochs=self.epochs, iterations=self.iterations,
+                       seed=self.seed)
         w2v.fit(" ".join(w) for w in walks)
         self._w2v = w2v
         return self

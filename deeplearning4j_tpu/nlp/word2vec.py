@@ -9,9 +9,12 @@ declarable ops (SURVEY §2.3 NLP row).
 
 TPU-native redesign: instead of the reference's per-pair native skipgram
 op with hierarchical softmax, training batches (center, context,
-negatives) index triples into ONE jitted negative-sampling SGD step —
+negatives) index triples into ONE jitted negative-sampling Adagrad step —
 embedding gathers/scatters lower to XLA dynamic-slice ops, and a whole
-epoch's pairs stream through fixed-shape batches (no retrace).
+epoch's pairs stream through fixed-shape batches (no retrace). Adagrad
+(not per-pair SGD) because batched scatter-add accumulates repeated word
+indices with no sequential feedback; adaptive scaling keeps the step
+stable across vocab sizes.
 """
 from __future__ import annotations
 
@@ -25,11 +28,27 @@ from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
 from deeplearning4j_tpu.nlp.vocab import VocabCache
 
 
+def _adagrad_apply(tables, accs, grads, lr):
+    """Adagrad update for embedding tables. Batched SGNS scatter-adds
+    gradients for repeated word indices (no per-pair sequential
+    feedback like the reference's native skipgram op), so plain SGD
+    either under- or over-shoots depending on vocab size; per-param
+    adaptive scaling is shape- and vocab-robust."""
+    import jax.numpy as jnp
+
+    new_tables, new_accs = [], []
+    for t, a, g in zip(tables, accs, grads):
+        a = a + g * g
+        new_tables.append(t - lr * g / jnp.sqrt(a + 1e-8))
+        new_accs.append(a)
+    return tuple(new_tables), tuple(new_accs)
+
+
 def _make_sg_step():
     import jax
     import jax.numpy as jnp
 
-    def step(syn0, syn1, centers, contexts, negatives, lr):
+    def step(syn0, syn1, acc0, acc1, centers, contexts, negatives, lr):
         def loss_fn(tables):
             s0, s1 = tables
             c = s0[centers]                       # [B, D]
@@ -38,23 +57,24 @@ def _make_sg_step():
             pos_score = jnp.sum(c * pos, axis=-1)
             neg_score = jnp.einsum("bd,bkd->bk", c, neg)
             # negative-sampling objective (Mikolov et al. 2013)
-            l = -jnp.mean(jax.nn.log_sigmoid(pos_score)
-                          + jnp.sum(jax.nn.log_sigmoid(-neg_score), -1))
+            l = -jnp.sum(jax.nn.log_sigmoid(pos_score)
+                         + jnp.sum(jax.nn.log_sigmoid(-neg_score), -1))
             return l
 
         loss, grads = jax.value_and_grad(loss_fn)((syn0, syn1))
-        syn0 = syn0 - lr * grads[0]
-        syn1 = syn1 - lr * grads[1]
-        return syn0, syn1, loss
+        (syn0, syn1), (acc0, acc1) = _adagrad_apply(
+            (syn0, syn1), (acc0, acc1), grads, lr)
+        return syn0, syn1, acc0, acc1, loss / centers.shape[0]
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
 
 def _make_cbow_step():
     import jax
     import jax.numpy as jnp
 
-    def step(syn0, syn1, contexts, mask, targets, negatives, lr):
+    def step(syn0, syn1, acc0, acc1, contexts, mask, targets,
+             negatives, lr):
         def loss_fn(tables):
             s0, s1 = tables
             ctx = s0[contexts]                    # [B, W, D]
@@ -64,13 +84,15 @@ def _make_cbow_step():
             neg = s1[negatives]
             pos_score = jnp.sum(mean * pos, -1)
             neg_score = jnp.einsum("bd,bkd->bk", mean, neg)
-            return -jnp.mean(jax.nn.log_sigmoid(pos_score)
-                             + jnp.sum(jax.nn.log_sigmoid(-neg_score), -1))
+            return -jnp.sum(jax.nn.log_sigmoid(pos_score)
+                            + jnp.sum(jax.nn.log_sigmoid(-neg_score), -1))
 
         loss, grads = jax.value_and_grad(loss_fn)((syn0, syn1))
-        return syn0 - lr * grads[0], syn1 - lr * grads[1], loss
+        (syn0, syn1), (acc0, acc1) = _adagrad_apply(
+            (syn0, syn1), (acc0, acc1), grads, lr)
+        return syn0, syn1, acc0, acc1, loss / targets.shape[0]
 
-    return jax.jit(step, donate_argnums=(0, 1))
+    return jax.jit(step, donate_argnums=(0, 1, 2, 3))
 
 
 class Word2Vec:
@@ -175,6 +197,8 @@ class Word2Vec:
         syn0 = jnp.asarray(
             (rng.random((v, d), np.float32) - 0.5) / d)
         syn1 = jnp.zeros((v, d), jnp.float32)
+        acc0 = jnp.zeros((v, d), jnp.float32)
+        acc1 = jnp.zeros((v, d), jnp.float32)
         noise = self.vocab.noise_distribution()
         keep = (self.vocab.subsample_keep_prob(self.sampling)
                 if self.sampling > 0 else None)
@@ -246,10 +270,11 @@ class Word2Vec:
                 lr = max(self.learning_rate * (1.0 - frac),
                          self.min_learning_rate)
                 if self.elements_algo == "skipgram":
-                    syn0, syn1, loss = step(syn0, syn1, ce, co, negs, lr)
+                    syn0, syn1, acc0, acc1, loss = step(
+                        syn0, syn1, acc0, acc1, ce, co, negs, lr)
                 else:
-                    syn0, syn1, loss = step(syn0, syn1, cc, cm, ce,
-                                            negs, lr)
+                    syn0, syn1, acc0, acc1, loss = step(
+                        syn0, syn1, acc0, acc1, cc, cm, ce, negs, lr)
                 total_steps += 1
             self._losses.append(float(loss))
         self.syn0 = np.asarray(syn0)
@@ -321,6 +346,8 @@ class ParagraphVectors(Word2Vec):
         v, d, nd = len(self.vocab), self.layer_size, len(encoded)
         docs = jnp.asarray((rng.random((nd, d), np.float32) - 0.5) / d)
         syn1 = jnp.zeros((v, d), jnp.float32)
+        acc0 = jnp.zeros((nd, d), jnp.float32)
+        acc1 = jnp.zeros((v, d), jnp.float32)
         noise = self.vocab.noise_distribution()
         step = _make_sg_step()
         n_epochs = self.epochs * self.iterations
@@ -350,7 +377,8 @@ class ParagraphVectors(Word2Vec):
                 lr = max(self.learning_rate
                          * (1 - total / (n_epochs * n_batches)),
                          self.min_learning_rate)
-                docs, syn1, loss = step(docs, syn1, dd, ww, negs, lr)
+                docs, syn1, acc0, acc1, loss = step(
+                    docs, syn1, acc0, acc1, dd, ww, negs, lr)
                 total += 1
         self.doc_vectors = np.asarray(docs)
         self.syn0 = np.asarray(syn1)   # word side for queries

@@ -1,0 +1,31 @@
+"""Reinforcement learning (reference: rl4j — SURVEY §2.4).
+
+DQN (double/dueling), batched advantage actor-critic (A3C-equivalent),
+async n-step Q; MDP interface with built-in CartPole/GridWorld envs;
+experience replay; policies.
+"""
+from deeplearning4j_tpu.rl.mdp import (CartPole, DiscreteSpace,
+                                       GridWorld, MDP, ObservationSpace,
+                                       VectorizedMDP)
+from deeplearning4j_tpu.rl.replay import ExpReplay
+from deeplearning4j_tpu.rl.network import (
+    ActorCriticFactorySeparateStdDense, DQNFactoryStdDense)
+from deeplearning4j_tpu.rl.policy import (BoltzmannQ, EpsGreedy, Greedy,
+                                          Policy)
+from deeplearning4j_tpu.rl.qlearning import (QLearningConfiguration,
+                                             QLearningDiscrete,
+                                             QLearningDiscreteDense,
+                                             QLearningResult)
+from deeplearning4j_tpu.rl.a3c import (A3CConfiguration, A3CDiscrete,
+                                       A3CDiscreteDense,
+                                       AsyncNStepQLearningDiscrete)
+
+__all__ = [
+    "MDP", "ObservationSpace", "DiscreteSpace", "CartPole", "GridWorld",
+    "VectorizedMDP", "ExpReplay", "DQNFactoryStdDense",
+    "ActorCriticFactorySeparateStdDense", "Policy", "Greedy",
+    "EpsGreedy", "BoltzmannQ", "QLearningConfiguration",
+    "QLearningDiscrete", "QLearningDiscreteDense", "QLearningResult",
+    "A3CConfiguration", "A3CDiscrete", "A3CDiscreteDense",
+    "AsyncNStepQLearningDiscrete",
+]

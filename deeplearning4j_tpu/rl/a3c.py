@@ -1,0 +1,211 @@
+"""Advantage actor-critic (A3C-family).
+
+Reference: ``org.deeplearning4j.rl4j.learning.async.a3c.A3CDiscrete``
+(+``A3CDiscreteDense``), configuration ``A3CConfiguration`` (numThread,
+nstep, gamma, …) and the async-n-step-Q sibling
+(``AsyncNStepQLearningDiscrete``).
+
+TPU-native redesign: rl4j runs numThread Java threads, each with its own
+env + model copy, pushing gradients to a shared model (Hogwild-style).
+On TPU, lock-free async updates against one program make no sense; the
+idiomatic equivalent is SYNCHRONOUS batched advantage actor-critic:
+``num_threads`` becomes ``n_envs`` vectorized env copies, every env
+steps together, and one jitted update consumes the whole
+[n_envs × n_step] rollout (policy-gradient + value loss + entropy
+bonus). Same estimator (n-step advantage), same hyperparameters, fixed
+shapes for XLA. This is the standard A3C→A2C equivalence.
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.rl.mdp import MDP, VectorizedMDP
+from deeplearning4j_tpu.rl.network import \
+    ActorCriticFactorySeparateStdDense
+
+
+@dataclass
+class A3CConfiguration:
+    """Reference: A3CDiscrete.A3CConfiguration (numThread→n_envs)."""
+    seed: int = 123
+    max_step: int = 20000        # total env steps across all envs
+    n_envs: int = 8              # numThread
+    n_step: int = 5              # nstep rollout length
+    gamma: float = 0.99
+    learning_rate: float = 7e-4
+    entropy_coef: float = 0.01
+    value_coef: float = 0.5
+    max_grad_norm: float = 0.5
+    reward_factor: float = 1.0
+
+
+def _make_update(apply_fn, optimizer, cfg: A3CConfiguration):
+    def update(params, opt_state, obs, actions, returns):
+        """obs [T*N, O]; actions [T*N]; returns [T*N] (n-step)."""
+        def loss_fn(p):
+            logits, values = apply_fn(p, obs)
+            logp = jax.nn.log_softmax(logits)
+            logp_a = jnp.take_along_axis(
+                logp, actions[:, None], axis=1)[:, 0]
+            adv = returns - values
+            pg_loss = -jnp.mean(
+                logp_a * jax.lax.stop_gradient(adv))
+            v_loss = jnp.mean(adv ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp) * logp, axis=-1))
+            return (pg_loss + cfg.value_coef * v_loss
+                    - cfg.entropy_coef * entropy), (pg_loss, v_loss)
+
+        (loss, _), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        if cfg.max_grad_norm:
+            gnorm = optax.global_norm(grads)
+            scale = jnp.minimum(1.0, cfg.max_grad_norm
+                                / (gnorm + 1e-8))
+            grads = jax.tree.map(lambda g: g * scale, grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return jax.jit(update, donate_argnums=(1,))
+
+
+class A3CDiscrete:
+    """Batched advantage actor-critic over a discrete-action MDP."""
+
+    def __init__(self, mdp: MDP,
+                 conf: Optional[A3CConfiguration] = None,
+                 factory: Optional[
+                     ActorCriticFactorySeparateStdDense] = None):
+        self.conf = conf or A3CConfiguration()
+        self.factory = factory or ActorCriticFactorySeparateStdDense()
+        self.venv = VectorizedMDP(mdp, self.conf.n_envs)
+        obs_size = int(np.prod(mdp.observation_space.shape))
+        init_fn, self.apply_fn = self.factory.build(
+            obs_size, mdp.action_space.size, seed=self.conf.seed)
+        self.params = init_fn()
+        self.optimizer = optax.adam(self.conf.learning_rate)
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = _make_update(self.apply_fn, self.optimizer,
+                                    self.conf)
+        self._fwd = jax.jit(self.apply_fn)
+        self._rng = np.random.default_rng(self.conf.seed)
+        self.step_count = 0
+        self.losses: List[float] = []
+        self.mean_returns: List[float] = []
+
+    def _sample_actions(self, obs: np.ndarray) -> np.ndarray:
+        logits, _ = self._fwd(self.params, jnp.asarray(obs))
+        p = np.asarray(jax.nn.softmax(logits))
+        return np.array(
+            [self._rng.choice(p.shape[1], p=p[i] / p[i].sum())
+             for i in range(p.shape[0])], np.int32)
+
+    def _bootstrap_value(self, obs: np.ndarray) -> np.ndarray:
+        """Terminal value for n-step returns: the critic's V(s)."""
+        _, v_last = self._fwd(self.params, jnp.asarray(obs))
+        return np.asarray(v_last)
+
+    def train(self) -> "A3CDiscrete":
+        c = self.conf
+        obs = self.venv.reset()
+        ep_ret = np.zeros(c.n_envs)
+        finished = deque(maxlen=20)
+        while self.step_count < c.max_step:
+            # n-step rollout
+            O, A, R, D = [], [], [], []
+            for _ in range(c.n_step):
+                acts = self._sample_actions(obs)
+                nxt, rews, dones = self.venv.step(acts)
+                O.append(obs)
+                A.append(acts)
+                R.append(rews * c.reward_factor)
+                D.append(dones)
+                ep_ret += rews
+                for i, d in enumerate(dones):
+                    if d:
+                        finished.append(ep_ret[i])
+                        ep_ret[i] = 0.0
+                obs = nxt
+                self.step_count += c.n_envs
+            # bootstrap at the final obs (critic V, or max-Q in the
+            # n-step-Q subclass)
+            ret = self._bootstrap_value(obs)
+            returns = np.zeros((c.n_step, c.n_envs), np.float32)
+            for t in reversed(range(c.n_step)):
+                ret = R[t] + c.gamma * ret * (1.0 - D[t])
+                returns[t] = ret
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state,
+                jnp.asarray(np.concatenate(O)),
+                jnp.asarray(np.concatenate(A)),
+                jnp.asarray(returns.reshape(-1)))
+            self.losses.append(float(loss))
+            if finished:
+                self.mean_returns.append(float(np.mean(finished)))
+        return self
+
+    def play(self, mdp: MDP, max_steps: int = 1000) -> float:
+        """Greedy (argmax-logits) rollout."""
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(max_steps):
+            logits, _ = self._fwd(self.params, jnp.asarray(obs[None]))
+            a = int(np.argmax(np.asarray(logits)[0]))
+            obs, r, done, _ = mdp.step(a)
+            total += r
+            if done:
+                break
+        return total
+
+
+class A3CDiscreteDense(A3CDiscrete):
+    """Reference A3CDiscreteDense alias (std-dense factories)."""
+    pass
+
+
+class AsyncNStepQLearningDiscrete(A3CDiscrete):
+    """Reference async n-step Q-learning
+    (``AsyncNStepQLearningDiscrete``). Shares the batched rollout
+    machinery; the learner regresses Q(s, a) on n-step returns and
+    bootstraps the rollout tail with max_a Q (the actor tower's logits
+    double as Q-values; the critic tower is unused)."""
+
+    def __init__(self, mdp, conf=None, factory=None):
+        super().__init__(mdp, conf, factory)
+
+        def q_update(params, opt_state, obs, actions, returns):
+            def loss_fn(p):
+                logits, _ = self.apply_fn(p, obs)   # logits double as Q
+                q_a = jnp.take_along_axis(
+                    logits, actions[:, None], axis=1)[:, 0]
+                return jnp.mean((q_a - returns) ** 2)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            return (optax.apply_updates(params, updates), opt_state,
+                    loss)
+
+        self._update = jax.jit(q_update, donate_argnums=(1,))
+
+    def _bootstrap_value(self, obs):
+        q, _ = self._fwd(self.params, jnp.asarray(obs))
+        return np.asarray(jnp.max(q, axis=-1))
+
+    def _sample_actions(self, obs):
+        # epsilon-greedy over Q (anneal like qlearning.EpsGreedy)
+        logits, _ = self._fwd(self.params, jnp.asarray(obs))
+        q = np.asarray(logits)
+        eps = max(0.1, 1.0 - self.step_count / (self.conf.max_step / 2))
+        acts = np.argmax(q, axis=1)
+        explore = self._rng.random(len(acts)) < eps
+        acts[explore] = self._rng.integers(
+            q.shape[1], size=int(explore.sum()))
+        return acts.astype(np.int32)

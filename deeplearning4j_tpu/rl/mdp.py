@@ -1,0 +1,189 @@
+"""MDP environment interface + built-in environments.
+
+Reference: ``org.deeplearning4j.rl4j.mdp.MDP`` (reset/step/isDone,
+getObservationSpace/getActionSpace), ``rl4j-gym``'s gym client, and the
+toy MDPs used by rl4j's tests (``SimpleToyMDP``, ``HardDeteministicToy``).
+No gym in this image, so the classic control envs ship in-repo.
+
+TPU-native note: envs run on host in numpy (cheap scalar physics); only
+the learner math is jitted. ``VectorizedMDP`` steps N env copies and
+returns stacked observations so the jitted policy/learner always sees
+fixed [N, obs] shapes — the batched analog of rl4j's async workers.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class DiscreteSpace:
+    """Reference: org.deeplearning4j.rl4j.space.DiscreteSpace."""
+    size: int
+
+    def random_action(self, rng) -> int:
+        return int(rng.integers(self.size))
+
+    def no_op(self) -> int:
+        return 0
+
+
+@dataclass
+class ObservationSpace:
+    """Reference: org.deeplearning4j.rl4j.space.ObservationSpace."""
+    shape: Tuple[int, ...]
+    low: Optional[np.ndarray] = None
+    high: Optional[np.ndarray] = None
+
+
+class MDP:
+    """Reference: org.deeplearning4j.rl4j.mdp.MDP interface."""
+
+    observation_space: ObservationSpace
+    action_space: DiscreteSpace
+
+    def reset(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int) -> Tuple[np.ndarray, float, bool, dict]:
+        """Returns (observation, reward, done, info)."""
+        raise NotImplementedError
+
+    def is_done(self) -> bool:
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+    def new_instance(self) -> "MDP":
+        raise NotImplementedError
+
+
+class CartPole(MDP):
+    """Classic cart-pole balancing (the rl4j gym examples' env;
+    standard Barto-Sutton-Anderson dynamics). Episode ends when the
+    pole falls past 12° / cart leaves ±2.4, or after ``max_steps``."""
+
+    def __init__(self, seed: int = 0, max_steps: int = 200):
+        self.observation_space = ObservationSpace((4,))
+        self.action_space = DiscreteSpace(2)
+        self.max_steps = max_steps
+        self._rng = np.random.default_rng(seed)
+        self._seed = seed
+        self._state = None
+        self._steps = 0
+        self._done = True
+
+    # physics constants (standard)
+    _G, _MCART, _MPOLE, _LEN, _F, _DT = 9.8, 1.0, 0.1, 0.5, 10.0, 0.02
+
+    def reset(self) -> np.ndarray:
+        self._state = self._rng.uniform(-0.05, 0.05, 4)
+        self._steps = 0
+        self._done = False
+        return self._state.astype(np.float32).copy()
+
+    def step(self, action: int):
+        x, x_dot, th, th_dot = self._state
+        force = self._F if action == 1 else -self._F
+        mtot = self._MCART + self._MPOLE
+        pml = self._MPOLE * self._LEN
+        cos, sin = np.cos(th), np.sin(th)
+        tmp = (force + pml * th_dot ** 2 * sin) / mtot
+        th_acc = (self._G * sin - cos * tmp) / (
+            self._LEN * (4.0 / 3.0 - self._MPOLE * cos ** 2 / mtot))
+        x_acc = tmp - pml * th_acc * cos / mtot
+        x += self._DT * x_dot
+        x_dot += self._DT * x_acc
+        th += self._DT * th_dot
+        th_dot += self._DT * th_acc
+        self._state = np.array([x, x_dot, th, th_dot])
+        self._steps += 1
+        fell = bool(abs(x) > 2.4 or abs(th) > 12 * np.pi / 180)
+        self._done = fell or self._steps >= self.max_steps
+        reward = 1.0
+        return (self._state.astype(np.float32).copy(), reward,
+                self._done, {})
+
+    def is_done(self) -> bool:
+        return self._done
+
+    def new_instance(self) -> "CartPole":
+        return CartPole(seed=int(self._rng.integers(2 ** 31)),
+                        max_steps=self.max_steps)
+
+
+class GridWorld(MDP):
+    """Deterministic N×N grid: start at (0,0), goal at (N-1,N-1);
+    actions up/down/left/right; reward −1 per step, +10 at goal.
+    One-hot observation. The shortest-path toy used for fast learner
+    tests (analog of rl4j's deterministic toy MDPs)."""
+
+    ACTIONS = [(-1, 0), (1, 0), (0, -1), (0, 1)]
+
+    def __init__(self, n: int = 4, max_steps: int = 50):
+        self.n = n
+        self.observation_space = ObservationSpace((n * n,))
+        self.action_space = DiscreteSpace(4)
+        self.max_steps = max_steps
+        self._pos = (0, 0)
+        self._steps = 0
+        self._done = True
+
+    def _obs(self) -> np.ndarray:
+        o = np.zeros(self.n * self.n, np.float32)
+        o[self._pos[0] * self.n + self._pos[1]] = 1.0
+        return o
+
+    def reset(self) -> np.ndarray:
+        self._pos = (0, 0)
+        self._steps = 0
+        self._done = False
+        return self._obs()
+
+    def step(self, action: int):
+        dr, dc = self.ACTIONS[action]
+        r = min(max(self._pos[0] + dr, 0), self.n - 1)
+        c = min(max(self._pos[1] + dc, 0), self.n - 1)
+        self._pos = (r, c)
+        self._steps += 1
+        at_goal = self._pos == (self.n - 1, self.n - 1)
+        self._done = at_goal or self._steps >= self.max_steps
+        reward = 10.0 if at_goal else -1.0
+        return self._obs(), reward, self._done, {}
+
+    def is_done(self) -> bool:
+        return self._done
+
+    def new_instance(self) -> "GridWorld":
+        return GridWorld(self.n, self.max_steps)
+
+
+class VectorizedMDP:
+    """N independent env copies stepped together; observations stack to
+    [N, *obs_shape]. Auto-resets finished envs. The synchronous batched
+    replacement for rl4j's per-thread async envs (threads don't help a
+    single-program TPU learner; fixed-shape batches do)."""
+
+    def __init__(self, proto: MDP, n: int):
+        self.envs: List[MDP] = [proto.new_instance() for _ in range(n)]
+        self.n = n
+        self.observation_space = proto.observation_space
+        self.action_space = proto.action_space
+
+    def reset(self) -> np.ndarray:
+        return np.stack([e.reset() for e in self.envs])
+
+    def step(self, actions: np.ndarray):
+        obs, rews, dones = [], [], []
+        for e, a in zip(self.envs, actions):
+            o, r, d, _ = e.step(int(a))
+            if d:
+                o = e.reset()
+            obs.append(o)
+            rews.append(r)
+            dones.append(d)
+        return (np.stack(obs), np.asarray(rews, np.float32),
+                np.asarray(dones, np.float32))

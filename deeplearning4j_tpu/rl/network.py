@@ -1,0 +1,111 @@
+"""Value/policy network factories for RL.
+
+Reference: ``org.deeplearning4j.rl4j.network.dqn.DQNFactoryStdDense``
+(stack of DenseLayers built from a conf bean), ``DQN``/``IDQN`` wrapper,
+``ActorCriticFactorySeparateStdDense``.
+
+TPU-native design: a factory returns (init, apply) pure functions over a
+params pytree — the whole DQN/AC update is then ONE jitted step in the
+learner (qlearning.py / a3c.py); there is no per-op dispatch object.
+Dueling heads (V + A − mean A) follow Wang et al., matching rl4j's
+dueling option.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _dense_init(key, n_in, n_out, dtype=jnp.float32):
+    # He-uniform fan-in (rl4j's RELU weight init for its std-dense DQN)
+    lim = math.sqrt(6.0 / n_in)
+    kW, _ = jax.random.split(key)
+    return {"W": jax.random.uniform(kW, (n_in, n_out), dtype, -lim, lim),
+            "b": jnp.zeros((n_out,), dtype)}
+
+
+@dataclass
+class DQNFactoryStdDense:
+    """MLP Q-network factory (reference DQNFactoryStdDense.Configuration:
+    numLayers/numHiddenNodes; plus the dueling-architecture option)."""
+    hidden: Sequence[int] = (64, 64)
+    dueling: bool = False
+
+    def build(self, obs_size: int, n_actions: int, seed: int = 0):
+        hidden = tuple(self.hidden)
+        dueling = self.dueling
+
+        def init(key=None):
+            key = key if key is not None else jax.random.PRNGKey(seed)
+            params = {}
+            n_in = obs_size
+            keys = jax.random.split(key, len(hidden) + 3)
+            for i, h in enumerate(hidden):
+                params[f"fc{i}"] = _dense_init(keys[i], n_in, h)
+                n_in = h
+            if dueling:
+                params["value"] = _dense_init(keys[-2], n_in, 1)
+                params["adv"] = _dense_init(keys[-1], n_in, n_actions)
+            else:
+                params["out"] = _dense_init(keys[-1], n_in, n_actions)
+            return params
+
+        def apply(params, x):
+            x = x.reshape(x.shape[0], -1)
+            for i in range(len(hidden)):
+                p = params[f"fc{i}"]
+                x = jax.nn.relu(x @ p["W"] + p["b"])
+            if dueling:
+                v = x @ params["value"]["W"] + params["value"]["b"]
+                a = x @ params["adv"]["W"] + params["adv"]["b"]
+                return v + a - jnp.mean(a, axis=-1, keepdims=True)
+            p = params["out"]
+            return x @ p["W"] + p["b"]
+
+        return init, apply
+
+
+@dataclass
+class ActorCriticFactorySeparateStdDense:
+    """Separate policy/value MLPs (reference
+    ActorCriticFactorySeparateStdDense); returns (init, apply) where
+    apply yields (logits, value)."""
+    hidden: Sequence[int] = (64, 64)
+
+    def build(self, obs_size: int, n_actions: int, seed: int = 0):
+        hidden = tuple(self.hidden)
+
+        def one_tower(key, n_out):
+            params = {}
+            n_in = obs_size
+            keys = jax.random.split(key, len(hidden) + 1)
+            for i, h in enumerate(hidden):
+                params[f"fc{i}"] = _dense_init(keys[i], n_in, h)
+                n_in = h
+            params["out"] = _dense_init(keys[-1], n_in, n_out)
+            return params
+
+        def tower_apply(params, x):
+            for i in range(len(hidden)):
+                p = params[f"fc{i}"]
+                x = jax.nn.relu(x @ p["W"] + p["b"])
+            p = params["out"]
+            return x @ p["W"] + p["b"]
+
+        def init(key=None):
+            key = key if key is not None else jax.random.PRNGKey(seed)
+            ka, kc = jax.random.split(key)
+            return {"actor": one_tower(ka, n_actions),
+                    "critic": one_tower(kc, 1)}
+
+        def apply(params, x):
+            x = x.reshape(x.shape[0], -1)
+            logits = tower_apply(params["actor"], x)
+            value = tower_apply(params["critic"], x)[:, 0]
+            return logits, value
+
+        return init, apply

@@ -1,0 +1,57 @@
+"""Action-selection policies.
+
+Reference: ``org.deeplearning4j.rl4j.policy.Policy`` hierarchy —
+``EpsGreedy`` (linear epsilon anneal over epsilonNbStep down to
+minEpsilon), ``DQNPolicy`` (greedy), ``BoltzmannQ``.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+
+class Policy:
+    def next_action(self, q_values: np.ndarray, step: int,
+                    rng) -> int:
+        raise NotImplementedError
+
+
+class Greedy(Policy):
+    """Reference DQNPolicy: argmax_a Q(s, a)."""
+
+    def next_action(self, q_values, step, rng):
+        return int(np.argmax(q_values))
+
+
+class EpsGreedy(Policy):
+    """Linear anneal from 1.0 to min_epsilon over anneal_steps
+    (reference EpsGreedy with epsilonNbStep/minEpsilon)."""
+
+    def __init__(self, min_epsilon: float = 0.1,
+                 anneal_steps: int = 10000):
+        self.min_epsilon = min_epsilon
+        self.anneal_steps = max(1, anneal_steps)
+
+    def epsilon(self, step: int) -> float:
+        frac = min(1.0, step / self.anneal_steps)
+        return 1.0 + frac * (self.min_epsilon - 1.0)
+
+    def next_action(self, q_values, step, rng):
+        if rng.random() < self.epsilon(step):
+            return int(rng.integers(len(q_values)))
+        return int(np.argmax(q_values))
+
+
+class BoltzmannQ(Policy):
+    """Softmax-with-temperature sampling (reference BoltzmannQ)."""
+
+    def __init__(self, temperature: float = 1.0):
+        self.temperature = temperature
+
+    def next_action(self, q_values, step, rng):
+        z = np.asarray(q_values, np.float64) / self.temperature
+        z = z - z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(rng.choice(len(p), p=p))

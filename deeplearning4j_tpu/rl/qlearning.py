@@ -1,0 +1,226 @@
+"""Deep Q-learning (sync DQN).
+
+Reference: ``org.deeplearning4j.rl4j.learning.sync.qlearning.discrete
+.QLearningDiscrete`` (+``QLearningDiscreteDense``), configuration bean
+``QLearning.QLConfiguration`` (maxEpochStep, maxStep, expRepMaxSize,
+batchSize, targetDqnUpdateFreq, updateStart, rewardFactor, gamma,
+errorClamp, minEpsilon, epsilonNbStep, doubleDQN).
+
+TPU-native redesign: the reference computes TD targets in Java, copies
+them into an INDArray and calls dqn.fit (one more JNI round-trip per
+batch). Here target computation + Huber loss + gradient + Adam update
+are ONE jitted step (double-DQN argmax included); the target-network
+sync is a pytree copy. Env stepping stays on host (scalar physics).
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.rl.mdp import MDP
+from deeplearning4j_tpu.rl.network import DQNFactoryStdDense
+from deeplearning4j_tpu.rl.policy import EpsGreedy, Greedy
+from deeplearning4j_tpu.rl.replay import ExpReplay
+
+
+@dataclass
+class QLearningConfiguration:
+    """Reference: QLearning.QLConfiguration (same field set)."""
+    seed: int = 123
+    max_epoch_step: int = 200          # maxEpochStep
+    max_step: int = 10000              # maxStep (total env steps)
+    exp_rep_max_size: int = 10000      # expRepMaxSize
+    batch_size: int = 32
+    target_dqn_update_freq: int = 100  # targetDqnUpdateFreq
+    update_start: int = 100            # updateStart (no-learn warmup)
+    reward_factor: float = 1.0         # rewardFactor (reward scaling)
+    gamma: float = 0.99
+    error_clamp: float = 1.0           # errorClamp (Huber delta)
+    min_epsilon: float = 0.1
+    epsilon_nb_step: int = 3000        # epsilonNbStep
+    double_dqn: bool = True
+    learning_rate: float = 1e-3
+
+
+def _make_train_step(apply_fn, optimizer, cfg: QLearningConfiguration):
+    gamma, double_dqn, clamp = (cfg.gamma, cfg.double_dqn,
+                                cfg.error_clamp)
+
+    def step(params, target_params, opt_state, obs, actions, rewards,
+             next_obs, dones):
+        def loss_fn(p):
+            q = apply_fn(p, obs)                              # [B, A]
+            q_sel = jnp.take_along_axis(
+                q, actions[:, None], axis=1)[:, 0]
+            qn_t = apply_fn(target_params, next_obs)
+            if double_dqn:
+                a_star = jnp.argmax(apply_fn(p, next_obs), axis=-1)
+                q_next = jnp.take_along_axis(
+                    qn_t, a_star[:, None], axis=1)[:, 0]
+            else:
+                q_next = jnp.max(qn_t, axis=-1)
+            target = rewards + gamma * q_next * (1.0 - dones)
+            td = q_sel - jax.lax.stop_gradient(target)
+            if clamp and clamp > 0:
+                loss = jnp.mean(optax.huber_loss(td, delta=clamp))
+            else:
+                loss = jnp.mean(td ** 2)
+            return loss
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(2,))
+
+
+@dataclass
+class QLearningResult:
+    """Per-epoch stats (reference Learning epoch logs / DataManager)."""
+    episode_rewards: List[float]
+    episode_lengths: List[int]
+    total_steps: int
+
+
+class QLearningDiscrete:
+    """Sync DQN trainer over a discrete-action MDP."""
+
+    def __init__(self, mdp: MDP,
+                 conf: Optional[QLearningConfiguration] = None,
+                 factory: Optional[DQNFactoryStdDense] = None):
+        self.mdp = mdp
+        self.factory = factory or DQNFactoryStdDense()
+        self._build(conf or QLearningConfiguration())
+
+    def _build(self, conf: QLearningConfiguration) -> None:
+        """(Re)derive everything baked from the config — jitted step
+        closure, optimizer, replay, epsilon schedule. Called from
+        __init__ and again from load() so a restored checkpoint trains
+        with ITS hyperparameters, not the constructor's."""
+        self.conf = conf
+        mdp = self.mdp
+        obs_size = int(np.prod(mdp.observation_space.shape))
+        n_act = mdp.action_space.size
+        self._init_fn, self.apply_fn = self.factory.build(
+            obs_size, n_act, seed=conf.seed)
+        self.params = self._init_fn()
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.optimizer = optax.adam(conf.learning_rate)
+        self.opt_state = self.optimizer.init(self.params)
+        self._train_step = _make_train_step(
+            self.apply_fn, self.optimizer, conf)
+        self._q_fwd = jax.jit(self.apply_fn)
+        self.replay = ExpReplay(conf.exp_rep_max_size,
+                                mdp.observation_space.shape,
+                                conf.batch_size, conf.seed)
+        self.policy = EpsGreedy(conf.min_epsilon, conf.epsilon_nb_step)
+        self._rng = np.random.default_rng(conf.seed)
+        self.step_count = 0
+        self.losses: List[float] = []
+
+    # -- acting ------------------------------------------------------------
+    def q_values(self, obs: np.ndarray) -> np.ndarray:
+        q = self._q_fwd(self.params, jnp.asarray(obs[None]))
+        return np.asarray(q[0])
+
+    def _act(self, obs) -> int:
+        return self.policy.next_action(self.q_values(obs),
+                                       self.step_count, self._rng)
+
+    # -- training ----------------------------------------------------------
+    def train(self) -> QLearningResult:
+        """Reference QLearningDiscrete.trainEpoch loop until maxStep."""
+        c = self.conf
+        ep_rewards, ep_lengths = [], []
+        while self.step_count < c.max_step:
+            obs = self.mdp.reset()
+            ep_r, ep_len = 0.0, 0
+            for _ in range(c.max_epoch_step):
+                a = self._act(obs)
+                nxt, r, done, _ = self.mdp.step(a)
+                self.replay.store(obs, a, r * c.reward_factor, nxt,
+                                  done)
+                obs = nxt
+                ep_r += r
+                ep_len += 1
+                self.step_count += 1
+                if (self.step_count >= c.update_start
+                        and len(self.replay) > 0):
+                    batch = self.replay.get_batch()
+                    self.params, self.opt_state, loss = \
+                        self._train_step(self.params,
+                                         self.target_params,
+                                         self.opt_state,
+                                         *map(jnp.asarray, batch))
+                    self.losses.append(float(loss))
+                if self.step_count % c.target_dqn_update_freq == 0:
+                    self.target_params = jax.tree.map(
+                        lambda x: x, self.params)
+                if done or self.step_count >= c.max_step:
+                    break
+            ep_rewards.append(ep_r)
+            ep_lengths.append(ep_len)
+        return QLearningResult(ep_rewards, ep_lengths, self.step_count)
+
+    # -- evaluation --------------------------------------------------------
+    def play(self, mdp: Optional[MDP] = None,
+             max_steps: Optional[int] = None) -> float:
+        """Greedy rollout, returns episode reward (reference
+        Policy.play)."""
+        mdp = mdp or self.mdp
+        greedy = Greedy()
+        obs = mdp.reset()
+        total = 0.0
+        for _ in range(max_steps or self.conf.max_epoch_step):
+            a = greedy.next_action(self.q_values(obs), 0, self._rng)
+            obs, r, done, _ = mdp.step(a)
+            total += r
+            if done:
+                break
+        return total
+
+    # -- persistence (reference DQNPolicy.save/load) -----------------------
+    def save(self, path: str) -> None:
+        flat = {"/".join(k): np.asarray(v) for k, v in
+                _flatten(self.params).items()}
+        np.savez(path, __conf__=json.dumps(asdict(self.conf)), **flat)
+
+    def load(self, path: str) -> None:
+        data = np.load(path if path.endswith(".npz") else path + ".npz",
+                       allow_pickle=False)
+        conf = QLearningConfiguration(
+            **json.loads(str(data["__conf__"])))
+        self._build(conf)      # rebuild step/optimizer/replay for conf
+        for k in data.files:
+            if k == "__conf__":
+                continue
+            parts = k.split("/")
+            d = self.params
+            for p in parts[:-1]:
+                d = d[p]
+            d[parts[-1]] = jnp.asarray(data[k])
+        self.opt_state = self.optimizer.init(self.params)
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+
+
+def _flatten(tree, prefix=()):
+    out = {}
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            out.update(_flatten(v, prefix + (k,)))
+        else:
+            out[prefix + (k,)] = v
+    return out
+
+
+class QLearningDiscreteDense(QLearningDiscrete):
+    """Reference QLearningDiscreteDense: QLearningDiscrete wired to the
+    std-dense DQN factory (kept as a named alias)."""
+    pass

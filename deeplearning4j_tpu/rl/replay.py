@@ -1,0 +1,55 @@
+"""Experience replay buffer.
+
+Reference: ``org.deeplearning4j.rl4j.learning.sync.ExpReplay`` (circular
+store of ``Transition`` objects, uniform batch sampling).
+
+TPU-native design: instead of a list of boxed Transition objects, the
+buffer is a set of preallocated numpy ring arrays; sampling gathers a
+fixed-shape batch (obs/action/reward/next_obs/done) that feeds the
+jitted learner step directly — no per-sample host object churn, no
+retrace (shapes constant from the first sample call).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+class ExpReplay:
+    """Uniform-sampling circular replay memory."""
+
+    def __init__(self, max_size: int, obs_shape: Tuple[int, ...],
+                 batch_size: int = 32, seed: int = 0):
+        self.max_size = int(max_size)
+        self.batch_size = int(batch_size)
+        self._rng = np.random.default_rng(seed)
+        self.obs = np.zeros((max_size, *obs_shape), np.float32)
+        self.next_obs = np.zeros((max_size, *obs_shape), np.float32)
+        self.actions = np.zeros(max_size, np.int32)
+        self.rewards = np.zeros(max_size, np.float32)
+        self.dones = np.zeros(max_size, np.float32)
+        self._idx = 0
+        self._size = 0
+
+    def store(self, obs, action, reward, next_obs, done) -> None:
+        i = self._idx
+        self.obs[i] = obs
+        self.actions[i] = action
+        self.rewards[i] = reward
+        self.next_obs[i] = next_obs
+        self.dones[i] = float(done)
+        self._idx = (i + 1) % self.max_size
+        self._size = min(self._size + 1, self.max_size)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def get_batch(self, batch_size: int = None):
+        """Uniform sample WITH replacement (size-stable even when the
+        buffer holds fewer than batch_size transitions, keeping the
+        jitted step's shapes fixed)."""
+        bs = batch_size or self.batch_size
+        idx = self._rng.integers(self._size, size=bs)
+        return (self.obs[idx], self.actions[idx], self.rewards[idx],
+                self.next_obs[idx], self.dones[idx])

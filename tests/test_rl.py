@@ -1,0 +1,148 @@
+"""RL package tests (reference: rl4j QLearningDiscrete/A3C tests —
+rl4j uses toy deterministic MDPs the same way)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.rl import (A3CConfiguration, A3CDiscrete,
+                                   BoltzmannQ, CartPole, EpsGreedy,
+                                   ExpReplay, GridWorld, Greedy,
+                                   QLearningConfiguration,
+                                   QLearningDiscrete, VectorizedMDP)
+from deeplearning4j_tpu.rl.network import DQNFactoryStdDense
+
+
+# --- envs -------------------------------------------------------------------
+
+def test_cartpole_dynamics():
+    env = CartPole(seed=3)
+    obs = env.reset()
+    assert obs.shape == (4,)
+    total = 0
+    while not env.is_done():
+        obs, r, done, _ = env.step(1)
+        total += r
+    assert 1 <= total < 200     # constant-push falls over quickly
+
+
+def test_gridworld_shortest_path_reward():
+    env = GridWorld(n=3)
+    env.reset()
+    # optimal: 2 downs + 2 rights = 4 steps, reward -3 + 10
+    rs = [env.step(a)[1] for a in [1, 1, 3, 3]]
+    assert rs == [-1, -1, -1, 10]
+    assert env.is_done()
+
+
+def test_vectorized_mdp_autoreset():
+    v = VectorizedMDP(GridWorld(n=3, max_steps=5), n=4)
+    obs = v.reset()
+    assert obs.shape == (4, 9)
+    for _ in range(6):
+        obs, r, d = v.step(np.zeros(4, np.int32))
+    assert obs.shape == (4, 9)    # envs auto-reset after max_steps
+
+
+# --- replay -----------------------------------------------------------------
+
+def test_exp_replay_ring_and_sampling():
+    rep = ExpReplay(max_size=8, obs_shape=(2,), batch_size=4, seed=0)
+    for i in range(12):           # wraps around
+        rep.store(np.full(2, i), i % 3, float(i), np.full(2, i + 1),
+                  i % 2)
+    assert len(rep) == 8
+    obs, a, r, nxt, d = rep.get_batch()
+    assert obs.shape == (4, 2) and a.shape == (4,)
+    assert r.min() >= 4.0         # oldest 4 were overwritten
+
+
+# --- policies ---------------------------------------------------------------
+
+def test_policies():
+    rng = np.random.default_rng(0)
+    q = np.array([0.1, 5.0, -1.0])
+    assert Greedy().next_action(q, 0, rng) == 1
+    eps = EpsGreedy(min_epsilon=0.1, anneal_steps=100)
+    assert eps.epsilon(0) == 1.0
+    assert eps.epsilon(100) == pytest.approx(0.1)
+    acts = {BoltzmannQ(0.1).next_action(q, 0, rng) for _ in range(20)}
+    assert 1 in acts              # low temperature ≈ greedy
+
+
+# --- DQN --------------------------------------------------------------------
+
+def test_dqn_learns_gridworld():
+    """DQN should find the shortest path on a 3x3 grid (optimal
+    return = -3 + 10 = 7)."""
+    conf = QLearningConfiguration(
+        seed=7, max_step=3000, max_epoch_step=30, batch_size=64,
+        exp_rep_max_size=3000, target_dqn_update_freq=100,
+        update_start=100, min_epsilon=0.05, epsilon_nb_step=1500,
+        gamma=0.95, learning_rate=2e-3, double_dqn=True)
+    ql = QLearningDiscrete(GridWorld(n=3, max_steps=30), conf,
+                           DQNFactoryStdDense(hidden=(32,)))
+    res = ql.train()
+    assert res.total_steps >= conf.max_step
+    assert ql.play() == 7.0, "greedy policy should be optimal"
+
+
+def test_dqn_dueling_and_save_load(tmp_path):
+    conf = QLearningConfiguration(seed=1, max_step=300, max_epoch_step=20,
+                                  update_start=50)
+    ql = QLearningDiscrete(GridWorld(n=3), conf,
+                           DQNFactoryStdDense(hidden=(16,),
+                                              dueling=True))
+    ql.train()
+    obs = GridWorld(n=3).reset()
+    q_before = ql.q_values(obs)
+    p = str(tmp_path / "dqn")
+    ql.save(p)
+    ql2 = QLearningDiscrete(GridWorld(n=3), conf,
+                            DQNFactoryStdDense(hidden=(16,),
+                                               dueling=True))
+    ql2.load(p)
+    np.testing.assert_allclose(ql2.q_values(obs), q_before, rtol=1e-6)
+
+
+# --- A2C/A3C ----------------------------------------------------------------
+
+def test_dqn_load_rebuilds_from_checkpoint_conf(tmp_path):
+    """load() must train with the checkpoint's hyperparameters, not
+    the constructor's."""
+    conf = QLearningConfiguration(seed=1, max_step=200, gamma=0.5,
+                                  learning_rate=5e-4, batch_size=16,
+                                  update_start=50)
+    ql = QLearningDiscrete(GridWorld(n=3), conf,
+                           DQNFactoryStdDense(hidden=(8,)))
+    ql.train()
+    p = str(tmp_path / "q")
+    ql.save(p)
+    other = QLearningDiscrete(GridWorld(n=3),
+                              QLearningConfiguration(seed=9),
+                              DQNFactoryStdDense(hidden=(8,)))
+    other.load(p)
+    assert other.conf.gamma == 0.5
+    assert other.replay.batch_size == 16
+    obs = GridWorld(n=3).reset()
+    np.testing.assert_allclose(other.q_values(obs), ql.q_values(obs),
+                               rtol=1e-6)
+
+
+def test_async_nstep_q_learns_gridworld():
+    from deeplearning4j_tpu.rl import AsyncNStepQLearningDiscrete
+    conf = A3CConfiguration(seed=11, max_step=12000, n_envs=8,
+                            n_step=8, gamma=0.9, learning_rate=2e-3)
+    nq = AsyncNStepQLearningDiscrete(GridWorld(n=3, max_steps=20), conf)
+    nq.train()
+    assert nq.play(GridWorld(n=3, max_steps=20)) > 0
+
+
+def test_a3c_improves_on_gridworld():
+    conf = A3CConfiguration(seed=5, max_step=12000, n_envs=8, n_step=8,
+                            gamma=0.95, learning_rate=3e-3,
+                            entropy_coef=0.01)
+    a3c = A3CDiscrete(GridWorld(n=3, max_steps=20), conf)
+    a3c.train()
+    # greedy policy reaches goal (optimal 7; allow any positive path)
+    score = a3c.play(GridWorld(n=3, max_steps=20))
+    assert score > 0, score
+    assert a3c.mean_returns[-1] > a3c.mean_returns[0]

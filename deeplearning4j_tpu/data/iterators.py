@@ -77,27 +77,33 @@ class AsyncDataSetIterator(DataSetIterator):
         self.base.reset()
 
     def __iter__(self):
-        q: queue.Queue = queue.Queue(maxsize=self.queue_size)
-        _END = object()
+        from deeplearning4j_tpu.native import RingQueue
+
+        q = RingQueue(capacity=self.queue_size)
         err: List[BaseException] = []
 
         def worker():
             try:
                 for ds in self.base:
-                    q.put(ds)
+                    if not q.put(ds):      # consumer closed early
+                        return
             except BaseException as e:  # propagate to consumer
                 err.append(e)
             finally:
-                q.put(_END)
+                q.close()
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is _END:
-                break
-            yield item
-        t.join()
+        try:
+            while True:
+                try:
+                    item = q.get()
+                except StopIteration:
+                    break
+                yield item
+        finally:
+            q.close()                      # unblock producer on break
+            t.join()
         if err:
             raise err[0]
 

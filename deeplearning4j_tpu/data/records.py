@@ -74,6 +74,21 @@ class CSVRecordReader(RecordReader):
             yield ([self._parse(v) for v in row] if self.parse_numbers
                    else [v.strip() for v in row])
 
+    def to_matrix(self):
+        """Whole-file numeric fast path → [rows, cols] float32, using
+        the native CSV parser (native/dl4j_tpu_native.cpp) when built.
+        Returns None if the data isn't purely numeric/rectangular —
+        callers then fall back to the row iterator."""
+        from deeplearning4j_tpu import native as _native
+
+        p = Path(str(self.path_or_text))
+        if p.exists():
+            data = p.read_bytes()
+        else:
+            data = str(self.path_or_text).encode()
+        return _native.csv_parse_f32(data, self.delimiter,
+                                     self.skip_lines)
+
 
 class LineRecordReader(RecordReader):
     """One record per line (reference LineRecordReader)."""

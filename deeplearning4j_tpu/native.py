@@ -1,0 +1,376 @@
+"""ctypes bindings for the native runtime (native/dl4j_tpu_native.cpp).
+
+The TPU compute path is JAX/XLA/Pallas; this module covers the runtime
+AROUND it, mirroring the reference's native pieces (SURVEY §2.1):
+CSV fast parsing (datavec ETL), the host-side threshold gradient codec
+(libnd4j encode_threshold/decode_threshold + bitmap encode), workspace
+arena allocation (include/memory/Workspace.h), and a blocking MPMC ring
+queue (AsyncDataSetIterator prefetch / IndexedTail fan-out).
+
+The .so is built on first use via ``make`` (g++ baked into the image);
+every entry point has a pure-numpy fallback so the package works even
+without a toolchain. ``available()`` reports which path is active.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libdl4j_tpu_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _try_build() -> bool:
+    global _build_failed
+    if _build_failed:
+        return False
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR],
+                       check=True, capture_output=True, timeout=120)
+        return os.path.exists(_SO_PATH)
+    except Exception:
+        _build_failed = True
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None on failure."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH) and not _try_build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        if lib.dl4j_tpu_native_abi_version() != 1:
+            return None
+        # signatures
+        lib.csv_parse_f32.restype = ctypes.c_int
+        lib.csv_parse_f32.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_char,
+            ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.encode_threshold_f32.restype = ctypes.c_int64
+        lib.encode_threshold_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ctypes.c_float, ctypes.POINTER(ctypes.c_int8),
+            ctypes.POINTER(ctypes.c_float)]
+        lib.decode_threshold_f32.restype = None
+        lib.decode_threshold_f32.argtypes = [
+            ctypes.POINTER(ctypes.c_int8), ctypes.c_int64,
+            ctypes.c_float, ctypes.POINTER(ctypes.c_float)]
+        lib.bitmap_encode.restype = None
+        lib.bitmap_encode.argtypes = [
+            ctypes.POINTER(ctypes.c_int8), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8)]
+        lib.bitmap_decode.restype = None
+        lib.bitmap_decode.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ctypes.c_float, ctypes.POINTER(ctypes.c_float)]
+        for name in ("ws_create", "ws_alloc"):
+            getattr(lib, name).restype = ctypes.c_void_p
+        lib.ws_create.argtypes = [ctypes.c_int64]
+        lib.ws_alloc.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.ws_reset.restype = ctypes.c_int64
+        lib.ws_reset.argtypes = [ctypes.c_void_p]
+        lib.ws_capacity.restype = ctypes.c_int64
+        lib.ws_capacity.argtypes = [ctypes.c_void_p]
+        lib.ws_destroy.restype = None
+        lib.ws_destroy.argtypes = [ctypes.c_void_p]
+        lib.ring_create.restype = ctypes.c_void_p
+        lib.ring_create.argtypes = [ctypes.c_int64]
+        lib.ring_push.restype = ctypes.c_int
+        lib.ring_push.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.ring_pop.restype = ctypes.c_int
+        lib.ring_pop.argtypes = [ctypes.c_void_p,
+                                 ctypes.POINTER(ctypes.c_int64)]
+        lib.ring_size.restype = ctypes.c_int64
+        lib.ring_size.argtypes = [ctypes.c_void_p]
+        lib.ring_close.restype = None
+        lib.ring_close.argtypes = [ctypes.c_void_p]
+        lib.ring_destroy.restype = None
+        lib.ring_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True when the native library is loaded (or loadable)."""
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# CSV
+# ---------------------------------------------------------------------------
+
+def csv_parse_f32(text: bytes, delimiter: str = ",",
+                  skip_rows: int = 0) -> Optional[np.ndarray]:
+    """Parse an all-numeric CSV byte buffer to a [rows, cols] float32
+    array. Returns None when the buffer isn't purely numeric/rectangular
+    (caller falls back to the general reader) — same contract native or
+    not."""
+    lib = _load()
+    if lib is None:
+        return _csv_parse_py(text, delimiter, skip_rows)
+    max_out = max(1, text.count(b"\n") + 1) * max(
+        1, text.split(b"\n", 1)[0].count(delimiter.encode()) + 1)
+    # generous bound: elements <= commas + lines
+    max_out = text.count(delimiter.encode()) + text.count(b"\n") + 2
+    out = np.empty(max_out, np.float32)
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    rc = lib.csv_parse_f32(
+        text, len(text), delimiter.encode()[0], skip_rows,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        max_out, ctypes.byref(rows), ctypes.byref(cols))
+    if rc != 0:
+        return None
+    r, c = rows.value, cols.value
+    return out[:r * c].reshape(r, c).copy()
+
+
+def _csv_parse_py(text: bytes, delimiter: str,
+                  skip_rows: int) -> Optional[np.ndarray]:
+    lines = [ln.rstrip("\r") for ln in text.decode().split("\n")]
+    lines = [ln for ln in lines if ln][skip_rows:]
+    if not lines:
+        return np.zeros((0, 0), np.float32)
+    try:
+        rows = [[float(x) for x in ln.split(delimiter)] for ln in lines]
+    except ValueError:
+        return None
+    n = len(rows[0])
+    if any(len(r) != n for r in rows):
+        return None
+    return np.asarray(rows, np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Threshold codec (host-side; device-side lives in parallel/compression)
+# ---------------------------------------------------------------------------
+
+def encode_threshold(grad: np.ndarray,
+                     tau: float) -> Tuple[np.ndarray, np.ndarray, int]:
+    """g → (ternary int8 sign, residual, nnz)."""
+    g = np.ascontiguousarray(grad, np.float32).reshape(-1)
+    lib = _load()
+    if lib is None:
+        sign = np.sign(g) * (np.abs(g) > tau)
+        sign = sign.astype(np.int8)
+        return sign, g - tau * sign.astype(np.float32), \
+            int(np.count_nonzero(sign))
+    sign = np.empty(g.size, np.int8)
+    residual = np.empty(g.size, np.float32)
+    nnz = lib.encode_threshold_f32(
+        g.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), g.size, tau,
+        sign.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        residual.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return sign, residual, int(nnz)
+
+
+def decode_threshold(sign: np.ndarray, tau: float) -> np.ndarray:
+    s = np.ascontiguousarray(sign, np.int8).reshape(-1)
+    lib = _load()
+    if lib is None:
+        return tau * s.astype(np.float32)
+    out = np.empty(s.size, np.float32)
+    lib.decode_threshold_f32(
+        s.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)), s.size, tau,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return out
+
+
+def bitmap_encode(sign: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Ternary int8 → (pos, neg) packed bitmaps, 8 elems/byte."""
+    s = np.ascontiguousarray(sign, np.int8).reshape(-1)
+    nb = (s.size + 7) // 8
+    lib = _load()
+    if lib is None:
+        bits_pos = np.packbits((s > 0).astype(np.uint8), bitorder="little")
+        bits_neg = np.packbits((s < 0).astype(np.uint8), bitorder="little")
+        return (np.resize(bits_pos, nb), np.resize(bits_neg, nb))
+    pos = np.zeros(nb, np.uint8)
+    neg = np.zeros(nb, np.uint8)
+    lib.bitmap_encode(
+        s.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)), s.size,
+        pos.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        neg.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return pos, neg
+
+
+def bitmap_decode(pos: np.ndarray, neg: np.ndarray, n: int,
+                  tau: float) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        p = np.unpackbits(pos, bitorder="little")[:n]
+        m = np.unpackbits(neg, bitorder="little")[:n]
+        return tau * (p.astype(np.float32) - m.astype(np.float32))
+    out = np.empty(n, np.float32)
+    lib.bitmap_decode(
+        np.ascontiguousarray(pos).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_uint8)),
+        np.ascontiguousarray(neg).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_uint8)),
+        n, tau, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Workspace arena
+# ---------------------------------------------------------------------------
+
+class Workspace:
+    """Host staging arena (reference MemoryWorkspace semantics: bump
+    alloc inside a cycle, reset at cycle end, spill+learn when
+    undersized). Returns numpy views over arena memory."""
+
+    def __init__(self, capacity_bytes: int):
+        self._lib = _load()
+        self.capacity = int(capacity_bytes)
+        self.high_water = 0
+        if self._lib is not None:
+            self._h = self._lib.ws_create(self.capacity)
+            if not self._h:
+                raise MemoryError("ws_create failed")
+        else:
+            self._h = None
+            self._offset = 0
+            self._spill = []
+
+    def alloc(self, shape, dtype=np.float32) -> np.ndarray:
+        dt = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dt.itemsize
+        if self._lib is not None:
+            ptr = self._lib.ws_alloc(self._h, nbytes)
+            if not ptr:
+                raise MemoryError("ws_alloc failed")
+            buf = (ctypes.c_char * nbytes).from_address(ptr)
+            return np.frombuffer(buf, dtype=dt).reshape(shape)
+        aligned = (self._offset + 63) & ~63
+        if aligned + nbytes <= self.capacity:
+            self._offset = aligned + nbytes
+        else:
+            self._spill.append(nbytes)
+        return np.empty(shape, dt)
+
+    def reset(self) -> int:
+        """Ends the cycle; returns the high-water mark in bytes."""
+        if self._lib is not None:
+            self.high_water = int(self._lib.ws_reset(self._h))
+        else:
+            self.high_water = self._offset + sum(self._spill)
+            self._offset = 0
+            self._spill = []
+        return self.high_water
+
+    def close(self):
+        if self._lib is not None and self._h:
+            self._lib.ws_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.reset()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Ring queue
+# ---------------------------------------------------------------------------
+
+class RingQueue:
+    """Bounded blocking MPMC queue of Python objects, backed by the
+    native condvar ring (tokens index a slot table). Drop-in for the
+    queue inside AsyncDataSetIterator; falls back to queue.Queue."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._lib = _load()
+        if self._lib is not None:
+            self._h = self._lib.ring_create(capacity)
+            self._slots = {}
+            self._slot_lock = threading.Lock()
+            self._next_token = 0
+        else:
+            import queue
+            self._q = queue.Queue(maxsize=capacity)
+            self._closed = False
+
+    def put(self, item) -> bool:
+        if self._lib is not None:
+            with self._slot_lock:
+                token = self._next_token
+                self._next_token += 1
+                self._slots[token] = item
+            if self._lib.ring_push(self._h, token) != 0:
+                with self._slot_lock:
+                    self._slots.pop(token, None)
+                return False
+            return True
+        if self._closed:
+            return False
+        self._q.put(item)
+        return True
+
+    def get(self):
+        """Blocks; returns the item or raises StopIteration when the
+        queue is closed and drained."""
+        if self._lib is not None:
+            token = ctypes.c_int64()
+            if self._lib.ring_pop(self._h, ctypes.byref(token)) != 0:
+                raise StopIteration
+            with self._slot_lock:
+                return self._slots.pop(token.value)
+        import queue
+        while True:
+            try:
+                return self._q.get(timeout=0.05)
+            except queue.Empty:
+                if self._closed:
+                    raise StopIteration from None
+
+    def qsize(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.ring_size(self._h))
+        return self._q.qsize()
+
+    def close(self):
+        if self._lib is not None:
+            self._lib.ring_close(self._h)
+        else:
+            self._closed = True
+
+    def __del__(self):
+        try:
+            if self._lib is not None and self._h:
+                self._lib.ring_close(self._h)
+                self._lib.ring_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass

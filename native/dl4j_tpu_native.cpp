@@ -1,0 +1,289 @@
+// dl4j_tpu native runtime — C ABI, loaded from Python via ctypes.
+//
+// TPU-native equivalents of the reference's native runtime pieces that
+// live OUTSIDE the XLA compute path (SURVEY §2.1: libnd4j memory/
+// workspaces, execution engine, C ABI surface; §2.2 AeronNDArray
+// chunking; datavec's native ETL):
+//
+//   * fast CSV float parser        (datavec CSVRecordReader hot path;
+//                                   reference: JavaCV/Java parsing)
+//   * threshold gradient codec     (libnd4j encode_threshold /
+//     + bitmap pack                 decode_threshold, bitmap encode —
+//                                   host-side flavor for DCN shipping;
+//                                   the on-device flavor is XLA/Pallas)
+//   * workspace arena allocator    (include/memory/Workspace.h: bump
+//                                   arena with reset/scope semantics)
+//   * blocking MPMC ring queue     (the prefetch machinery behind
+//                                   AsyncDataSetIterator / IndexedTail
+//                                   fan-out queues)
+//
+// Pure C++17 + std::thread; no external deps. Built by native/Makefile
+// (or deeplearning4j_tpu/native.py on first import) into
+// libdl4j_tpu_native.so. Every entry point is exercised against the
+// pure-Python fallback in tests/test_native.py.
+
+#include <algorithm>
+#include <atomic>
+#include <cctype>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <new>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// CSV fast path
+// ---------------------------------------------------------------------------
+
+// Parse a numeric CSV buffer into `out` (row-major), returning 0 on
+// success. Rows are '\n'-separated (trailing '\r' tolerated), fields by
+// `delim`. Empty lines are skipped. On any non-numeric field returns -2
+// (caller falls back to the general Python reader). Returns -1 if the
+// parsed element count would exceed `max_out`. n_rows/n_cols receive
+// the shape; ragged rows return -3.
+int csv_parse_f32(const char* buf, int64_t len, char delim, int skip_rows,
+                  float* out, int64_t max_out,
+                  int64_t* n_rows, int64_t* n_cols) {
+    int64_t rows = 0, cols = -1, n = 0;
+    const char* p = buf;
+    const char* end = buf + len;
+    while (p < end) {
+        const char* line_end =
+            static_cast<const char*>(memchr(p, '\n', end - p));
+        if (!line_end) line_end = end;
+        const char* le = line_end;
+        if (le > p && le[-1] == '\r') --le;
+        if (le == p) { p = line_end + 1; continue; }  // empty line
+        if (skip_rows > 0) { --skip_rows; p = line_end + 1; continue; }
+        int64_t row_cols = 0;
+        const char* f = p;
+        while (f <= le) {
+            const char* fe = f;
+            while (fe < le && *fe != delim) ++fe;
+            // parse [f, fe) as float
+            char tmp[64];
+            int64_t flen = fe - f;
+            // trim spaces
+            while (flen > 0 && isspace(static_cast<unsigned char>(*f))) {
+                ++f; --flen;
+            }
+            while (flen > 0 &&
+                   isspace(static_cast<unsigned char>(f[flen - 1])))
+                --flen;
+            if (flen <= 0 || flen >= 63) return -2;
+            memcpy(tmp, f, flen);
+            tmp[flen] = '\0';
+            char* endptr = nullptr;
+            float v = strtof(tmp, &endptr);
+            if (endptr != tmp + flen) return -2;
+            if (n >= max_out) return -1;
+            out[n++] = v;
+            ++row_cols;
+            if (fe >= le) break;
+            f = fe + 1;
+        }
+        if (cols < 0) cols = row_cols;
+        else if (cols != row_cols) return -3;
+        ++rows;
+        p = line_end + 1;
+    }
+    *n_rows = rows;
+    *n_cols = cols < 0 ? 0 : cols;
+    return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Threshold gradient codec (reference libnd4j encode_threshold /
+// decode_threshold / bitmap encode — SURVEY §2.3 EncodedGradients row)
+// ---------------------------------------------------------------------------
+
+// g -> ternary sign (|g|>tau), residual = g - tau*sign. Returns count of
+// non-zeros (the reference's encoded-update length).
+int64_t encode_threshold_f32(const float* g, int64_t n, float tau,
+                             int8_t* sign, float* residual) {
+    int64_t nnz = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        float v = g[i];
+        int8_t s = (v > tau) ? 1 : (v < -tau ? -1 : 0);
+        sign[i] = s;
+        residual[i] = v - tau * static_cast<float>(s);
+        nnz += (s != 0);
+    }
+    return nnz;
+}
+
+void decode_threshold_f32(const int8_t* sign, int64_t n, float tau,
+                          float* out) {
+    for (int64_t i = 0; i < n; ++i)
+        out[i] = tau * static_cast<float>(sign[i]);
+}
+
+// Pack ternary signs into two bitmaps (pos/neg), 8 elements/byte each —
+// 16x smaller than f32. n_bytes = ceil(n/8).
+void bitmap_encode(const int8_t* sign, int64_t n, uint8_t* pos,
+                   uint8_t* neg) {
+    int64_t nb = (n + 7) / 8;
+    memset(pos, 0, nb);
+    memset(neg, 0, nb);
+    for (int64_t i = 0; i < n; ++i) {
+        if (sign[i] > 0) pos[i >> 3] |= (1u << (i & 7));
+        else if (sign[i] < 0) neg[i >> 3] |= (1u << (i & 7));
+    }
+}
+
+void bitmap_decode(const uint8_t* pos, const uint8_t* neg, int64_t n,
+                   float tau, float* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        bool p = pos[i >> 3] & (1u << (i & 7));
+        bool m = neg[i >> 3] & (1u << (i & 7));
+        out[i] = p ? tau : (m ? -tau : 0.0f);
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Workspace arena (reference include/memory/Workspace.h: cyclic bump
+// allocator; host staging buffers here — device memory is XLA's job)
+// ---------------------------------------------------------------------------
+
+struct Workspace {
+    char* base;
+    int64_t capacity;
+    int64_t offset;        // bump pointer
+    int64_t spilled;       // bytes served by malloc when arena is full
+    std::vector<void*> spill_allocs;
+    std::mutex mu;
+};
+
+void* ws_create(int64_t bytes) {
+    auto* ws = new (std::nothrow) Workspace();
+    if (!ws) return nullptr;
+    ws->base = static_cast<char*>(std::malloc(bytes));
+    if (!ws->base) { delete ws; return nullptr; }
+    ws->capacity = bytes;
+    ws->offset = 0;
+    ws->spilled = 0;
+    return ws;
+}
+
+// 64-byte-aligned bump alloc; falls back to malloc "spill" when the
+// arena is exhausted (reference workspaces spill to external allocs and
+// learn the high-water mark for the next cycle).
+void* ws_alloc(void* handle, int64_t bytes) {
+    auto* ws = static_cast<Workspace*>(handle);
+    std::lock_guard<std::mutex> lk(ws->mu);
+    int64_t aligned = (ws->offset + 63) & ~int64_t(63);
+    if (aligned + bytes <= ws->capacity) {
+        ws->offset = aligned + bytes;
+        return ws->base + aligned;
+    }
+    void* p = std::malloc(bytes);
+    if (p) {
+        ws->spill_allocs.push_back(p);
+        ws->spilled += bytes;
+    }
+    return p;
+}
+
+// End-of-cycle reset: frees spills, rewinds the bump pointer, returns
+// the high-water mark (arena use + spill) so callers can grow.
+int64_t ws_reset(void* handle) {
+    auto* ws = static_cast<Workspace*>(handle);
+    std::lock_guard<std::mutex> lk(ws->mu);
+    int64_t high_water = ws->offset + ws->spilled;
+    for (void* p : ws->spill_allocs) std::free(p);
+    ws->spill_allocs.clear();
+    ws->offset = 0;
+    ws->spilled = 0;
+    return high_water;
+}
+
+int64_t ws_capacity(void* handle) {
+    return static_cast<Workspace*>(handle)->capacity;
+}
+
+void ws_destroy(void* handle) {
+    auto* ws = static_cast<Workspace*>(handle);
+    ws_reset(handle);
+    std::free(ws->base);
+    delete ws;
+}
+
+// ---------------------------------------------------------------------------
+// Blocking MPMC ring queue (prefetch backbone; reference
+// AsyncDataSetIterator's bounded queue + IndexedTail fan-out)
+// ---------------------------------------------------------------------------
+
+struct Ring {
+    std::deque<int64_t> q;
+    int64_t capacity;
+    bool closed = false;
+    std::mutex mu;
+    std::condition_variable cv_push, cv_pop;
+};
+
+void* ring_create(int64_t capacity) {
+    auto* r = new (std::nothrow) Ring();
+    if (!r) return nullptr;
+    r->capacity = capacity;
+    return r;
+}
+
+// Blocking push of an opaque token (Python passes buffer indices).
+// Returns 0 on success, -1 if the ring is closed.
+int ring_push(void* handle, int64_t token) {
+    auto* r = static_cast<Ring*>(handle);
+    std::unique_lock<std::mutex> lk(r->mu);
+    r->cv_push.wait(lk, [&] {
+        return r->closed ||
+               static_cast<int64_t>(r->q.size()) < r->capacity;
+    });
+    if (r->closed) return -1;
+    r->q.push_back(token);
+    r->cv_pop.notify_one();
+    return 0;
+}
+
+// Blocking pop; returns 0 and sets *token, or -1 when closed AND
+// drained (the end-of-stream signal).
+int ring_pop(void* handle, int64_t* token) {
+    auto* r = static_cast<Ring*>(handle);
+    std::unique_lock<std::mutex> lk(r->mu);
+    r->cv_pop.wait(lk, [&] { return r->closed || !r->q.empty(); });
+    if (r->q.empty()) return -1;
+    *token = r->q.front();
+    r->q.pop_front();
+    r->cv_push.notify_one();
+    return 0;
+}
+
+int64_t ring_size(void* handle) {
+    auto* r = static_cast<Ring*>(handle);
+    std::lock_guard<std::mutex> lk(r->mu);
+    return static_cast<int64_t>(r->q.size());
+}
+
+void ring_close(void* handle) {
+    auto* r = static_cast<Ring*>(handle);
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->closed = true;
+    r->cv_push.notify_all();
+    r->cv_pop.notify_all();
+}
+
+void ring_destroy(void* handle) {
+    delete static_cast<Ring*>(handle);
+}
+
+// ---------------------------------------------------------------------------
+// ABI versioning
+// ---------------------------------------------------------------------------
+
+int dl4j_tpu_native_abi_version() { return 1; }
+
+}  // extern "C"

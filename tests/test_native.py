@@ -1,0 +1,185 @@
+"""Native runtime tests: every C++ entry point vs the pure-Python
+fallback (the same-suite-over-every-backend lesson, SURVEY §4)."""
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native
+
+
+requires_native = pytest.mark.skipif(not native.available(),
+                                     reason="native toolchain missing")
+
+
+# --- CSV --------------------------------------------------------------------
+
+def test_csv_parse_matches_python():
+    text = b"1.5,2,3\n4,-5.25,6e2\n7,8,9\n"
+    got = native.csv_parse_f32(text)
+    np.testing.assert_allclose(
+        got, [[1.5, 2, 3], [4, -5.25, 600], [7, 8, 9]])
+    assert got.dtype == np.float32
+
+
+def test_csv_parse_skip_rows_and_crlf():
+    text = b"a,b,c\r\n1,2,3\r\n4,5,6\r\n"
+    got = native.csv_parse_f32(text, skip_rows=1)
+    np.testing.assert_allclose(got, [[1, 2, 3], [4, 5, 6]])
+
+
+def test_csv_parse_rejects_non_numeric_and_ragged():
+    assert native.csv_parse_f32(b"1,2\n3,x\n") is None
+    assert native.csv_parse_f32(b"1,2\n3\n") is None
+
+
+@requires_native
+def test_csv_native_agrees_with_fallback():
+    rng = np.random.default_rng(0)
+    arr = rng.normal(size=(50, 7)).astype(np.float32)
+    text = "\n".join(",".join(f"{v:.6g}" for v in row)
+                     for row in arr).encode()
+    nat = native.csv_parse_f32(text)
+    py = native._csv_parse_py(text, ",", 0)
+    np.testing.assert_allclose(nat, py, rtol=1e-6)
+    np.testing.assert_allclose(nat, arr, rtol=1e-4)
+
+
+def test_csv_record_reader_to_matrix(tmp_path):
+    from deeplearning4j_tpu.data.records import CSVRecordReader
+    p = tmp_path / "d.csv"
+    p.write_text("h1,h2\n1,2\n3,4\n")
+    m = CSVRecordReader(str(p), skip_lines=1).to_matrix()
+    np.testing.assert_allclose(m, [[1, 2], [3, 4]])
+    # non-numeric file → None (fallback signal), iterator still works
+    assert CSVRecordReader(str(p)).to_matrix() is None
+    rows = list(CSVRecordReader(str(p), skip_lines=1))
+    assert rows == [[1, 2], [3, 4]]
+
+
+# --- threshold codec --------------------------------------------------------
+
+def test_threshold_codec_roundtrip():
+    rng = np.random.default_rng(1)
+    g = rng.normal(scale=0.01, size=1000).astype(np.float32)
+    tau = 0.01
+    sign, residual, nnz = native.encode_threshold(g, tau)
+    assert sign.dtype == np.int8
+    assert nnz == int(np.count_nonzero(sign))
+    decoded = native.decode_threshold(sign, tau)
+    np.testing.assert_allclose(decoded + residual, g, atol=1e-6)
+    # residual of thresholded-away entries is the full gradient
+    small = np.abs(g) <= tau
+    np.testing.assert_allclose(residual[small], g[small])
+
+
+def test_bitmap_roundtrip():
+    rng = np.random.default_rng(2)
+    sign = rng.choice([-1, 0, 1], size=123).astype(np.int8)
+    pos, neg = native.bitmap_encode(sign)
+    assert pos.size == (123 + 7) // 8
+    out = native.bitmap_decode(pos, neg, 123, 0.5)
+    np.testing.assert_allclose(out, 0.5 * sign.astype(np.float32))
+
+
+@requires_native
+def test_codec_native_agrees_with_fallback(monkeypatch):
+    rng = np.random.default_rng(3)
+    g = rng.normal(scale=0.02, size=513).astype(np.float32)
+    tau = 0.015
+    n_sign, n_res, n_nnz = native.encode_threshold(g, tau)
+    n_pos, n_neg = native.bitmap_encode(n_sign)
+    monkeypatch.setattr(native, "_load", lambda: None)
+    p_sign, p_res, p_nnz = native.encode_threshold(g, tau)
+    p_pos, p_neg = native.bitmap_encode(p_sign)
+    np.testing.assert_array_equal(n_sign, p_sign)
+    np.testing.assert_allclose(n_res, p_res, atol=1e-7)
+    assert n_nnz == p_nnz
+    np.testing.assert_array_equal(n_pos, p_pos)
+    np.testing.assert_array_equal(n_neg, p_neg)
+
+
+# --- workspace --------------------------------------------------------------
+
+def test_workspace_alloc_reset_highwater():
+    ws = native.Workspace(1 << 16)
+    a = ws.alloc((16, 16), np.float32)
+    a[:] = 3.0
+    b = ws.alloc((8,), np.float64)
+    b[:] = 2.0
+    assert a.shape == (16, 16) and b.dtype == np.float64
+    hw = ws.reset()
+    assert hw >= 16 * 16 * 4 + 8 * 8
+    # after reset the arena is reusable
+    c = ws.alloc((4,), np.float32)
+    c[:] = 1.0
+    ws.close()
+
+
+def test_workspace_spill_beyond_capacity():
+    ws = native.Workspace(256)
+    big = ws.alloc((1024,), np.float32)     # 4KB > 256B arena
+    big[:] = 7.0
+    assert float(big.sum()) == 7.0 * 1024
+    hw = ws.reset()
+    assert hw >= 4096
+    ws.close()
+
+
+# --- ring queue -------------------------------------------------------------
+
+def test_ring_queue_fifo_and_close():
+    q = native.RingQueue(capacity=4)
+    for i in range(4):
+        assert q.put(("item", i))
+    assert q.qsize() == 4
+    got = [q.get()[1] for _ in range(4)]
+    assert got == [0, 1, 2, 3]
+    q.close()
+    with pytest.raises(StopIteration):
+        q.get()
+
+
+def test_ring_queue_producer_consumer_threads():
+    q = native.RingQueue(capacity=8)
+    N = 200
+    out = []
+
+    def producer():
+        for i in range(N):
+            q.put(i)
+        q.close()
+
+    def consumer():
+        while True:
+            try:
+                out.append(q.get())
+            except StopIteration:
+                return
+
+    t1 = threading.Thread(target=producer)
+    t2 = threading.Thread(target=consumer)
+    t2.start()
+    t1.start()
+    t1.join(timeout=30)
+    t2.join(timeout=30)
+    assert out == list(range(N))
+
+
+def test_ring_queue_blocking_backpressure():
+    q = native.RingQueue(capacity=2)
+    q.put(1)
+    q.put(2)
+    done = threading.Event()
+
+    def blocked_put():
+        q.put(3)          # blocks until a slot frees
+        done.set()
+
+    t = threading.Thread(target=blocked_put)
+    t.start()
+    assert not done.wait(0.2), "put should block when full"
+    assert q.get() == 1
+    assert done.wait(5), "put should unblock after get"
+    t.join()
+    q.close()

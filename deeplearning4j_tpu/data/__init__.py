@@ -9,9 +9,20 @@ from deeplearning4j_tpu.data.normalizers import (
     NormalizerStandardize, NormalizerMinMaxScaler,
     ImagePreProcessingScaler,
 )
+from deeplearning4j_tpu.data.image import (
+    ColorConversionTransform, CropImageTransform, EqualizeHistTransform,
+    FlipImageTransform, ImageRecordReader, ImageTransform,
+    NativeImageLoader, ParentPathLabelGenerator, PipelineImageTransform,
+    ResizeImageTransform, RotateImageTransform, ScaleImageTransform,
+)
 
 __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
     "AsyncDataSetIterator", "NormalizerStandardize",
     "NormalizerMinMaxScaler", "ImagePreProcessingScaler",
+    "NativeImageLoader", "ImageRecordReader", "ParentPathLabelGenerator",
+    "ImageTransform", "ResizeImageTransform", "ScaleImageTransform",
+    "CropImageTransform", "FlipImageTransform", "RotateImageTransform",
+    "ColorConversionTransform", "EqualizeHistTransform",
+    "PipelineImageTransform",
 ]

@@ -179,8 +179,13 @@ class RecordReaderDataSetIterator:
 
         for rec in self.reader:
             lab = rec[self.label_index]
-            row = [float(v) for j, v in enumerate(rec)
-                   if j != self.label_index]
+            rest = [v for j, v in enumerate(rec)
+                    if j != self.label_index]
+            if len(rest) == 1 and isinstance(rest[0], np.ndarray):
+                # image-style record: [ndarray, label]
+                row = rest[0]
+            else:
+                row = [float(v) for v in rest]
             feats.append(row)
             labels.append(float(lab) if self.regression else int(lab))
             if len(feats) == self.batch_size:

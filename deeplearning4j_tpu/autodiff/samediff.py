@@ -233,6 +233,7 @@ class SameDiff:
         self._nodes: List[_Node] = []
         self._producer: Dict[str, _Node] = {}
         self._loss_names: List[str] = []
+        self._resolved_loss: Optional[List[str]] = None
         self._counter = 0
         self._fn_cache: Dict[Tuple, Callable] = {}
         self._grad_cache: Dict[Tuple, Callable] = {}
@@ -309,6 +310,12 @@ class SameDiff:
     def variables(self) -> List[SDVariable]:
         return [v for v in self._vars.values() if v.vtype == VARIABLE]
 
+    def outputs(self) -> List[str]:
+        """Terminal variables: produced by some op, consumed by none
+        (reference SameDiff.outputs)."""
+        consumed = {i for n in self._nodes for i in n.inputs}
+        return [n for n in self._producer if n not in consumed]
+
     def get_variable(self, name) -> SDVariable:
         return self._vars[name]
 
@@ -332,6 +339,7 @@ class SameDiff:
         self._fn_cache.clear()
         self._grad_cache.clear()
         self._train_step = None
+        self._resolved_loss = None
         return outs[0] if n_out == 1 else tuple(outs)
 
     # -- control flow (reference: sd.ifCond / sd.whileLoop) -----------------
@@ -423,6 +431,42 @@ class SameDiff:
                             for n in names]
         self._train_step = None
 
+    def _resolve_loss_names(self) -> List[str]:
+        """Explicit loss variables, else float-dtype terminal outputs
+        (reference behavior: loss variables default to graph outputs)."""
+        if self._loss_names:
+            return list(self._loss_names)
+        if self._resolved_loss is not None:
+            return list(self._resolved_loss)
+        outs = self.outputs()
+        floats = [n for n in outs
+                  if jnp.issubdtype(
+                      jnp.result_type(self._infer_dtype(n)), jnp.floating)]
+        if not floats:
+            raise ValueError("no loss variables and no differentiable "
+                             "graph outputs: call set_loss_variables first")
+        self._resolved_loss = floats
+        return floats
+
+    _NON_DIFF_OPS = frozenset({
+        "argmax", "argmin", "shape_of",
+        "eq", "neq", "gt", "gte", "lt", "lte", "is_nan", "is_inf",
+        "logical_and", "logical_or", "logical_not"})
+
+    def _infer_dtype(self, name: str):
+        v = self._vars.get(name)
+        if v is not None and v.dtype is not None:
+            return v.dtype
+        if name in self._arrays:
+            return self._arrays[name].dtype
+        prod = self._producer.get(name)
+        if prod is not None:
+            if prod.op in self._NON_DIFF_OPS:
+                return jnp.int32
+            if prod.op == "cast" and prod.kwargs.get("dtype") is not None:
+                return prod.kwargs["dtype"]
+        return jnp.float32
+
     def _loss_fn(self, out: Tuple[str, ...]) -> Callable:
         def loss_fn(variables, placeholders):
             vals = self._replay({**self._const_values(), **variables,
@@ -434,10 +478,8 @@ class SameDiff:
                             wrt: Sequence[str]) -> Dict[str, np.ndarray]:
         """d(sum of loss variables)/d(wrt) (reference
         sd.calculateGradients; the reverse graph is jax.grad)."""
-        if not self._loss_names:
-            raise ValueError("call set_loss_variables first")
         wrt = tuple(w.name if isinstance(w, SDVariable) else w for w in wrt)
-        out = tuple(self._loss_names)
+        out = tuple(self._resolve_loss_names())
         key = (out, wrt)
         if key not in self._grad_cache:
             def loss_fn(wrt_vals, rest_vals, placeholders):
@@ -468,10 +510,7 @@ class SameDiff:
 
     def _make_train_step(self):
         cfg = self._training_config
-        loss_names = tuple(cfg.loss_variables or self._loss_names)
-        if not loss_names:
-            raise ValueError("no loss variables: set_loss_variables or "
-                             "TrainingConfig.loss_variables")
+        loss_names = tuple(cfg.loss_variables or self._resolve_loss_names())
         updater = cfg.updater or upd.Adam(learning_rate=1e-3)
         tx = updater.to_optax() if hasattr(updater, "to_optax") else updater
         loss_fn = self._loss_fn(loss_names)

@@ -453,19 +453,34 @@ class SameDiff:
         "eq", "neq", "gt", "gte", "lt", "lte", "is_nan", "is_inf",
         "logical_and", "logical_or", "logical_not"})
 
-    def _infer_dtype(self, name: str):
+    def _infer_dtype(self, name: str, _memo=None):
+        """Propagate dtypes through producers so int-derived chains
+        (e.g. sum(eq(a,b))) are recognized as non-differentiable."""
+        if _memo is None:
+            _memo = {}
+        if name in _memo:
+            return _memo[name]
+        _memo[name] = jnp.float32        # cycle guard (graphs are DAGs)
         v = self._vars.get(name)
         if v is not None and v.dtype is not None:
-            return v.dtype
-        if name in self._arrays:
-            return self._arrays[name].dtype
-        prod = self._producer.get(name)
-        if prod is not None:
-            if prod.op in self._NON_DIFF_OPS:
-                return jnp.int32
-            if prod.op == "cast" and prod.kwargs.get("dtype") is not None:
-                return prod.kwargs["dtype"]
-        return jnp.float32
+            dt = v.dtype
+        elif name in self._arrays:
+            dt = self._arrays[name].dtype
+        else:
+            prod = self._producer.get(name)
+            if prod is None:
+                dt = jnp.float32
+            elif prod.op in self._NON_DIFF_OPS:
+                dt = jnp.int32
+            elif prod.op == "cast" and prod.kwargs.get("dtype") is not None:
+                dt = prod.kwargs["dtype"]
+            elif prod.inputs:
+                dt = jnp.result_type(*[
+                    self._infer_dtype(i, _memo) for i in prod.inputs])
+            else:
+                dt = jnp.float32
+        _memo[name] = dt
+        return dt
 
     def _loss_fn(self, out: Tuple[str, ...]) -> Callable:
         def loss_fn(variables, placeholders):

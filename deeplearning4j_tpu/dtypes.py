@@ -89,3 +89,23 @@ def is_float(dtype) -> bool:
 
 def is_integer(dtype) -> bool:
     return np.issubdtype(jnp.dtype(dtype), np.integer)
+
+
+def cast_float_tree(tree, dtype):
+    """Cast every floating-point leaf of a pytree to ``dtype``.
+
+    Mixed-precision helper: params/activations go bf16 for the MXU
+    while integer leaves (embedding indices, masks) are untouched.
+    """
+    import jax
+    dt = resolve(dtype)
+
+    def _cast(leaf):
+        try:
+            if is_float(leaf.dtype):
+                return leaf.astype(dt)
+        except AttributeError:
+            pass
+        return leaf
+
+    return jax.tree.map(_cast, tree)

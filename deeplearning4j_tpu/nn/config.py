@@ -65,6 +65,7 @@ class MultiLayerConfiguration:
     layers: List[Layer] = field(default_factory=list)
     seed: int = 12345
     dtype: str = "float32"
+    compute_dtype: Optional[str] = None   # bf16 fwd/bwd, fp32 params
     updater: Any = None
     gradient_normalization: Optional[str] = None
     gradient_normalization_threshold: float = 1.0
@@ -84,6 +85,7 @@ class MultiLayerConfiguration:
             "layers": [l.to_dict() for l in self.layers],
             "seed": self.seed,
             "dtype": self.dtype,
+            "compute_dtype": self.compute_dtype,
             "updater": self.updater.to_dict(),
             "gradient_normalization": self.gradient_normalization,
             "gradient_normalization_threshold":
@@ -102,6 +104,7 @@ class MultiLayerConfiguration:
             layers=[layer_from_dict(ld) for ld in d["layers"]],
             seed=d.get("seed", 12345),
             dtype=d.get("dtype", "float32"),
+            compute_dtype=d.get("compute_dtype"),
             updater=upd.updater_from_dict(d["updater"]),
             gradient_normalization=d.get("gradient_normalization"),
             gradient_normalization_threshold=d.get(
@@ -165,6 +168,7 @@ class ListBuilder:
             layers=self._layers,
             seed=self._g.seed_,
             dtype=self._g.dtype_,
+            compute_dtype=self._g.compute_dtype_,
             updater=self._g.updater_,
             gradient_normalization=self._g.grad_norm_,
             gradient_normalization_threshold=self._g.grad_norm_threshold_,
@@ -181,6 +185,7 @@ class NeuralNetConfiguration:
     def __init__(self):
         self.seed_ = 12345
         self.dtype_ = "float32"
+        self.compute_dtype_ = None
         self.updater_ = upd.Sgd(learning_rate=1e-2)
         self.activation = None
         self.weight_init = None
@@ -204,6 +209,15 @@ class NeuralNetConfiguration:
 
     def data_type(self, dtype: str):
         self.dtype_ = dtype
+        return self
+
+    def compute_data_type(self, dtype: Optional[str]):
+        """Mixed precision: run forward/backward math in ``dtype``
+        (bfloat16 on TPU — MXU-native) while params, optimizer state
+        and the loss stay in ``data_type`` (fp32). The reference has no
+        equivalent (nd4j global dtype changes params too); this is the
+        TPU-idiomatic split."""
+        self.compute_dtype_ = dtype
         return self
 
     def updater(self, u):

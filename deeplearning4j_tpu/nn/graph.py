@@ -44,6 +44,7 @@ class ComputationGraphConfiguration:
     def __init__(self, inputs: List[str], outputs: List[str],
                  nodes: List[_Node], seed: int = 12345,
                  updater=None, dtype: str = "float32",
+                 compute_dtype: Optional[str] = None,
                  input_types: Optional[Dict[str, InputType]] = None,
                  gradient_normalization: Optional[str] = None,
                  gradient_normalization_threshold: float = 1.0):
@@ -53,6 +54,7 @@ class ComputationGraphConfiguration:
         self.seed = seed
         self.updater = updater or upd.Sgd(learning_rate=1e-2)
         self.dtype = dtype
+        self.compute_dtype = compute_dtype
         self.input_types = input_types or {}
         self.gradient_normalization = gradient_normalization
         self.gradient_normalization_threshold = \
@@ -68,6 +70,7 @@ class ComputationGraphConfiguration:
             "seed": self.seed,
             "updater": self.updater.to_dict(),
             "dtype": self.dtype,
+            "compute_dtype": self.compute_dtype,
             "input_types": {k: v.to_dict()
                             for k, v in self.input_types.items()},
             "gradient_normalization": self.gradient_normalization,
@@ -88,6 +91,7 @@ class ComputationGraphConfiguration:
             seed=d.get("seed", 12345),
             updater=upd.updater_from_dict(d["updater"]),
             dtype=d.get("dtype", "float32"),
+            compute_dtype=d.get("compute_dtype"),
             input_types={k: InputType.from_dict(v)
                          for k, v in d.get("input_types", {}).items()},
             gradient_normalization=d.get("gradient_normalization"),
@@ -142,6 +146,7 @@ class GraphBuilder:
             seed=g.seed_ if g else 12345,
             updater=g.updater_ if g else None,
             dtype=g.dtype_ if g else "float32",
+            compute_dtype=g.compute_dtype_ if g else None,
             input_types=self._input_types,
             gradient_normalization=g.grad_norm_ if g else None,
             gradient_normalization_threshold=(
@@ -295,6 +300,11 @@ class ComputationGraph:
 
     def _loss_fn(self, params, state, inputs, labels, masks, lmasks, rng):
         any_fused = any(self._out_loss(o)[1] for o in self.conf.outputs)
+        cd = self.conf.compute_dtype
+        if cd is not None:
+            # bf16 fwd/bwd, fp32 master params (grads return fp32)
+            params = dtypes.cast_float_tree(params, cd)
+            inputs = dtypes.cast_float_tree(inputs, cd)
         acts, new_state = self._forward(params, state, inputs, train=True,
                                         rng=rng, masks=masks,
                                         pre_output=any_fused)
@@ -304,7 +314,10 @@ class ComputationGraph:
             fn = losses_mod.get(loss_name)
             kw = {"from_logits": True} if fused else {}
             lm = lmasks.get(name) if lmasks else None
-            total = total + fn(y, acts[name], mask=lm, **kw)
+            logits = acts[name]
+            if cd is not None:
+                logits = logits.astype(jnp.float32)
+            total = total + fn(y, logits, mask=lm, **kw)
         return total, new_state
 
     # ------------------------------------------------------------------
@@ -375,10 +388,19 @@ class ComputationGraph:
         """Returns a list of output activations (reference
         ComputationGraph.output)."""
         if self._output_fn is None:
+            cd = self.conf.compute_dtype
+
             def infer(params, state, inputs):
+                if cd is not None:
+                    params = dtypes.cast_float_tree(params, cd)
+                    state = dtypes.cast_float_tree(state, cd)
+                    inputs = dtypes.cast_float_tree(inputs, cd)
                 acts, _ = self._forward(params, state, inputs,
                                         train=False, rng=None)
-                return [acts[o] for o in self.conf.outputs]
+                outs = [acts[o] for o in self.conf.outputs]
+                if cd is not None:
+                    outs = [o.astype(jnp.float32) for o in outs]
+                return outs
             self._output_fn = jax.jit(infer)
         inputs = {n: jnp.asarray(np.asarray(x))
                   for n, x in zip(self.conf.inputs, features)}

@@ -201,13 +201,22 @@ class MultiLayerNetwork:
 
     def _loss_fn(self, params, state, x, y, mask, lmask, rng):
         loss_name, fused = self._last_loss()
+        cd = self.conf.compute_dtype
+        master = params
+        if cd is not None:
+            # bf16 fwd/bwd, fp32 master params: the cast is inside the
+            # grad trace, so grads come back fp32 for the optimizer
+            params = dtypes.cast_float_tree(params, cd)
+            x = dtypes.cast_float_tree(x, cd)
         out, new_state, _ = self._forward(
             params, state, x, train=True, rng=rng, mask=mask,
             pre_output_last=fused)
+        if cd is not None:
+            out = out.astype(jnp.float32)
         loss_fn = losses_mod.get(loss_name)
         kw = {"from_logits": True} if fused else {}
         data_loss = loss_fn(y, out, mask=lmask, **kw)
-        return data_loss + self._reg_score(params), new_state
+        return data_loss + self._reg_score(master), new_state
 
     # ------------------------------------------------------------------
     # fit
@@ -321,14 +330,22 @@ class MultiLayerNetwork:
         loss_name, fused = self._last_loss()
         loss_fn = losses_mod.get(loss_name)
 
+        cd = self.conf.compute_dtype
+
         def loss_with_state(params, state, rnn_init, x, y, mask, lmask,
                             rng):
+            master = params
+            if cd is not None:
+                params = dtypes.cast_float_tree(params, cd)
+                x = dtypes.cast_float_tree(x, cd)
             out, new_state, rnn_states = self._forward(
                 params, state, x, train=True, rng=rng, mask=mask,
                 rnn_init=rnn_init, pre_output_last=fused)
+            if cd is not None:
+                out = out.astype(jnp.float32)
             kw = {"from_logits": True} if fused else {}
             loss = loss_fn(y, out, mask=lmask, **kw)
-            return loss + self._reg_score(params), (new_state, rnn_states)
+            return loss + self._reg_score(master), (new_state, rnn_states)
 
         def step(params, opt_state, state, rnn_init, x, y, mask, lmask,
                  rng):
@@ -350,10 +367,16 @@ class MultiLayerNetwork:
         """Reference: MultiLayerNetwork.output (SURVEY §3.3)."""
         x = jnp.asarray(np.asarray(x))
         if self._output_fn is None:
+            cd = self.conf.compute_dtype
+
             def infer(params, state, x, mask):
+                if cd is not None:
+                    params = dtypes.cast_float_tree(params, cd)
+                    state = dtypes.cast_float_tree(state, cd)
+                    x = dtypes.cast_float_tree(x, cd)
                 out, _, _ = self._forward(params, state, x, train=False,
                                           rng=None, mask=mask)
-                return out
+                return out.astype(jnp.float32) if cd is not None else out
             self._output_fn = jax.jit(infer)
         return self._output_fn(self.params, self.state, x, mask)
 

@@ -21,12 +21,14 @@ from deeplearning4j_tpu.nn import updaters as upd
 
 class ResNet50:
     def __init__(self, num_classes: int = 1000, seed: int = 123,
-                 input_shape=(224, 224, 3), updater=None):
+                 input_shape=(224, 224, 3), updater=None,
+                 compute_dtype=None):
         self.num_classes = num_classes
         self.seed = seed
         self.input_shape = input_shape
         self.updater = updater or upd.Nesterovs(learning_rate=0.1,
                                                 momentum=0.9)
+        self.compute_dtype = compute_dtype  # "bfloat16" on TPU
 
     # -- blocks ----------------------------------------------------------
     def _conv_bn(self, b, name, inp, n_out, kernel, stride=(1, 1),
@@ -64,6 +66,7 @@ class ResNet50:
                    .seed(self.seed)
                    .updater(self.updater)
                    .weight_init_fn("relu")
+                   .compute_data_type(self.compute_dtype)
                    .graph_builder()
                    .add_inputs("input"))
         b = builder

@@ -212,3 +212,66 @@ def test_masked_sequence_fit():
     mask[:, 0] = 1
     net.fit(x, y, features_mask=mask, labels_mask=mask)
     assert np.isfinite(net.score())
+
+
+# ---------------------------------------------------------------------------
+# mixed precision (compute_dtype: bf16 fwd/bwd, fp32 master params)
+# ---------------------------------------------------------------------------
+def _mp_net(compute_dtype):
+    b = (NeuralNetConfiguration.builder().seed(7)
+         .updater(upd.Adam(learning_rate=1e-2))
+         .l2_(1e-4))
+    if compute_dtype:
+        b = b.compute_data_type(compute_dtype)
+    conf = (b.list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_mixed_precision_trains_close_to_fp32():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 8)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    n32, nbf = _mp_net(None), _mp_net("bfloat16")
+    for _ in range(25):
+        n32.fit(x, y)
+        nbf.fit(x, y)
+    assert abs(n32.score() - nbf.score()) < 0.15
+    # master params and grads stay fp32 — optimizer state too
+    assert all(p.dtype == jnp.float32
+               for p in jax.tree.leaves(nbf.params))
+    # inference returns fp32 even though compute ran bf16
+    assert np.asarray(nbf.output(x)).dtype == np.float32
+
+
+def test_mixed_precision_json_roundtrip():
+    net = _mp_net("bfloat16")
+    conf2 = MultiLayerConfiguration.from_json(net.conf.to_json())
+    assert conf2.compute_dtype == "bfloat16"
+
+
+def test_mixed_precision_tbptt_rnn():
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater(upd.Adam(learning_rate=0.02))
+            .compute_data_type("bfloat16")
+            .list()
+            .backprop_type("TruncatedBPTT")
+            .tbptt_fwd_length(4).tbptt_back_length(4)
+            .layer(LSTM(n_out=4))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .set_input_type(InputType.recurrent(2))
+            .build())
+    net = MultiLayerNetwork(conf).init(input_shape=(8, 2))
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(4, 8, 2)).astype(np.float32)
+    y = np.stack([(x[..., 0] > 0), (x[..., 0] <= 0)], -1).astype(
+        np.float32)
+    net.fit(x, y)
+    assert np.isfinite(net.score())
+    assert all(p.dtype == jnp.float32
+               for p in jax.tree.leaves(net.params))

@@ -5,6 +5,7 @@ checks vs numpy, finite-difference gradient validation (reference
 ``OpValidation``/``GradCheckUtil``), end-to-end fit, save/load
 round-trip (reference FlatBuffers serialization tests).
 """
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -151,3 +152,30 @@ def test_eval_sugar_and_conv():
                     ["pool"])["pool"]
     assert out.shape == (1, 4, 4, 2)
     assert np.isfinite(out).all()
+
+
+def test_default_loss_from_outputs():
+    """Loss variables default to float terminal outputs (no explicit
+    set_loss_variables), excluding int-derived terminals."""
+    sd = SameDiff.create()
+    x = sd.placeholder("x", jnp.float32, 2, 3)
+    w = sd.var("w", np.ones((3, 2), np.float32))
+    y = x.mmul(w, name="y")
+    g = sd.calculate_gradients({"x": np.ones((2, 3), np.float32)}, ["w"])
+    assert np.allclose(g["w"], 2.0)
+    assert sd.outputs() == ["y"]
+
+
+def test_default_loss_skips_int_chains():
+    sd = SameDiff.create()
+    a = sd.placeholder("a", jnp.float32, 4)
+    b = sd.placeholder("b", jnp.float32, 4)
+    w = sd.var("w", np.ones((4,), np.float32))
+    # int-derived chain: sum(eq(...)) — must not be picked as a loss
+    eq = sd._rec("eq", [a.mul(w), b])
+    n_correct = eq.sum()
+    import pytest
+    with pytest.raises(ValueError, match="set_loss_variables"):
+        sd.calculate_gradients(
+            {"a": np.ones(4, np.float32), "b": np.ones(4, np.float32)},
+            ["w"])

@@ -53,11 +53,21 @@ def main():
     key = jax.random.PRNGKey(0)
     inputs, labels = {"input": x}, [y]
 
-    # warmup: compile + 20 steps (BASELINE.md protocol)
+    # warmup: compile + 20 steps (BASELINE.md protocol). Sync via a
+    # scalar host transfer: the loss is data-dependent on the whole
+    # step chain, and (unlike block_until_ready) a device->host copy
+    # is a true barrier on every platform including the axon TPU tunnel.
+    import jax as _jax
+
+    def sync(tree):
+        # scalar host transfer of a param leaf: data-dependent on the
+        # final optimizer update, so the whole chain must be done
+        float(_jax.tree.leaves(tree)[0].ravel()[0])
+
     for _ in range(20):
         params, opt_state, state, loss = step(params, opt_state, state,
                                               inputs, labels, {}, {}, key)
-    jax.block_until_ready(params)
+    sync(params)
 
     def timed_run(n_steps=20):
         nonlocal params, opt_state, state
@@ -65,7 +75,7 @@ def main():
         for _ in range(n_steps):
             params, opt_state, state, loss = step(
                 params, opt_state, state, inputs, labels, {}, {}, key)
-        jax.block_until_ready(params)
+        sync(params)
         return n_steps * batch / (time.perf_counter() - t0)
 
     runs = sorted(timed_run() for _ in range(3))

@@ -336,13 +336,19 @@ class ComputationGraph:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
-    def fit(self, features, labels=None, *, epochs: int = 1):
-        """fit(MultiDataSet iterator) | fit([x...], [y...]) | fit(x, y)."""
+    def fit(self, features, labels=None, *, epochs: int = 1,
+            features_masks=None, labels_masks=None):
+        """fit(MultiDataSet iterator) | fit([x...], [y...]) | fit(x, y).
+
+        ``features_masks``: sequence aligned with inputs ([B,T] each or
+        None); ``labels_masks``: aligned with outputs — reference
+        MultiDataSet mask semantics (per-position loss masking, e.g.
+        MLM masked positions)."""
         if labels is not None:
             xs = features if isinstance(features, (list, tuple)) \
                 else [features]
             ys = labels if isinstance(labels, (list, tuple)) else [labels]
-            self._fit_batch(xs, ys)
+            self._fit_batch(xs, ys, features_masks, labels_masks)
             return self
         it = features
         for _ in range(epochs):
@@ -357,27 +363,36 @@ class ComputationGraph:
                           else [mds.features])
                     ys = (mds.labels if isinstance(mds.labels, list)
                           else [mds.labels])
+                    fms = getattr(mds, "features_masks", None)
+                    lms = getattr(mds, "labels_masks", None)
                 else:
                     xs, ys = mds
                     xs = xs if isinstance(xs, list) else [xs]
                     ys = ys if isinstance(ys, list) else [ys]
-                self._fit_batch(xs, ys)
+                    fms = lms = None
+                self._fit_batch(xs, ys, fms, lms)
             for l in self.listeners:
                 l.on_epoch_end(self)
             self.epoch += 1
         return self
 
-    def _fit_batch(self, xs, ys):
+    def _fit_batch(self, xs, ys, fms=None, lms=None):
         if self._train_step_fn is None:
             self._train_step_fn = self._make_train_step()
         inputs = {n: jnp.asarray(np.asarray(x))
                   for n, x in zip(self.conf.inputs, xs)}
         labels = [jnp.asarray(np.asarray(y)) for y in ys]
+        masks = {n: jnp.asarray(np.asarray(m))
+                 for n, m in zip(self.conf.inputs, fms or [])
+                 if m is not None}
+        lmasks = {n: jnp.asarray(np.asarray(m))
+                  for n, m in zip(self.conf.outputs, lms or [])
+                  if m is not None}
         rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed),
                                  self.iteration)
         self.params, self.opt_state, self.state, loss = \
             self._train_step_fn(self.params, self.opt_state, self.state,
-                                inputs, labels, None, None, rng)
+                                inputs, labels, masks, lmasks, rng)
         self.score_ = float(loss)
         self.iteration += 1
         for l in self.listeners:

@@ -30,7 +30,7 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
 )
 from deeplearning4j_tpu.nn.layers.attention import (
     SelfAttentionLayer, LearnedSelfAttentionLayer, MultiHeadAttention,
-    TransformerEncoderBlock, PositionalEmbeddingLayer,
+    TransformerEncoderBlock, PositionalEmbeddingLayer, ClsTokenPoolLayer,
 )
 from deeplearning4j_tpu.nn.layers.special import (
     AutoEncoder, VariationalAutoencoder, CenterLossOutputLayer,

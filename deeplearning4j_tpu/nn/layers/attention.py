@@ -204,3 +204,35 @@ class TransformerEncoderBlock(Layer):
         h = h @ params["W2"] + params["b2"]
         x = x + self._maybe_dropout(h, train, r2)
         return x, state
+
+
+@register_layer
+@dataclass
+class ClsTokenPoolLayer(Layer):
+    """[B,T,F] -> [B,F]: select the first (CLS) token, optionally through
+    a tanh pooler dense (BERT's pooler). The reference has no such layer
+    — its BERT path pools inside the imported TF graph (SURVEY §3.4)."""
+    n_out: int = 0                 # 0: no pooler dense, raw CLS vector
+    pooler: bool = False
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        t, f = input_shape
+        if self.n_out and not self.pooler:
+            raise ValueError("ClsTokenPoolLayer: n_out requires "
+                             "pooler=True (no projection otherwise)")
+        if self.pooler:
+            n = self.n_out or f
+            wi = winit.get(self.weight_init or "xavier")
+            params = {"W": wi(key, (f, n), dtype),
+                      "b": jnp.zeros((n,), dtype)}
+            return params, {}, (n,)
+        return {}, {}, (f,)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        cls = x[:, 0, :]
+        if self.pooler:
+            cls = jnp.tanh(cls @ params["W"] + params["b"])
+        return cls, state
+
+    def propagate_mask(self, mask, out_len=None):
+        return None                # sequence axis is gone

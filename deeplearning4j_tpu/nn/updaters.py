@@ -218,10 +218,26 @@ class Adam(Updater):
 @dataclass
 class AdamW(Adam):
     weight_decay: float = 0.01
+    # BERT-recipe decay masking: keys named like biases (b, bo, b1...)
+    # or LayerNorm scales (gamma/beta) are excluded from decay
+    exclude_bias_and_norm: bool = False
 
     def to_optax(self):
+        mask = None
+        if self.exclude_bias_and_norm:
+            def _decay_leaf(path):
+                key = str(path[-1].key if hasattr(path[-1], "key")
+                          else path[-1])
+                return not (key.startswith("b") or
+                            key in ("gamma", "beta"))
+
+            def mask(params):
+                import jax
+                return jax.tree_util.tree_map_with_path(
+                    lambda p, _: _decay_leaf(p), params)
         return optax.adamw(self._lr(), b1=self.beta1, b2=self.beta2,
-                           eps=self.epsilon, weight_decay=self.weight_decay)
+                           eps=self.epsilon,
+                           weight_decay=self.weight_decay, mask=mask)
 
 
 @register_updater

@@ -1,0 +1,341 @@
+"""Pallas (Mosaic) TPU kernels.
+
+The reference accelerates its hot ops with hand-written CUDA/cuDNN
+platform helpers dispatched before the generic implementation
+(`include/ops/declarable/platform/cudnn/*.cu`, SURVEY §2.1). The
+TPU-native analog: XLA already fuses almost everything; the few ops
+that benefit from a hand-written kernel are implemented here with
+Pallas and dispatched the same way — fast path when available,
+generic jnp fallback otherwise.
+
+Kernels:
+- ``flash_attention`` — blockwise online-softmax attention
+  (never materialises the [T,T] score matrix; VMEM-resident
+  accumulators; MXU matmuls per block). Used by
+  ``scaled_dot_attention`` for long sequences on TPU and as the
+  building block the ring-attention layer composes over ICI.
+- ``threshold_encode`` / ``threshold_decode`` — fused gradient
+  threshold compression (reference libnd4j ops ``encode_threshold`` /
+  ``decode_threshold``): one VMEM pass computes the ternary
+  quantisation, packs 16 two-bit codes per int32 word (16× smaller
+  than f32), and emits the residual.
+
+On CPU the kernels run in Pallas interpret mode (tests), so the same
+code path is exercised everywhere.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() not in ("tpu",)
+
+
+def _vma(*xs) -> frozenset:
+    """Union of the inputs' varying-manual-axes. Outside ``shard_map``
+    this is empty; inside, ``pallas_call`` out_shapes must declare it
+    (check_vma) — outputs vary over every axis an input varies over."""
+    out: frozenset = frozenset()
+    for x in xs:
+        out = out | getattr(jax.typeof(x), "vma", frozenset())
+    return out
+
+
+def _align_vma(x, vma: frozenset):
+    """Broadcast a replicated operand onto varying manual axes so every
+    kernel operand carries the same vma (mixed vmas trip check_vma
+    inside pallas interpret mode)."""
+    missing = vma - getattr(jax.typeof(x), "vma", frozenset())
+    return lax.pvary(x, tuple(missing)) if missing else x
+
+
+def _jnp_fallback(*xs) -> bool:
+    """Pallas interpret mode (CPU) cannot run under shard_map manual
+    axes (its internal index ops trip check_vma) — use the equivalent
+    jnp path there. Real TPU lowering handles manual axes natively."""
+    return _interpret() and bool(_vma(*xs))
+
+
+# ---------------------------------------------------------------------------
+# flash attention
+# ---------------------------------------------------------------------------
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc, m, l, *,
+                  scale: float, causal: bool, t_real: int,
+                  block_q: int, block_k: int):
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        m[:] = jnp.full_like(m[:], -jnp.inf)
+        l[:] = jnp.zeros_like(l[:])
+        acc[:] = jnp.zeros_like(acc[:])
+
+    # skip dead blocks entirely (the einsum path can't): kv blocks
+    # fully past the real sequence, and — causal — blocks fully above
+    # the diagonal
+    i = pl.program_id(1)
+    live = j * block_k < t_real
+    if causal:
+        live = jnp.logical_and(
+            live, j * block_k <= i * block_q + block_q - 1)
+
+    @pl.when(live)
+    def _():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+
+        # mask padded kv positions (t_real is the unpadded length)
+        kv_idx = j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = kv_idx < t_real
+        if causal:
+            q_idx = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, kv_idx <= q_idx)
+        s = jnp.where(mask, s, -jnp.inf)
+
+        m_prev = m[:, :1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        # exp(-inf - -inf) guard: rows with no live keys yet keep m=-inf
+        p = jnp.exp(s - jnp.where(jnp.isinf(m_new), 0.0, m_new))
+        p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(jnp.where(jnp.isinf(m_prev), -jnp.inf, m_prev)
+                        - jnp.where(jnp.isinf(m_new), 0.0, m_new))
+        alpha = jnp.where(jnp.isinf(m_prev), 0.0, alpha)
+
+        l[:, :1] = l[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc[:] = acc[:] * alpha + jnp.dot(
+            p.astype(v_ref.dtype), v_ref[0],
+            preferred_element_type=jnp.float32)
+        m[:, :1] = m_new
+
+    @pl.when(j == nk - 1)
+    def _():
+        den = jnp.maximum(l[:, :1], 1e-30)
+        o_ref[0] = (acc[:] / den).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
+    """q,k,v: [BH, T, D] (heads folded). Returns [BH, T, D]."""
+    if _jnp_fallback(q, k, v):
+        return _reference_scan(q, k, v, causal)
+    bh, t, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    t128 = -(-t // 128) * 128
+    block_q = min(block_q, t128)              # don't block past the data
+    block_k = min(block_k, t128)
+    tq = -(-t // block_q) * block_q           # q and kv padded separately
+    tk = -(-t // block_k) * block_k           # (≤ one partial block each)
+    dp = max(-(-d // 128) * 128, 128)         # lane-align head dim
+
+    def pad(x, tpad):
+        return jnp.pad(x, ((0, 0), (0, tpad - t), (0, dp - d)))
+
+    vma = _vma(q, k, v)
+    qp = _align_vma(pad(q, tq), vma)
+    kp = _align_vma(pad(k, tk), vma)
+    vp = _align_vma(pad(v, tk), vma)
+    nq, nk = tq // block_q, tk // block_k
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=scale, causal=causal,
+                          t_real=t, block_q=block_q, block_k=block_k),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, dp), q.dtype,
+                                       vma=vma),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dp),
+                               lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, dp), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp)
+    return out[:, :t, :d]
+
+
+def _reference_scan(q, k, v, causal: bool, block: int = 512):
+    """Differentiable O(T) -memory blockwise attention in plain jnp
+    (lax.scan over kv blocks) — the backward path and CPU fallback."""
+    bh, t, d = q.shape
+    tp = -(-t // block) * block
+    kp = jnp.pad(k, ((0, 0), (0, tp - t), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, tp - t), (0, 0)))
+    scale = 1.0 / (d ** 0.5)
+    q_idx = jnp.arange(t)[:, None]
+
+    def step(carry, blk):
+        m_prev, l_prev, acc = carry
+        kb, vb, j0 = blk
+        s = jnp.einsum("bqd,bkd->bqk", q, kb) * scale
+        kv_idx = j0 + jnp.arange(block)[None, :]
+        mask = kv_idx < t
+        if causal:
+            mask = jnp.logical_and(mask, kv_idx <= q_idx)
+        s = jnp.where(mask[None], s, -jnp.inf)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        safe = jnp.where(jnp.isinf(m_new), 0.0, m_new)
+        p = jnp.where(mask[None], jnp.exp(s - safe), 0.0)
+        alpha = jnp.where(jnp.isinf(m_prev), 0.0,
+                          jnp.exp(m_prev - safe))
+        l_new = l_prev * alpha + jnp.sum(p, -1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bqk,bkd->bqd", p, vb)
+        return (m_new, l_new, acc), None
+
+    nb = tp // block
+    kb = kp.reshape(bh, nb, block, d).swapaxes(0, 1)
+    vb = vp.reshape(bh, nb, block, d).swapaxes(0, 1)
+    j0s = jnp.arange(nb) * block
+    init = (jnp.full((bh, t, 1), -jnp.inf),
+            jnp.zeros((bh, t, 1)), jnp.zeros((bh, t, d)))
+    (m, l, acc), _ = lax.scan(step, init, (kb, vb, j0s))
+    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, causal, block_q, block_k)
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, res, g):
+    q, k, v = res
+    # recompute-based backward through the O(T)-memory scan reference
+    _, vjp = jax.vjp(lambda a, b, c: _reference_scan(a, b, c, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    block_q: int = 256, block_k: int = 1024):
+    """Blockwise attention, [B, T, H, D] layout (head axis 2) like
+    ``scaled_dot_attention``. Differentiable (recompute backward)."""
+    b, t, h, d = q.shape
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, -1)
+    o = _flash(fold(q), fold(k), fold(v), causal, block_q, block_k)
+    return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# threshold compression codec
+# ---------------------------------------------------------------------------
+_GROUP = 16          # 16 two-bit codes per int32 word
+_BLOCK_COLS = 32768  # grid block width (16x32768 f32 = 2 MB VMEM)
+
+
+def _encode_kernel(g_ref, tau_ref, packed_ref, resid_ref):
+    tau = tau_ref[0]
+    g = g_ref[:]                               # (16, C)
+    code = jnp.where(g > tau, 1, jnp.where(g < -tau, 2, 0))
+    q = jnp.where(g > tau, tau, jnp.where(g < -tau, -tau, 0.0))
+    resid_ref[:] = g - q
+    shifts = 2 * lax.broadcasted_iota(jnp.int32, g.shape, 0)
+    packed_ref[:] = jnp.sum(code.astype(jnp.int32) << shifts, axis=0,
+                            keepdims=True)
+
+
+def _decode_kernel(p_ref, tau_ref, out_ref):
+    tau = tau_ref[0]
+    shifts = 2 * lax.broadcasted_iota(jnp.int32, out_ref.shape, 0)
+    code = (p_ref[:] >> shifts) & 3            # broadcast (1,C)->(16,C)
+    out_ref[:] = jnp.where(code == 1, tau,
+                           jnp.where(code == 2, -tau, 0.0))
+
+
+def threshold_encode(grad: jax.Array, tau):
+    """Fused threshold encode: grad → (packed int32 codes, residual).
+
+    Reference op ``encode_threshold`` (+ residual handling of
+    ``EncodedGradientsAccumulator``): q = τ·sign(g)·1[|g|>τ]; 2 bits
+    per element (code 0 / +τ=1 / −τ=2), residual = g − q.
+    """
+    shape, size = grad.shape, grad.size
+    flat = grad.reshape(-1)
+    c = -(-size // _GROUP)
+    c = -(-c // 128) * 128                     # lane-align columns
+    flat = jnp.pad(flat, (0, _GROUP * c - size))
+    g2 = flat.reshape(c, _GROUP).T             # (16, C), flat-major groups
+    tau_arr = jnp.asarray([tau], jnp.float32)
+    bc = min(c, _BLOCK_COLS)
+    c = -(-c // bc) * bc
+    g2 = jnp.pad(g2, ((0, 0), (0, c - g2.shape[1])))
+    if _jnp_fallback(grad):
+        g2 = g2.astype(jnp.float32)
+        tau_f = jnp.asarray(tau, jnp.float32)
+        code = jnp.where(g2 > tau_f, 1, jnp.where(g2 < -tau_f, 2, 0))
+        qv = jnp.where(g2 > tau_f, tau_f,
+                       jnp.where(g2 < -tau_f, -tau_f, 0.0))
+        shifts = 2 * jnp.arange(_GROUP, dtype=jnp.int32)[:, None]
+        packed = jnp.sum(code.astype(jnp.int32) << shifts, axis=0,
+                         keepdims=True)
+        resid = g2 - qv
+        residual = resid.T.reshape(-1)[:size].reshape(shape)
+        return packed[0], residual
+    tau_arr = _align_vma(tau_arr, _vma(grad))
+    packed, resid = pl.pallas_call(
+        _encode_kernel,
+        out_shape=(jax.ShapeDtypeStruct((1, c), jnp.int32,
+                                        vma=_vma(grad)),
+                   jax.ShapeDtypeStruct((_GROUP, c), jnp.float32,
+                                        vma=_vma(grad))),
+        grid=(c // bc,),
+        in_specs=[pl.BlockSpec((_GROUP, bc), lambda i: (0, i)),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=(pl.BlockSpec((1, bc), lambda i: (0, i)),
+                   pl.BlockSpec((_GROUP, bc), lambda i: (0, i))),
+        interpret=_interpret(),
+    )(g2.astype(jnp.float32), tau_arr)
+    residual = resid.T.reshape(-1)[:size].reshape(shape)
+    return packed[0], residual
+
+
+def threshold_decode(packed: jax.Array, tau, size: int, shape=None):
+    """Reference op ``decode_threshold``: packed codes → dense ±τ."""
+    c0 = packed.shape[0]
+    bc = min(c0, _BLOCK_COLS)
+    c = -(-c0 // bc) * bc
+    packed = jnp.pad(packed, (0, c - c0))
+    if _jnp_fallback(packed):
+        tau_f = jnp.asarray(tau, jnp.float32)
+        shifts = 2 * jnp.arange(_GROUP, dtype=jnp.int32)[:, None]
+        code = (packed[None, :] >> shifts) & 3
+        out = jnp.where(code == 1, tau_f,
+                        jnp.where(code == 2, -tau_f, 0.0))
+        dense = out.T.reshape(-1)[:size]
+        return dense.reshape(shape) if shape is not None else dense
+    tau_arr = _align_vma(jnp.asarray([tau], jnp.float32), _vma(packed))
+    out = pl.pallas_call(
+        _decode_kernel,
+        out_shape=jax.ShapeDtypeStruct((_GROUP, c), jnp.float32,
+                                       vma=_vma(packed)),
+        grid=(c // bc,),
+        in_specs=[pl.BlockSpec((1, bc), lambda i: (0, i)),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec((_GROUP, bc), lambda i: (0, i)),
+        interpret=_interpret(),
+    )(packed.reshape(1, c), tau_arr)
+    dense = out.T.reshape(-1)[:size]
+    return dense.reshape(shape) if shape is not None else dense

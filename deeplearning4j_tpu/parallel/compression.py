@@ -144,3 +144,52 @@ class EncodedGradientsAccumulator:
             "tau": new_tau,
         }
         return jax.tree.unflatten(treedef, decoded), new_state
+
+
+    def exchange_packed(self, grads, state, axis_name: str = "data"):
+        """Compressed-wire variant: encode with the fused Pallas kernel
+        (ops/pallas_kernels.py — 16 two-bit codes per int32 word),
+        ``all_gather`` the PACKED words (16× less ICI/DCN traffic than
+        gathering f32 gradients), then decode every peer's update
+        locally and average. This is the reference's fan-out semantics
+        (every replica applies every other replica's encoded update,
+        SURVEY §3.5 IndexedTail) made synchronous; meant for
+        DCN-constrained cross-slice meshes where psum of dense f32 is
+        the bottleneck."""
+        from deeplearning4j_tpu.ops.pallas_kernels import (
+            threshold_decode, threshold_encode)
+        tau = state["tau"]
+        flat, treedef = jax.tree.flatten(grads)
+        rflat = jax.tree.leaves(state["residual"])
+        n_dev = jax.lax.psum(1, axis_name)
+        decoded, residuals = [], []
+        total = 0.0
+        nnz = 0.0
+        for g, r in zip(flat, rflat):
+            gi = g + r
+            packed, res = threshold_encode(gi, tau)
+            res = jnp.clip(res, -self.residual_clip * tau,
+                           self.residual_clip * tau)
+            residuals.append(res)
+            # adapt tau on the LOCAL encoded fraction (reference
+            # ThresholdAlgorithm semantics) — computable before any
+            # communication
+            nnz = nnz + jnp.sum((jnp.abs(gi) > tau).astype(jnp.float32))
+            allp = jax.lax.all_gather(packed, axis_name)   # [N, C] int32
+            # decode peers one at a time: peak extra memory stays
+            # O(g.size) instead of O(N·g.size)
+            from deeplearning4j_tpu.ops.pallas_kernels import (
+                _align_vma, _vma)
+            dec_sum = jax.lax.fori_loop(
+                0, allp.shape[0],
+                lambda i, acc: acc + threshold_decode(
+                    allp[i], tau, g.size, g.shape),
+                _align_vma(jnp.zeros(g.shape, jnp.float32),
+                           _vma(allp, tau)))
+            decoded.append(dec_sum / n_dev)
+            total += float(np.prod(g.shape))
+        new_state = {
+            "residual": jax.tree.unflatten(treedef, residuals),
+            "tau": self.algo.update(tau, nnz / total),
+        }
+        return jax.tree.unflatten(treedef, decoded), new_state

@@ -46,12 +46,17 @@ def main():
     y = jnp.asarray(np.eye(1000, dtype=np.float32)[
         rng.integers(0, 1000, batch)])
 
-    if net._train_step_fn is None:
-        net._train_step_fn = net._make_train_step()
-    step = net._train_step_fn
+    # scanned device loop (steps_per_loop): K train steps per dispatched
+    # executable — the idiomatic TPU training loop (host/dispatch latency
+    # amortised; the reference instead pays a JNI crossing PER OP).
+    k_inner = 4
+    assert 20 % k_inner == 0, "warmup/timed step counts must divide k_inner"
+    loop = net._make_train_loop()
     params, opt_state, state = net.params, net.opt_state, net.state
-    key = jax.random.PRNGKey(0)
-    inputs, labels = {"input": x}, [y]
+    base = jax.random.PRNGKey(0)
+    x_stack = {"input": jnp.stack([x] * k_inner)}
+    y_stack = [jnp.stack([y] * k_inner)]
+    rngs = jnp.stack([jax.random.fold_in(base, i) for i in range(k_inner)])
 
     # warmup: compile + 20 steps (BASELINE.md protocol). Sync via a
     # scalar host transfer: the loss is data-dependent on the whole
@@ -64,17 +69,18 @@ def main():
         # final optimizer update, so the whole chain must be done
         float(_jax.tree.leaves(tree)[0].ravel()[0])
 
-    for _ in range(20):
-        params, opt_state, state, loss = step(params, opt_state, state,
-                                              inputs, labels, {}, {}, key)
+    for _ in range(20 // k_inner):
+        params, opt_state, state, _ = loop(params, opt_state, state,
+                                           x_stack, y_stack, rngs)
     sync(params)
 
     def timed_run(n_steps=20):
         nonlocal params, opt_state, state
+        assert n_steps % k_inner == 0
         t0 = time.perf_counter()
-        for _ in range(n_steps):
-            params, opt_state, state, loss = step(
-                params, opt_state, state, inputs, labels, {}, {}, key)
+        for _ in range(n_steps // k_inner):
+            params, opt_state, state, _ = loop(
+                params, opt_state, state, x_stack, y_stack, rngs)
         sync(params)
         return n_steps * batch / (time.perf_counter() - t0)
 

@@ -186,6 +186,7 @@ class ComputationGraph:
         self.epoch = 0
         self.score_ = float("nan")
         self._train_step_fn = None
+        self._train_loop_fn = None
         self._output_fn = None
         self._optimizer = None
         self._shapes: Dict[str, tuple] = {}
@@ -321,23 +322,83 @@ class ComputationGraph:
         return total, new_state
 
     # ------------------------------------------------------------------
+    def _update(self, params, opt_state, state, inputs, labels, masks,
+                lmasks, rng):
+        """One gradient+optimizer update — the single source of truth
+        traced by both the per-batch step and the scanned loop."""
+        (loss, new_state), grads = jax.value_and_grad(
+            self._loss_fn, has_aux=True)(params, state, inputs,
+                                         labels, masks, lmasks, rng)
+        updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                    params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, new_state, loss
+
     def _make_train_step(self):
-        optimizer = self._optimizer
+        return jax.jit(self._update, donate_argnums=(0, 1, 2))
 
-        def step(params, opt_state, state, inputs, labels, masks,
-                 lmasks, rng):
-            (loss, new_state), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True)(params, state, inputs,
-                                             labels, masks, lmasks, rng)
-            updates, opt_state = optimizer.update(grads, opt_state,
-                                                  params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, new_state, loss
+    def _make_train_loop(self):
+        """K train steps per dispatched executable (``lax.scan`` over
+        stacked batches) — the idiomatic TPU device loop. Each launch
+        through the runtime costs ~10ms of host/dispatch latency that a
+        per-batch ``fit`` pays per step; the scanned loop pays it once
+        per K steps. Numerically identical to K sequential steps: the
+        per-iteration rng keys are precomputed and scanned over."""
+        def one(carry, batch):
+            params, opt_state, state = carry
+            inputs, labels, rng = batch
+            params, opt_state, new_state, loss = self._update(
+                params, opt_state, state, inputs, labels, {}, {}, rng)
+            return (params, opt_state, new_state), loss
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        def loop(params, opt_state, state, inputs_stack, labels_stack,
+                 rng_stack):
+            (p, o, s), losses = jax.lax.scan(
+                one, (params, opt_state, state),
+                (inputs_stack, labels_stack, rng_stack))
+            return p, o, s, losses
+
+        return jax.jit(loop, donate_argnums=(0, 1, 2))
+
+    def _fit_group(self, group):
+        """Run a group of uniformly-shaped mask-free batches in one
+        scanned call (see ``_make_train_loop``)."""
+        if self._train_loop_fn is None:
+            self._train_loop_fn = self._make_train_loop()
+        inputs = {n: jnp.stack([jnp.asarray(np.asarray(xs[i]))
+                                for xs, _ in group])
+                  for i, n in enumerate(self.conf.inputs)}
+        labels = [jnp.stack([jnp.asarray(np.asarray(ys[j]))
+                             for _, ys in group])
+                  for j in range(len(group[0][1]))]
+        base = jax.random.PRNGKey(self.conf.seed)
+        rngs = jnp.stack([jax.random.fold_in(base, self.iteration + i)
+                          for i in range(len(group))])
+        try:
+            self.params, self.opt_state, self.state, losses = \
+                self._train_loop_fn(self.params, self.opt_state,
+                                    self.state, inputs, labels, rngs)
+        except Exception as e:       # HBM OOM → diagnostic dump
+            from deeplearning4j_tpu.utils import crashreport
+            if crashreport.is_oom(e):
+                path = crashreport.write_memory_crash_dump(self, e)
+                if path:
+                    raise RuntimeError(
+                        f"scanned train loop ran out of device memory "
+                        f"(steps_per_loop={len(group)} stacks the group "
+                        f"on device — try a smaller value); crash dump "
+                        f"written to {path}") from e
+            raise
+        losses = np.asarray(losses)   # one host transfer for the group
+        for loss in losses:
+            self.score_ = float(loss)
+            self.iteration += 1
+            for l in self.listeners:
+                l.iteration_done(self, self.iteration, self.epoch)
 
     def fit(self, features, labels=None, *, epochs: int = 1,
-            features_masks=None, labels_masks=None):
+            features_masks=None, labels_masks=None,
+            steps_per_loop: int = 1):
         """fit(MultiDataSet iterator) | fit([x...], [y...]) | fit(x, y).
 
         ``features_masks``: sequence aligned with inputs ([B,T] each or
@@ -356,6 +417,7 @@ class ComputationGraph:
                 l.on_epoch_start(self)
             if hasattr(it, "reset"):
                 it.reset()
+            group: list = []
             for mds in it:
                 if hasattr(mds, "features"):
                     xs = (mds.features
@@ -370,11 +432,34 @@ class ComputationGraph:
                     xs = xs if isinstance(xs, list) else [xs]
                     ys = ys if isinstance(ys, list) else [ys]
                     fms = lms = None
-                self._fit_batch(xs, ys, fms, lms)
+                if steps_per_loop > 1 and not fms and not lms:
+                    # group uniformly-shaped batches into one scanned
+                    # device loop; shape change flushes the group
+                    if group and any(
+                            np.shape(a) != np.shape(b)
+                            for a, b in zip(group[-1][0] + group[-1][1],
+                                            xs + ys)):
+                        self._flush_group(group)
+                    group.append((xs, ys))
+                    if len(group) == steps_per_loop:
+                        self._flush_group(group)
+                else:
+                    self._flush_group(group)
+                    self._fit_batch(xs, ys, fms, lms)
+            self._flush_group(group)
             for l in self.listeners:
                 l.on_epoch_end(self)
             self.epoch += 1
         return self
+
+    def _flush_group(self, group):
+        if not group:
+            return
+        if len(group) == 1:
+            self._fit_batch(*group[0])
+        else:
+            self._fit_group(list(group))
+        group.clear()
 
     def _fit_batch(self, xs, ys, fms=None, lms=None):
         if self._train_step_fn is None:

@@ -196,19 +196,42 @@ class BatchNormalization(Layer):
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         axes = tuple(range(x.ndim - 1))
+        sdt = jnp.promote_types(x.dtype, jnp.float32)  # f64 stays f64
         if train:
-            mu = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            xf = x.astype(sdt)
+            mu = jnp.mean(xf, axis=axes)
+            if x.dtype in (jnp.bfloat16, jnp.float16):
+                # one-pass batch stats: E[x] and E[x²] reduce together
+                # in a single fused multi-output reduction (jnp.var
+                # walks x twice and materialises x-mu — ~25% of a
+                # ResNet-50 step went to those reductions). Safe here:
+                # a half-precision input with |mean|≫std carries no var
+                # information in EITHER formulation, and the squares
+                # accumulate in fp32.
+                var = (jnp.mean(jnp.square(xf), axis=axes)
+                       - jnp.square(mu))
+                var = jnp.maximum(var, 0.0)
+            else:
+                # full precision: shifted two-pass, immune to the
+                # catastrophic cancellation of E[x²]−E[x]²
+                var = jnp.mean(jnp.square(xf - mu), axis=axes)
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mu,
                 "var": self.decay * state["var"] + (1 - self.decay) * var,
             }
         else:
-            mu, var = state["mean"], state["var"]
+            mu = state["mean"].astype(sdt)
+            var = state["var"].astype(sdt)
             new_state = state
-        y = (x - mu) / jnp.sqrt(var + self.eps)
+        # fold into one fused multiply-add over the big tensor:
+        # y = a·x + b with per-channel a, b
+        inv = jax.lax.rsqrt(var + self.eps)
         if not self.lock_gamma_beta:
-            y = y * params["gamma"] + params["beta"]
+            inv = inv * params["gamma"].astype(sdt)
+            b = params["beta"].astype(sdt) - mu * inv
+        else:
+            b = -mu * inv
+        y = x * inv.astype(x.dtype) + b.astype(x.dtype)
         return self._act()(y), new_state
 
     def has_params(self):

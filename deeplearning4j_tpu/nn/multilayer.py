@@ -59,6 +59,7 @@ class MultiLayerNetwork:
         self.epoch = 0
         self._rnn_state: Optional[Dict[str, Any]] = None  # stored-state API
         self._train_step_fn = None
+        self._train_loop_fn = None
         self._output_fn = None
         self._optimizer = None
         self.score_ = float("nan")
@@ -221,26 +222,88 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     # fit
     # ------------------------------------------------------------------
+    def _update(self, params, opt_state, state, x, y, mask, lmask, rng):
+        """One gradient+optimizer update — the single source of truth
+        traced by both the per-batch step and the scanned loop."""
+        (loss, new_state), grads = jax.value_and_grad(
+            self._loss_fn, has_aux=True)(
+                params, state, x, y, mask, lmask, rng)
+        updates, opt_state = self._optimizer.update(grads, opt_state,
+                                                    params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, new_state, loss
+
     def _make_train_step(self):
-        optimizer = self._optimizer
+        return jax.jit(self._update, donate_argnums=(0, 1, 2))
 
-        def step(params, opt_state, state, x, y, mask, lmask, rng):
-            (loss, new_state), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True)(
-                    params, state, x, y, mask, lmask, rng)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, new_state, loss
+    def _make_train_loop(self):
+        """K train steps per dispatched executable (``lax.scan`` over
+        stacked batches) — see ComputationGraph._make_train_loop.
+        Numerically identical to K sequential ``fit`` calls (same
+        per-iteration rng fold_in scheme)."""
+        def one(carry, batch):
+            params, opt_state, state = carry
+            x, y, rng = batch
+            params, opt_state, new_state, loss = self._update(
+                params, opt_state, state, x, y, None, None, rng)
+            return (params, opt_state, new_state), loss
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        def loop(params, opt_state, state, x_stack, y_stack, rng_stack):
+            (p, o, s), losses = jax.lax.scan(
+                one, (params, opt_state, state),
+                (x_stack, y_stack, rng_stack))
+            return p, o, s, losses
+
+        return jax.jit(loop, donate_argnums=(0, 1, 2))
+
+    def _fit_group(self, group):
+        if self._train_loop_fn is None:
+            self._train_loop_fn = self._make_train_loop()
+        xs = jnp.stack([jnp.asarray(np.asarray(x)) for x, _ in group])
+        ys = jnp.stack([jnp.asarray(np.asarray(y)) for _, y in group])
+        base = jax.random.PRNGKey(self.conf.seed)
+        rngs = jnp.stack([jax.random.fold_in(base, self.iteration + i)
+                          for i in range(len(group))])
+        try:
+            self.params, self.opt_state, self.state, losses = \
+                self._train_loop_fn(self.params, self.opt_state,
+                                    self.state, xs, ys, rngs)
+        except Exception as e:       # HBM OOM → diagnostic dump
+            from deeplearning4j_tpu.utils import crashreport
+            if crashreport.is_oom(e):
+                path = crashreport.write_memory_crash_dump(self, e)
+                if path:
+                    raise RuntimeError(
+                        f"scanned train loop ran out of device memory "
+                        f"(steps_per_loop={len(group)} stacks the group "
+                        f"on device — try a smaller value); crash dump "
+                        f"written to {path}") from e
+            raise
+        for loss in np.asarray(losses):
+            self.score_ = float(loss)
+            self.iteration += 1
+            for l in self.listeners:
+                l.iteration_done(self, self.iteration, self.epoch)
+
+    def _flush_group(self, group):
+        if not group:
+            return
+        if len(group) == 1:
+            self._fit_batch(*group[0])
+        else:
+            self._fit_group(list(group))
+        group.clear()
 
     def fit(self, features, labels=None, *, epochs: int = 1,
-            features_mask=None, labels_mask=None):
+            features_mask=None, labels_mask=None, steps_per_loop: int = 1):
         """fit(x, y) for one batch, or fit(iterator, epochs=N).
 
         Iterator elements: DataSet-like (``.features``/``.labels``/
         ``.features_mask``/``.labels_mask``) or (x, y) tuples.
         Reference: MultiLayerNetwork.fit(DataSetIterator) — SURVEY §3.2.
+        ``steps_per_loop``: batches are grouped and run K steps per
+        dispatched executable (scanned device loop) — amortises
+        host/dispatch latency; mask-free uniformly-shaped batches only.
         """
         if labels is not None:
             self._fit_batch(features, labels, features_mask, labels_mask)
@@ -258,6 +321,7 @@ class MultiLayerNetwork:
                 l.on_epoch_start(self)
             if hasattr(it, "reset"):
                 it.reset()
+            group: list = []
             for ds in it:
                 if hasattr(ds, "features"):
                     x, y = ds.features, ds.labels
@@ -266,7 +330,20 @@ class MultiLayerNetwork:
                 else:
                     x, y = ds
                     fm = lm = None
-                self._fit_batch(x, y, fm, lm)
+                tbptt = (self.conf.backprop_type == "TruncatedBPTT"
+                         and np.ndim(x) == 3)
+                if steps_per_loop > 1 and fm is None and lm is None \
+                        and not tbptt:
+                    if group and (np.shape(group[-1][0]) != np.shape(x)
+                                  or np.shape(group[-1][1]) != np.shape(y)):
+                        self._flush_group(group)
+                    group.append((x, y))
+                    if len(group) == steps_per_loop:
+                        self._flush_group(group)
+                else:
+                    self._flush_group(group)
+                    self._fit_batch(x, y, fm, lm)
+            self._flush_group(group)
             for l in self.listeners:
                 l.on_epoch_end(self)
             self.epoch += 1

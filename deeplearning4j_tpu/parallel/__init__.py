@@ -17,10 +17,17 @@ from deeplearning4j_tpu.parallel.compression import (
     EncodedGradientsAccumulator, encode_threshold, decode_threshold,
     encode_bitmap, decode_bitmap, AdaptiveThresholdAlgorithm,
 )
+from deeplearning4j_tpu.parallel.master import (
+    ParameterAveragingTrainingMaster, SharedTrainingMaster,
+    SparkDl4jMultiLayer, SparkComputationGraph, ShardedDataSetIterator,
+)
 
 __all__ = [
     "make_mesh", "data_parallel_mesh", "initialize_distributed",
     "ParallelWrapper", "ParallelInference",
     "EncodedGradientsAccumulator", "encode_threshold", "decode_threshold",
     "encode_bitmap", "decode_bitmap", "AdaptiveThresholdAlgorithm",
+    "ParameterAveragingTrainingMaster", "SharedTrainingMaster",
+    "SparkDl4jMultiLayer", "SparkComputationGraph",
+    "ShardedDataSetIterator",
 ]

@@ -60,6 +60,22 @@ class ParallelWrapper:
         self.prefetch_buffer = prefetch_buffer
         self._step = None
         self._dp_state = None  # mode-specific device state
+        # MultiLayerNetwork takes (x, y); ComputationGraph takes
+        # ({name: x}, [y]) — adapt here so every mode's step body can
+        # stay network-agnostic (single-input/single-output graphs)
+        if hasattr(net.conf, "inputs"):
+            ins, outs = net.conf.inputs, net.conf.outputs
+            if len(ins) != 1 or len(outs) != 1:
+                raise ValueError(
+                    "ParallelWrapper supports single-input/single-output"
+                    f" graphs; got {len(ins)} inputs / {len(outs)} "
+                    "outputs — shard multi-io batches manually with "
+                    "shard_map over the net's _loss_fn")
+            self._loss = lambda p, s, x, y, rng: net._loss_fn(
+                p, s, {ins[0]: x}, [y], {}, {}, rng)
+        else:
+            self._loss = lambda p, s, x, y, rng: net._loss_fn(
+                p, s, x, y, None, None, rng)
 
     # -- builder parity (reference ParallelWrapper.Builder) -------------
     class Builder:
@@ -104,8 +120,7 @@ class ParallelWrapper:
 
         def step(params, opt_state, state, x, y, rng):
             (loss, new_state), grads = jax.value_and_grad(
-                net._loss_fn, has_aux=True)(params, state, x, y, None,
-                                            None, rng)
+                self._loss, has_aux=True)(params, state, x, y, rng)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return params, opt_state, new_state, loss
@@ -127,8 +142,7 @@ class ParallelWrapper:
             acc_state = jax.tree.map(lambda a: a[0], acc_state)
             # per-device grads on the local shard
             (loss, new_state), grads = jax.value_and_grad(
-                net._loss_fn, has_aux=True)(params, state, x, y, None,
-                                            None, rng)
+                self._loss, has_aux=True)(params, state, x, y, rng)
             grads, acc_state = acc.exchange(grads, acc_state, "data")
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
@@ -156,8 +170,7 @@ class ParallelWrapper:
             params = jax.tree.map(lambda a: a[0], params)
             opt_state = jax.tree.map(lambda a: a[0], opt_state)
             (loss, new_state), grads = jax.value_and_grad(
-                net._loss_fn, has_aux=True)(params, state, x, y, None,
-                                            None, rng)
+                self._loss, has_aux=True)(params, state, x, y, rng)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             # every k-th iteration: replica averaging (reference

@@ -1,0 +1,97 @@
+"""In-process TF graph execution — reference: ``nd4j-tensorflow``
+``org.nd4j.tensorflow.conversion.graphrunner.GraphRunner`` (SURVEY
+§2.2), which runs real TensorFlow GraphDefs through the TF C API with
+casting rules and named feeds/fetches.
+
+TPU-native design: the installed TensorFlow runtime executes the graph
+(mirroring the reference's in-process libtensorflow), arrays cross the
+boundary zero-copy via numpy. For graphs the importer supports,
+``TFImporter`` (tf_import.py) is the faster path — it retraces to JAX
+and jits; GraphRunner is the conformance/eval tool that runs the
+ORIGINAL graph, e.g. to produce goldens the import path is tested
+against.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class GraphRunner:
+    """Run a frozen TF GraphDef with named inputs/outputs.
+
+    Reference API mirrored: construct with graph bytes/path + input and
+    output op names; ``run({name: array})`` returns ``{name: array}``.
+    """
+
+    def __init__(self, graph_def=None, *, path: Optional[str] = None,
+                 input_names: Optional[Sequence[str]] = None,
+                 output_names: Optional[Sequence[str]] = None,
+                 cast_inputs: Optional[Dict[str, str]] = None):
+        import tensorflow as tf  # local: heavy dep, only when used
+        self._tf = tf
+        if graph_def is None:
+            if path is None:
+                raise ValueError("need graph_def or path")
+            graph_def = tf.compat.v1.GraphDef()
+            with open(path, "rb") as f:
+                graph_def.ParseFromString(f.read())
+        elif isinstance(graph_def, (bytes, bytearray)):
+            gd = tf.compat.v1.GraphDef()
+            gd.ParseFromString(bytes(graph_def))
+            graph_def = gd
+        self.graph_def = graph_def
+        node_names = [n.name for n in graph_def.node]
+        self.input_names = list(input_names) if input_names else [
+            n.name for n in graph_def.node if n.op == "Placeholder"]
+        self.cast_inputs = cast_inputs or {}
+
+        graph = tf.Graph()
+        with graph.as_default():
+            tf.graph_util.import_graph_def(graph_def, name="")
+        self._graph = graph
+
+        if output_names:
+            self.output_names = list(output_names)
+        else:
+            # terminal nodes: consumed by nobody AND producing at least
+            # one tensor (frozen graphs often carry NoOp/Assert leaves)
+            consumed = {i.split(":")[0].lstrip("^")
+                        for n in graph_def.node for i in n.input}
+            self.output_names = [
+                n for n in node_names
+                if n not in consumed
+                and graph.get_operation_by_name(n).outputs]
+
+        feeds = [graph.get_tensor_by_name(f"{n}:0")
+                 for n in self.input_names]
+        self._feed_dtypes = {n: t.dtype.as_numpy_dtype
+                             for n, t in zip(self.input_names, feeds)}
+
+        # wrap as a ConcreteFunction once; repeated run() calls are
+        # then a single in-process executor invocation (the reference
+        # keeps one TF_Session for the same reason)
+        self._fn = tf.compat.v1.wrap_function(
+            lambda *args: tf.graph_util.import_graph_def(
+                graph_def, name="",
+                input_map=dict(zip(self.input_names, args)),
+                return_elements=[f"{n}:0" for n in self.output_names]),
+            [tf.TensorSpec(t.shape, t.dtype) for t in feeds])
+
+    def run(self, inputs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        args = []
+        for n in self.input_names:
+            a = np.asarray(inputs[n])
+            # reference GraphRunner casting rules: explicit cast map
+            # first, else coerce to the placeholder dtype (numpy's
+            # float64 default would otherwise fail against f32 graphs)
+            a = a.astype(self.cast_inputs.get(n, self._feed_dtypes[n]))
+            args.append(self._tf.constant(a))
+        outs = self._fn(*args)
+        return {n: np.asarray(o) for n, o in zip(self.output_names, outs)}
+
+    # reference API aliases
+    def run_list(self, inputs: List[np.ndarray]) -> List[np.ndarray]:
+        out = self.run(dict(zip(self.input_names, inputs)))
+        return [out[n] for n in self.output_names]

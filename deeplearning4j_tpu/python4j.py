@@ -1,0 +1,83 @@
+"""python4j equivalent — reference: ``python4j/python4j-core``
+``org.nd4j.python4j.PythonExecutioner`` + ``python4j-numpy`` (SURVEY
+§2.4): embedded CPython with GIL management and zero-copy
+numpy↔INDArray exchange, used to run user Python snippets inside JVM
+pipelines (datavec transforms, serving pre/post-processing).
+
+In a Python-native framework the host language IS Python, so the
+embedding machinery disappears; what remains useful — and is preserved
+here — is the sandboxed-namespace executor API that DataVec transforms
+and serving pipelines program against: named inputs in, named outputs
+out, zero-copy for numpy/jax arrays, per-job isolated globals.
+"""
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Any, Dict, Optional, Sequence
+
+
+class PythonJob:
+    """A named, reusable code snippet (reference ``PythonJob``):
+    compiled once, executed many times against fresh variable sets.
+    Setup-created values are deep-copied into each run's namespace
+    where possible (mutating them in job code does not leak into the
+    next run); uncopyable values (modules, handles) are shared.
+    ``exec`` is serialised by a per-job lock, mirroring the
+    reference's GIL-held execution."""
+
+    def __init__(self, name: str, code: str,
+                 setup_code: Optional[str] = None):
+        self.name = name
+        self.code = compile(code, f"<python4j:{name}>", "exec")
+        self.setup = (compile(setup_code, f"<python4j:{name}:setup>",
+                              "exec") if setup_code else None)
+        self._lock = threading.Lock()
+        self._setup_globals: Dict[str, Any] = {}
+        if self.setup is not None:
+            exec(self.setup, self._setup_globals)
+
+    @staticmethod
+    def _fresh(v):
+        try:
+            return copy.deepcopy(v)
+        except Exception:
+            return v
+
+    def exec(self, inputs: Dict[str, Any],
+             outputs: Sequence[str]) -> Dict[str, Any]:
+        with self._lock:
+            ns = {k: self._fresh(v)
+                  for k, v in self._setup_globals.items()}
+            ns.update(inputs)
+            exec(self.code, ns)
+            missing = [o for o in outputs if o not in ns]
+            if missing:
+                raise KeyError(f"job {self.name!r} did not produce "
+                               f"outputs {missing}")
+            return {o: ns[o] for o in outputs}
+
+
+class PythonExecutioner:
+    """Reference ``PythonExecutioner``: run code with named variables.
+
+    Arrays pass zero-copy (they are the same objects; the reference
+    needed javacpp buffer aliasing for this). A lock serialises
+    ``exec`` calls the way the reference serialises on the GIL.
+    """
+
+    _lock = threading.Lock()
+
+    @staticmethod
+    def exec(code: str, inputs: Optional[Dict[str, Any]] = None,
+             outputs: Optional[Sequence[str]] = None) -> Dict[str, Any]:
+        with PythonExecutioner._lock:
+            ns: Dict[str, Any] = dict(inputs or {})
+            exec(compile(code, "<python4j>", "exec"), ns)
+            if outputs is None:
+                return {k: v for k, v in ns.items()
+                        if not k.startswith("__")}
+            missing = [o for o in outputs if o not in ns]
+            if missing:
+                raise KeyError(f"code did not produce outputs {missing}")
+            return {o: ns[o] for o in outputs}

@@ -1,0 +1,88 @@
+"""GraphRunner (TF in-process execution) + python4j executor tests.
+Reference analogs: GraphRunnerTest (nd4j-tensorflow),
+PythonExecutionerTest (python4j-core).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.python4j import PythonExecutioner, PythonJob
+
+
+def _make_graphdef():
+    tf = pytest.importorskip("tensorflow")
+
+    @tf.function
+    def f(a, b):
+        return {"sum": a + b, "prod": tf.matmul(a, b)}
+
+    conc = f.get_concrete_function(
+        tf.TensorSpec([2, 2], tf.float32, name="a"),
+        tf.TensorSpec([2, 2], tf.float32, name="b"))
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    frozen = convert_variables_to_constants_v2(conc)
+    return frozen.graph.as_graph_def(), frozen
+
+
+def test_graph_runner_matches_tf():
+    gd, frozen = _make_graphdef()
+    from deeplearning4j_tpu.modelimport.graph_runner import GraphRunner
+    runner = GraphRunner(gd, input_names=["a", "b"],
+                         output_names=["Identity", "Identity_1"])
+    a = np.arange(4, dtype=np.float32).reshape(2, 2)
+    b = np.ones((2, 2), np.float32)
+    out = runner.run({"a": a, "b": b})
+    # exact per-value comparison against the known math: outputs are
+    # {a+b, a@b} in some Identity order
+    want = {"sum": a + b, "prod": a @ b}
+    got = list(out.values())
+    m = [np.allclose(g, want["sum"]) or np.allclose(g, want["prod"])
+         for g in got]
+    assert all(m) and not np.allclose(got[0], got[1])
+    # run_list order matches output_names and values match run()
+    outs = runner.run_list([a, b])
+    for name, v in zip(runner.output_names, outs):
+        np.testing.assert_array_equal(v, out[name])
+    # float64 numpy inputs are coerced to the placeholder dtype
+    out64 = runner.run({"a": a.astype(np.float64),
+                        "b": b.astype(np.float64)})
+    for name in runner.output_names:
+        np.testing.assert_allclose(out64[name], out[name], rtol=1e-6)
+
+
+def test_graph_runner_skips_zero_output_terminals():
+    tf = pytest.importorskip("tensorflow")
+    gd, _ = _make_graphdef()
+    noop = gd.node.add()
+    noop.name = "init"
+    noop.op = "NoOp"
+    from deeplearning4j_tpu.modelimport.graph_runner import GraphRunner
+    runner = GraphRunner(gd, input_names=["a", "b"])  # auto outputs
+    assert "init" not in runner.output_names
+    assert set(runner.output_names) == {"Identity", "Identity_1"}
+
+
+def test_python_executioner():
+    out = PythonExecutioner.exec(
+        "c = a + b\nd = (a * b).sum()",
+        inputs={"a": np.arange(3.0), "b": np.ones(3)},
+        outputs=["c", "d"])
+    np.testing.assert_allclose(out["c"], [1.0, 2.0, 3.0])
+    assert out["d"] == 3.0
+    with pytest.raises(KeyError):
+        PythonExecutioner.exec("x = 1", outputs=["y"])
+
+
+def test_python_job_setup_reuse():
+    job = PythonJob("scale", "y = w * x", setup_code="w = 10")
+    assert job.exec({"x": 3}, ["y"])["y"] == 30
+    # fresh namespace per exec: leakage from previous run is not visible
+    assert job.exec({"x": 4}, ["y"])["y"] == 40
+    # in-place mutation of setup state doesn't leak across runs either
+    job2 = PythonJob("acc", "w.append(x)\ny = list(w)", setup_code="w = []")
+    assert job2.exec({"x": 1}, ["y"])["y"] == [1]
+    assert job2.exec({"x": 2}, ["y"])["y"] == [2]
+    # zero-copy: the SAME array object flows through
+    a = np.zeros(4)
+    out = PythonExecutioner.exec("b = a", inputs={"a": a}, outputs=["b"])
+    assert out["b"] is a

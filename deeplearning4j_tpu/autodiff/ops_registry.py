@@ -306,6 +306,175 @@ def _loss_cosd(labels, preds, *, axis=-1):
     return jnp.mean(1.0 - jnp.sum(labels * preds, axis=axis))
 
 
+# --- additional math (reference libnd4j transforms/*.cpp) -------------------
+op("atan2")(jnp.arctan2)
+op("hypot")(jnp.hypot)
+op("logaddexp")(jnp.logaddexp)
+op("xlogy")(jax.scipy.special.xlogy)
+op("lgamma")(jax.scipy.special.gammaln)
+op("digamma")(jax.scipy.special.digamma)
+op("expm1")(jnp.expm1)
+op("log2")(jnp.log2)
+op("log10")(jnp.log10)
+op("cbrt")(jnp.cbrt)
+op("asinh")(jnp.arcsinh)
+op("acosh")(jnp.arccosh)
+op("atanh")(jnp.arctanh)
+op("log_sigmoid")(jax.nn.log_sigmoid)
+op("mish")(jax.nn.mish)
+op("cube")(lambda a: a * a * a)
+op("rect_tanh")(lambda a: jnp.maximum(0.0, jnp.tanh(a)))
+op("prelu")(lambda x, alpha: jnp.where(x >= 0, x, alpha * x))
+op("step")(lambda a, *, cutoff=0.0: (a > cutoff).astype(a.dtype))
+op("zero_fraction")(lambda a: jnp.mean((a == 0).astype(jnp.float32)))
+op("count_nonzero")(_red(lambda a, axis, keepdims: jnp.sum(
+    (a != 0).astype(jnp.int32), axis=axis, keepdims=keepdims)))
+# abs-variants of the reductions (reference amax/amin/amean/asum)
+op("amax")(_red(lambda a, axis, keepdims: jnp.max(jnp.abs(a), axis=axis,
+                                                  keepdims=keepdims)))
+op("amin")(_red(lambda a, axis, keepdims: jnp.min(jnp.abs(a), axis=axis,
+                                                  keepdims=keepdims)))
+op("amean")(_red(lambda a, axis, keepdims: jnp.mean(jnp.abs(a), axis=axis,
+                                                    keepdims=keepdims)))
+op("norm_max")(OPS["amax"])
+# 0·log 0 = 0 via xlogy: one-hot / sparse distributions stay finite
+op("entropy")(_red(lambda a, axis, keepdims: -jnp.sum(
+    jax.scipy.special.xlogy(a, a), axis=axis, keepdims=keepdims)))
+op("log_entropy")(_red(lambda a, axis, keepdims: jnp.log(-jnp.sum(
+    jax.scipy.special.xlogy(a, a), axis=axis, keepdims=keepdims))))
+
+
+@op("moments")
+def _moments(a, *, axis=None, keepdims=False):
+    if isinstance(axis, list):
+        axis = tuple(axis)
+    return (jnp.mean(a, axis=axis, keepdims=keepdims),
+            jnp.var(a, axis=axis, keepdims=keepdims))
+
+
+# --- distance reduce3 ops (reference include/loops/reduce3) -----------------
+op("euclidean_distance")(lambda a, b: jnp.sqrt(jnp.sum(jnp.square(a - b))))
+op("manhattan_distance")(lambda a, b: jnp.sum(jnp.abs(a - b)))
+op("cosine_similarity")(lambda a, b: jnp.sum(a * b) / (
+    jnp.linalg.norm(a) * jnp.linalg.norm(b)))
+op("cosine_distance")(lambda a, b: 1.0 - jnp.sum(a * b) / (
+    jnp.linalg.norm(a) * jnp.linalg.norm(b)))
+op("hamming_distance")(lambda a, b: jnp.sum((a != b).astype(jnp.float32)))
+op("jaccard_distance")(lambda a, b: 1.0 - jnp.sum(jnp.minimum(a, b))
+                       / jnp.sum(jnp.maximum(a, b)))
+op("dot_product")(lambda a, b: jnp.sum(a * b))
+
+# --- linalg (reference blas/ generic ops) -----------------------------------
+op("cholesky")(jnp.linalg.cholesky)
+op("matrix_inverse")(jnp.linalg.inv)
+op("matrix_determinant")(jnp.linalg.det)
+op("log_matrix_determinant")(lambda a: jnp.linalg.slogdet(a)[1])
+op("solve")(jnp.linalg.solve)
+op("triangular_solve")(lambda a, b, *, lower=True:
+                       jax.scipy.linalg.solve_triangular(a, b, lower=lower))
+op("qr")(lambda a: jnp.linalg.qr(a))
+op("svd")(lambda a, *, full_matrices=False:
+          jnp.linalg.svd(a, full_matrices=full_matrices))
+op("eye")(lambda *, n, m=None, dtype=jnp.float32: jnp.eye(
+    n, m, dtype=dtype))
+op("trace")(jnp.trace)
+op("diag")(jnp.diag)
+op("diag_part")(jnp.diagonal)
+op("triu")(lambda a, *, k=0: jnp.triu(a, k))
+op("tril")(lambda a, *, k=0: jnp.tril(a, k))
+op("cross")(jnp.cross)
+op("kron")(jnp.kron)
+op("outer")(jnp.outer)
+op("lstsq")(lambda a, b: jnp.linalg.lstsq(a, b)[0])
+
+# --- sorting / search -------------------------------------------------------
+op("sort")(lambda a, *, axis=-1, descending=False:
+           -jnp.sort(-a, axis=axis) if descending
+           else jnp.sort(a, axis=axis))
+op("argsort")(lambda a, *, axis=-1: jnp.argsort(a, axis=axis))
+op("top_k")(lambda a, *, k, sorted=True: jax.lax.top_k(a, k))
+op("in_top_k")(lambda preds, targets, *, k: jnp.any(
+    jax.lax.top_k(preds, k)[1]
+    == targets.astype(jnp.int32)[..., None], axis=-1))
+op("searchsorted")(lambda a, v: jnp.searchsorted(a, v))
+
+# --- scatter / segment (reference scatter*.cpp, segment*.cpp) ---------------
+op("scatter_update")(lambda a, idx, upd: a.at[idx.astype(jnp.int32)]
+                     .set(upd))
+op("scatter_add")(lambda a, idx, upd: a.at[idx.astype(jnp.int32)]
+                  .add(upd))
+op("scatter_sub")(lambda a, idx, upd: a.at[idx.astype(jnp.int32)]
+                  .add(-upd))
+op("scatter_mul")(lambda a, idx, upd: a.at[idx.astype(jnp.int32)]
+                  .multiply(upd))
+op("scatter_max")(lambda a, idx, upd: a.at[idx.astype(jnp.int32)]
+                  .max(upd))
+op("scatter_min")(lambda a, idx, upd: a.at[idx.astype(jnp.int32)]
+                  .min(upd))
+op("segment_sum")(lambda a, ids, *, num_segments: jax.ops.segment_sum(
+    a, ids.astype(jnp.int32), num_segments))
+op("segment_max")(lambda a, ids, *, num_segments: jax.ops.segment_max(
+    a, ids.astype(jnp.int32), num_segments))
+op("segment_min")(lambda a, ids, *, num_segments: jax.ops.segment_min(
+    a, ids.astype(jnp.int32), num_segments))
+op("segment_mean")(lambda a, ids, *, num_segments:
+                   jax.ops.segment_sum(a, ids.astype(jnp.int32),
+                                       num_segments)
+                   / jnp.maximum(jax.ops.segment_sum(
+                       jnp.ones_like(a), ids.astype(jnp.int32),
+                       num_segments), 1))
+op("gather_nd")(lambda a, idx: a[tuple(jnp.moveaxis(
+    idx.astype(jnp.int32), -1, 0))])
+op("take_along_axis")(lambda a, idx, *, axis: jnp.take_along_axis(
+    a, idx.astype(jnp.int32), axis=axis))
+
+# --- image / spatial (reference resize ops, s2d/b2s) ------------------------
+op("resize_bilinear")(lambda a, *, size: jax.image.resize(
+    a, (a.shape[0],) + tuple(size) + (a.shape[-1],), "bilinear"))
+op("resize_nearest")(lambda a, *, size: jax.image.resize(
+    a, (a.shape[0],) + tuple(size) + (a.shape[-1],), "nearest"))
+
+
+@op("space_to_depth")
+def _space_to_depth(a, *, block_size):
+    b, h, w, c = a.shape
+    k = block_size
+    a = a.reshape(b, h // k, k, w // k, k, c)
+    return a.transpose(0, 1, 3, 2, 4, 5).reshape(
+        b, h // k, w // k, k * k * c)
+
+
+@op("depth_to_space")
+def _depth_to_space(a, *, block_size):
+    b, h, w, c = a.shape
+    k = block_size
+    a = a.reshape(b, h, w, k, k, c // (k * k))
+    return a.transpose(0, 1, 3, 2, 4, 5).reshape(
+        b, h * k, w * k, c // (k * k))
+
+
+op("roll")(lambda a, *, shift, axis=None: jnp.roll(a, shift, axis))
+op("linspace")(lambda *, start, stop, num: jnp.linspace(start, stop, num))
+op("arange")(lambda *, start, stop, step=1: jnp.arange(start, stop, step))
+op("meshgrid")(lambda *arrs, indexing="xy": tuple(
+    jnp.meshgrid(*arrs, indexing=indexing)))
+op("full_like")(lambda a, *, value: jnp.full_like(a, value))
+op("zeros_like")(jnp.zeros_like)
+op("ones_like")(jnp.ones_like)
+
+
+# --- sequence losses --------------------------------------------------------
+@op("ctc_loss")
+def _ctc_loss(labels, logits, label_lengths, logit_lengths, *, blank=0):
+    """CTC negative log-likelihood (reference libnd4j ``ctc_loss``).
+    Delegates to the optax-backed implementation in ops/losses.py —
+    one CTC source of truth (validated against brute-force path
+    enumeration in test_op_validation)."""
+    from deeplearning4j_tpu.ops import losses as losses_mod
+    return losses_mod.ctc_loss(labels, logits, label_lengths,
+                               logit_lengths, blank_id=blank)
+
+
 # --- random (seeded per-node: deterministic under retrace) ------------------
 @op("random_normal")
 def _random_normal(*, shape, seed, mean=0.0, stddev=1.0):

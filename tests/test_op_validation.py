@@ -1,0 +1,385 @@
+"""OpValidation — every registered samediff op is validated, coverage-
+gated like the reference's ``org.nd4j.autodiff.opvalidation`` framework
+(SURVEY §4: "coverage-tracked so unvalidated ops fail CI"): forward
+executed (finite + shape), float ops finite-difference gradient-checked
+in float64, and — where a trusted producer exists — compared against
+numpy goldens.
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.autodiff.ops_registry import OPS, get_op
+
+R = np.random.default_rng(7)
+
+
+def A(*shape, pos=False, lo=None, hi=None, dtype=np.float64):
+    a = R.standard_normal(shape)
+    if pos:
+        a = np.abs(a) + 0.5
+    if lo is not None:
+        a = np.clip(a, lo, hi)
+    return a.astype(dtype)
+
+
+# name -> (args, kwargs, flags). flags: g=gradcheck, golden=np callable
+CASES = {}
+
+
+def case(name, *args, g=True, golden=None, **kwargs):
+    CASES.setdefault(name, []).append((list(args), kwargs, g, golden))
+
+
+# --- elementwise unary ------------------------------------------------------
+for n, gold, dom in [
+    ("abs", np.abs, {}), ("exp", np.exp, {}), ("neg", lambda a: -a, {}),
+    ("log", np.log, {"pos": True}), ("log1p", np.log1p, {"pos": True}),
+    ("sqrt", np.sqrt, {"pos": True}), ("square", np.square, {}),
+    ("reciprocal", lambda a: 1 / a, {"pos": True}),
+    ("sin", np.sin, {}), ("cos", np.cos, {}), ("tan", np.tan, {}),
+    ("asin", np.arcsin, {"lo": -0.9, "hi": 0.9}),
+    ("acos", np.arccos, {"lo": -0.9, "hi": 0.9}),
+    ("atan", np.arctan, {}), ("sinh", np.sinh, {}),
+    ("cosh", np.cosh, {}), ("tanh", np.tanh, {}),
+    ("expm1", np.expm1, {}), ("log2", np.log2, {"pos": True}),
+    ("log10", np.log10, {"pos": True}), ("cbrt", np.cbrt, {"pos": True}),
+    ("asinh", np.arcsinh, {}),
+    ("acosh", lambda a: np.arccosh(a + 1.5), None),
+    ("atanh", np.arctanh, {"lo": -0.9, "hi": 0.9}),
+    ("cube", lambda a: a ** 3, {}),
+]:
+    if n == "acosh":
+        case(n, A(3, 4, pos=True) + 1.5, golden=np.arccosh)
+    else:
+        case(n, A(3, 4, **dom), golden=gold)
+
+for n in ["sigmoid", "softplus", "softsign", "swish", "gelu", "elu",
+          "selu", "relu", "relu6", "hard_sigmoid", "hard_tanh",
+          "log_sigmoid", "mish", "erf", "erfc", "lgamma", "digamma",
+          "rsqrt", "rect_tanh"]:
+    case(n, A(3, 4, pos=(n in ("lgamma", "digamma", "rsqrt"))),
+         g=(n not in ("relu", "relu6", "hard_tanh", "rect_tanh")))
+case("leaky_relu", A(3, 4), alpha=0.1)
+case("prelu", A(3, 4), A(4, pos=True))
+case("mish", A(3, 4))
+
+# non-differentiable unaries: forward only
+case("sign", A(3, 4), g=False, golden=np.sign)
+case("floor", A(3, 4), g=False, golden=np.floor)
+case("ceil", A(3, 4), g=False, golden=np.ceil)
+case("round", A(3, 4), g=False, golden=np.round)
+case("step", A(3, 4), g=False, cutoff=0.0)
+case("is_nan", A(3, 4), g=False, golden=np.isnan)
+case("is_inf", A(3, 4), g=False, golden=np.isinf)
+case("zero_fraction", np.array([[0.0, 1.0], [2.0, 0.0]]), g=False)
+case("clip_by_value", A(3, 4), g=False, min=-0.5, max=0.5,
+     golden=lambda a: np.clip(a, -0.5, 0.5))
+
+# --- binary -----------------------------------------------------------------
+for n, gold in [("add", np.add), ("sub", np.subtract),
+                ("mul", np.multiply), ("maximum", np.maximum),
+                ("minimum", np.minimum), ("atan2", np.arctan2),
+                ("hypot", np.hypot), ("logaddexp", np.logaddexp),
+                ("squared_difference", lambda a, b: (a - b) ** 2)]:
+    case(n, A(3, 4), A(3, 4), golden=gold)
+case("div", A(3, 4), A(3, 4, pos=True), golden=np.divide)
+case("rsub", A(3, 4), A(3, 4), golden=lambda a, b: b - a)
+case("rdiv", A(3, 4, pos=True), A(3, 4), golden=lambda a, b: b / a)
+case("pow", A(3, 4, pos=True), A(3, 4), golden=np.power)
+case("floormod", A(3, 4), A(3, 4, pos=True), g=False, golden=np.mod)
+case("xlogy", A(3, 4, pos=True), A(3, 4, pos=True))
+for n in ["eq", "neq", "gt", "gte", "lt", "lte"]:
+    case(n, A(3, 4), A(3, 4), g=False)
+b1, b2 = A(3, 4) > 0, A(3, 4) > 0
+case("logical_and", b1, b2, g=False, golden=np.logical_and)
+case("logical_or", b1, b2, g=False, golden=np.logical_or)
+case("logical_not", b1, g=False, golden=np.logical_not)
+case("where", b1, A(3, 4), A(3, 4), g=False)
+
+# --- matmul / linalg --------------------------------------------------------
+case("matmul", A(3, 4), A(4, 5), golden=np.matmul)
+case("matmul", A(3, 4), A(5, 4), transpose_b=True)
+case("dot", A(4), A(4), golden=np.dot)
+case("tensordot", A(3, 4), A(4, 5), axes=1)
+case("linear", A(5, 3), A(3, 2), A(2))
+case("bias_add", A(5, 3), A(3))
+spd = (lambda m: m @ m.T + 3 * np.eye(4))(A(4, 4))
+case("cholesky", spd, g=False, golden=np.linalg.cholesky)
+case("matrix_inverse", spd, golden=np.linalg.inv)
+case("matrix_determinant", spd, golden=np.linalg.det)
+case("log_matrix_determinant", spd,
+     golden=lambda a: np.linalg.slogdet(a)[1])
+case("solve", spd, A(4, 2), golden=np.linalg.solve)
+case("triangular_solve", np.linalg.cholesky(spd), A(4, 2), g=False,
+     lower=True)
+case("qr", A(4, 3), g=False)
+case("svd", A(4, 3), g=False)
+case("lstsq", A(5, 3), A(5, 2), g=False)
+case("eye", g=False, n=3, m=4, golden=None)
+case("trace", A(4, 4), golden=np.trace)
+case("diag", A(4), g=False, golden=np.diag)
+case("diag_part", A(4, 4), g=False, golden=np.diagonal)
+case("triu", A(4, 4), g=False, golden=np.triu)
+case("tril", A(4, 4), g=False, golden=np.tril)
+case("cross", A(3), A(3), golden=np.cross)
+case("kron", A(2, 2), A(3, 3), g=False, golden=np.kron)
+case("outer", A(3), A(4), golden=np.outer)
+
+# --- reductions -------------------------------------------------------------
+for n, gold in [("sum", np.sum), ("mean", np.mean), ("max", np.max),
+                ("min", np.min), ("prod", np.prod), ("std", np.std),
+                ("variance", np.var)]:
+    case(n, A(3, 4, pos=(n == "prod")), axis=1,
+         g=(n not in ("max", "min")),
+         golden=lambda a, _g=gold: _g(a, axis=1))
+case("sum", A(3, 4), axis=None, golden=np.sum)
+case("norm1", A(3, 4), axis=1,
+     golden=lambda a: np.abs(a).sum(1))
+case("norm2", A(3, 4), axis=1,
+     golden=lambda a: np.sqrt((a ** 2).sum(1)))
+case("norm_max", A(3, 4), axis=1, g=False,
+     golden=lambda a: np.abs(a).max(1))
+for n in ["amax", "amin", "amean"]:
+    case(n, A(3, 4), axis=1, g=False)
+case("count_nonzero", np.array([[1.0, 0.0], [2.0, 3.0]]), g=False,
+     axis=1)
+case("entropy", np.array([0.2, 0.3, 0.5]))
+case("log_entropy", np.array([0.2, 0.3, 0.5]))
+case("moments", A(3, 4), axis=0)
+case("argmax", A(3, 4), g=False, axis=1,
+     golden=lambda a: np.argmax(a, 1))
+case("argmin", A(3, 4), g=False, axis=1,
+     golden=lambda a: np.argmin(a, 1))
+case("cumsum", A(3, 4), axis=1, golden=lambda a: np.cumsum(a, 1))
+case("cumprod", A(3, 4), axis=1, golden=lambda a: np.cumprod(a, 1))
+case("logsumexp", A(3, 4), axis=1)
+
+# --- distances --------------------------------------------------------------
+case("euclidean_distance", A(5), A(5),
+     golden=lambda a, b: np.linalg.norm(a - b))
+case("manhattan_distance", A(5), A(5),
+     golden=lambda a, b: np.abs(a - b).sum())
+case("cosine_similarity", A(5), A(5))
+case("cosine_distance", A(5), A(5))
+case("hamming_distance", np.array([1.0, 2, 3]), np.array([1.0, 0, 3]),
+     g=False)
+case("jaccard_distance", A(5, pos=True), A(5, pos=True), g=False)
+case("dot_product", A(5), A(5), golden=lambda a, b: a @ b)
+
+# --- shape ops --------------------------------------------------------------
+case("reshape", A(3, 4), g=False, shape=(4, 3))
+case("transpose", A(3, 4), g=False, golden=np.transpose)
+case("permute", A(2, 3, 4), g=False, axes=(2, 0, 1))
+case("expand_dims", A(3, 4), g=False, axis=1)
+case("squeeze", A(3, 1, 4), g=False, axis=1)
+case("concat", A(2, 3), A(2, 3), g=False, axis=0)
+case("stack", A(2, 3), A(2, 3), g=False, axis=0)
+case("unstack", A(3, 4), g=False, axis=0, num=3)
+case("split", A(4, 6), g=False, num=2, axis=1)
+case("tile", A(2, 3), g=False, reps=(2, 2))
+case("gather", A(5, 3), np.array([0, 2, 4]), g=False, axis=0)
+case("gather_nd", A(4, 5), np.array([[0, 1], [2, 3]]), g=False)
+case("take_along_axis", A(3, 4), np.array([[0], [1], [2]]), g=False,
+     axis=1)
+case("slice", A(5, 6), g=False, begin=(1, 2), size=(2, 3))
+case("strided_slice", A(6, 6), g=False, begin=(0, 1), end=(4, 5),
+     strides=(2, 1))
+case("getitem", A(5, 6), g=False,
+     spec=[{"t": "int", "v": 2},
+           {"t": "slice", "start": 1, "stop": 4, "step": 1}])
+case("cast", A(3, 4), g=False, dtype="float32")
+case("shape_of", A(3, 4), g=False)
+case("one_hot", np.array([0, 2, 1]), g=False, depth=3)
+case("reverse", A(3, 4), g=False, axis=1,
+     golden=lambda a: np.flip(a, 1))
+case("pad", A(2, 3), g=False, paddings=((1, 1), (0, 2)),
+     golden=lambda a: np.pad(a, ((1, 1), (0, 2))))
+case("roll", A(3, 4), g=False, shift=2, axis=1,
+     golden=lambda a: np.roll(a, 2, 1))
+case("linspace", g=False, start=0.0, stop=1.0, num=5)
+case("arange", g=False, start=0, stop=10, step=2)
+case("meshgrid", A(3), A(4), g=False)
+case("full_like", A(2, 2), g=False, value=7.0)
+case("zeros_like", A(2, 2), g=False, golden=np.zeros_like)
+case("ones_like", A(2, 2), g=False, golden=np.ones_like)
+
+# --- sorting / search -------------------------------------------------------
+case("sort", A(4, 5), g=False, axis=1, golden=lambda a: np.sort(a, 1))
+case("sort", A(4, 5), g=False, axis=1, descending=True)
+case("argsort", A(4, 5), g=False, axis=1)
+case("top_k", A(4, 6), g=False, k=2)
+case("in_top_k", A(4, 6), np.array([1, 2, 3, 0]), g=False, k=3)
+case("searchsorted", np.sort(A(8)), A(3), g=False)
+
+# --- scatter / segment ------------------------------------------------------
+case("scatter_update", A(5, 3), np.array([0, 2]), A(2, 3), g=False)
+case("scatter_add", A(5, 3), np.array([0, 2]), A(2, 3), g=False)
+case("scatter_sub", A(5, 3), np.array([0, 2]), A(2, 3), g=False)
+case("scatter_mul", A(5, 3), np.array([0, 2]), A(2, 3), g=False)
+case("scatter_max", A(5, 3), np.array([0, 2]), A(2, 3), g=False)
+case("scatter_min", A(5, 3), np.array([0, 2]), A(2, 3), g=False)
+seg_ids = np.array([0, 0, 1, 2, 2])
+case("segment_sum", A(5, 3), seg_ids, g=False, num_segments=3)
+case("segment_max", A(5, 3), seg_ids, g=False, num_segments=3)
+case("segment_min", A(5, 3), seg_ids, g=False, num_segments=3)
+case("segment_mean", A(5, 3), seg_ids, g=False, num_segments=3)
+
+# --- nn / conv / pool / attention ------------------------------------------
+case("softmax", A(3, 5), axis=-1)
+case("log_softmax", A(3, 5), axis=-1)
+case("layer_norm", A(4, 6), A(6, pos=True), A(6))
+case("batch_norm", A(4, 6), A(6), A(6, pos=True), A(6, pos=True), A(6))
+case("dropout", A(4, 6), g=False, rate=0.5, seed=0, deterministic=True)
+case("conv2d", A(1, 8, 8, 2), A(3, 3, 2, 4), strides=(1, 1),
+     padding="SAME")
+case("depthwise_conv2d", A(1, 8, 8, 2), A(3, 3, 2, 2), g=False,
+     strides=(1, 1), padding="SAME")
+case("max_pooling2d", A(1, 8, 8, 2), g=False, kernel=(2, 2),
+     strides=(2, 2))
+case("avg_pooling2d", A(1, 8, 8, 2), kernel=(2, 2), strides=(2, 2))
+case("dot_product_attention", A(2, 4, 8), A(2, 6, 8), A(2, 6, 8))
+case("resize_bilinear", A(1, 4, 4, 2), g=False, size=(8, 8))
+case("resize_nearest", A(1, 4, 4, 2), g=False, size=(8, 8))
+case("space_to_depth", A(1, 4, 4, 3), g=False, block_size=2)
+case("depth_to_space", A(1, 2, 2, 12), g=False, block_size=2)
+
+# --- losses -----------------------------------------------------------------
+lbl5 = np.eye(5)[R.integers(0, 5, 4)].astype(np.float64)
+case("loss_mse", lbl5, A(4, 5))
+case("loss_mae", lbl5, A(4, 5))
+case("loss_softmax_cross_entropy", lbl5, A(4, 5))
+case("loss_sparse_softmax_cross_entropy",
+     R.integers(0, 5, 4).astype(np.float64), A(4, 5), g=False)
+case("loss_sigmoid_cross_entropy", (A(4, 5) > 0).astype(np.float64),
+     A(4, 5))
+case("loss_log", (A(4, 5) > 0).astype(np.float64),
+     A(4, 5, lo=0.05, hi=0.95))
+case("loss_huber", lbl5, A(4, 5))
+case("loss_cosine_distance", lbl5, A(4, 5))
+case("ctc_loss", np.array([[1, 2], [2, 1]], np.float64),
+     A(2, 6, 4), np.array([2.0, 2.0]), np.array([6.0, 5.0]), g=False)
+
+# --- random -----------------------------------------------------------------
+case("random_normal", g=False, shape=(3, 4), seed=1)
+case("random_uniform", g=False, shape=(3, 4), seed=1, minval=2.0,
+     maxval=3.0)
+case("random_bernoulli", g=False, shape=(100,), seed=1, p=0.3)
+
+
+def test_every_op_has_validation_case():
+    """The coverage gate: adding an op without a validation case fails
+    CI (reference OpValidation coverage tracking)."""
+    missing = sorted(set(OPS) - set(CASES))
+    assert not missing, f"ops without validation cases: {missing}"
+    unknown = sorted(set(CASES) - set(OPS))
+    assert not unknown, f"cases for unregistered ops: {unknown}"
+
+
+def test_ctc_loss_matches_brute_force():
+    """CTC nll vs explicit enumeration of all T-length paths that
+    collapse (dedup + blank-strip) to the label."""
+    import itertools
+    T, C, blank = 4, 3, 0
+    logits = np.asarray(A(1, T, C))
+    label = [1, 2]
+
+    def collapse(path):
+        out, prev = [], None
+        for p in path:
+            if p != prev and p != blank:
+                out.append(p)
+            prev = p
+        return out
+
+    logp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), -1))[0]
+    tot = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == label:
+            tot = np.logaddexp(tot, sum(logp[t, p]
+                                        for t, p in enumerate(path)))
+    want = -tot
+    got = float(get_op("ctc_loss")(
+        jnp.asarray([label]), jnp.asarray(logits),
+        jnp.asarray([2.0]), jnp.asarray([float(T)])))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_ctc_loss_empty_label():
+    """label_length 0 → nll of the all-blank path exactly."""
+    T, C = 3, 3
+    logits = np.asarray(A(1, T, C))
+    logp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), -1))[0]
+    want = -logp[:, 0].sum()
+    got = float(get_op("ctc_loss")(
+        jnp.asarray([[0, 0]]), jnp.asarray(logits),
+        jnp.asarray([0.0]), jnp.asarray([float(T)])))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_ctc_loss_respects_logit_lengths():
+    """Padded time steps beyond logit_length must not change the nll."""
+    T, C = 5, 3
+    logits = np.asarray(A(1, T, C))
+    base = float(get_op("ctc_loss")(
+        jnp.asarray([[1, 2]]), jnp.asarray(logits[:, :4]),
+        jnp.asarray([2.0]), jnp.asarray([4.0])))
+    padded = logits.copy()
+    padded[:, 4:] = R.standard_normal((1, 1, C)) * 50  # garbage pad
+    got = float(get_op("ctc_loss")(
+        jnp.asarray([[1, 2]]), jnp.asarray(padded),
+        jnp.asarray([2.0]), jnp.asarray([4.0])))
+    np.testing.assert_allclose(got, base, rtol=1e-6)
+
+
+def _leaves(out):
+    return [o for o in jax.tree.leaves(out)
+            if jnp.issubdtype(jnp.asarray(o).dtype, jnp.inexact)]
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_op_forward_and_grad(name):
+    fn = get_op(name)
+    for args, kwargs, grad, golden in CASES[name]:
+        with jax.enable_x64(True):
+            jargs = [jnp.asarray(a) for a in args]
+            out = fn(*jargs, **kwargs)
+            for leaf in jax.tree.leaves(out):
+                assert np.isfinite(
+                    np.asarray(leaf, dtype=np.float64)).all() or \
+                    not jnp.issubdtype(jnp.asarray(leaf).dtype,
+                                       jnp.inexact), \
+                    f"{name}: non-finite output"
+            if golden is not None:
+                want = golden(*[np.asarray(a) for a in args])
+                np.testing.assert_allclose(
+                    np.asarray(jax.tree.leaves(out)[0]), want,
+                    rtol=1e-6, atol=1e-8, err_msg=name)
+            if grad:
+                def scalar(*fa):
+                    o = fn(*fa, **kwargs)
+                    return sum(jnp.sum(l) for l in _leaves(o))
+                g = jax.grad(scalar, argnums=tuple(range(len(jargs))))(
+                    *jargs)
+                eps = 1e-6
+                for ai, ga in enumerate(g):
+                    flat = np.asarray(args[ai], np.float64).ravel()
+                    # probe a few indices
+                    for idx in range(0, flat.size,
+                                     max(1, flat.size // 3)):
+                        fp = flat.copy(); fp[idx] += eps
+                        fm = flat.copy(); fm[idx] -= eps
+                        sh = np.asarray(args[ai]).shape
+                        ap = [jnp.asarray(fp.reshape(sh))
+                              if j == ai else jargs[j]
+                              for j in range(len(jargs))]
+                        am = [jnp.asarray(fm.reshape(sh))
+                              if j == ai else jargs[j]
+                              for j in range(len(jargs))]
+                        fd = (float(scalar(*ap)) - float(scalar(*am))) \
+                            / (2 * eps)
+                        an = float(np.asarray(ga).ravel()[idx])
+                        assert abs(fd - an) <= 1e-4 * max(
+                            1.0, abs(fd), abs(an)), \
+                            f"{name} arg{ai}[{idx}]: fd={fd} grad={an}"

@@ -294,3 +294,41 @@ class ImageRecordReader(RecordReader):
 
     def reset(self):
         pass
+
+
+class BatchImageETL:
+    """Batched decode-to-device ETL tail (reference NativeImageLoader +
+    ImagePreProcessingScaler fused): decoded u8 [N,H,W,C] pixels →
+    normalized f32 NHWC with per-image random crop + horizontal flip.
+    The per-pixel loop runs in the threaded native runtime
+    (native/dl4j_tpu_native.cpp img_batch_normalize_u8) when available,
+    with an identical numpy fallback."""
+
+    def __init__(self, out_hw=None, mean=None, std=None,
+                 random_crop: bool = False, random_flip: bool = False,
+                 seed: int = 0, n_threads: int = 0):
+        self.out_hw = out_hw
+        self.mean = mean
+        self.std = std
+        self.random_crop = random_crop
+        self.random_flip = random_flip
+        self.n_threads = n_threads
+        self._rng = np.random.default_rng(seed)
+
+    def __call__(self, batch_u8: np.ndarray,
+                 train: bool = True) -> np.ndarray:
+        from deeplearning4j_tpu import native
+        n, h, w, _ = batch_u8.shape
+        oh, ow = self.out_hw or (h, w)
+        crops = flips = None
+        if train and self.random_crop and (oh < h or ow < w):
+            crops = np.stack(
+                [self._rng.integers(0, h - oh + 1, n),
+                 self._rng.integers(0, w - ow + 1, n)], 1)
+        elif oh < h or ow < w:           # eval: center crop
+            crops = np.tile([[(h - oh) // 2, (w - ow) // 2]], (n, 1))
+        if train and self.random_flip:
+            flips = self._rng.integers(0, 2, n).astype(np.uint8)
+        return native.img_batch_normalize(
+            batch_u8, out_hw=(oh, ow), mean=self.mean, std=self.std,
+            crop_offsets=crops, flips=flips, n_threads=self.n_threads)

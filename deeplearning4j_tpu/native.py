@@ -45,20 +45,30 @@ def _try_build() -> bool:
 
 def _load() -> Optional[ctypes.CDLL]:
     """Load (building if needed) the native library; None on failure."""
-    global _lib
+    global _lib, _build_failed
     if _lib is not None:
         return _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
+        if _build_failed:
+            return None
         if not os.path.exists(_SO_PATH) and not _try_build():
             return None
         try:
             lib = ctypes.CDLL(_SO_PATH)
         except OSError:
             return None
-        if lib.dl4j_tpu_native_abi_version() != 1:
-            return None
+        if lib.dl4j_tpu_native_abi_version() != 2:
+            # stale .so from an older ABI: rebuild (make sees the newer
+            # .cpp) and reload once; cache failure otherwise
+            if not _try_build():
+                _build_failed = True
+                return None
+            lib = ctypes.CDLL(_SO_PATH)
+            if lib.dl4j_tpu_native_abi_version() != 2:
+                _build_failed = True
+                return None
         # signatures
         lib.csv_parse_f32.restype = ctypes.c_int
         lib.csv_parse_f32.argtypes = [
@@ -108,6 +118,30 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.ring_close.argtypes = [ctypes.c_void_p]
         lib.ring_destroy.restype = None
         lib.ring_destroy.argtypes = [ctypes.c_void_p]
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        lib.img_batch_normalize_u8.restype = ctypes.c_int
+        lib.img_batch_normalize_u8.argtypes = [
+            u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), u8p, ctypes.c_int64,
+            ctypes.c_int64, f32p, f32p, f32p, ctypes.c_int]
+        lib.dl4j_crc32.restype = ctypes.c_uint32
+        lib.dl4j_crc32.argtypes = [u8p, ctypes.c_int64]
+        lib.chunk_count.restype = ctypes.c_int64
+        lib.chunk_count.argtypes = [ctypes.c_int64, ctypes.c_int64]
+        lib.chunk_frame_bytes.restype = ctypes.c_int64
+        lib.chunk_frame_bytes.argtypes = [ctypes.c_int64, ctypes.c_int64]
+        lib.chunk_message.restype = ctypes.c_int64
+        lib.chunk_message.argtypes = [
+            ctypes.c_uint64, u8p, ctypes.c_int64, ctypes.c_int64, u8p]
+        lib.chunk_parse_frame.restype = ctypes.c_int64
+        lib.chunk_parse_frame.argtypes = [
+            u8p, ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_int64)]
         _lib = lib
         return _lib
 
@@ -374,3 +408,201 @@ class RingQueue:
                 self._h = None
         except Exception:
             pass
+
+
+# ---------------------------------------------------------------------------
+# Image batch ETL (reference datavec NativeImageLoader hot path)
+# ---------------------------------------------------------------------------
+
+def img_batch_normalize(batch_u8: np.ndarray,
+                        out_hw=None,
+                        mean=None, std=None,
+                        crop_offsets=None, flips=None,
+                        n_threads: int = 0) -> np.ndarray:
+    """Decoded u8 [N,H,W,C] pixels → normalized f32 NHWC batch:
+    (x/255 − mean)/std, with optional per-image crop offsets and
+    horizontal flips (augmentation applied natively, decided by the
+    caller's rng). Threaded C++ when the native lib is present,
+    vectorized numpy otherwise — identical results either way."""
+    a = np.ascontiguousarray(batch_u8, np.uint8)
+    n, h, w, c = a.shape
+    oh, ow = out_hw if out_hw is not None else (h, w)
+    mean_a = (np.ascontiguousarray(mean, np.float32)
+              if mean is not None else None)
+    std_a = (np.ascontiguousarray(std, np.float32)
+             if std is not None else None)
+    cy = cx = None
+    if crop_offsets is not None:
+        off = np.ascontiguousarray(crop_offsets, np.int32)
+        cy, cx = np.ascontiguousarray(off[:, 0]), \
+            np.ascontiguousarray(off[:, 1])
+    fl = (np.ascontiguousarray(flips, np.uint8)
+          if flips is not None else None)
+    lib = _load()
+    if lib is not None:
+        out = np.empty((n, oh, ow, c), np.float32)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        rc = lib.img_batch_normalize_u8(
+            a.ctypes.data_as(u8p), n, h, w, c,
+            cy.ctypes.data_as(i32p) if cy is not None else None,
+            cx.ctypes.data_as(i32p) if cx is not None else None,
+            fl.ctypes.data_as(u8p) if fl is not None else None,
+            oh, ow,
+            mean_a.ctypes.data_as(f32p) if mean_a is not None else None,
+            std_a.ctypes.data_as(f32p) if std_a is not None else None,
+            out.ctypes.data_as(f32p), n_threads)
+        if rc == 0:
+            return out
+    # numpy fallback — same math
+    out = np.empty((n, oh, ow, c), np.float32)
+    for i in range(n):
+        y0 = int(cy[i]) if cy is not None else 0
+        x0 = int(cx[i]) if cx is not None else 0
+        y0 = max(0, min(y0, h - oh))
+        x0 = max(0, min(x0, w - ow))
+        img = a[i, y0:y0 + oh, x0:x0 + ow]
+        if fl is not None and fl[i]:
+            img = img[:, ::-1]
+        out[i] = img.astype(np.float32) / 255.0
+    if mean_a is not None:
+        out -= mean_a
+    if std_a is not None:
+        out /= np.where(std_a == 0, 1, std_a)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Chunked message framing (reference nd4j-aeron NDArray message
+# chunking/reassembly; ~64KB frames, crc-checked)
+# ---------------------------------------------------------------------------
+
+DEFAULT_CHUNK_BYTES = 64 * 1024
+_HEADER = 24  # u64 msg_id | u32 seq | u32 total | u32 len | u32 crc
+
+
+def crc32(data: bytes) -> int:
+    lib = _load()
+    if lib is not None:
+        buf = np.frombuffer(data, np.uint8) if data else \
+            np.empty(0, np.uint8)
+        p = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)) \
+            if len(buf) else None
+        return int(lib.dl4j_crc32(p, len(buf)))
+    import zlib
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def chunk_message(msg_id: int, payload: bytes,
+                  chunk_bytes: int = DEFAULT_CHUNK_BYTES) -> bytes:
+    """Frame a payload into crc-checked ~chunk_bytes frames (one
+    contiguous buffer; split on the wire as needed)."""
+    if chunk_bytes <= 0:
+        raise ValueError(f"chunk_bytes must be positive, got {chunk_bytes}")
+    lib = _load()
+    if lib is not None:
+        pl = np.frombuffer(payload, np.uint8) if payload else \
+            np.empty(0, np.uint8)
+        nbytes = lib.chunk_frame_bytes(len(pl), chunk_bytes)
+        out = np.empty(int(nbytes), np.uint8)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        n = lib.chunk_message(
+            msg_id, pl.ctypes.data_as(u8p) if len(pl) else None,
+            len(pl), chunk_bytes, out.ctypes.data_as(u8p))
+        if n > 0:
+            return out.tobytes()
+    # python fallback
+    import struct
+    total = max(1, -(-len(payload) // chunk_bytes))
+    frames = []
+    for seq in range(total):
+        part = payload[seq * chunk_bytes:(seq + 1) * chunk_bytes]
+        frames.append(struct.pack("<QIII", msg_id, seq, total,
+                                  len(part))
+                      + struct.pack("<I", crc32(part)) + part)
+    return b"".join(frames)
+
+
+def parse_frames(buf: bytes):
+    """Iterate (msg_id, seq, total, payload) over a frame buffer.
+    Raises ValueError on truncation or crc mismatch."""
+    import struct
+    lib = _load()
+    off = 0
+    view = memoryview(buf)
+    while off < len(buf):
+        if lib is not None:
+            arr = np.frombuffer(view[off:], np.uint8)
+            mid = ctypes.c_uint64()
+            seq = ctypes.c_uint32()
+            tot = ctypes.c_uint32()
+            plen = ctypes.c_uint32()
+            poff = ctypes.c_int64()
+            rc = lib.chunk_parse_frame(
+                arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                len(arr), ctypes.byref(mid), ctypes.byref(seq),
+                ctypes.byref(tot), ctypes.byref(plen),
+                ctypes.byref(poff))
+            if rc == -2:
+                raise ValueError("crc mismatch")
+            if rc < 0:
+                raise ValueError("truncated frame")
+            payload = bytes(view[off + poff.value:
+                                 off + poff.value + plen.value])
+            yield mid.value, seq.value, tot.value, payload
+            off += rc
+        else:
+            if off + _HEADER > len(buf):
+                raise ValueError("truncated frame")
+            mid, seq, tot, plen, crc = struct.unpack_from(
+                "<QIIII", buf, off)
+            payload = bytes(view[off + _HEADER:off + _HEADER + plen])
+            if len(payload) != plen:
+                raise ValueError("truncated frame")
+            if crc32(payload) != crc:
+                raise ValueError("crc mismatch")
+            yield mid, seq, tot, payload
+            off += _HEADER + plen
+
+
+class MessageReassembler:
+    """Out-of-order chunk reassembly (reference nd4j-aeron subscriber
+    side): feed frames from any interleaving of messages; complete
+    payloads are returned keyed by msg_id. Frames with inconsistent
+    numbering (seq >= total, or a total that disagrees with earlier
+    frames of the same message) are dropped and counted instead of
+    crashing the receive loop. Incomplete messages are evicted oldest-
+    first past ``max_pending`` (a lost frame must not leak its
+    siblings' memory forever)."""
+
+    def __init__(self, max_pending: int = 64):
+        self._partial: dict = {}       # mid -> (total, {seq: bytes})
+        self.max_pending = max_pending
+        self.dropped_frames = 0
+        self.evicted_messages = 0
+
+    def feed(self, frame_buf: bytes):
+        done = []
+        for mid, seq, tot, payload in parse_frames(frame_buf):
+            if tot <= 0 or seq >= tot:
+                self.dropped_frames += 1
+                continue
+            known_tot, parts = self._partial.get(mid, (tot, {}))
+            if tot != known_tot:
+                self.dropped_frames += 1
+                continue
+            parts[seq] = payload
+            self._partial[mid] = (known_tot, parts)
+            if len(parts) == known_tot:
+                done.append(
+                    (mid, b"".join(parts[i] for i in range(known_tot))))
+                del self._partial[mid]
+            while len(self._partial) > self.max_pending:
+                oldest = next(iter(self._partial))
+                del self._partial[oldest]
+                self.evicted_messages += 1
+        return done
+
+    def pending(self) -> int:
+        return len(self._partial)

@@ -33,6 +33,7 @@
 #include <deque>
 #include <mutex>
 #include <new>
+#include <thread>
 #include <vector>
 
 extern "C" {
@@ -281,9 +282,168 @@ void ring_destroy(void* handle) {
 }
 
 // ---------------------------------------------------------------------------
+// Image batch ETL (reference datavec-data-image NativeImageLoader hot
+// path: decoded u8 pixels -> normalized f32 NHWC batch; JavaCV/OpenCV
+// there, plain threaded C++ here — decode stays in Python/PIL, the
+// per-pixel convert/normalize/augment loop is the native part)
+// ---------------------------------------------------------------------------
+
+// in:  u8 [n, h, w, c] (already-decoded pixels)
+// out: f32 [n, out_h, out_w, c], (x/255 - mean[ch]) / std[ch]
+// crop_y/crop_x: per-image top-left crop offsets; flip: per-image
+// horizontal-flip flags (augmentation decided by the Python side's rng,
+// applied natively). n_threads <= 0 -> hardware concurrency.
+int img_batch_normalize_u8(const uint8_t* in, int64_t n, int64_t h,
+                           int64_t w, int64_t c, const int32_t* crop_y,
+                           const int32_t* crop_x, const uint8_t* flip,
+                           int64_t out_h, int64_t out_w,
+                           const float* mean, const float* stddev,
+                           float* out, int n_threads) {
+    if (out_h > h || out_w > w || c > 16) return -1;
+    float inv_std[16], mu[16];
+    for (int64_t ch = 0; ch < c; ++ch) {
+        mu[ch] = mean ? mean[ch] : 0.0f;
+        float sd = stddev ? stddev[ch] : 1.0f;
+        inv_std[ch] = 1.0f / (sd == 0.0f ? 1.0f : sd);
+    }
+    int nt = n_threads > 0
+                 ? n_threads
+                 : static_cast<int>(std::thread::hardware_concurrency());
+    nt = std::max(1, std::min<int>(nt, static_cast<int>(n)));
+    std::atomic<int64_t> next(0);
+    auto worker = [&] {
+        for (;;) {
+            int64_t i = next.fetch_add(1);
+            if (i >= n) return;
+            const uint8_t* src = in + i * h * w * c;
+            float* dst = out + i * out_h * out_w * c;
+            int64_t cy = crop_y ? crop_y[i] : 0;
+            int64_t cx = crop_x ? crop_x[i] : 0;
+            cy = std::max<int64_t>(0, std::min(cy, h - out_h));
+            cx = std::max<int64_t>(0, std::min(cx, w - out_w));
+            bool fl = flip && flip[i];
+            for (int64_t y = 0; y < out_h; ++y) {
+                const uint8_t* row = src + ((cy + y) * w + cx) * c;
+                for (int64_t x = 0; x < out_w; ++x) {
+                    int64_t sx = fl ? (out_w - 1 - x) : x;
+                    const uint8_t* px = row + sx * c;
+                    float* po = dst + (y * out_w + x) * c;
+                    for (int64_t ch = 0; ch < c; ++ch)
+                        po[ch] = (px[ch] * (1.0f / 255.0f) - mu[ch])
+                                 * inv_std[ch];
+                }
+            }
+        }
+    };
+    std::vector<std::thread> ts;
+    for (int t = 1; t < nt; ++t) ts.emplace_back(worker);
+    worker();
+    for (auto& t : ts) t.join();
+    return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Chunked message framing (reference nd4j-aeron AeronNDArrayPublisher/
+// Subscriber: ~64KB chunked NDArray messages with reassembly; the UDP
+// transport itself is replaced by jax collectives/DCN, but host-side
+// gradient shipping for DCN-constrained topologies still needs framing)
+//
+// Frame layout (little-endian):
+//   u64 msg_id | u32 seq | u32 total | u32 payload_len | u32 crc32
+//   followed by payload_len bytes.
+// ---------------------------------------------------------------------------
+
+static uint32_t crc32_table[256];
+static std::atomic<bool> crc_init_done(false);
+static std::mutex crc_init_mu;
+
+static void crc32_init() {
+    if (crc_init_done.load(std::memory_order_acquire)) return;
+    std::lock_guard<std::mutex> lk(crc_init_mu);
+    if (crc_init_done.load(std::memory_order_relaxed)) return;
+    for (uint32_t i = 0; i < 256; ++i) {
+        uint32_t r = i;
+        for (int j = 0; j < 8; ++j)
+            r = (r >> 1) ^ (0xEDB88320u & (~(r & 1) + 1));
+        crc32_table[i] = r;
+    }
+    crc_init_done.store(true, std::memory_order_release);
+}
+
+uint32_t dl4j_crc32(const uint8_t* p, int64_t n) {
+    crc32_init();
+    uint32_t crc = 0xFFFFFFFFu;
+    for (int64_t i = 0; i < n; ++i)
+        crc = (crc >> 8) ^ crc32_table[(crc ^ p[i]) & 0xFF];
+    return crc ^ 0xFFFFFFFFu;
+}
+
+static const int64_t kHeaderLen = 8 + 4 + 4 + 4 + 4;
+
+// Number of frames needed for a payload at the given chunk size.
+int64_t chunk_count(int64_t payload_len, int64_t chunk_bytes) {
+    if (chunk_bytes <= 0) return -1;
+    return payload_len == 0 ? 1
+                            : (payload_len + chunk_bytes - 1) / chunk_bytes;
+}
+
+int64_t chunk_frame_bytes(int64_t payload_len, int64_t chunk_bytes) {
+    int64_t n = chunk_count(payload_len, chunk_bytes);
+    return n < 0 ? -1 : n * kHeaderLen + payload_len;
+}
+
+// Serialize `payload` into consecutive frames in `out` (caller sizes it
+// with chunk_frame_bytes). Returns the frame count, or -1 on bad args.
+int64_t chunk_message(uint64_t msg_id, const uint8_t* payload,
+                      int64_t payload_len, int64_t chunk_bytes,
+                      uint8_t* out) {
+    int64_t total = chunk_count(payload_len, chunk_bytes);
+    if (total < 0) return -1;
+    uint8_t* p = out;
+    for (int64_t seq = 0; seq < total; ++seq) {
+        int64_t off = seq * chunk_bytes;
+        int64_t len = std::min(chunk_bytes, payload_len - off);
+        if (len < 0) len = 0;
+        uint32_t crc = dl4j_crc32(payload + off, len);
+        std::memcpy(p, &msg_id, 8);
+        uint32_t seq32 = static_cast<uint32_t>(seq);
+        uint32_t tot32 = static_cast<uint32_t>(total);
+        uint32_t len32 = static_cast<uint32_t>(len);
+        std::memcpy(p + 8, &seq32, 4);
+        std::memcpy(p + 12, &tot32, 4);
+        std::memcpy(p + 16, &len32, 4);
+        std::memcpy(p + 20, &crc, 4);
+        std::memcpy(p + kHeaderLen, payload + off, len);
+        p += kHeaderLen + len;
+    }
+    return total;
+}
+
+// Parse one frame at `buf` (which holds `len` readable bytes). Fills
+// header fields, sets *payload_off to the payload start offset, and
+// returns the total frame length, or -1 on truncation / -2 on CRC
+// mismatch.
+int64_t chunk_parse_frame(const uint8_t* buf, int64_t len,
+                          uint64_t* msg_id, uint32_t* seq,
+                          uint32_t* total, uint32_t* payload_len,
+                          int64_t* payload_off) {
+    if (len < kHeaderLen) return -1;
+    std::memcpy(msg_id, buf, 8);
+    std::memcpy(seq, buf + 8, 4);
+    std::memcpy(total, buf + 12, 4);
+    std::memcpy(payload_len, buf + 16, 4);
+    uint32_t crc;
+    std::memcpy(&crc, buf + 20, 4);
+    if (len < kHeaderLen + static_cast<int64_t>(*payload_len)) return -1;
+    if (dl4j_crc32(buf + kHeaderLen, *payload_len) != crc) return -2;
+    *payload_off = kHeaderLen;
+    return kHeaderLen + *payload_len;
+}
+
+// ---------------------------------------------------------------------------
 // ABI versioning
 // ---------------------------------------------------------------------------
 
-int dl4j_tpu_native_abi_version() { return 1; }
+int dl4j_tpu_native_abi_version() { return 2; }
 
 }  // extern "C"

@@ -183,3 +183,115 @@ def test_ring_queue_blocking_backpressure():
     assert done.wait(5), "put should unblock after get"
     t.join()
     q.close()
+
+
+def test_img_batch_normalize_native_matches_fallback():
+    from deeplearning4j_tpu import native
+    rng = np.random.default_rng(0)
+    batch = rng.integers(0, 256, (4, 10, 12, 3), dtype=np.uint8)
+    crops = np.stack([rng.integers(0, 3, 4), rng.integers(0, 5, 4)], 1)
+    flips = rng.integers(0, 2, 4).astype(np.uint8)
+    mean = np.array([0.485, 0.456, 0.406], np.float32)
+    std = np.array([0.229, 0.224, 0.225], np.float32)
+    kw = dict(out_hw=(8, 8), mean=mean, std=std,
+              crop_offsets=crops, flips=flips)
+    out = native.img_batch_normalize(batch, **kw)
+    # force the numpy fallback and compare
+    lib, native._lib = native._lib, None
+    bf, native._build_failed = native._build_failed, True
+    try:
+        ref = native.img_batch_normalize(batch, **kw)
+    finally:
+        native._lib, native._build_failed = lib, bf
+    assert out.shape == (4, 8, 8, 3)
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_batch_image_etl():
+    from deeplearning4j_tpu.data.image import BatchImageETL
+    rng = np.random.default_rng(1)
+    batch = rng.integers(0, 256, (3, 12, 12, 3), dtype=np.uint8)
+    etl = BatchImageETL(out_hw=(8, 8), random_crop=True,
+                        random_flip=True, seed=5)
+    out = etl(batch, train=True)
+    assert out.shape == (3, 8, 8, 3)
+    assert out.dtype == np.float32
+    assert 0.0 <= out.min() and out.max() <= 1.0
+    # eval path: deterministic center crop
+    e1, e2 = etl(batch, train=False), etl(batch, train=False)
+    np.testing.assert_array_equal(e1, e2)
+
+
+def test_chunk_message_roundtrip_and_reassembly():
+    from deeplearning4j_tpu import native
+    rng = np.random.default_rng(2)
+    payload = rng.integers(0, 256, 200_000, dtype=np.uint8).tobytes()
+    buf = native.chunk_message(7, payload, chunk_bytes=64 * 1024)
+    frames = list(native.parse_frames(buf))
+    assert len(frames) == 4 and all(f[0] == 7 for f in frames)
+    assert b"".join(f[3] for f in frames) == payload
+    # out-of-order, interleaved reassembly
+    buf2 = native.chunk_message(8, b"x" * 100, chunk_bytes=40)
+    f2 = list(native.parse_frames(buf2))
+    r = native.MessageReassembler()
+    order = [f2[2], frames[1], f2[0], frames[3], frames[0], f2[1],
+             frames[2]]
+    done = {}
+    for mid, seq, tot, pl in order:
+        import struct
+        fb = struct.pack("<QIII", mid, seq, tot, len(pl)) + \
+            struct.pack("<I", native.crc32(pl)) + pl
+        for m, p in r.feed(fb):
+            done[m] = p
+    assert done == {7: payload, 8: b"x" * 100}
+    assert r.pending() == 0
+    # corruption detected
+    bad = bytearray(buf)
+    bad[-1] ^= 0xFF
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        list(native.parse_frames(bytes(bad)))
+    # native crc equals zlib crc
+    import zlib
+    assert native.crc32(payload) == zlib.crc32(payload) & 0xFFFFFFFF
+
+
+def test_reassembler_rejects_malformed_and_evicts():
+    from deeplearning4j_tpu import native
+    import struct
+
+    def frame(mid, seq, tot, pl):
+        return struct.pack("<QIII", mid, seq, tot, len(pl)) + \
+            struct.pack("<I", native.crc32(pl)) + pl
+
+    r = native.MessageReassembler(max_pending=2)
+    # seq >= total: dropped, no crash
+    assert r.feed(frame(1, 5, 2, b"x")) == []
+    assert r.dropped_frames == 1
+    # inconsistent total across frames of one message: dropped
+    r.feed(frame(2, 0, 3, b"a"))
+    r.feed(frame(2, 1, 4, b"b"))
+    assert r.dropped_frames == 2
+    # eviction: three incomplete messages, max_pending=2
+    r2 = native.MessageReassembler(max_pending=2)
+    for mid in (10, 11, 12):
+        r2.feed(frame(mid, 0, 2, b"p"))
+    assert r2.pending() == 2 and r2.evicted_messages == 1
+    # the evicted message (oldest=10) can't complete; newest can
+    assert r2.feed(frame(12, 1, 2, b"q")) == [(12, b"pq")]
+
+
+def test_img_batch_normalize_negative_crops_clamped():
+    from deeplearning4j_tpu import native
+    batch = np.full((1, 6, 6, 1), 128, np.uint8)
+    out = native.img_batch_normalize(
+        batch, out_hw=(4, 4), crop_offsets=np.array([[-5, -3]]))
+    np.testing.assert_allclose(out, 128 / 255.0, rtol=1e-6)
+
+
+def test_chunk_message_rejects_bad_chunk_bytes():
+    from deeplearning4j_tpu import native
+    import pytest as _pytest
+    for bad in (0, -1):
+        with _pytest.raises(ValueError):
+            native.chunk_message(1, b"abc", chunk_bytes=bad)

@@ -71,14 +71,32 @@ class CheckpointListener(TrainingListener):
 
     def __init__(self, save_dir, save_every_n_iterations: Optional[int]
                  = None, save_every_n_epochs: Optional[int] = None,
-                 keep_last: int = 3):
+                 keep_last: int = 3, sharded: bool = False):
+        """``sharded=True`` switches from the zip ModelSerializer to the
+        orbax-backed ShardedCheckpointer (async, tensorstore layout) —
+        the multi-host/TP-sharded path; saves don't block the step."""
         self.dir = Path(save_dir)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.every_iter = save_every_n_iterations
         self.every_epoch = save_every_n_epochs
         self.keep_last = keep_last
+        self.sharded = sharded
+        self._ck = None
+        self._last_sharded_step = None
 
     def _save(self, net, tag: str):
+        if self.sharded:
+            if self._ck is None:
+                from deeplearning4j_tpu.serialization import \
+                    ShardedCheckpointer
+                self._ck = ShardedCheckpointer(self.dir,
+                                               keep_last=self.keep_last)
+            # steps are net.iteration: an epoch-end save right after an
+            # iteration-triggered one would collide — skip duplicates
+            if net.iteration != self._last_sharded_step:
+                self._ck.save(net.iteration, net)
+                self._last_sharded_step = net.iteration
+            return
         from deeplearning4j_tpu.serialization import ModelSerializer
         path = self.dir / f"checkpoint_{tag}.zip"
         ModelSerializer.write_model(net, path)
@@ -94,6 +112,15 @@ class CheckpointListener(TrainingListener):
     def on_epoch_end(self, net):
         if self.every_epoch and (net.epoch + 1) % self.every_epoch == 0:
             self._save(net, f"epoch_{net.epoch}")
+        # epoch boundary = async barrier: surfaces any background save
+        # error here instead of losing the checkpoint silently
+        self.flush()
+
+    def flush(self):
+        """Block until pending async sharded saves land (call after a
+        batch-API training loop that never crosses an epoch end)."""
+        if self._ck is not None:
+            self._ck.wait_until_finished()
 
 
 class EvaluativeListener(TrainingListener):

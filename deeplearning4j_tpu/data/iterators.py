@@ -49,6 +49,12 @@ class ListDataSetIterator(DataSetIterator):
         self.seed = seed
         self._epoch = 0
 
+    def __len__(self):
+        if isinstance(self._data, DataSet):
+            n = self._data.features.shape[0]
+            return -(-n // self.batch_size)
+        return len(self._data)
+
     def __iter__(self):
         data = self._data
         if isinstance(data, DataSet):
@@ -68,13 +74,20 @@ class AsyncDataSetIterator(DataSetIterator):
     the jitted step runs async anyway (dispatch returns immediately), so
     a small queue suffices to hide ETL latency."""
 
-    def __init__(self, base: DataSetIterator, queue_size: int = 4):
-        super().__init__(base.batch_size)
+    def __init__(self, base, queue_size: int = 4):
+        # base may be any iterable of DataSets (list, sharded view, …);
+        # batch_size is None when the base doesn't declare one — don't
+        # fabricate a number for downstream consumers
+        super().__init__(getattr(base, "batch_size", None))
         self.base = base
         self.queue_size = queue_size
 
+    def __len__(self):
+        return len(self.base)
+
     def reset(self):
-        self.base.reset()
+        if hasattr(self.base, "reset"):
+            self.base.reset()
 
     def __iter__(self):
         from deeplearning4j_tpu.native import RingQueue

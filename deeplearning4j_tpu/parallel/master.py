@@ -177,6 +177,18 @@ class ShardedDataSetIterator:
             if i % self.num_shards == self.shard_index:
                 yield ds
 
+    def __len__(self):
+        n = len(self.base)        # sized bases only (list, ListDSI…)
+        full, rem = divmod(n, self.num_shards)
+        return full + (1 if self.shard_index < rem else 0)
+
+    def __getattr__(self, name):
+        # delegate iterator metadata (batch_size, labels, …) to the base
+        # so wrappers like AsyncDataSetIterator see a normal iterator
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self.base, name)
+
 
 class SparkDl4jMultiLayer:
     """Reference ``SparkDl4jMultiLayer`` facade: distributed fit of a

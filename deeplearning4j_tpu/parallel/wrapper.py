@@ -225,19 +225,68 @@ class ParallelWrapper:
             raise ValueError(f"unknown mode {self.mode!r}")
 
     def fit(self, iterator, epochs: int = 1):
-        """Reference: ParallelWrapper.fit(DataSetIterator)."""
+        """Reference: ParallelWrapper.fit(DataSetIterator).
+
+        Multi-host (jax.process_count() > 1): every jitted step is a
+        collective spanning all hosts, so the processes must agree on
+        the number and shape of steps. The iterator (or its wrapped
+        base) must be sized (``__len__``); the per-epoch step count is
+        the cross-process minimum, each local batch is trimmed to the
+        cross-process minimum batch size, and a batch smaller than that
+        raises instead of desyncing the cluster.
+        """
         net = self.net
         if self._step is None:
             self._prepare()
         from deeplearning4j_tpu.data.iterators import AsyncDataSetIterator
+        from deeplearning4j_tpu.parallel.master import make_global_batch
+        multi = jax.process_count() > 1
+        # divisibility is a LOCAL constraint: this process's batch
+        # splits over its local devices; equal trims keep the global
+        # batch divisible by the full mesh
+        local_n = max(1, self.n // jax.process_count())
+        n_steps = None          # per-epoch step budget (multi-host)
+        b_local = None          # agreed per-process batch size
+        if multi:
+            from jax.experimental import multihost_utils as mhu
+            try:
+                n_local = len(iterator)
+            except TypeError:
+                raise ValueError(
+                    "multi-host ParallelWrapper.fit needs a sized "
+                    "iterator (len()) so all processes can agree on "
+                    "the step count") from None
+            counts = np.asarray(mhu.process_allgather(
+                jnp.asarray([n_local], jnp.int32)))
+            n_steps = int(counts.min())
+            first = next(iter(iterator))
+            b0 = first.features.shape[0] - (
+                first.features.shape[0] % local_n)
+            sizes = np.asarray(mhu.process_allgather(
+                jnp.asarray([b0], jnp.int32)))
+            b_local = int(sizes.min())
+            if b_local == 0:
+                raise ValueError(
+                    f"per-process batch ({first.features.shape[0]}) "
+                    f"smaller than local device count ({local_n})")
         it = AsyncDataSetIterator(iterator, self.prefetch_buffer) \
             if self.prefetch_buffer else iterator
         for _ in range(epochs):
             if hasattr(it, "reset"):
                 it.reset()
+            step_i = 0
             for ds in it:
+                if n_steps is not None and step_i >= n_steps:
+                    break               # stay in lockstep across hosts
                 x, y = ds.features, ds.labels
-                b = x.shape[0] - (x.shape[0] % self.n)
+                b = b_local if multi else \
+                    x.shape[0] - (x.shape[0] % self.n)
+                if multi and x.shape[0] < b:
+                    raise ValueError(
+                        f"batch of {x.shape[0]} smaller than the "
+                        f"agreed per-process size {b}: multi-host "
+                        "training needs uniform batches (drop or pad "
+                        "the ragged remainder)")
                 if b == 0:
                     import logging
                     logging.getLogger("deeplearning4j_tpu").warning(
@@ -245,7 +294,13 @@ class ParallelWrapper:
                         "(< %d workers); use batch sizes divisible by "
                         "the worker count", x.shape[0], self.n)
                     continue
-                x, y = jnp.asarray(x[:b]), jnp.asarray(y[:b])
+                step_i += 1
+                if multi:
+                    # each process feeds its local shard; assemble ONE
+                    # global device array spanning hosts
+                    x, y = make_global_batch(self.mesh, x[:b], y[:b])
+                else:
+                    x, y = jnp.asarray(x[:b]), jnp.asarray(y[:b])
                 rng = jax.random.fold_in(
                     jax.random.PRNGKey(net.conf.seed), net.iteration)
                 if self.mode == self.SYNC:

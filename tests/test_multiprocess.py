@@ -1,0 +1,99 @@
+"""TRUE multi-process distributed training test: two OS processes form
+a jax.distributed cluster on localhost (2 procs x 2 CPU devices = one
+4-device global mesh) and run SparkDl4jMultiLayer fit over it, each
+process feeding its shard. Reference analog: GradientSharingTrainingTest
+/ DelayedModelParameterServerTest simulate multi-node in ONE JVM
+(SURVEY §4); this exercises the real process boundary instead.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+WORKER = textwrap.dedent("""
+    import os, sys, warnings
+    sys.path.insert(0, %(repo)r)
+    warnings.filterwarnings("ignore")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=os.environ["COORD"],
+        num_processes=int(os.environ["NPROC"]),
+        process_id=int(os.environ["PROC_ID"]))
+    import numpy as np
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn import updaters as upd
+    from deeplearning4j_tpu.parallel import (
+        ParameterAveragingTrainingMaster, ShardedDataSetIterator,
+        SparkDl4jMultiLayer, make_mesh)
+
+    pid = jax.process_index()
+    conf = (NeuralNetConfiguration.builder().seed(42)
+            .updater(upd.Adam(learning_rate=0.05)).list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)          # same data on every proc
+    x = rng.standard_normal((448, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    # 7 batches -> UNEVEN round-robin shards (4 vs 3): processes must
+    # agree on the per-epoch step count instead of desyncing/hanging
+    data = [DataSet(x[i:i + 64], y[i:i + 64]) for i in range(0, 448, 64)]
+
+    master = (ParameterAveragingTrainingMaster.Builder(64)
+              .averaging_frequency(2).build())
+    trainer = SparkDl4jMultiLayer(net, master)
+    # each process trains on its round-robin shard of the batches
+    trainer.fit(ShardedDataSetIterator(data), epochs=8)
+    score = trainer.score()
+    print(f"proc {pid} score {score:.4f}", flush=True)
+    assert score < 0.4, score
+    # replicated params must be identical across processes: compare a
+    # checksum via a collective
+    leaf = jax.tree.leaves(net.params)[0]
+    s = float(jnp.sum(jnp.asarray(leaf)))
+    print(f"proc {pid} checksum {s:.6f}", flush=True)
+    print(f"proc {pid} DONE", flush=True)
+""")
+
+
+@pytest.mark.skipif(os.environ.get("DL4J_TPU_SKIP_MP") == "1",
+                    reason="multi-process test disabled")
+def test_two_process_distributed_training(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER % {"repo": repo})
+    port = 29500 + (os.getpid() % 500)
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ,
+                   COORD=f"127.0.0.1:{port}", NPROC="2",
+                   PROC_ID=str(pid),
+                   XLA_FLAGS="--xla_force_host_platform_device_count=2",
+                   JAX_PLATFORMS="cpu")
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
+        assert f"proc {pid} DONE" in out
+    # identical replicated params on both processes
+    import re
+    sums = [re.search(r"checksum (-?[\d.]+)", o).group(1) for o in outs]
+    assert sums[0] == sums[1], sums

@@ -4,6 +4,7 @@ iterators, normalizers) + datavec ETL (``data.records`` / ``transform``).
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.data.iterators import (
     DataSetIterator, ListDataSetIterator, AsyncDataSetIterator,
+    TfDataSetIterator,
 )
 from deeplearning4j_tpu.data.normalizers import (
     NormalizerStandardize, NormalizerMinMaxScaler,
@@ -18,6 +19,7 @@ from deeplearning4j_tpu.data.image import (
 
 __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
+    "TfDataSetIterator",
     "AsyncDataSetIterator", "NormalizerStandardize",
     "NormalizerMinMaxScaler", "ImagePreProcessingScaler",
     "NativeImageLoader", "ImageRecordReader", "ParentPathLabelGenerator",

@@ -138,3 +138,40 @@ class IteratorDataSetIterator(DataSetIterator):
                 xs, ys = [], []
         if xs:
             yield self._apply_pp(DataSet(np.stack(xs), np.stack(ys)))
+
+
+class TfDataSetIterator(DataSetIterator):
+    """Adapter: a ``tf.data.Dataset`` drives the training loop as a
+    DataSetIterator (SURVEY §7: RecordReader/TransformProcess API over
+    tf.data). Elements may be ``(features, labels)`` tuples or dicts
+    with 'features'/'labels' keys; tensors convert to numpy zero-copy
+    where tf allows. Re-iterating the dataset is tf.data's reset
+    semantics, so epochs restart cleanly (shuffle/reshuffle is the
+    dataset's own configuration).
+
+    ``batch_size=None`` (default): the dataset is already batched and
+    consumed as-is. A given ``batch_size`` applies
+    ``dataset.batch(batch_size)`` — the sibling iterators' contract,
+    for per-example datasets.
+    """
+
+    def __init__(self, dataset, batch_size: Optional[int] = None):
+        super().__init__(batch_size)
+        self.dataset = (dataset if batch_size is None
+                        else dataset.batch(batch_size))
+
+    def __len__(self):
+        n = int(self.dataset.cardinality())
+        if n < 0:                            # INFINITE or UNKNOWN
+            raise TypeError("tf.data cardinality unknown")
+        return n
+
+    def __iter__(self):
+        for el in self.dataset.as_numpy_iterator():
+            if isinstance(el, dict):
+                x, y = el["features"], el.get("labels")
+            else:
+                x, y = el if isinstance(el, (tuple, list)) else (el, None)
+            yield self._apply_pp(DataSet(np.asarray(x),
+                                         None if y is None
+                                         else np.asarray(y)))

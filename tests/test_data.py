@@ -163,3 +163,43 @@ def test_dataset_ops():
     assert sorted(sh.labels.tolist()) == list(range(10))
     m = DataSet.merge([tr, te])
     assert m.num_examples() == 10
+
+
+def test_tf_data_adapter():
+    tf = pytest.importorskip("tensorflow")
+    from deeplearning4j_tpu.data import TfDataSetIterator
+    import numpy as np
+    x = np.arange(40, dtype=np.float32).reshape(10, 4)
+    y = np.eye(2, dtype=np.float32)[np.arange(10) % 2]
+    ds = tf.data.Dataset.from_tensor_slices((x, y))
+    it = TfDataSetIterator(ds, batch_size=4)   # adapter applies .batch(4)
+    assert len(it) == 3
+    batches = list(it)
+    assert [b.features.shape[0] for b in batches] == [4, 4, 2]
+    np.testing.assert_array_equal(batches[0].features, x[:4])
+    # epochs restart cleanly; trains through the normal fit loop
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, \
+        NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    conf = (NeuralNetConfiguration.builder().seed(1).list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(it, epochs=2)
+    assert net.iteration == 6
+
+
+def test_tf_data_adapter_unlabeled_and_prebatched():
+    tf = pytest.importorskip("tensorflow")
+    from deeplearning4j_tpu.data import TfDataSetIterator
+    import numpy as np
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    # pre-batched dataset consumed as-is (batch_size=None)
+    pre = tf.data.Dataset.from_tensor_slices(x).batch(3)
+    batches = list(TfDataSetIterator(pre))
+    assert [b.features.shape for b in batches] == [(3, 2), (3, 2)]
+    # unlabeled elements keep labels None (not an object array)
+    assert all(b.labels is None for b in batches)

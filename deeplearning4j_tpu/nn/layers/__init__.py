@@ -16,6 +16,7 @@ from deeplearning4j_tpu.nn.layers.core import (
     DenseLayer, OutputLayer, LossLayer, ActivationLayer, DropoutLayer,
     EmbeddingLayer, EmbeddingSequenceLayer, ElementWiseMultiplicationLayer,
     BatchNormalization, LayerNormalization, LocalResponseNormalization,
+    CnnLossLayer, Cnn3DLossLayer,
 )
 from deeplearning4j_tpu.nn.layers.conv import (
     ConvolutionLayer, Convolution1DLayer, Convolution3DLayer,
@@ -23,14 +24,17 @@ from deeplearning4j_tpu.nn.layers.conv import (
     SeparableConvolution2DLayer, SubsamplingLayer, Subsampling1DLayer,
     Subsampling3DLayer, GlobalPoolingLayer, Upsampling2DLayer,
     ZeroPaddingLayer, CroppingLayer, SpaceToDepthLayer, DepthToSpaceLayer,
+    Upsampling1DLayer, Upsampling3DLayer,
 )
 from deeplearning4j_tpu.nn.layers.recurrent import (
     LSTM, GravesLSTM, SimpleRnn, GRU, Bidirectional, LastTimeStep,
     RnnOutputLayer, RnnLossLayer, MaskZeroLayer, TimeDistributed,
+    GravesBidirectionalLSTM,
 )
 from deeplearning4j_tpu.nn.layers.attention import (
     SelfAttentionLayer, LearnedSelfAttentionLayer, MultiHeadAttention,
     TransformerEncoderBlock, PositionalEmbeddingLayer, ClsTokenPoolLayer,
+    RecurrentAttentionLayer,
 )
 from deeplearning4j_tpu.nn.layers.special import (
     AutoEncoder, VariationalAutoencoder, CenterLossOutputLayer,

@@ -243,3 +243,62 @@ class ClsTokenPoolLayer(Layer):
 
     def propagate_mask(self, mask, out_len=None):
         return None                # sequence axis is gone
+
+
+@register_layer
+@dataclass
+class RecurrentAttentionLayer(Layer):
+    """Reference RecurrentAttentionLayer: a SimpleRnn whose step also
+    attends over the WHOLE input sequence with the previous hidden
+    state as query —
+    ``h_t = act(W·x_t + U·h_{t-1} + Wo·attn(h_{t-1}, X, X) + b)``.
+    K/V projections are one big MXU matmul outside the ``lax.scan``;
+    only the query/attend/update runs per step."""
+    n_in: Optional[int] = None
+    n_out: int = 0
+    n_heads: int = 1
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        n_in = self.n_in or input_shape[-1]
+        h = self.n_out
+        if h % self.n_heads:
+            raise ValueError(f"n_out={h} % n_heads={self.n_heads} != 0")
+        wi = winit.get(self.weight_init or "xavier")
+        ks = jax.random.split(key, 6)
+        params = {"W": wi(ks[0], (n_in, h), dtype),
+                  "U": wi(ks[1], (h, h), dtype),
+                  "Wq": wi(ks[2], (h, h), dtype),
+                  "Wk": wi(ks[3], (n_in, h), dtype),
+                  "Wv": wi(ks[4], (n_in, h), dtype),
+                  "Wo": wi(ks[5], (h, h), dtype),
+                  "b": jnp.zeros((h,), dtype)}
+        t = input_shape[0]
+        return params, {}, (t, h)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        b, t, _ = x.shape
+        h = self.n_out
+        nh = self.n_heads
+        hd = h // nh
+        dt = x.dtype
+        act = self._act("tanh")
+        xg = jnp.swapaxes(x @ params["W"] + params["b"], 0, 1)  # [T,B,H]
+        k = (x @ params["Wk"]).reshape(b, t, nh, hd)
+        v = (x @ params["Wv"]).reshape(b, t, nh, hd)
+        m = (jnp.ones((t, b, 1), dt) if mask is None
+             else jnp.swapaxes(mask, 0, 1)[..., None].astype(dt))
+        U, Wq, Wo = params["U"], params["Wq"], params["Wo"]
+
+        def step(hp, inp):
+            g, mt = inp
+            q = (hp @ Wq).reshape(b, 1, nh, hd)
+            a = scaled_dot_attention(q, k, v, mask).reshape(b, h)
+            hh = act(g + hp @ U + a @ Wo)
+            # masked steps hold state, emit zeros (module convention)
+            hn = mt * hh + (1 - mt) * hp
+            return hn, hh * mt
+
+        h0 = jnp.zeros((b, h), dt)
+        _, ys = jax.lax.scan(step, h0, (xg, m))
+        y = jnp.swapaxes(ys, 0, 1)
+        return self._maybe_dropout(y, train, rng), state

@@ -442,3 +442,49 @@ class DepthToSpaceLayer(Layer):
 
     def has_params(self):
         return False
+
+
+@register_layer
+@dataclass
+class Upsampling1DLayer(Layer):
+    """Nearest-neighbor upsampling over time (reference Upsampling1D),
+    [B, T, C]."""
+    size: int = 2
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        t = input_shape[0]
+        return {}, {}, (None if t is None else t * self.size,
+                        input_shape[1])
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.repeat(x, self.size, axis=1), state
+
+    def propagate_mask(self, mask, input_shape):
+        # time axis grows T -> T*size; stretch the mask with it
+        return None if mask is None else jnp.repeat(mask, self.size,
+                                                    axis=1)
+
+    def has_params(self):
+        return False
+
+
+@register_layer
+@dataclass
+class Upsampling3DLayer(Layer):
+    """Nearest-neighbor upsampling (reference Upsampling3D),
+    [B, D, H, W, C]."""
+    size: Sequence[int] = (2, 2, 2)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        s = _tup(self.size, 3)
+        return {}, {}, (input_shape[0] * s[0], input_shape[1] * s[1],
+                        input_shape[2] * s[2], input_shape[3])
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        s = _tup(self.size, 3)
+        for ax, r in zip((1, 2, 3), s):
+            x = jnp.repeat(x, r, axis=ax)
+        return x, state
+
+    def has_params(self):
+        return False

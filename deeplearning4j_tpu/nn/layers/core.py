@@ -286,3 +286,19 @@ class LocalResponseNormalization(Layer):
 
     def has_params(self):
         return False
+
+
+@register_layer
+@dataclass
+class CnnLossLayer(LossLayer):
+    """Per-position loss over [B, H, W, C] feature maps (reference
+    CnnLossLayer — segmentation-style heads where every spatial
+    position carries a label). Loss machinery is the network's
+    (labels shaped like the activations); this layer applies the
+    activation only."""
+
+
+@register_layer
+@dataclass
+class Cnn3DLossLayer(LossLayer):
+    """Reference Cnn3DLossLayer — [B, D, H, W, C] per-position loss."""

@@ -396,3 +396,22 @@ class RnnOutputLayer(OutputLayer):
 @dataclass
 class RnnLossLayer(LossLayer):
     """Loss-only over sequences (reference RnnLossLayer)."""
+
+
+@register_layer
+@dataclass
+class GravesBidirectionalLSTM(Bidirectional):
+    """Reference GravesBidirectionalLSTM — forward and backward
+    GravesLSTM passes SUMMED (reference semantics: output width stays
+    ``n_out``, unlike Bidirectional's default concat)."""
+    n_in: Optional[int] = None
+    n_out: int = 0
+    mode: str = "add"
+
+    def __post_init__(self):
+        if self.fwd is None:
+            self.fwd = GravesLSTM(
+                n_in=self.n_in, n_out=self.n_out,
+                activation=self.activation,
+                weight_init=self.weight_init, dropout=self.dropout,
+                l1=self.l1, l2=self.l2, bias_init=self.bias_init)

@@ -264,3 +264,62 @@ def test_dropout_train_vs_infer():
 
 def test_prelu():
     _gradcheck_layer(PReLULayer(), (4,))
+
+
+def test_recurrent_attention_gradcheck():
+    from deeplearning4j_tpu.nn.layers import RecurrentAttentionLayer
+    _gradcheck_layer(RecurrentAttentionLayer(n_out=4, n_heads=2),
+                     (3, 2), tol=5e-4)
+
+
+def test_graves_bidirectional_lstm():
+    from deeplearning4j_tpu.nn.layers import GravesBidirectionalLSTM
+    layer = GravesBidirectionalLSTM(n_out=3)
+    params, state, out = layer.init(KEY, (5, 2))
+    x = jax.random.normal(KEY, (2, 5, 2))
+    y, _ = layer.apply(params, state, x)
+    assert y.shape == (2, 5, 3)          # reference semantics: summed
+    # weight_init/dropout forwarded to the wrapped GravesLSTM
+    l2 = GravesBidirectionalLSTM(n_out=3, weight_init="uniform",
+                                 dropout=0.1)
+    assert l2.fwd.weight_init == "uniform" and l2.fwd.dropout == 0.1
+
+
+def test_upsampling_1d_3d_and_cnn_loss():
+    from deeplearning4j_tpu.nn.layers import (Cnn3DLossLayer,
+                                              CnnLossLayer,
+                                              Upsampling1DLayer,
+                                              Upsampling3DLayer)
+    x1 = jax.random.normal(KEY, (2, 4, 3))
+    up1 = Upsampling1DLayer(size=3)
+    y1, _ = up1.apply({}, {}, x1)
+    assert y1.shape == (2, 12, 3)
+    m = jnp.asarray(np.array([[1, 1, 0, 0], [1, 0, 0, 0]], np.float32))
+    assert up1.propagate_mask(m, (4, 3)).shape == (2, 12)
+    np.testing.assert_array_equal(np.asarray(y1[:, 0]),
+                                  np.asarray(y1[:, 2]))
+    x3 = jax.random.normal(KEY, (1, 2, 3, 4, 2))
+    y3, _ = Upsampling3DLayer(size=(2, 2, 2)).apply({}, {}, x3)
+    assert y3.shape == (1, 4, 6, 8, 2)
+    xl = jax.random.normal(KEY, (2, 3, 3, 4))
+    yl, _ = CnnLossLayer(loss="mse", activation="sigmoid").apply({}, {}, xl)
+    assert yl.shape == xl.shape and float(yl.min()) >= 0.0
+    y3l, _ = Cnn3DLossLayer().apply({}, {}, x3)
+    assert y3l.shape == x3.shape
+
+
+def test_recurrent_attention_mask_holds_state():
+    from deeplearning4j_tpu.nn.layers import RecurrentAttentionLayer
+    layer = RecurrentAttentionLayer(n_out=4, n_heads=2)
+    params, state, _ = layer.init(KEY, (5, 3))
+    x = jax.random.normal(KEY, (2, 5, 3))
+    m = jnp.asarray(np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]],
+                             np.float32))
+    y, _ = layer.apply(params, state, x, mask=m)
+    # masked positions emit zeros
+    np.testing.assert_array_equal(np.asarray(y[0, 3:]), 0.0)
+    # valid prefix must not depend on what lies beyond the mask
+    x2 = x.at[0, 3:].set(123.0)
+    y2, _ = layer.apply(params, state, x2, mask=m)
+    np.testing.assert_allclose(np.asarray(y[0, :3]),
+                               np.asarray(y2[0, :3]), rtol=1e-5)

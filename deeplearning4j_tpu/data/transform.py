@@ -271,3 +271,195 @@ class TransformProcess:
             schema = TransformProcess(schema, [(kind, arg)]
                                       ).final_schema()
         return rows
+
+
+def _stdev(v):
+    m = sum(v) / len(v)
+    return (sum((x - m) ** 2 for x in v) / max(1, len(v) - 1)) ** 0.5
+
+
+class Reducer:
+    """Group-by aggregation over records (reference
+    ``org.datavec.api.transform.reduce.Reducer`` + ``IAssociativeReducer``):
+    rows sharing the key column values collapse to one row per group,
+    non-key columns aggregated by the named op.
+
+    Ops: sum, mean, min, max, count, range, stdev, first, last,
+    count_unique.
+    """
+
+    _OPS = {
+        "sum": lambda v: float(sum(v)),
+        "mean": lambda v: float(sum(v)) / len(v),
+        "min": lambda v: min(v),
+        "max": lambda v: max(v),
+        "count": lambda v: len(v),
+        "range": lambda v: max(v) - min(v),
+        "stdev": _stdev,
+        "first": lambda v: v[0],
+        "last": lambda v: v[-1],
+        "count_unique": lambda v: len(set(v)),
+    }
+
+    class Builder:
+        def __init__(self, *key_columns: str):
+            self._keys = list(key_columns)
+            self._ops: Dict[str, str] = {}
+            self._default = "first"
+
+        def default_op(self, op: str):
+            self._default = op
+            return self
+
+        def _add(self, op, names):
+            for n in names:
+                self._ops[n] = op
+            return self
+
+        def sum_columns(self, *names):
+            return self._add("sum", names)
+
+        def mean_columns(self, *names):
+            return self._add("mean", names)
+
+        def min_columns(self, *names):
+            return self._add("min", names)
+
+        def max_columns(self, *names):
+            return self._add("max", names)
+
+        def count_columns(self, *names):
+            return self._add("count", names)
+
+        def stdev_columns(self, *names):
+            return self._add("stdev", names)
+
+        def count_unique_columns(self, *names):
+            return self._add("count_unique", names)
+
+        def build(self) -> "Reducer":
+            r = Reducer()
+            r.keys = self._keys
+            r.ops = dict(self._ops)
+            r.default = self._default
+            return r
+
+    def reduce(self, schema: Schema, records) -> List[List[Any]]:
+        names = schema.names()
+        kidx = [names.index(k) for k in self.keys]
+        vidx = [i for i in range(len(names)) if i not in kidx]
+        groups: Dict[tuple, List] = {}
+        order: List[tuple] = []
+        for r in records:
+            key = tuple(r[i] for i in kidx)
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append(r)
+        out = []
+        for key in order:
+            rows = groups[key]
+            row = list(key)
+            for i in vidx:
+                op = self.ops.get(names[i], self.default)
+                row.append(self._OPS[op]([r[i] for r in rows]))
+            out.append(row)
+        return out
+
+    def output_schema(self, schema: Schema) -> Schema:
+        names = schema.names()
+        out = Schema()
+        cols = []
+        for k in self.keys:
+            c = schema.columns[names.index(k)]
+            cols.append(c)
+        for i, n in enumerate(names):
+            if n not in self.keys:
+                op = self.ops.get(n, self.default)
+                if op in ("count", "count_unique"):
+                    cols.append((n, "integer", None))
+                elif op in ("first", "last", "min", "max"):
+                    # value-preserving ops keep the source column type
+                    cols.append(schema.columns[i])
+                else:
+                    cols.append((n, "double", None))
+        out.columns = cols
+        return out
+
+
+class Join:
+    """Join two record sets on key columns (reference
+    ``org.datavec.api.transform.join.Join``): Inner, LeftOuter,
+    RightOuter, FullOuter; missing sides fill with None."""
+
+    INNER = "Inner"
+    LEFT_OUTER = "LeftOuter"
+    RIGHT_OUTER = "RightOuter"
+    FULL_OUTER = "FullOuter"
+
+    class Builder:
+        def __init__(self, join_type: str = "Inner"):
+            valid = (Join.INNER, Join.LEFT_OUTER, Join.RIGHT_OUTER,
+                     Join.FULL_OUTER)
+            if join_type not in valid:
+                raise ValueError(f"join_type {join_type!r} not one of "
+                                 f"{valid}")
+            self._type = join_type
+            self._left = None
+            self._right = None
+            self._keys = []
+
+        def set_schemas(self, left: Schema, right: Schema):
+            self._left, self._right = left, right
+            return self
+
+        def set_keys(self, *names: str):
+            self._keys = list(names)
+            return self
+
+        def build(self) -> "Join":
+            j = Join()
+            j.join_type = self._type
+            j.left_schema = self._left
+            j.right_schema = self._right
+            j.keys = self._keys
+            return j
+
+    def output_schema(self) -> Schema:
+        out = Schema()
+        out.columns = list(self.left_schema.columns) + [
+            c for c in self.right_schema.columns
+            if c[0] not in self.keys]
+        return out
+
+    def execute(self, left_records, right_records) -> List[List[Any]]:
+        ln = self.left_schema.names()
+        rn = self.right_schema.names()
+        lk = [ln.index(k) for k in self.keys]
+        rk = [rn.index(k) for k in self.keys]
+        rv = [i for i in range(len(rn)) if i not in rk]
+        right_by_key: Dict[tuple, List] = {}
+        for r in right_records:
+            right_by_key.setdefault(tuple(r[i] for i in rk), []).append(r)
+        out = []
+        matched_right = set()
+        for l in left_records:
+            key = tuple(l[i] for i in lk)
+            matches = right_by_key.get(key, [])
+            if matches:
+                matched_right.add(key)
+                for r in matches:
+                    out.append(list(l) + [r[i] for i in rv])
+            elif self.join_type in (self.LEFT_OUTER, self.FULL_OUTER):
+                out.append(list(l) + [None] * len(rv))
+        if self.join_type in (self.RIGHT_OUTER, self.FULL_OUTER):
+            lv = len(ln)
+            for key, rows in right_by_key.items():
+                if key in matched_right:
+                    continue
+                for r in rows:
+                    row = [None] * lv
+                    for li, ri in zip(lk, rk):
+                        row[li] = r[ri]
+                    out.append(row + [r[i] for i in rv])
+        return out

@@ -203,3 +203,70 @@ def test_tf_data_adapter_unlabeled_and_prebatched():
     assert [b.features.shape for b in batches] == [(3, 2), (3, 2)]
     # unlabeled elements keep labels None (not an object array)
     assert all(b.labels is None for b in batches)
+
+
+def test_reducer_group_by():
+    from deeplearning4j_tpu.data.transform import Reducer, Schema
+    schema = (Schema.builder()
+              .add_column_string("city")
+              .add_column_double("temp")
+              .add_column_double("sales").build())
+    records = [["nyc", 10.0, 1.0], ["sf", 20.0, 2.0],
+               ["nyc", 30.0, 3.0], ["sf", 16.0, 4.0],
+               ["nyc", 20.0, 5.0]]
+    red = (Reducer.Builder("city")
+           .mean_columns("temp").sum_columns("sales").build())
+    out = red.reduce(schema, records)
+    assert out == [["nyc", 20.0, 9.0], ["sf", 18.0, 6.0]]
+    os_ = red.output_schema(schema)
+    assert os_.names() == ["city", "temp", "sales"]
+    # count/stdev/count_unique ops
+    red2 = (Reducer.Builder("city").count_columns("temp")
+            .count_unique_columns("sales").build())
+    out2 = red2.reduce(schema, records)
+    assert out2 == [["nyc", 3, 3], ["sf", 2, 2]]
+
+
+def test_join_types():
+    from deeplearning4j_tpu.data.transform import Join, Schema
+    left = (Schema.builder().add_column_integer("id")
+            .add_column_string("name").build())
+    right = (Schema.builder().add_column_integer("id")
+             .add_column_double("score").build())
+    L = [[1, "a"], [2, "b"], [3, "c"]]
+    R = [[2, 20.0], [3, 30.0], [4, 40.0]]
+
+    def mk(t):
+        return (Join.Builder(t).set_schemas(left, right)
+                .set_keys("id").build())
+    assert mk(Join.INNER).execute(L, R) == [[2, "b", 20.0],
+                                            [3, "c", 30.0]]
+    assert mk(Join.LEFT_OUTER).execute(L, R) == [
+        [1, "a", None], [2, "b", 20.0], [3, "c", 30.0]]
+    ro = mk(Join.RIGHT_OUTER).execute(L, R)
+    assert [2, "b", 20.0] in ro and [4, None, 40.0] in ro
+    fo = mk(Join.FULL_OUTER).execute(L, R)
+    assert [1, "a", None] in fo and [4, None, 40.0] in fo
+    assert mk(Join.INNER).output_schema().names() == ["id", "name",
+                                                      "score"]
+
+
+def test_reducer_schema_and_join_validation():
+    from deeplearning4j_tpu.data.transform import Join, Reducer, Schema
+    schema = (Schema.builder().add_column_string("k")
+              .add_column_string("label")
+              .add_column_double("v").build())
+    red = Reducer.Builder("k").mean_columns("v").build()  # label: first
+    os_ = red.output_schema(schema)
+    # value-preserving default op keeps the string type
+    assert os_.type_of("label") == "string"
+    assert os_.type_of("v") == "double"
+    out = red.reduce(schema, [["a", "x", 1.0], ["a", "y", 3.0]])
+    assert out == [["a", "x", 2.0]]
+    # stdev is correct (ddof=1)
+    red2 = Reducer.Builder("k").stdev_columns("v").build()
+    out2 = red2.reduce(schema, [["a", "x", 1.0], ["a", "y", 3.0]])
+    assert abs(out2[0][2] - 2 ** 0.5) < 1e-9
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        Join.Builder("left_outer")

@@ -6,6 +6,10 @@ from deeplearning4j_tpu.data.iterators import (
     DataSetIterator, ListDataSetIterator, AsyncDataSetIterator,
     TfDataSetIterator,
 )
+from deeplearning4j_tpu.data.datasets import (
+    EmnistDataSetIterator, Cifar10DataSetIterator, SvhnDataSetIterator,
+    IrisDataSetIterator,
+)
 from deeplearning4j_tpu.data.normalizers import (
     NormalizerStandardize, NormalizerMinMaxScaler,
     ImagePreProcessingScaler,
@@ -19,7 +23,7 @@ from deeplearning4j_tpu.data.image import (
 
 __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
-    "TfDataSetIterator",
+    "TfDataSetIterator", "EmnistDataSetIterator", "Cifar10DataSetIterator", "SvhnDataSetIterator", "IrisDataSetIterator",
     "AsyncDataSetIterator", "NormalizerStandardize",
     "NormalizerMinMaxScaler", "ImagePreProcessingScaler",
     "NativeImageLoader", "ImageRecordReader", "ParentPathLabelGenerator",

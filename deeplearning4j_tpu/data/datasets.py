@@ -1,0 +1,202 @@
+"""Dataset fetcher iterators beyond MNIST — reference:
+``org.deeplearning4j.datasets.iterator.impl`` (EmnistDataSetIterator,
+CifarDataSetIterator, IrisDataSetIterator, SvhnDataSetIterator;
+deeplearning4j-datasets fetchers).
+
+Same loading contract as ``data.mnist``: real files if present under
+``~/.deeplearning4j_tpu/<name>/`` (or ``$DL4J_TPU_<NAME>_DIR``),
+otherwise a DETERMINISTIC SYNTHETIC set marked ``synthetic=True`` —
+separable but not trivial, so models and pipelines exercise end-to-end
+without network egress.
+"""
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import DataSetIterator
+from deeplearning4j_tpu.data.mnist import _find_idx, _read_idx
+
+
+def _synthetic_images(n: int, n_classes: int, hw: int, channels: int,
+                      train: bool, seed: int) -> Tuple[np.ndarray,
+                                                       np.ndarray]:
+    """Class templates (low-frequency patterns per channel) + jitter +
+    noise — the mnist.py recipe generalized to any image shape."""
+    rng = np.random.default_rng(seed)      # templates shared train/test
+    block = max(1, hw // 8)
+    grid = -(-hw // block)                 # cover hw, crop the excess
+    base = rng.normal(size=(n_classes, grid, grid, channels))
+    templates = np.kron(base, np.ones((block, block, 1)))[:, :hw, :hw]
+    templates -= templates.min(axis=(1, 2), keepdims=True)
+    templates /= templates.max(axis=(1, 2), keepdims=True) + 1e-9
+
+    srng = np.random.default_rng(seed + (1 if train else 2))
+    labels = srng.integers(0, n_classes, n)
+    imgs = templates[labels]
+    shifts = srng.integers(-2, 3, (n, 2))
+    out = np.empty((n, hw, hw, channels), np.float32)
+    for i in range(n):
+        out[i] = np.roll(np.roll(imgs[i], shifts[i, 0], 0),
+                         shifts[i, 1], 1)
+    out += srng.normal(0, 0.3, out.shape).astype(np.float32)
+    return np.clip(out, 0, 1), labels
+
+
+class _ArrayDataSetIterator(DataSetIterator):
+    def __init__(self, x, labels, n_classes, batch_size):
+        super().__init__(batch_size)
+        self._x = x.astype(np.float32)
+        self._y = np.eye(n_classes, dtype=np.float32)[labels]
+
+    def __len__(self):
+        return -(-self._x.shape[0] // self.batch_size)
+
+    def __iter__(self):
+        b = self.batch_size
+        for i in range(0, self._x.shape[0], b):
+            yield self._apply_pp(DataSet(self._x[i:i + b],
+                                         self._y[i:i + b]))
+
+
+class EmnistDataSetIterator(_ArrayDataSetIterator):
+    """Reference EmnistDataSetIterator. Sets: LETTERS (26), DIGITS (10),
+    BALANCED (47), BYCLASS (62) — IDX files under the emnist dir if
+    present, synthetic otherwise."""
+
+    SETS = {"LETTERS": 26, "DIGITS": 10, "BALANCED": 47, "BYCLASS": 62}
+
+    @staticmethod
+    def _find_emnist(root: Path, set_name: str, train: bool):
+        """Standard EMNIST filenames:
+        emnist-<set>-{train,test}-images-idx3-ubyte[.gz]."""
+        part = "train" if train else "test"
+        img = f"emnist-{set_name}-{part}-images-idx3-ubyte"
+        lab = f"emnist-{set_name}-{part}-labels-idx1-ubyte"
+        for suffix in ("", ".gz"):
+            ip, lp = root / (img + suffix), root / (lab + suffix)
+            if ip.exists() and lp.exists():
+                return ip, lp
+        return None
+
+    def __init__(self, dataset: str = "LETTERS", batch_size: int = 64,
+                 train: bool = True, seed: int = 123,
+                 n_examples: Optional[int] = None,
+                 data_dir: Optional[str] = None):
+        if dataset.upper() not in self.SETS:
+            raise ValueError(f"unknown EMNIST set {dataset!r}; one of "
+                             f"{sorted(self.SETS)}")
+        n_classes = self.SETS[dataset.upper()]
+        root = Path(data_dir or os.environ.get(
+            "DL4J_TPU_EMNIST_DIR",
+            Path.home() / ".deeplearning4j_tpu" / "emnist"))
+        found = (self._find_emnist(root, dataset.lower(), train)
+                 or _find_idx(root, train))
+        if found:
+            imgs = _read_idx(found[0]).astype(np.float32) / 255.0
+            labels = _read_idx(found[1]).astype(np.int64)
+            # EMNIST LETTERS labels are 1-indexed; re-base to 0
+            labels = labels - labels.min()
+            x = imgs[..., None]
+            self.synthetic = False
+        else:
+            n = n_examples or (4096 if train else 1024)
+            x, labels = _synthetic_images(n, n_classes, 28, 1, train,
+                                          seed)
+            self.synthetic = True
+        if n_examples:
+            x, labels = x[:n_examples], labels[:n_examples]
+        super().__init__(x, labels, n_classes, batch_size)
+
+
+class Cifar10DataSetIterator(_ArrayDataSetIterator):
+    """Reference CifarDataSetIterator (CIFAR-10): binary batch files
+    under the cifar dir if present (data_batch_*.bin / test_batch.bin,
+    3072-byte RGB rows), synthetic 32x32x3 otherwise."""
+
+    def __init__(self, batch_size: int = 64, train: bool = True,
+                 seed: int = 123, n_examples: Optional[int] = None,
+                 data_dir: Optional[str] = None):
+        root = Path(data_dir or os.environ.get(
+            "DL4J_TPU_CIFAR_DIR",
+            Path.home() / ".deeplearning4j_tpu" / "cifar10"))
+        files = (sorted(root.glob("data_batch_*.bin")) if train
+                 else ([root / "test_batch.bin"]
+                       if (root / "test_batch.bin").exists() else []))
+        if files:
+            xs, ls = [], []
+            for f in files:
+                raw = np.frombuffer(f.read_bytes(), np.uint8)
+                rows = raw.reshape(-1, 3073)
+                ls.append(rows[:, 0].astype(np.int64))
+                xs.append(rows[:, 1:].reshape(-1, 3, 32, 32)
+                          .transpose(0, 2, 3, 1))      # NHWC
+            x = np.concatenate(xs).astype(np.float32) / 255.0
+            labels = np.concatenate(ls)
+            self.synthetic = False
+        else:
+            n = n_examples or (4096 if train else 1024)
+            x, labels = _synthetic_images(n, 10, 32, 3, train, seed)
+            self.synthetic = True
+        if n_examples:
+            x, labels = x[:n_examples], labels[:n_examples]
+        super().__init__(x, labels, 10, batch_size)
+
+
+class SvhnDataSetIterator(Cifar10DataSetIterator):
+    """Reference SvhnDataSetIterator — 32x32x3 digits; synthetic unless
+    pre-extracted under the svhn dir (same binary layout as cifar)."""
+
+    def __init__(self, batch_size: int = 64, train: bool = True,
+                 seed: int = 321, n_examples: Optional[int] = None,
+                 data_dir: Optional[str] = None):
+        root = data_dir or os.environ.get(
+            "DL4J_TPU_SVHN_DIR",
+            str(Path.home() / ".deeplearning4j_tpu" / "svhn"))
+        super().__init__(batch_size, train, seed, n_examples, root)
+
+
+class IrisDataSetIterator(_ArrayDataSetIterator):
+    """Reference IrisDataSetIterator: 150×4 → 3 classes. Real
+    ``iris.data`` CSV if present; otherwise deterministic Gaussian
+    clusters with iris-like class statistics."""
+
+    def __init__(self, batch_size: int = 150, n_examples: int = 150,
+                 seed: int = 12, data_dir: Optional[str] = None):
+        root = Path(data_dir or os.environ.get(
+            "DL4J_TPU_IRIS_DIR",
+            Path.home() / ".deeplearning4j_tpu" / "iris"))
+        csv = root / "iris.data"
+        if csv.exists():
+            names = {"Iris-setosa": 0, "Iris-versicolor": 1,
+                     "Iris-virginica": 2}
+            rows = [ln.split(",") for ln in
+                    csv.read_text().strip().splitlines() if ln.strip()]
+            x = np.asarray([[float(v) for v in r[:4]] for r in rows],
+                           np.float32)
+            labels = np.asarray([names[r[4].strip()] for r in rows],
+                                np.int64)
+            self.synthetic = False
+        else:
+            # class means/scales shaped like the real dataset
+            means = np.array([[5.0, 3.4, 1.5, 0.2],
+                              [5.9, 2.8, 4.3, 1.3],
+                              [6.6, 3.0, 5.6, 2.0]], np.float32)
+            scales = np.array([[0.35, 0.38, 0.17, 0.10],
+                               [0.52, 0.31, 0.47, 0.20],
+                               [0.64, 0.32, 0.55, 0.27]], np.float32)
+            rng = np.random.default_rng(seed)
+            per = -(-n_examples // 3)           # round up, trim below
+            labels = np.repeat(np.arange(3), per)[:n_examples]
+            x = (means[labels]
+                 + rng.normal(size=(labels.size, 4)).astype(np.float32)
+                 * scales[labels])
+            # deterministic shuffle: class-sorted batches starve SGD
+            perm = rng.permutation(labels.size)
+            x, labels = x[perm], labels[perm]
+            self.synthetic = True
+        super().__init__(x, labels, 3, batch_size)

@@ -1,0 +1,186 @@
+"""Parameter constraints + weight noise — reference:
+``org.deeplearning4j.nn.api.layers.LayerConstraint``
+(MaxNormConstraint, MinMaxNormConstraint, NonNegativeConstraint,
+UnitNormConstraint — applied to parameters AFTER each updater step,
+SURVEY §2.3 config-system row) and
+``org.deeplearning4j.nn.conf.weightnoise`` (WeightNoise, DropConnect —
+parameters perturbed during the training forward pass only).
+
+Both are pure functions of the param pytree, applied inside the jitted
+train step: constraints right after ``optax.apply_updates``, weight
+noise right before the forward. By default they touch weight matrices
+only (param keys not named like biases/norm-scales), matching the
+reference's ``applyToWeights``-default.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# params that are NOT weights (bias / norm scale-shift / running aux)
+_NON_WEIGHT_KEYS = {"b", "bo", "beta", "gamma", "g", "rb", "P"}
+
+
+def _is_weight(key: str) -> bool:
+    return key not in _NON_WEIGHT_KEYS
+
+
+def _map_weights(fn, params, apply_to_bias=False):
+    def rec(tree):
+        if isinstance(tree, dict):
+            return {k: (rec(v) if isinstance(v, dict)
+                        else (fn(v) if (apply_to_bias or _is_weight(k))
+                              else v))
+                    for k, v in tree.items()}
+        return tree
+    return rec(params)
+
+
+_CONSTRAINTS: Dict[str, type] = {}
+
+
+def _register(cls):
+    _CONSTRAINTS[cls.__name__] = cls
+    return cls
+
+
+@dataclass
+class BaseConstraint:
+    apply_to_bias: bool = False
+
+    def constrain(self, p):
+        raise NotImplementedError
+
+    def apply(self, params):
+        return _map_weights(self.constrain, params, self.apply_to_bias)
+
+    def to_dict(self):
+        return {"@class": type(self).__name__, **self.__dict__}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "BaseConstraint":
+        d = dict(d)
+        kind = d.pop("@class")
+        return _CONSTRAINTS[kind](**d)
+
+
+def _axis_norms(p, eps=1e-12):
+    # norm over all axes except the last (output/feature axis) —
+    # reference constraints normalize per output unit
+    axes = tuple(range(p.ndim - 1)) if p.ndim > 1 else (0,)
+    return jnp.sqrt(jnp.sum(jnp.square(p), axis=axes, keepdims=True)
+                    ) + eps
+
+
+@_register
+@dataclass
+class MaxNormConstraint(BaseConstraint):
+    """Reference MaxNormConstraint: rescale columns whose norm exceeds
+    ``max_norm``."""
+    max_norm: float = 2.0
+
+    def constrain(self, p):
+        n = _axis_norms(p)
+        return p * jnp.minimum(1.0, self.max_norm / n)
+
+
+@_register
+@dataclass
+class MinMaxNormConstraint(BaseConstraint):
+    """Reference MinMaxNormConstraint: clamp column norms into
+    [min_norm, max_norm], interpolated by ``rate``."""
+    min_norm: float = 0.5
+    max_norm: float = 2.0
+    rate: float = 1.0
+
+    def constrain(self, p):
+        n = _axis_norms(p)
+        clipped = jnp.clip(n, self.min_norm, self.max_norm)
+        target = self.rate * clipped + (1.0 - self.rate) * n
+        return p * (target / n)
+
+
+@_register
+@dataclass
+class NonNegativeConstraint(BaseConstraint):
+    """Reference NonNegativeConstraint: clip params at zero."""
+
+    def constrain(self, p):
+        return jnp.maximum(p, 0.0)
+
+
+@_register
+@dataclass
+class UnitNormConstraint(BaseConstraint):
+    """Reference UnitNormConstraint: rescale every column to norm 1."""
+
+    def constrain(self, p):
+        return p / _axis_norms(p)
+
+
+# ---------------------------------------------------------------------------
+# weight noise
+# ---------------------------------------------------------------------------
+_NOISES: Dict[str, type] = {}
+
+
+def _register_noise(cls):
+    _NOISES[cls.__name__] = cls
+    return cls
+
+
+@dataclass
+class BaseWeightNoise:
+    apply_to_bias: bool = False
+
+    def perturb(self, p, rng):
+        raise NotImplementedError
+
+    def apply(self, params, rng):
+        # single traversal: fold a fresh key per perturbed leaf
+        key_box = [rng]
+
+        def perturb(p):
+            key_box[0], sub = jax.random.split(key_box[0])
+            return self.perturb(p, sub)
+        return _map_weights(perturb, params, self.apply_to_bias)
+
+    def to_dict(self):
+        return {"@class": type(self).__name__, **self.__dict__}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "BaseWeightNoise":
+        d = dict(d)
+        kind = d.pop("@class")
+        return _NOISES[kind](**d)
+
+
+@_register_noise
+@dataclass
+class WeightNoise(BaseWeightNoise):
+    """Reference WeightNoise: gaussian noise on weights during the
+    training forward — additive (w + n) or multiplicative (w * (1+n))."""
+    stddev: float = 0.01
+    mean: float = 0.0
+    additive: bool = True
+
+    def perturb(self, p, rng):
+        n = self.mean + self.stddev * jax.random.normal(rng, p.shape,
+                                                        p.dtype)
+        return p + n if self.additive else p * (1.0 + n)
+
+
+@_register_noise
+@dataclass
+class DropConnect(BaseWeightNoise):
+    """Reference DropConnect: bernoulli mask on weights (inverted
+    scaling) during the training forward."""
+    weight_retain_prob: float = 0.5
+
+    def perturb(self, p, rng):
+        keep = self.weight_retain_prob
+        m = jax.random.bernoulli(rng, keep, p.shape)
+        return jnp.where(m, p / keep, 0.0).astype(p.dtype)

@@ -299,9 +299,42 @@ class ComputationGraph:
             isinstance(layer, OutputLayer)
         return loss_name, fused
 
+    def _apply_weight_noise(self, params, rng):
+        """Train-time weight noise per layer node (reference
+        WeightNoise / DropConnect)."""
+        out = dict(params)
+        for node in self.order:
+            wn = (getattr(node.obj, "weight_noise", None)
+                  if node.kind == "layer" else None)
+            if wn is not None and node.name in out:
+                rng, sub = jax.random.split(rng)
+                out[node.name] = wn.apply(out[node.name], sub)
+        return out
+
+    def _apply_constraints(self, params):
+        """Post-update parameter constraints (reference LayerConstraint)."""
+        out = dict(params)
+        for node in self.order:
+            cs = (getattr(node.obj, "constraints", None)
+                  if node.kind == "layer" else None)
+            if cs and node.name in out:
+                p = out[node.name]
+                for c in cs:
+                    p = c.apply(p)
+                out[node.name] = p
+        return out
+
+    def _has_weight_noise(self):
+        return any(node.kind == "layer"
+                   and getattr(node.obj, "weight_noise", None) is not None
+                   for node in self.order)
+
     def _loss_fn(self, params, state, inputs, labels, masks, lmasks, rng):
         any_fused = any(self._out_loss(o)[1] for o in self.conf.outputs)
         cd = self.conf.compute_dtype
+        if self._has_weight_noise():
+            nrng, rng = jax.random.split(rng)
+            params = self._apply_weight_noise(params, nrng)
         if cd is not None:
             # bf16 fwd/bwd, fp32 master params (grads return fp32)
             params = dtypes.cast_float_tree(params, cd)
@@ -332,6 +365,7 @@ class ComputationGraph:
         updates, opt_state = self._optimizer.update(grads, opt_state,
                                                     params)
         params = optax.apply_updates(params, updates)
+        params = self._apply_constraints(params)
         return params, opt_state, new_state, loss
 
     def _make_train_step(self):

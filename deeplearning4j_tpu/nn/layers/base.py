@@ -35,9 +35,21 @@ def layer_from_dict(d: Dict[str, Any]) -> "Layer":
     kwargs = {}
     for k, v in d.items():
         if k in nested:
-            # Re-hydrate nested layer beans (e.g. Bidirectional wrapping)
+            # Re-hydrate nested beans (wrapped layers, constraints,
+            # weight noise)
             if isinstance(v, dict) and "@class" in v:
-                v = layer_from_dict(v)
+                from deeplearning4j_tpu.nn import constraints as cmod
+                cname = v["@class"]
+                if cname in cmod._CONSTRAINTS:
+                    v = cmod.BaseConstraint.from_dict(v)
+                elif cname in cmod._NOISES:
+                    v = cmod.BaseWeightNoise.from_dict(v)
+                else:
+                    v = layer_from_dict(v)
+            elif (k == "constraints" and isinstance(v, list)):
+                from deeplearning4j_tpu.nn import constraints as cmod
+                v = [cmod.BaseConstraint.from_dict(c)
+                     if isinstance(c, dict) else c for c in v]
             kwargs[k] = v
     return cls(**kwargs)
 
@@ -65,6 +77,8 @@ class Layer:
     updater: Optional[Any] = None            # per-layer updater override
     learning_rate: Optional[float] = None    # per-layer LR override
     trainable: bool = True
+    constraints: Optional[list] = None       # post-update param constraints
+    weight_noise: Optional[Any] = None       # train-time weight noise
 
     # ---- serialization ---------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:
@@ -73,6 +87,9 @@ class Layer:
             v = getattr(self, f.name)
             if isinstance(v, Layer):
                 v = v.to_dict()
+            elif isinstance(v, list):
+                v = [e.to_dict() if hasattr(e, "to_dict") else e
+                     for e in v]
             elif hasattr(v, "to_dict") and not isinstance(v, type):
                 v = v.to_dict()
             out[f.name] = v

@@ -200,10 +200,39 @@ class MultiLayerNetwork:
                     total = total + 0.5 * l2v * jnp.sum(jnp.square(leaf))
         return total
 
+    def _apply_weight_noise(self, params, rng):
+        """Train-time weight noise per layer (reference WeightNoise /
+        DropConnect, conf.weightnoise) — perturbs the forward's view of
+        the params; the master params are untouched."""
+        out = dict(params)
+        for i, layer in enumerate(self.layers):
+            wn = getattr(layer, "weight_noise", None)
+            if wn is not None and _lname(i) in out:
+                rng, sub = jax.random.split(rng)
+                out[_lname(i)] = wn.apply(out[_lname(i)], sub)
+        return out
+
+    def _apply_constraints(self, params):
+        """Post-update parameter constraints per layer (reference
+        LayerConstraint, applied after the updater step)."""
+        out = dict(params)
+        for i, layer in enumerate(self.layers):
+            cs = getattr(layer, "constraints", None)
+            if cs and _lname(i) in out:
+                p = out[_lname(i)]
+                for c in cs:
+                    p = c.apply(p)
+                out[_lname(i)] = p
+        return out
+
     def _loss_fn(self, params, state, x, y, mask, lmask, rng):
         loss_name, fused = self._last_loss()
         cd = self.conf.compute_dtype
         master = params
+        if any(getattr(l, "weight_noise", None) is not None
+               for l in self.layers):
+            nrng, rng = jax.random.split(rng)
+            params = self._apply_weight_noise(params, nrng)
         if cd is not None:
             # bf16 fwd/bwd, fp32 master params: the cast is inside the
             # grad trace, so grads come back fp32 for the optimizer
@@ -231,6 +260,7 @@ class MultiLayerNetwork:
         updates, opt_state = self._optimizer.update(grads, opt_state,
                                                     params)
         params = optax.apply_updates(params, updates)
+        params = self._apply_constraints(params)
         return params, opt_state, new_state, loss
 
     def _make_train_step(self):
@@ -456,6 +486,10 @@ class MultiLayerNetwork:
         def loss_with_state(params, state, rnn_init, x, y, mask, lmask,
                             rng):
             master = params
+            if any(getattr(l, "weight_noise", None) is not None
+                   for l in self.layers):
+                nrng, rng = jax.random.split(rng)
+                params = self._apply_weight_noise(params, nrng)
             if cd is not None:
                 params = dtypes.cast_float_tree(params, cd)
                 x = dtypes.cast_float_tree(x, cd)
@@ -477,6 +511,7 @@ class MultiLayerNetwork:
             rnn_states = jax.tree.map(jax.lax.stop_gradient, rnn_states)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
+            params = self._apply_constraints(params)
             return params, opt_state, new_state, rnn_states, loss
 
         return jax.jit(step)

@@ -123,6 +123,7 @@ class ParallelWrapper:
                 self._loss, has_aux=True)(params, state, x, y, rng)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
+            params = net._apply_constraints(params)
             return params, opt_state, new_state, loss
 
         return jax.jit(
@@ -146,6 +147,7 @@ class ParallelWrapper:
             grads, acc_state = acc.exchange(grads, acc_state, "data")
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
+            params = net._apply_constraints(params)
             loss = jax.lax.pmean(loss, "data")
             acc_state = jax.tree.map(lambda a: a[None], acc_state)
             return params, opt_state, new_state, acc_state, loss
@@ -173,6 +175,7 @@ class ParallelWrapper:
                 self._loss, has_aux=True)(params, state, x, y, rng)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
+            params = net._apply_constraints(params)
             # every k-th iteration: replica averaging (reference
             # ParameterAveraging semantics)
             do_avg = (it % k) == (k - 1)

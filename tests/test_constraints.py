@@ -1,0 +1,138 @@
+"""Parameter constraints + weight noise (reference LayerConstraint /
+conf.weightnoise). Reference analog: TestConstraints,
+TestWeightNoise (deeplearning4j-core).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.config import InputType
+from deeplearning4j_tpu.nn.constraints import (DropConnect,
+                                               MaxNormConstraint,
+                                               MinMaxNormConstraint,
+                                               NonNegativeConstraint,
+                                               UnitNormConstraint,
+                                               WeightNoise)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn import updaters as upd
+
+
+def _col_norms(w):
+    return np.sqrt((np.asarray(w) ** 2).sum(0))
+
+
+def test_constraint_math():
+    w = jnp.asarray(np.random.default_rng(0)
+                    .standard_normal((6, 4)).astype(np.float32)) * 3.0
+    out = MaxNormConstraint(max_norm=1.0).constrain(w)
+    assert (_col_norms(out) <= 1.0 + 1e-5).all()
+    out = UnitNormConstraint().constrain(w)
+    np.testing.assert_allclose(_col_norms(out), 1.0, rtol=1e-5)
+    out = MinMaxNormConstraint(min_norm=2.0, max_norm=4.0).constrain(w)
+    n = _col_norms(out)
+    assert (n >= 2.0 - 1e-4).all() and (n <= 4.0 + 1e-4).all()
+    out = NonNegativeConstraint().constrain(-w)
+    assert (np.asarray(out) >= 0).all()
+    # bias untouched by default in the tree-level apply
+    params = {"W": w, "b": -jnp.ones((4,))}
+    ap = MaxNormConstraint(max_norm=0.1).apply(params)
+    np.testing.assert_array_equal(np.asarray(ap["b"]),
+                                  np.asarray(params["b"]))
+    assert (_col_norms(ap["W"]) <= 0.1 + 1e-5).all()
+
+
+def _net(layer_kw=None, out_kw=None):
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater(upd.Sgd(learning_rate=0.5)).list()
+            .layer(DenseLayer(n_out=8, activation="tanh",
+                              **(layer_kw or {})))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent", **(out_kw or {})))
+            .set_input_type(InputType.feed_forward(4)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_constraints_enforced_during_training():
+    net = _net(layer_kw={"constraints": [MaxNormConstraint(
+        max_norm=0.7)]})
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    for _ in range(10):           # big LR would push norms way past 0.7
+        net.fit(x, y)
+    w = net.params["layer_0"]["W"]
+    assert (_col_norms(w) <= 0.7 + 1e-4).all()
+    # the unconstrained layer's bias moved freely (nothing clipped it
+    # to the constrained layer's budget) — constraints are per-layer
+    assert "layer_1" in net.params
+    n1 = _col_norms(net.params["layer_1"]["W"])
+    w_init = _net().params["layer_1"]["W"]
+    assert not np.allclose(n1, _col_norms(w_init))
+
+
+def test_weight_noise_train_only():
+    net = _net(layer_kw={"weight_noise": WeightNoise(stddev=0.5)})
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((32, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    w_before = np.asarray(net.params["layer_0"]["W"]).copy()
+    net.fit(x, y)
+    # training ran with noise but the MASTER params moved only by the
+    # gradient step (no noise baked in): finite and changed
+    w_after = np.asarray(net.params["layer_0"]["W"])
+    assert np.isfinite(w_after).all() and not np.allclose(w_before,
+                                                          w_after)
+    # inference is noise-free and deterministic
+    o1, o2 = np.asarray(net.output(x)), np.asarray(net.output(x))
+    np.testing.assert_array_equal(o1, o2)
+
+
+def test_dropconnect_learns():
+    net = _net(layer_kw={"weight_noise": DropConnect(
+        weight_retain_prob=0.8)})
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((128, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    first = None
+    for _ in range(40):
+        net.fit(x, y)
+        if first is None:
+            first = net.score()
+    assert net.score() < first * 0.7
+
+
+def test_config_roundtrip_with_constraints_and_noise():
+    net = _net(layer_kw={"constraints": [UnitNormConstraint()],
+                         "weight_noise": DropConnect(
+                             weight_retain_prob=0.9)})
+    js = net.conf.to_json()
+    from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+    conf2 = MultiLayerConfiguration.from_json(js)
+    l0 = conf2.layers[0]
+    assert isinstance(l0.constraints[0], UnitNormConstraint)
+    assert isinstance(l0.weight_noise, DropConnect)
+    assert l0.weight_noise.weight_retain_prob == 0.9
+
+
+def test_constraints_and_noise_in_tbptt_path():
+    from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+    conf = (NeuralNetConfiguration.builder().seed(4)
+            .updater(upd.Sgd(learning_rate=0.5)).list()
+            .layer(LSTM(n_out=6, constraints=[MaxNormConstraint(
+                max_norm=0.5)],
+                weight_noise=WeightNoise(stddev=0.1)))
+            .layer(RnnOutputLayer(n_out=2, activation="softmax",
+                                  loss="mcxent"))
+            .backprop_type("TruncatedBPTT").tbptt_fwd_length(2)
+            .set_input_type(InputType.recurrent(3)).build())
+    net = MultiLayerNetwork(conf).init(input_shape=(8, 3))
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 8, 3)).astype(np.float32)
+    y = np.stack([(x[..., 0] > 0), (x[..., 0] <= 0)], -1).astype(
+        np.float32)
+    for _ in range(6):
+        net.fit(x, y)
+    for key in ("W", "U"):
+        n = _col_norms(net.params["layer_0"][key])
+        assert (n <= 0.5 + 1e-4).all(), (key, n.max())

@@ -270,3 +270,46 @@ def test_reducer_schema_and_join_validation():
     import pytest as _pytest
     with _pytest.raises(ValueError):
         Join.Builder("left_outer")
+
+
+def test_dataset_fetchers_synthetic():
+    from deeplearning4j_tpu.data import (Cifar10DataSetIterator,
+                                         EmnistDataSetIterator,
+                                         IrisDataSetIterator,
+                                         SvhnDataSetIterator)
+    em = EmnistDataSetIterator("LETTERS", batch_size=32, n_examples=128)
+    b = next(iter(em))
+    assert b.features.shape == (32, 28, 28, 1)
+    assert b.labels.shape == (32, 26) and em.synthetic
+    # deterministic across constructions
+    em2 = EmnistDataSetIterator("LETTERS", batch_size=32, n_examples=128)
+    np.testing.assert_array_equal(next(iter(em2)).features, b.features)
+    with pytest.raises(ValueError):
+        EmnistDataSetIterator("NOPE")
+
+    cf = Cifar10DataSetIterator(batch_size=16, n_examples=64)
+    bc = next(iter(cf))
+    assert bc.features.shape == (16, 32, 32, 3)
+    assert bc.labels.shape == (16, 10)
+    sv = SvhnDataSetIterator(batch_size=8, n_examples=32)
+    assert next(iter(sv)).features.shape == (8, 32, 32, 3)
+
+    ir = IrisDataSetIterator(batch_size=150)
+    bi = next(iter(ir))
+    assert bi.features.shape == (150, 4) and bi.labels.shape == (150, 3)
+    # separable enough to learn quickly
+    from deeplearning4j_tpu.nn import MultiLayerNetwork, \
+        NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn import updaters as upd
+    conf = (NeuralNetConfiguration.builder().seed(2)
+            .updater(upd.Adam(learning_rate=0.05)).list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(ir, epochs=60)
+    ev = net.evaluate(ir)
+    assert ev.accuracy() > 0.9, ev.accuracy()

@@ -1,0 +1,20 @@
+"""Examples smoke tests — every example runs end-to-end in FAST mode
+(reference analog: dl4j-examples compiled+run in CI)."""
+import os
+import runpy
+from pathlib import Path
+
+import pytest
+
+EXAMPLES = Path(__file__).parent.parent / "examples"
+
+
+@pytest.mark.parametrize("name", [
+    "lenet_mnist", "char_rnn_textgen", "bert_finetune",
+    "distributed_data_parallel", "samediff_autodiff",
+])
+def test_example_runs(name, monkeypatch, capsys):
+    monkeypatch.setenv("DL4J_TPU_EXAMPLE_FAST", "1")
+    runpy.run_path(str(EXAMPLES / f"{name}.py"), run_name="__main__")
+    out = capsys.readouterr().out
+    assert out.strip(), f"{name} produced no output"

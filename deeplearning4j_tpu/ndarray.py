@@ -319,6 +319,130 @@ class NDArray:
         return format(np.asarray(self._a), spec)
 
 
+
+    # -- row/column vector broadcasting (reference addRowVector etc.) ---
+    def _rowvec(self, other, op):
+        o = jnp.asarray(_unwrap(other)).reshape(1, -1)
+        return NDArray(op(self._a, o))
+
+    def _colvec(self, other, op):
+        o = jnp.asarray(_unwrap(other)).reshape(-1, 1)
+        return NDArray(op(self._a, o))
+
+    def add_row_vector(self, v):
+        return self._rowvec(v, jnp.add)
+
+    def sub_row_vector(self, v):
+        return self._rowvec(v, jnp.subtract)
+
+    def mul_row_vector(self, v):
+        return self._rowvec(v, jnp.multiply)
+
+    def div_row_vector(self, v):
+        return self._rowvec(v, jnp.divide)
+
+    def add_column_vector(self, v):
+        return self._colvec(v, jnp.add)
+
+    def sub_column_vector(self, v):
+        return self._colvec(v, jnp.subtract)
+
+    def mul_column_vector(self, v):
+        return self._colvec(v, jnp.multiply)
+
+    def div_column_vector(self, v):
+        return self._colvec(v, jnp.divide)
+
+    # -- row/column access (reference getRow/putRow/getColumn…) ---------
+    def get_row(self, i):
+        return NDArray(self._a[i])
+
+    def get_rows(self, *idx):
+        return NDArray(self._a[jnp.asarray(idx)])
+
+    def get_column(self, i):
+        return NDArray(self._a[:, i])
+
+    def get_columns(self, *idx):
+        return NDArray(self._a[:, jnp.asarray(idx)])
+
+    def put_row(self, i, v):
+        self._a = self._a.at[i].set(jnp.asarray(_unwrap(v)))
+        return self
+
+    def put_column(self, i, v):
+        self._a = self._a.at[:, i].set(jnp.asarray(_unwrap(v)))
+        return self
+
+    def put_scalar(self, idx, value):
+        if isinstance(idx, int):
+            idx = (idx,)
+        self._a = self._a.at[tuple(idx)].set(value)
+        return self
+
+    def get_double(self, *idx):
+        return float(self._a[tuple(idx)])
+
+    def get_int(self, *idx):
+        return int(self._a[tuple(idx)])
+
+    # -- number-returning reductions (reference sumNumber() etc.) -------
+    def sum_number(self):
+        return float(jnp.sum(self._a))
+
+    def mean_number(self):
+        return float(jnp.mean(self._a))
+
+    def max_number(self):
+        return float(jnp.max(self._a))
+
+    def min_number(self):
+        return float(jnp.min(self._a))
+
+    def std_number(self):
+        # Bessel-corrected like std() and the reference stdNumber()
+        return float(jnp.std(self._a, ddof=1))
+
+    def amax(self, axis=None, keepdims=False):
+        return NDArray(jnp.max(jnp.abs(self._a), axis=axis,
+                               keepdims=keepdims))
+
+    def amin(self, axis=None, keepdims=False):
+        return NDArray(jnp.min(jnp.abs(self._a), axis=axis,
+                               keepdims=keepdims))
+
+    def amean(self, axis=None, keepdims=False):
+        return NDArray(jnp.mean(jnp.abs(self._a), axis=axis,
+                                keepdims=keepdims))
+
+    # -- named comparisons (reference gt/lt/gte/lte return masks) -------
+    def gt(self, o):
+        return NDArray(self._a > jnp.asarray(_unwrap(o)))
+
+    def gte(self, o):
+        return NDArray(self._a >= jnp.asarray(_unwrap(o)))
+
+    def lt(self, o):
+        return NDArray(self._a < jnp.asarray(_unwrap(o)))
+
+    def lte(self, o):
+        return NDArray(self._a <= jnp.asarray(_unwrap(o)))
+
+    # -- distances (reference distance1/distance2/cosineSim) ------------
+    def distance1(self, o):
+        return float(jnp.sum(jnp.abs(self._a - _unwrap(o))))
+
+    def distance2(self, o):
+        return float(jnp.sqrt(jnp.sum(jnp.square(
+            self._a - _unwrap(o)))))
+
+    def cosine_sim(self, o):
+        b = jnp.asarray(_unwrap(o))
+        return float(jnp.sum(self._a * b)
+                     / (jnp.linalg.norm(self._a)
+                        * jnp.linalg.norm(b) + 1e-12))
+
+
 def _ndarray_unflatten(_, children):
     # Rebind the leaf directly: transforms (eval_shape, jit tracing) pass
     # tracer/ShapeDtypeStruct leaves that jnp.asarray would reject.
@@ -418,6 +542,154 @@ class Nd4j:
         if descending:
             out = jnp.flip(out, axis=axis)
         return NDArray(out)
+
+
+    @staticmethod
+    def zeros_like(a):
+        return NDArray(jnp.zeros_like(_unwrap(a)))
+
+    @staticmethod
+    def ones_like(a):
+        return NDArray(jnp.ones_like(_unwrap(a)))
+
+    @staticmethod
+    def scalar(value):
+        return NDArray(jnp.asarray(value))
+
+    @staticmethod
+    def empty(dtype=None):
+        return NDArray(jnp.zeros(
+            (0,), dtypes.resolve(dtype) if dtype is not None
+            else dtypes.default_dtype()))
+
+    @staticmethod
+    def diag(v):
+        return NDArray(jnp.diag(jnp.asarray(_unwrap(v))))
+
+    @staticmethod
+    def pile(*arrs):
+        """Stack along a new leading axis (reference Nd4j.pile)."""
+        return Nd4j.stack(0, *arrs)
+
+    @staticmethod
+    def rot90(a, k: int = 1):
+        return NDArray(jnp.rot90(jnp.asarray(_unwrap(a)), k))
+
+    @staticmethod
+    def pad(a, pad_width, mode="constant", value=0.0):
+        kw = {"constant_values": value} if mode == "constant" else {}
+        return NDArray(jnp.pad(jnp.asarray(_unwrap(a)), pad_width,
+                               mode=mode, **kw))
+
+    @staticmethod
+    def shuffle(a, seed=None):
+        """Permute rows (reference Nd4j.shuffle; functional here)."""
+        arr = jnp.asarray(_unwrap(a))
+        perm = jax.random.permutation(_next_key(seed), arr.shape[0])
+        return NDArray(arr[perm])
+
+    @staticmethod
+    def argsort(a, axis=-1):
+        return NDArray(jnp.argsort(jnp.asarray(_unwrap(a)), axis=axis))
+
+    @staticmethod
+    def to_flattened(*arrs):
+        """Concatenate raveled arrays (reference Nd4j.toFlattened)."""
+        return NDArray(jnp.concatenate(
+            [jnp.ravel(jnp.asarray(_unwrap(a))) for a in arrs]))
+
+
+class Transforms:
+    """Reference ``org.nd4j.linalg.ops.transforms.Transforms`` — the
+    eager math-helper namespace users reach for first."""
+
+    @staticmethod
+    def _wrap1(fn, a):
+        return NDArray(fn(jnp.asarray(_unwrap(a))))
+
+    sigmoid = staticmethod(lambda a: Transforms._wrap1(jax.nn.sigmoid, a))
+    tanh = staticmethod(lambda a: Transforms._wrap1(jnp.tanh, a))
+    relu = staticmethod(lambda a: Transforms._wrap1(jax.nn.relu, a))
+    leaky_relu = staticmethod(
+        lambda a, alpha=0.01: NDArray(jax.nn.leaky_relu(
+            jnp.asarray(_unwrap(a)), alpha)))
+    softmax = staticmethod(
+        lambda a, axis=-1: NDArray(jax.nn.softmax(
+            jnp.asarray(_unwrap(a)), axis=axis)))
+    exp = staticmethod(lambda a: Transforms._wrap1(jnp.exp, a))
+    log = staticmethod(lambda a: Transforms._wrap1(jnp.log, a))
+    sqrt = staticmethod(lambda a: Transforms._wrap1(jnp.sqrt, a))
+    abs = staticmethod(lambda a: Transforms._wrap1(jnp.abs, a))
+    sign = staticmethod(lambda a: Transforms._wrap1(jnp.sign, a))
+    floor = staticmethod(lambda a: Transforms._wrap1(jnp.floor, a))
+    ceil = staticmethod(lambda a: Transforms._wrap1(jnp.ceil, a))
+    round = staticmethod(lambda a: Transforms._wrap1(jnp.round, a))
+    sin = staticmethod(lambda a: Transforms._wrap1(jnp.sin, a))
+    cos = staticmethod(lambda a: Transforms._wrap1(jnp.cos, a))
+    asin = staticmethod(lambda a: Transforms._wrap1(jnp.arcsin, a))
+    acos = staticmethod(lambda a: Transforms._wrap1(jnp.arccos, a))
+    atan = staticmethod(lambda a: Transforms._wrap1(jnp.arctan, a))
+    hard_tanh = staticmethod(
+        lambda a: NDArray(jnp.clip(jnp.asarray(_unwrap(a)), -1, 1)))
+    soft_plus = staticmethod(
+        lambda a: Transforms._wrap1(jax.nn.softplus, a))
+    elu = staticmethod(lambda a: Transforms._wrap1(jax.nn.elu, a))
+
+    @staticmethod
+    def pow(a, p):
+        return NDArray(jnp.power(jnp.asarray(_unwrap(a)), _unwrap(p)))
+
+    @staticmethod
+    def max(a, b):
+        return NDArray(jnp.maximum(jnp.asarray(_unwrap(a)),
+                                   jnp.asarray(_unwrap(b))))
+
+    @staticmethod
+    def min(a, b):
+        return NDArray(jnp.minimum(jnp.asarray(_unwrap(a)),
+                                   jnp.asarray(_unwrap(b))))
+
+    @staticmethod
+    def unit_vec(a):
+        arr = jnp.asarray(_unwrap(a))
+        return NDArray(arr / (jnp.linalg.norm(arr) + 1e-12))
+
+    @staticmethod
+    def normalize_zero_mean_and_unit_variance(a):
+        arr = jnp.asarray(_unwrap(a))
+        return NDArray((arr - jnp.mean(arr, 0)) / (jnp.std(arr, 0)
+                                                   + 1e-12))
+
+    @staticmethod
+    def cosine_sim(a, b):
+        x = jnp.asarray(_unwrap(a)).ravel()
+        y = jnp.asarray(_unwrap(b)).ravel()
+        return float(jnp.dot(x, y) / (jnp.linalg.norm(x)
+                                      * jnp.linalg.norm(y) + 1e-12))
+
+    @staticmethod
+    def euclidean_distance(a, b):
+        return float(jnp.linalg.norm(jnp.asarray(_unwrap(a)).ravel()
+                                     - jnp.asarray(_unwrap(b)).ravel()))
+
+    @staticmethod
+    def manhattan_distance(a, b):
+        return float(jnp.sum(jnp.abs(
+            jnp.asarray(_unwrap(a)).ravel()
+            - jnp.asarray(_unwrap(b)).ravel())))
+
+    @staticmethod
+    def all_cosine_similarities(a, b):
+        """Pairwise cosine similarities between rows of a and b
+        (reference allCosineSimilarities)."""
+        x = jnp.asarray(_unwrap(a))
+        y = jnp.asarray(_unwrap(b))
+        xn = x / (jnp.linalg.norm(x, axis=1, keepdims=True) + 1e-12)
+        yn = y / (jnp.linalg.norm(y, axis=1, keepdims=True) + 1e-12)
+        # analytics helper, not a hot path: full-precision matmul (the
+        # TPU default bf16 MXU precision is visible at 1e-4 here)
+        return NDArray(jnp.matmul(xn, yn.T,
+                                  precision=jax.lax.Precision.HIGHEST))
 
 
 def _shape(shape) -> tuple:

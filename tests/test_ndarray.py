@@ -147,3 +147,62 @@ def test_pytree_registration():
     a = Nd4j.create([1.0, 2.0])
     out = jax.tree.map(lambda x: x, {"w": a})
     assert isinstance(out["w"], NDArray)
+
+
+def test_row_column_vector_ops_and_access():
+    from deeplearning4j_tpu.ndarray import Nd4j
+    a = Nd4j.create(np.arange(12.0).reshape(3, 4))
+    np.testing.assert_allclose(
+        a.add_row_vector([1, 1, 1, 1]).numpy()[0], [1, 2, 3, 4])
+    np.testing.assert_allclose(
+        a.mul_column_vector([1, 2, 3]).numpy()[2], [24, 27, 30, 33])
+    np.testing.assert_allclose(a.get_row(1).numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(a.get_column(2).numpy(), [2, 6, 10])
+    np.testing.assert_allclose(a.get_rows(0, 2).numpy().shape, (2, 4))
+    a.put_row(0, [9, 9, 9, 9]).put_scalar((1, 1), -1.0)
+    assert a.get_double(0, 3) == 9 and a.get_int(1, 1) == -1
+    assert a.sum_number() == 90.0  # 36 + (4-1+6+7) + 38
+    b = Nd4j.create(np.full((3, 4), 5.0))
+    assert a.gt(b).numpy().sum() == 10  # 9s row (4) + {6,7} + {8..11}
+
+
+def test_distances_and_transforms():
+    from deeplearning4j_tpu.ndarray import Nd4j, Transforms
+    v1, v2 = Nd4j.create([1.0, 0.0]), Nd4j.create([0.0, 1.0])
+    assert abs(v1.distance2(v2) - 2 ** 0.5) < 1e-6
+    assert v1.distance1(v2) == 2.0
+    assert abs(v1.cosine_sim(v2)) < 1e-6
+    assert float(Transforms.sigmoid(Nd4j.scalar(0.0)).item()) == 0.5
+    a = Nd4j.create(np.arange(6.0).reshape(2, 3))
+    s = Transforms.all_cosine_similarities(a, a)
+    np.testing.assert_allclose(np.diag(s.numpy()), 1.0, atol=1e-5)
+    u = Transforms.unit_vec(Nd4j.create([3.0, 4.0]))
+    np.testing.assert_allclose(u.numpy(), [0.6, 0.8], rtol=1e-6)
+    n = Transforms.normalize_zero_mean_and_unit_variance(
+        Nd4j.create(np.random.default_rng(0)
+                    .standard_normal((50, 3)) * 7 + 3))
+    assert abs(float(n.mean().item())) < 1e-5
+
+
+def test_nd4j_factory_extras():
+    from deeplearning4j_tpu.ndarray import Nd4j
+    a = Nd4j.create(np.arange(6.0).reshape(2, 3))
+    assert Nd4j.zeros_like(a).shape == (2, 3)
+    assert Nd4j.ones_like(a).sum_number() == 6.0
+    assert Nd4j.value_array_of((2, 2), 7.0).numpy().tolist() == \
+        [[7, 7], [7, 7]]
+    # std_number is Bessel-corrected like std()
+    v = Nd4j.create([1.0, 2.0, 3.0, 4.0])
+    assert abs(v.std_number() - float(v.std().item())) < 1e-6
+    # zero-norm cosine guard: no NaN
+    assert Nd4j.zeros((3,)).cosine_sim([1.0, 2.0, 3.0]) == 0.0
+    assert Nd4j.pile(a, a, a).shape == (3, 2, 3)
+    assert Nd4j.to_flattened(a, a).shape == (12,)
+    assert Nd4j.diag(Nd4j.create([1.0, 2.0])).numpy()[1, 1] == 2.0
+    assert Nd4j.rot90(a).shape == (3, 2)
+    assert Nd4j.pad(a, ((1, 1), (0, 0))).shape == (4, 3)
+    sh = Nd4j.shuffle(a, seed=0)
+    assert sorted(sh.numpy()[:, 0].tolist()) == [0.0, 3.0]
+    assert Nd4j.argsort(Nd4j.create([3.0, 1.0, 2.0])).numpy().tolist() \
+        == [1, 2, 0]
+    assert Nd4j.empty().length() == 0

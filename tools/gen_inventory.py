@@ -1,0 +1,75 @@
+"""Generate docs/INVENTORY.md — the auto-generated component inventory
+(analog of the reference's contrib/codegen-tools op-def generation:
+there it generates op classes + docs from definitions; here the living
+registries ARE the definitions, and this script renders them).
+
+    python tools/gen_inventory.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    import warnings
+    warnings.filterwarnings("ignore")
+    import deeplearning4j_tpu.nn.layers  # noqa: F401 (registers layers)
+    from deeplearning4j_tpu.autodiff.ops_registry import OPS
+    from deeplearning4j_tpu.nn.layers.base import _LAYER_REGISTRY
+    from deeplearning4j_tpu.ops import activations, losses
+    from deeplearning4j_tpu.nn import updaters as upd
+    from deeplearning4j_tpu.nn.constraints import _CONSTRAINTS, _NOISES
+    from deeplearning4j_tpu import zoo
+
+    lines = ["# Component inventory (auto-generated)",
+             "",
+             "Run `python tools/gen_inventory.py` to refresh.",
+             ""]
+
+    def section(title, names, per_line=6):
+        lines.append(f"## {title} ({len(names)})")
+        lines.append("")
+        names = sorted(names)
+        for i in range(0, len(names), per_line):
+            lines.append(", ".join(f"`{n}`"
+                                   for n in names[i:i + per_line]) + ",")
+        if lines[-1].endswith(","):
+            lines[-1] = lines[-1][:-1]
+        lines.append("")
+
+    section("SameDiff ops", list(OPS))
+    section("Layers", list(_LAYER_REGISTRY))
+    section("Activations", list(activations._REGISTRY))
+    section("Losses", list(losses._REGISTRY))
+    def all_subclasses(cls):
+        out = []
+        for c in cls.__subclasses__():
+            out.append(c.__name__)
+            out.extend(all_subclasses(c))
+        return out
+
+    section("Updaters", all_subclasses(upd.Updater))
+    scheds = [c.__name__ for c in upd.Schedule.__subclasses__()]
+    section("LR schedules", scheds)
+    section("Constraints", list(_CONSTRAINTS))
+    section("Weight noise", list(_NOISES))
+    import inspect
+    zoo_models = [n for n in dir(zoo)
+                  if inspect.isclass(getattr(zoo, n))
+                  or (callable(getattr(zoo, n)) and n[:1].isupper())]
+    section("Zoo models", zoo_models)
+
+    out = os.path.join(os.path.dirname(__file__), "..", "docs",
+                       "INVENTORY.md")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        f.write("\n".join(lines))
+    print(f"wrote {os.path.normpath(out)}:")
+    for ln in lines:
+        if ln.startswith("## "):
+            print(" ", ln[3:])
+
+
+if __name__ == "__main__":
+    main()

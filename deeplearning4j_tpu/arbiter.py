@@ -1,0 +1,192 @@
+"""Hyperparameter optimization — reference: the ``arbiter/`` module
+present in most fork vintages (SURVEY §0 note):
+``org.deeplearning4j.arbiter.optimize``'s ParameterSpace hierarchy,
+CandidateGenerator (random/grid search), and OptimizationRunner with
+score functions and termination conditions.
+
+TPU-native notes: candidates are independent full training runs; run
+them sequentially on one chip (each already saturates it) or fan out
+one candidate per slice in multi-host settings. The config-bean design
+makes a candidate just a dict of sampled values applied to a
+model-builder callable.
+"""
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# parameter spaces (reference org.deeplearning4j.arbiter.optimize.parameter)
+# ---------------------------------------------------------------------------
+class ParameterSpace:
+    def sample(self, rng) -> Any:
+        raise NotImplementedError
+
+    def grid(self, n: int) -> List[Any]:
+        raise NotImplementedError
+
+
+@dataclass
+class ContinuousParameterSpace(ParameterSpace):
+    """Uniform (or log-uniform) float range."""
+    min: float = 0.0
+    max: float = 1.0
+    log: bool = False
+
+    def __post_init__(self):
+        if self.min >= self.max:
+            raise ValueError(f"min {self.min} >= max {self.max}")
+        if self.log and self.min <= 0:
+            raise ValueError(
+                f"log-uniform space needs min > 0, got {self.min}")
+
+    def sample(self, rng):
+        if self.log:
+            lo, hi = math.log(self.min), math.log(self.max)
+            return float(math.exp(rng.uniform(lo, hi)))
+        return float(rng.uniform(self.min, self.max))
+
+    def grid(self, n):
+        if self.log:
+            return [float(v) for v in np.exp(np.linspace(
+                math.log(self.min), math.log(self.max), n))]
+        return [float(v) for v in np.linspace(self.min, self.max, n)]
+
+
+@dataclass
+class IntegerParameterSpace(ParameterSpace):
+    min: int = 0
+    max: int = 10
+
+    def sample(self, rng):
+        return int(rng.integers(self.min, self.max + 1))
+
+    def grid(self, n):
+        return sorted({int(round(v)) for v in
+                       np.linspace(self.min, self.max, n)})
+
+
+@dataclass
+class DiscreteParameterSpace(ParameterSpace):
+    values: Sequence[Any] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.values:
+            raise ValueError("DiscreteParameterSpace needs values")
+
+    def sample(self, rng):
+        return self.values[int(rng.integers(0, len(self.values)))]
+
+    def grid(self, n):
+        return list(self.values)
+
+
+# ---------------------------------------------------------------------------
+# candidate generators (reference CandidateGenerator)
+# ---------------------------------------------------------------------------
+class RandomSearchGenerator:
+    def __init__(self, space: Dict[str, ParameterSpace], seed: int = 0):
+        self.space = space
+        self.seed = seed
+
+    def __iter__(self):
+        # fresh stream per iteration: the same generator object yields
+        # the same reproducible candidate sequence every run
+        rng = np.random.default_rng(self.seed)
+        while True:
+            yield {k: s.sample(rng) for k, s in self.space.items()}
+
+
+class GridSearchGenerator:
+    def __init__(self, space: Dict[str, ParameterSpace],
+                 points_per_dim: int = 3):
+        self.space = space
+        self.n = points_per_dim
+
+    def __iter__(self):
+        import itertools
+        keys = list(self.space)
+        axes = [self.space[k].grid(self.n) for k in keys]
+        for combo in itertools.product(*axes):
+            yield dict(zip(keys, combo))
+
+
+# ---------------------------------------------------------------------------
+# runner (reference OptimizationRunner + scoring + termination)
+# ---------------------------------------------------------------------------
+@dataclass
+class CandidateResult:
+    index: int
+    params: Dict[str, Any]
+    score: float
+    model: Any = None
+    seconds: float = 0.0
+
+
+class OptimizationRunner:
+    """Evaluate candidates from a generator with a user model-builder
+    and score function; keep the best.
+
+    ``build_and_score(candidate_params) -> (score, model)`` — lower is
+    better by default (set ``maximize=True`` for accuracy-style
+    scores). Termination: ``max_candidates`` and/or
+    ``max_minutes`` (reference MaxCandidatesCondition /
+    TimeoutTerminationCondition).
+    """
+
+    def __init__(self, generator, build_and_score: Callable,
+                 max_candidates: int = 10,
+                 max_minutes: Optional[float] = None,
+                 maximize: bool = False,
+                 keep_models: bool = False):
+        self.generator = generator
+        self.build_and_score = build_and_score
+        self.max_candidates = max_candidates
+        self.max_minutes = max_minutes
+        self.maximize = maximize
+        self.keep_models = keep_models
+        self.results: List[CandidateResult] = []
+
+    def execute(self) -> CandidateResult:
+        t0 = time.monotonic()
+        self.results = []                  # re-entrant: fresh run
+        best: Optional[CandidateResult] = None
+        for i, cand in enumerate(self.generator):
+            if i >= self.max_candidates:
+                break
+            if self.max_minutes is not None and \
+                    (time.monotonic() - t0) / 60.0 > self.max_minutes:
+                break
+            tc = time.monotonic()
+            score, model = self.build_and_score(cand)
+            res = CandidateResult(
+                i, dict(cand), float(score),
+                model if self.keep_models else None,
+                time.monotonic() - tc)
+            self.results.append(res)
+            # NaN scores (diverged candidates) never become "best" —
+            # NaN comparisons are all False, which would lock them in
+            if math.isnan(res.score):
+                continue
+            better = (best is None
+                      or (res.score > best.score if self.maximize
+                          else res.score < best.score))
+            if better:
+                best = res
+        if best is None:
+            raise RuntimeError(
+                "no candidates evaluated (or every score was NaN)")
+        return best
+
+    def best(self) -> CandidateResult:
+        finite = [r for r in self.results if not math.isnan(r.score)]
+        if not finite:
+            raise RuntimeError("no finite-score candidates")
+        key = (lambda r: -r.score) if self.maximize else \
+            (lambda r: r.score)
+        return min(finite, key=key)

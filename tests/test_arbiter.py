@@ -1,0 +1,121 @@
+"""Arbiter hyperparameter optimization tests. Reference analog:
+arbiter's TestRandomSearch / TestGridSearch / optimization runner
+tests."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.arbiter import (ContinuousParameterSpace,
+                                        DiscreteParameterSpace,
+                                        GridSearchGenerator,
+                                        IntegerParameterSpace,
+                                        OptimizationRunner,
+                                        RandomSearchGenerator)
+
+
+def test_parameter_spaces():
+    rng = np.random.default_rng(0)
+    c = ContinuousParameterSpace(0.1, 10.0, log=True)
+    vals = [c.sample(rng) for _ in range(200)]
+    assert all(0.1 <= v <= 10.0 for v in vals)
+    # log-uniform: median near geometric mean, not arithmetic middle
+    assert 0.5 < float(np.median(vals)) < 2.0
+    g = c.grid(3)
+    assert pytest.approx(g[1], rel=1e-6) == 1.0
+    i = IntegerParameterSpace(2, 5)
+    assert set(i.grid(4)) == {2, 3, 4, 5}
+    assert all(2 <= i.sample(rng) <= 5 for _ in range(50))
+    d = DiscreteParameterSpace(["a", "b"])
+    assert d.grid(99) == ["a", "b"]
+
+
+def test_grid_generator_enumerates_product():
+    gen = GridSearchGenerator({
+        "lr": DiscreteParameterSpace([0.1, 0.01]),
+        "units": IntegerParameterSpace(8, 16),
+    }, points_per_dim=2)
+    combos = list(gen)
+    assert len(combos) == 4
+    assert {c["lr"] for c in combos} == {0.1, 0.01}
+
+
+def test_runner_finds_minimum():
+    # quadratic bowl: best candidate is the closest sample to x=3
+    gen = RandomSearchGenerator(
+        {"x": ContinuousParameterSpace(0.0, 10.0)}, seed=1)
+
+    def score(c):
+        return (c["x"] - 3.0) ** 2, None
+
+    runner = OptimizationRunner(gen, score, max_candidates=40)
+    best = runner.execute()
+    assert abs(best.params["x"] - 3.0) < 0.5
+    assert len(runner.results) == 40
+    assert best.score == runner.best().score
+
+
+def test_runner_trains_real_models():
+    """End-to-end: arbiter searches hidden size + lr for a real net."""
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn import updaters as upd
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((256, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+
+    def build_and_score(c):
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater(upd.Adam(learning_rate=c["lr"])).list()
+                .layer(DenseLayer(n_out=c["units"], activation="tanh"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        for _ in range(15):
+            net.fit(x, y)
+        return net.score(), net
+
+    runner = OptimizationRunner(
+        RandomSearchGenerator({
+            "lr": ContinuousParameterSpace(1e-4, 0.1, log=True),
+            "units": DiscreteParameterSpace([4, 16]),
+        }, seed=3),
+        build_and_score, max_candidates=4, keep_models=True)
+    best = runner.execute()
+    assert best.score < 0.6
+    assert best.model is not None
+    assert best.seconds > 0
+
+
+def test_runner_nan_scores_and_reentry():
+    calls = []
+
+    def score(c):
+        calls.append(c["x"])
+        # first candidate diverges
+        return (float("nan") if len(calls) == 1
+                else (c["x"] - 3.0) ** 2), None
+
+    gen = RandomSearchGenerator(
+        {"x": ContinuousParameterSpace(0.0, 10.0)}, seed=1)
+    runner = OptimizationRunner(gen, score, max_candidates=10)
+    best = runner.execute()
+    assert not np.isnan(best.score)
+    # re-entrant execute: results reset, same reproducible candidates
+    n1 = len(runner.results)
+    first_run_xs = [r.params["x"] for r in runner.results]
+    calls.clear()
+    runner.execute()
+    assert len(runner.results) == n1
+    assert [r.params["x"] for r in runner.results] == first_run_xs
+
+
+def test_space_validation():
+    with pytest.raises(ValueError):
+        ContinuousParameterSpace(0.0, 1.0, log=True)
+    with pytest.raises(ValueError):
+        ContinuousParameterSpace(2.0, 1.0)
+    with pytest.raises(ValueError):
+        DiscreteParameterSpace([])

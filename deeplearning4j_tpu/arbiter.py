@@ -63,6 +63,10 @@ class IntegerParameterSpace(ParameterSpace):
     min: int = 0
     max: int = 10
 
+    def __post_init__(self):
+        if self.min >= self.max:
+            raise ValueError(f"min {self.min} >= max {self.max}")
+
     def sample(self, rng):
         return int(rng.integers(self.min, self.max + 1))
 
@@ -155,7 +159,6 @@ class OptimizationRunner:
     def execute(self) -> CandidateResult:
         t0 = time.monotonic()
         self.results = []                  # re-entrant: fresh run
-        best: Optional[CandidateResult] = None
         for i, cand in enumerate(self.generator):
             if i >= self.max_candidates:
                 break
@@ -164,24 +167,11 @@ class OptimizationRunner:
                 break
             tc = time.monotonic()
             score, model = self.build_and_score(cand)
-            res = CandidateResult(
+            self.results.append(CandidateResult(
                 i, dict(cand), float(score),
                 model if self.keep_models else None,
-                time.monotonic() - tc)
-            self.results.append(res)
-            # NaN scores (diverged candidates) never become "best" —
-            # NaN comparisons are all False, which would lock them in
-            if math.isnan(res.score):
-                continue
-            better = (best is None
-                      or (res.score > best.score if self.maximize
-                          else res.score < best.score))
-            if better:
-                best = res
-        if best is None:
-            raise RuntimeError(
-                "no candidates evaluated (or every score was NaN)")
-        return best
+                time.monotonic() - tc))
+        return self.best()
 
     def best(self) -> CandidateResult:
         finite = [r for r in self.results if not math.isnan(r.score)]

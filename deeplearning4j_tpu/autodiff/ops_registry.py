@@ -492,3 +492,7 @@ def _random_uniform(*, shape, seed, minval=0.0, maxval=1.0):
 def _random_bernoulli(*, shape, seed, p=0.5):
     return jax.random.bernoulli(jax.random.PRNGKey(seed), p,
                                 tuple(shape)).astype(jnp.float32)
+
+
+# Extended declarable surface (registers ~200 more ops into OPS).
+from deeplearning4j_tpu.autodiff import ops_registry_ext  # noqa: E402,F401

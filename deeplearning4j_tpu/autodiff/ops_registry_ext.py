@@ -1,0 +1,1228 @@
+"""Extended op surface toward the reference's ~500 declarable ops.
+
+Reference: ``libnd4j/include/ops/declarable/generic/**`` — one C++ file
+per named op, grouped by category (``transforms/``, ``nn/``, ``blas/``,
+``recurrent/``, ``images/``, ``random/``, ``updaters/``, ``loss/``,
+``parity_ops/``, ``bitwise/``…) and registered in
+``OpRegistrator.cpp``.  JVM mirrors live under
+``org.nd4j.linalg.api.ops.impl.*``.
+
+TPU-native design: every op is a pure jax-traceable function in the
+same ``OPS`` registry as :mod:`ops_registry`, so the whole graph still
+compiles into one XLA program (no per-op dispatch).  Ops whose output
+*shape* depends on data (``unique``, ``dynamic_partition``…) take a
+static ``size`` argument for use under jit, mirroring how XLA forbids
+data-dependent shapes; eagerly they also work without it.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.autodiff.ops_registry import OPS, op, _red
+
+
+# --------------------------------------------------------------------------
+# transforms / math (reference generic/transforms/*.cpp)
+# --------------------------------------------------------------------------
+op("rint")(jnp.rint)
+op("trunc")(jnp.trunc)
+op("mod")(OPS["floormod"])
+op("truncatediv")(lambda a, b: jnp.trunc(a / b))
+op("truncatemod")(jnp.fmod)
+op("divide_no_nan")(lambda a, b: jnp.where(b == 0, 0.0, a / jnp.where(
+    b == 0, 1.0, b)))
+op("igamma")(jax.scipy.special.gammainc)
+op("igammac")(jax.scipy.special.gammaincc)
+op("betainc")(jax.scipy.special.betainc)
+op("polygamma")(lambda n, x: jax.scipy.special.polygamma(
+    n.astype(jnp.int32) if hasattr(n, "astype") else n, x))
+op("zeta")(jax.scipy.special.zeta)
+op("erfinv")(jax.scipy.special.erfinv)
+op("precise_gelu")(lambda a: jax.nn.gelu(a, approximate=False))
+op("identity")(lambda a: a)
+op("assign")(lambda a, b: jnp.broadcast_to(b, a.shape).astype(a.dtype))
+op("stop_gradient")(lax.stop_gradient)
+op("thresholdedrelu")(lambda a, *, theta=1.0: jnp.where(a > theta, a, 0.0))
+op("mergeadd")(lambda *arrs: functools.reduce(jnp.add, arrs))
+op("mergeavg")(lambda *arrs: functools.reduce(jnp.add, arrs) / len(arrs))
+op("mergemax")(lambda *arrs: functools.reduce(jnp.maximum, arrs))
+
+
+@op("mergemaxindex")
+def _mergemaxindex(*arrs):
+    return jnp.argmax(jnp.stack(arrs, 0), axis=0)
+
+
+@op("check_numerics")
+def _check_numerics(a, *, message="check_numerics"):
+    try:
+        ok = bool(jnp.all(jnp.isfinite(a)))
+        if not ok:
+            raise FloatingPointError(f"{message}: non-finite values")
+    except jax.errors.TracerBoolConversionError:
+        pass                       # under jit: a no-op passthrough
+    return a
+
+
+@op("standardize")
+def _standardize(a, *, axis=-1, eps=0.0):
+    mu = jnp.mean(a, axis=axis, keepdims=True)
+    sd = jnp.std(a, axis=axis, keepdims=True)
+    return (a - mu) / (sd + eps if eps else sd)
+
+
+def _safe_norm_scale(sumsq, clip_norm):
+    # double-where: sqrt'(0)=inf would NaN the grad of an all-zero
+    # tensor (the first gradient-clipping step of training); keep both
+    # where-branches finite
+    safe = jnp.where(sumsq > 0, sumsq, 1.0)
+    n = jnp.sqrt(safe)
+    return jnp.where(sumsq > 0, clip_norm / jnp.maximum(n, clip_norm),
+                     1.0)
+
+
+@op("clip_by_norm")
+def _clip_by_norm(a, *, clip_norm, axis=None):
+    sumsq = jnp.sum(jnp.square(a), axis=axis, keepdims=True)
+    return a * _safe_norm_scale(sumsq, clip_norm)
+
+
+@op("clip_by_avg_norm")
+def _clip_by_avg_norm(a, *, clip_norm, axis=None):
+    sumsq = jnp.mean(jnp.square(a), axis=axis, keepdims=True)
+    return a * _safe_norm_scale(sumsq, clip_norm)
+
+
+@op("clip_by_global_norm")
+def _clip_by_global_norm(*arrs, clip_norm):
+    g = jnp.sqrt(sum(jnp.sum(jnp.square(a)) for a in arrs))
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(g, 1e-12))
+    out = tuple(a * scale for a in arrs)
+    return out if len(out) > 1 else out[0]
+
+
+# --------------------------------------------------------------------------
+# bitwise (reference generic/bitwise/*.cpp)
+# --------------------------------------------------------------------------
+op("bitwise_and")(jnp.bitwise_and)
+op("bitwise_or")(jnp.bitwise_or)
+op("bitwise_xor")(jnp.bitwise_xor)
+op("toggle_bits")(jnp.bitwise_not)
+op("shift_bits")(lambda a, n: jnp.left_shift(a, n))
+op("rshift_bits")(lambda a, n: jnp.right_shift(a, n))
+
+
+def _rotate(a, n, left):
+    """Bit-rotate on the unsigned view (logical shifts; n masked to the
+    bit width so n=0 stays defined)."""
+    bits = a.dtype.itemsize * 8
+    u = a.astype(jnp.dtype(f"uint{bits}"))
+    n = n % bits
+    if not left:
+        n = (bits - n) % bits
+    out = jnp.left_shift(u, n) | jnp.right_shift(u, (bits - n) % bits)
+    return out.astype(a.dtype)
+
+
+op("cyclic_shift_bits")(lambda a, n: _rotate(a, n, left=True))
+op("cyclic_rshift_bits")(lambda a, n: _rotate(a, n, left=False))
+op("bitcast")(lambda a, *, dtype: lax.bitcast_convert_type(a, dtype))
+
+
+@op("compare_and_bitpack")
+def _compare_and_bitpack(a, *, threshold=0.0):
+    bits = (a > threshold).astype(jnp.uint8)
+    bits = bits.reshape(a.shape[:-1] + (a.shape[-1] // 8, 8))
+    weights = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.uint8)
+    return jnp.sum(bits * weights, axis=-1).astype(jnp.uint8)
+
+
+# --------------------------------------------------------------------------
+# reductions (reference include/loops/reduce_*, generic/parity_ops)
+# --------------------------------------------------------------------------
+op("all")(_red(lambda a, axis, keepdims: jnp.all(a != 0, axis=axis,
+                                                 keepdims=keepdims)))
+op("any")(_red(lambda a, axis, keepdims: jnp.any(a != 0, axis=axis,
+                                                 keepdims=keepdims)))
+op("asum")(_red(lambda a, axis, keepdims: jnp.sum(jnp.abs(a), axis=axis,
+                                                  keepdims=keepdims)))
+op("sqnorm")(_red(lambda a, axis, keepdims: jnp.sum(jnp.square(a),
+                                                    axis=axis,
+                                                    keepdims=keepdims)))
+op("count_zero")(_red(lambda a, axis, keepdims: jnp.sum(
+    (a == 0).astype(jnp.int32), axis=axis, keepdims=keepdims)))
+op("reduce_dot")(lambda a, b, *, axis=None, keepdims=False: jnp.sum(
+    a * b, axis=tuple(axis) if isinstance(axis, list) else axis,
+    keepdims=keepdims))
+op("percentile")(lambda a, *, q, axis=None: jnp.percentile(
+    a, q, axis=tuple(axis) if isinstance(axis, list) else axis))
+op("median")(lambda a, *, axis=None: jnp.median(a, axis=axis))
+op("iamax")(lambda a, *, axis=-1: jnp.argmax(jnp.abs(a), axis=axis))
+op("iamin")(lambda a, *, axis=-1: jnp.argmin(jnp.abs(a), axis=axis))
+
+_CONDS = {
+    "gt": lambda a, v: a > v, "gte": lambda a, v: a >= v,
+    "lt": lambda a, v: a < v, "lte": lambda a, v: a <= v,
+    "eq": lambda a, v: a == v, "neq": lambda a, v: a != v,
+    "abs_gt": lambda a, v: jnp.abs(a) > v,
+    "abs_lt": lambda a, v: jnp.abs(a) < v,
+}
+
+
+@op("first_index")
+def _first_index(a, *, condition="gt", value=0.0, axis=None):
+    """Index of first element matching condition; -1 if none.
+    Reference: index-reduce loop ``FirstIndex`` (include/loops/indexreduce)."""
+    m = _CONDS[condition](a, value)
+    idx = jnp.argmax(m, axis=axis)
+    found = jnp.any(m, axis=axis)
+    return jnp.where(found, idx, -1)
+
+
+@op("last_index")
+def _last_index(a, *, condition="gt", value=0.0, axis=None):
+    m = _CONDS[condition](a, value)
+    if axis is None:
+        n = m.size
+        rev = jnp.argmax(jnp.ravel(m)[::-1])
+        return jnp.where(jnp.any(m), n - 1 - rev, -1)
+    n = m.shape[axis]
+    rev = jnp.argmax(jnp.flip(m, axis), axis=axis)
+    return jnp.where(jnp.any(m, axis=axis), n - 1 - rev, -1)
+
+
+@op("match_condition")
+def _match_condition(a, *, condition="gt", value=0.0):
+    """Count of elements matching condition (reference MatchCondition)."""
+    return jnp.sum(_CONDS[condition](a, value).astype(jnp.int32))
+
+
+@op("match_condition_transform")
+def _match_condition_transform(a, *, condition="gt", value=0.0):
+    return _CONDS[condition](a, value)
+
+
+# --------------------------------------------------------------------------
+# shape / gather-scatter (reference generic/shape, generic/parity_ops)
+# --------------------------------------------------------------------------
+op("broadcast_to")(lambda a, *, shape: jnp.broadcast_to(a, tuple(shape)))
+op("flatten")(lambda a: jnp.ravel(a))
+op("rank")(lambda a: jnp.asarray(a.ndim, jnp.int32))
+op("size")(lambda a: jnp.asarray(a.size, jnp.int32))
+op("size_at")(lambda a, *, dim: jnp.asarray(a.shape[dim], jnp.int32))
+op("repeat")(lambda a, *, repeats, axis=None: jnp.repeat(a, repeats, axis))
+op("fill")(lambda *, shape, value, dtype=jnp.float32: jnp.full(
+    tuple(shape), value, dtype))
+op("invert_permutation")(lambda a: jnp.argsort(a.astype(jnp.int32)))
+op("matrix_diag")(lambda a: jnp.zeros(a.shape + (a.shape[-1],),
+                                      a.dtype).at[
+    ..., jnp.arange(a.shape[-1]), jnp.arange(a.shape[-1])].set(a))
+op("matrix_diag_part")(lambda a: jnp.diagonal(a, axis1=-2, axis2=-1))
+
+
+@op("matrix_set_diag")
+def _matrix_set_diag(a, d):
+    n = min(a.shape[-2], a.shape[-1])
+    i = jnp.arange(n)
+    return a.at[..., i, i].set(d[..., :n])
+
+
+@op("matrix_band_part")
+def _matrix_band_part(a, *, num_lower=-1, num_upper=-1):
+    m, n = a.shape[-2], a.shape[-1]
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    keep = jnp.ones((m, n), bool)
+    if num_lower >= 0:
+        keep &= (i - j) <= num_lower
+    if num_upper >= 0:
+        keep &= (j - i) <= num_upper
+    return jnp.where(keep, a, 0)
+
+
+@op("reverse_sequence")
+def _reverse_sequence(a, lengths, *, seq_axis=1, batch_axis=0):
+    n = a.shape[seq_axis]
+    i = jnp.arange(n)
+    lengths = lengths.astype(jnp.int32)
+
+    def one(row, ln):
+        idx = jnp.where(i < ln, ln - 1 - i, i)
+        return jnp.take(row, idx, axis=seq_axis - (1 if seq_axis >
+                                                   batch_axis else 0))
+    return jax.vmap(one, in_axes=(batch_axis, 0),
+                    out_axes=batch_axis)(a, lengths)
+
+
+@op("sequence_mask")
+def _sequence_mask(lengths, *, maxlen, dtype=jnp.float32):
+    return (jnp.arange(maxlen)[None, :]
+            < lengths.astype(jnp.int32)[..., None]).astype(dtype)
+
+
+@op("confusion_matrix")
+def _confusion_matrix(labels, preds, *, num_classes):
+    cm = jnp.zeros((num_classes, num_classes), jnp.int32)
+    return cm.at[labels.astype(jnp.int32),
+                 preds.astype(jnp.int32)].add(1)
+
+
+op("bincount")(lambda a, *, length: jnp.bincount(
+    a.astype(jnp.int32), length=length))
+
+
+@op("histogram_fixed_width")
+def _histogram_fixed_width(a, *, range, nbins):
+    lo, hi = range
+    idx = jnp.clip(((a - lo) / (hi - lo) * nbins).astype(jnp.int32),
+                   0, nbins - 1)
+    return jnp.bincount(jnp.ravel(idx), length=nbins)
+
+
+@op("histogram")
+def _histogram(a, *, nbins):
+    lo = jnp.min(a)
+    width = jnp.maximum(jnp.max(a) - lo, 1e-9)
+    idx = jnp.clip(((a - lo) / width * nbins).astype(jnp.int32),
+                   0, nbins - 1)
+    return jnp.bincount(jnp.ravel(idx), length=nbins)
+
+
+@op("unique")
+def _unique(a, *, size=None):
+    """Unique values; under jit pass static ``size`` (XLA static shapes).
+    Overlong ``size`` pads with the minimum unique value — use the zero
+    counts from ``unique_with_counts`` to detect padding
+    (reference: generic/parity_ops/unique.cpp)."""
+    return jnp.unique(jnp.ravel(a), size=size)
+
+
+@op("unique_with_counts")
+def _unique_with_counts(a, *, size=None):
+    vals, counts = jnp.unique(jnp.ravel(a), size=size, return_counts=True)
+    return vals, counts
+
+
+@op("listdiff")
+def _listdiff(a, b):
+    """Elements of a not in b (eager-only: data-dependent output shape)."""
+    import numpy as np
+    a_np, b_np = np.asarray(a), np.asarray(b)
+    keep = ~np.isin(a_np, b_np)
+    return jnp.asarray(a_np[keep]), jnp.asarray(np.nonzero(keep)[0])
+
+
+@op("dynamic_partition")
+def _dynamic_partition(a, partitions, *, num_partitions):
+    """Eager-only (data-dependent sizes), like the reference's eager exec."""
+    import numpy as np
+    p = np.asarray(partitions)
+    a_np = np.asarray(a)
+    return tuple(jnp.asarray(a_np[p == i]) for i in range(num_partitions))
+
+
+@op("dynamic_stitch")
+def _dynamic_stitch(*args):
+    half = len(args) // 2
+    indices, data = args[:half], args[half:]
+    n = sum(int(i.size) for i in indices)
+    out = jnp.zeros((n,) + data[0].shape[1:], data[0].dtype)
+    for idx, d in zip(indices, data):
+        out = out.at[idx.astype(jnp.int32)].set(d)
+    return out
+
+
+op("scatter_nd")(lambda idx, upd, *, shape: jnp.zeros(
+    tuple(shape), upd.dtype).at[tuple(jnp.moveaxis(
+        idx.astype(jnp.int32), -1, 0))].add(upd))
+op("scatter_nd_add")(lambda a, idx, upd: a.at[tuple(jnp.moveaxis(
+    idx.astype(jnp.int32), -1, 0))].add(upd))
+op("scatter_nd_sub")(lambda a, idx, upd: a.at[tuple(jnp.moveaxis(
+    idx.astype(jnp.int32), -1, 0))].add(-upd))
+op("scatter_nd_update")(lambda a, idx, upd: a.at[tuple(jnp.moveaxis(
+    idx.astype(jnp.int32), -1, 0))].set(upd))
+
+for _name, _fn in [("unsorted_segment_sum", jax.ops.segment_sum),
+                   ("unsorted_segment_max", jax.ops.segment_max),
+                   ("unsorted_segment_min", jax.ops.segment_min),
+                   ("unsorted_segment_prod", jax.ops.segment_prod)]:
+    op(_name)(functools.partial(
+        lambda fn, a, ids, *, num_segments: fn(
+            a, ids.astype(jnp.int32), num_segments), _fn))
+
+
+@op("unsorted_segment_mean")
+def _unsorted_segment_mean(a, ids, *, num_segments):
+    ids = ids.astype(jnp.int32)
+    s = jax.ops.segment_sum(a, ids, num_segments)
+    c = jax.ops.segment_sum(jnp.ones_like(a), ids, num_segments)
+    return s / jnp.maximum(c, 1)
+
+
+@op("unsorted_segment_sqrt_n")
+def _unsorted_segment_sqrt_n(a, ids, *, num_segments):
+    ids = ids.astype(jnp.int32)
+    s = jax.ops.segment_sum(a, ids, num_segments)
+    c = jax.ops.segment_sum(jnp.ones_like(a), ids, num_segments)
+    return s / jnp.sqrt(jnp.maximum(c, 1))
+
+
+@op("nth_element")
+def _nth_element(a, *, n, reverse=False):
+    s = jnp.sort(a, axis=-1)
+    if reverse:
+        s = jnp.flip(s, axis=-1)
+    return s[..., n]
+
+
+@op("batch_to_space_nd")
+def _batch_to_space_nd(a, *, block_shape, crops):
+    bs = list(block_shape)
+    m = len(bs)
+    batch = a.shape[0]
+    rest = a.shape[1:]
+    prod_bs = 1
+    for b in bs:
+        prod_bs *= b
+    x = a.reshape(tuple(bs) + (batch // prod_bs,) + rest)
+    # interleave block dims into spatial dims
+    perm = [m]
+    for i in range(m):
+        perm += [m + 1 + i, i]
+    perm += list(range(2 * m + 1, x.ndim))
+    x = x.transpose(perm)
+    new_spatial = [rest[i] * bs[i] for i in range(m)]
+    x = x.reshape((batch // prod_bs,) + tuple(new_spatial)
+                  + rest[m:])
+    sl = [slice(None)]
+    for i in range(m):
+        lo, hi = crops[i]
+        sl.append(slice(lo, new_spatial[i] - hi))
+    return x[tuple(sl)]
+
+
+@op("space_to_batch_nd")
+def _space_to_batch_nd(a, *, block_shape, paddings):
+    bs = list(block_shape)
+    m = len(bs)
+    pads = [(0, 0)] + [tuple(p) for p in paddings] + [(0, 0)] * (
+        a.ndim - 1 - m)
+    x = jnp.pad(a, pads)
+    batch = x.shape[0]
+    spatial = x.shape[1:1 + m]
+    rest = x.shape[1 + m:]
+    shp = (batch,)
+    for i in range(m):
+        shp += (spatial[i] // bs[i], bs[i])
+    shp += rest
+    x = x.reshape(shp)
+    perm = []
+    for i in range(m):
+        perm.append(2 + 2 * i)
+    perm.append(0)
+    for i in range(m):
+        perm.append(1 + 2 * i)
+    perm += list(range(1 + 2 * m, x.ndim))
+    x = x.transpose(perm)
+    prod_bs = 1
+    for b in bs:
+        prod_bs *= b
+    return x.reshape((batch * prod_bs,)
+                     + tuple(spatial[i] // bs[i] for i in range(m))
+                     + rest)
+
+
+op("batch_to_space")(lambda a, *, block_size, crops: _batch_to_space_nd(
+    a, block_shape=[block_size, block_size], crops=crops))
+op("space_to_batch")(lambda a, *, block_size, paddings: _space_to_batch_nd(
+    a, block_shape=[block_size, block_size], paddings=paddings))
+
+
+@op("mirror_pad")
+def _mirror_pad(a, *, paddings, mode="REFLECT"):
+    return jnp.pad(a, paddings,
+                   mode="reflect" if mode.upper() == "REFLECT"
+                   else "symmetric")
+
+
+# --------------------------------------------------------------------------
+# nn convolutions / pooling (reference generic/nn/convo, generic/nn/pooling)
+# --------------------------------------------------------------------------
+@op("conv1d")
+def _conv1d(x, w, *, stride=1, padding="SAME", dilation=1):
+    # x: NWC, w: WIO
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride,), padding=padding,
+        rhs_dilation=(dilation,), dimension_numbers=("NWC", "WIO", "NWC"))
+
+
+@op("conv3d")
+def _conv3d(x, w, *, strides=(1, 1, 1), padding="SAME",
+            dilations=(1, 1, 1)):
+    # x: NDHWC, w: DHWIO — TPU-native layouts
+    return lax.conv_general_dilated(
+        x, w, window_strides=tuple(strides), padding=padding,
+        rhs_dilation=tuple(dilations),
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+
+
+@op("deconv2d")
+def _deconv2d(x, w, *, strides=(2, 2), padding="SAME"):
+    return lax.conv_transpose(
+        x, w, strides=tuple(strides), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+@op("deconv3d")
+def _deconv3d(x, w, *, strides=(2, 2, 2), padding="SAME"):
+    return lax.conv_transpose(
+        x, w, strides=tuple(strides), padding=padding,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+
+
+@op("sconv2d")
+def _sconv2d(x, wd, wp, *, strides=(1, 1), padding="SAME"):
+    """Separable conv: depthwise then pointwise
+    (reference generic/nn/convo/sconv2d.cpp)."""
+    y = OPS["depthwise_conv2d"](x, wd, strides=strides, padding=padding)
+    return lax.conv_general_dilated(
+        y, wp, window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _pool3d(x, kernel, strides, padding, init, reduce_fn):
+    return lax.reduce_window(
+        x, init, reduce_fn, (1,) + tuple(kernel) + (1,),
+        (1,) + tuple(strides) + (1,), padding)
+
+
+@op("max_pooling3d")
+def _maxpool3d(x, *, kernel=(2, 2, 2), strides=(2, 2, 2),
+               padding="VALID"):
+    return _pool3d(x, kernel, strides, padding, -jnp.inf, lax.max)
+
+
+@op("avg_pooling3d")
+def _avgpool3d(x, *, kernel=(2, 2, 2), strides=(2, 2, 2),
+               padding="VALID"):
+    s = _pool3d(x, kernel, strides, padding, 0.0, lax.add)
+    c = _pool3d(jnp.ones_like(x), kernel, strides, padding, 0.0, lax.add)
+    return s / c
+
+
+@op("pnormpool2d")
+def _pnormpool2d(x, *, kernel=(2, 2), strides=(2, 2), padding="VALID",
+                 pnorm=2):
+    s = lax.reduce_window(
+        jnp.abs(x) ** pnorm, 0.0, lax.add, (1,) + tuple(kernel) + (1,),
+        (1,) + tuple(strides) + (1,), padding)
+    return s ** (1.0 / pnorm)
+
+
+def _window_offsets(x, kernel, strides, padding, pad_value):
+    """Stacked shifted views (N, H', W', C, kh*kw) — static small loop."""
+    kh, kw = kernel
+    sh, sw = strides
+    if padding == "SAME":
+        H, W = x.shape[1], x.shape[2]
+        oh = -(-H // sh)
+        ow = -(-W // sw)
+        ph = max((oh - 1) * sh + kh - H, 0)
+        pw = max((ow - 1) * sw + kw - W, 0)
+        x = jnp.pad(x, ((0, 0), (ph // 2, ph - ph // 2),
+                        (pw // 2, pw - pw // 2), (0, 0)),
+                    constant_values=pad_value)
+        off_h, off_w = ph // 2, pw // 2
+    else:
+        off_h = off_w = 0
+    H, W = x.shape[1], x.shape[2]
+    oh = (H - kh) // sh + 1
+    ow = (W - kw) // sw + 1
+    views = []
+    for dy in range(kh):
+        for dx in range(kw):
+            views.append(x[:, dy:dy + (oh - 1) * sh + 1:sh,
+                           dx:dx + (ow - 1) * sw + 1:sw, :])
+    return jnp.stack(views, axis=-1), (off_h, off_w, oh, ow)
+
+
+@op("max_pool_with_argmax")
+def _max_pool_with_argmax(x, *, kernel=(2, 2), strides=(2, 2),
+                          padding="VALID"):
+    """Returns (pooled, argmax) with TF-style flat indices h*W*C+w*C+c."""
+    N, H, W, C = x.shape
+    kh, kw = kernel
+    sh, sw = strides
+    win, (off_h, off_w, oh, ow) = _window_offsets(
+        x, kernel, strides, padding, -jnp.inf)
+    pooled = jnp.max(win, axis=-1)
+    k = jnp.argmax(win, axis=-1)               # (N, oh, ow, C) in [0, kh*kw)
+    dy, dx = k // kw, k % kw
+    hh = (jnp.arange(oh)[None, :, None, None] * sh + dy - off_h)
+    ww = (jnp.arange(ow)[None, None, :, None] * sw + dx - off_w)
+    cc = jnp.arange(C)[None, None, None, :]
+    idx = (hh * W + ww) * C + cc
+    return pooled, idx.astype(jnp.int32)
+
+
+@op("im2col")
+def _im2col(x, *, kernel, strides=(1, 1), padding="VALID"):
+    """(N,H,W,C) → (N, H', W', kh*kw*C) patches
+    (reference generic/nn/convo/im2col — NCHW there; NHWC here for TPU)."""
+    win, (_, _, oh, ow) = _window_offsets(x, kernel, strides, padding, 0.0)
+    # win: (N, oh, ow, C, kh*kw) → (N, oh, ow, kh*kw, C) → flat
+    win = jnp.swapaxes(win, -1, -2)
+    N, _, _, kk, C = win.shape
+    return win.reshape(N, oh, ow, kk * C)
+
+
+@op("col2im")
+def _col2im(cols, *, input_shape, kernel, strides=(1, 1),
+            padding="VALID"):
+    """Adjoint of im2col (scatter-add of patches) via jax.vjp — the
+    gradient relationship the reference implements by hand."""
+    x0 = jnp.zeros(tuple(input_shape), cols.dtype)
+    _, vjp = jax.vjp(
+        lambda x: _im2col(x, kernel=kernel, strides=strides,
+                          padding=padding), x0)
+    return vjp(cols)[0]
+
+
+op("extract_image_patches")(lambda x, *, kernel, strides=(1, 1),
+                            padding="VALID": _im2col(
+    x, kernel=kernel, strides=strides, padding=padding))
+
+
+@op("lrn")
+def _lrn(x, *, depth=5, bias=1.0, alpha=1e-4, beta=0.75):
+    """Across-channel local response normalization
+    (reference generic/nn/lrn.cpp; NHWC)."""
+    sq = jnp.square(x)
+    padded = jnp.pad(sq, ((0, 0),) * (x.ndim - 1)
+                     + (((depth - 1) // 2, depth // 2),))
+    ssum = lax.reduce_window(
+        padded, 0.0, lax.add, (1,) * (x.ndim - 1) + (depth,),
+        (1,) * x.ndim, "VALID")
+    return x / jnp.power(bias + alpha * ssum, beta)
+
+
+@op("fused_batch_norm")
+def _fused_batch_norm(x, gamma, beta, *, eps=1e-3, axis=-1):
+    axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
+    mu = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mu) / jnp.sqrt(var + eps) * gamma + beta
+    return y, jnp.squeeze(mu), jnp.squeeze(var)
+
+
+op("xw_plus_b")(OPS["linear"])
+op("relu_layer")(lambda x, w, b: jax.nn.relu(jnp.matmul(x, w) + b))
+op("embedding_lookup")(lambda table, ids: jnp.take(
+    table, ids.astype(jnp.int32), axis=0))
+op("upsampling2d")(lambda x, *, factor=2: jnp.repeat(
+    jnp.repeat(x, factor, axis=1), factor, axis=2))
+op("upsampling3d")(lambda x, *, factor=2: jnp.repeat(jnp.repeat(
+    jnp.repeat(x, factor, axis=1), factor, axis=2), factor, axis=3))
+
+
+@op("multi_head_dot_product_attention")
+def _mhdpa(q, k, v, wq, wk, wv, wo, *, num_heads, scale=None):
+    """Projected multi-head attention
+    (reference generic/nn/multi_head_dot_product_attention.cpp).
+    q,k,v: (B, T, E); w*: (E, E); heads split on the projected dim."""
+    B, Tq, E = q.shape
+    H = num_heads
+    d = E // H
+
+    def split(x, w):
+        return jnp.einsum("bte,ef->btf", x, w).reshape(
+            B, -1, H, d).transpose(0, 2, 1, 3)
+    qh, kh, vh = split(q, wq), split(k, wk), split(v, wv)
+    s = scale if scale is not None else 1.0 / jnp.sqrt(d)
+    a = jax.nn.softmax(jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s, -1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", a, vh)
+    o = o.transpose(0, 2, 1, 3).reshape(B, Tq, E)
+    return jnp.einsum("bte,ef->btf", o, wo)
+
+
+# --------------------------------------------------------------------------
+# recurrent cells (reference generic/recurrent/*.cpp)
+# --------------------------------------------------------------------------
+@op("lstm_cell")
+def _lstm_cell(x, h_prev, c_prev, wx, wh, b):
+    """One LSTM step; gate order [i, f, g, o]
+    (reference generic/recurrent/lstmCell.cpp semantics, TPU layout:
+    x (B,I), wx (I,4H), wh (H,4H), b (4H))."""
+    z = jnp.matmul(x, wx) + jnp.matmul(h_prev, wh) + b
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    c = jax.nn.sigmoid(f) * c_prev + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return h, c
+
+
+@op("gru_cell")
+def _gru_cell(x, h_prev, wx, wh, b):
+    """One GRU step; gate order [r, u, n]
+    (reference generic/recurrent/gruCell.cpp)."""
+    zi = jnp.matmul(x, wx)
+    zh = jnp.matmul(h_prev, wh)
+    H = h_prev.shape[-1]
+    r = jax.nn.sigmoid(zi[..., :H] + zh[..., :H] + b[:H])
+    u = jax.nn.sigmoid(zi[..., H:2 * H] + zh[..., H:2 * H] + b[H:2 * H])
+    n = jnp.tanh(zi[..., 2 * H:] + r * zh[..., 2 * H:] + b[2 * H:])
+    return u * h_prev + (1 - u) * n
+
+
+@op("sru_cell")
+def _sru_cell(x, c_prev, w, b):
+    """Simple Recurrent Unit step (reference generic/recurrent/sru.cpp):
+    x (B,I), w (I,3H), b (2H)."""
+    z = jnp.matmul(x, w)
+    H = c_prev.shape[-1]
+    xt, fz, rz = z[..., :H], z[..., H:2 * H], z[..., 2 * H:]
+    f = jax.nn.sigmoid(fz + b[:H])
+    r = jax.nn.sigmoid(rz + b[H:])
+    c = f * c_prev + (1 - f) * xt
+    h = r * jnp.tanh(c) + (1 - r) * xt[..., :H]
+    return h, c
+
+
+@op("lstm_layer")
+def _lstm_layer(x, h0, c0, wx, wh, b):
+    """Full-sequence LSTM via lax.scan — ONE fused XLA loop instead of
+    the reference's per-step native calls (generic/recurrent/lstmLayer.cpp).
+    x: (T, B, I) time-major for scan; returns (hs (T,B,H), (hT, cT))."""
+    def step(carry, xt):
+        h, c = carry
+        h, c = _lstm_cell(xt, h, c, wx, wh, b)
+        return (h, c), h
+    (hT, cT), hs = lax.scan(step, (h0, c0), x)
+    return hs, hT, cT
+
+
+@op("gru")
+def _gru_layer(x, h0, wx, wh, b):
+    def step(h, xt):
+        h = _gru_cell(xt, h, wx, wh, b)
+        return h, h
+    hT, hs = lax.scan(step, h0, x)
+    return hs, hT
+
+
+@op("sru")
+def _sru_layer(x, c0, w, b):
+    def step(c, xt):
+        h, c = _sru_cell(xt, c, w, b)
+        return c, h
+    cT, hs = lax.scan(step, c0, x)
+    return hs, cT
+
+
+# --------------------------------------------------------------------------
+# updater ops (reference generic/updaters/*.cpp) — functional:
+# (grad, state...) -> (update, state'...)  instead of in-place buffers
+# --------------------------------------------------------------------------
+@op("sgd_updater")
+def _sgd_updater(g, *, lr):
+    return g * lr
+
+
+@op("adam_updater")
+def _adam_updater(g, m, v, *, lr, beta1=0.9, beta2=0.999, eps=1e-8,
+                  iteration=0):
+    m2 = beta1 * m + (1 - beta1) * g
+    v2 = beta2 * v + (1 - beta2) * jnp.square(g)
+    t = iteration + 1
+    a = lr * jnp.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
+    return a * m2 / (jnp.sqrt(v2) + eps), m2, v2
+
+
+@op("ada_max_updater")
+def _ada_max_updater(g, m, u, *, lr, beta1=0.9, beta2=0.999, eps=1e-8,
+                     iteration=0):
+    m2 = beta1 * m + (1 - beta1) * g
+    u2 = jnp.maximum(beta2 * u, jnp.abs(g))
+    t = iteration + 1
+    return lr / (1 - beta1 ** t) * m2 / (u2 + eps), m2, u2
+
+
+@op("nadam_updater")
+def _nadam_updater(g, m, v, *, lr, beta1=0.9, beta2=0.999, eps=1e-8,
+                   iteration=0):
+    m2 = beta1 * m + (1 - beta1) * g
+    v2 = beta2 * v + (1 - beta2) * jnp.square(g)
+    t = iteration + 1
+    mhat = m2 / (1 - beta1 ** t)
+    vhat = v2 / (1 - beta2 ** t)
+    mbar = beta1 * mhat + (1 - beta1) * g / (1 - beta1 ** t)
+    return lr * mbar / (jnp.sqrt(vhat) + eps), m2, v2
+
+
+@op("ams_grad_updater")
+def _ams_grad_updater(g, m, v, vhat, *, lr, beta1=0.9, beta2=0.999,
+                      eps=1e-8, iteration=0):
+    m2 = beta1 * m + (1 - beta1) * g
+    v2 = beta2 * v + (1 - beta2) * jnp.square(g)
+    vh2 = jnp.maximum(vhat, v2)
+    t = iteration + 1
+    a = lr * jnp.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
+    return a * m2 / (jnp.sqrt(vh2) + eps), m2, v2, vh2
+
+
+@op("ada_delta_updater")
+def _ada_delta_updater(g, msg, msdx, *, rho=0.95, eps=1e-6):
+    msg2 = rho * msg + (1 - rho) * jnp.square(g)
+    upd = jnp.sqrt(msdx + eps) / jnp.sqrt(msg2 + eps) * g
+    msdx2 = rho * msdx + (1 - rho) * jnp.square(upd)
+    return upd, msg2, msdx2
+
+
+@op("ada_grad_updater")
+def _ada_grad_updater(g, h, *, lr, eps=1e-6):
+    h2 = h + jnp.square(g)
+    return lr * g / (jnp.sqrt(h2) + eps), h2
+
+
+@op("rms_prop_updater")
+def _rms_prop_updater(g, h, *, lr, decay=0.95, eps=1e-8):
+    h2 = decay * h + (1 - decay) * jnp.square(g)
+    return lr * g / (jnp.sqrt(h2) + eps), h2
+
+
+@op("nesterovs_updater")
+def _nesterovs_updater(g, v, *, lr, momentum=0.9):
+    v2 = momentum * v - lr * g
+    return -(momentum * v2 - lr * g), v2
+
+
+@op("ada_belief_updater")
+def _ada_belief_updater(g, m, s, *, lr, beta1=0.9, beta2=0.999,
+                        eps=1e-16, iteration=0):
+    m2 = beta1 * m + (1 - beta1) * g
+    s2 = beta2 * s + (1 - beta2) * jnp.square(g - m2) + eps
+    t = iteration + 1
+    a = lr * jnp.sqrt(1 - beta2 ** t) / (1 - beta1 ** t)
+    return a * m2 / (jnp.sqrt(s2) + eps), m2, s2
+
+
+# --------------------------------------------------------------------------
+# losses (reference generic/loss/*.cpp)
+# --------------------------------------------------------------------------
+@op("absolute_difference_loss")
+def _absolute_difference_loss(labels, preds, weights=None):
+    d = jnp.abs(labels - preds)
+    return jnp.mean(d if weights is None else d * weights)
+
+
+@op("l2_loss")
+def _l2_loss(a):
+    return jnp.sum(jnp.square(a)) / 2
+
+
+@op("log_poisson_loss")
+def _log_poisson_loss(labels, log_preds, *, full=False):
+    loss = jnp.exp(log_preds) - labels * log_preds
+    if full:
+        loss += (labels * jnp.log(jnp.maximum(labels, 1e-8)) - labels
+                 + 0.5 * jnp.log(2 * jnp.pi * jnp.maximum(labels, 1.0)))
+    return jnp.mean(loss)
+
+
+@op("mean_pairwssqerr_loss")
+def _mean_pairwssqerr_loss(labels, preds):
+    d = (labels - preds).reshape(labels.shape[0], -1)
+    n = d.shape[-1]
+    diff = d[:, :, None] - d[:, None, :]
+    return jnp.mean(jnp.sum(jnp.square(diff), axis=(1, 2))
+                    / (2.0 * n * n))
+
+
+@op("weighted_cross_entropy_with_logits")
+def _weighted_xent(labels, logits, *, pos_weight=1.0):
+    log_w = 1 + (pos_weight - 1) * labels
+    return jnp.mean((1 - labels) * logits + log_w * (
+        jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        + jnp.maximum(-logits, 0)))
+
+
+@op("hinge_loss")
+def _hinge_loss(labels, logits):
+    signs = 2.0 * labels - 1.0
+    return jnp.mean(jnp.maximum(0.0, 1.0 - signs * logits))
+
+
+op("softmax_cross_entropy_with_logits")(
+    OPS["loss_softmax_cross_entropy"])
+op("sigmoid_cross_entropy_with_logits")(
+    OPS["loss_sigmoid_cross_entropy"])
+
+
+@op("sufficient_statistics")
+def _sufficient_statistics(a, *, axis, shift=None):
+    ax = tuple(axis) if isinstance(axis, list) else axis
+    x = a - shift if shift is not None else a
+    count = jnp.asarray(
+        jnp.prod(jnp.asarray([a.shape[i] for i in (
+            ax if isinstance(ax, tuple) else (ax,))])), a.dtype)
+    return count, jnp.sum(x, axis=ax), jnp.sum(jnp.square(x), axis=ax)
+
+
+@op("normalize_moments")
+def _normalize_moments(count, mean_ss, var_ss, *, shift=0.0):
+    mean = mean_ss / count + shift
+    var = var_ss / count - jnp.square(mean_ss / count)
+    return mean, var
+
+
+@op("weighted_moments")
+def _weighted_moments(a, weights, *, axis=None, keepdims=False):
+    ax = tuple(axis) if isinstance(axis, list) else axis
+    wsum = jnp.sum(weights * jnp.ones_like(a), axis=ax, keepdims=True)
+    mean = jnp.sum(a * weights, axis=ax, keepdims=True) / wsum
+    var = jnp.sum(weights * jnp.square(a - mean), axis=ax,
+                  keepdims=True) / wsum
+    if not keepdims:
+        mean, var = jnp.squeeze(mean, ax), jnp.squeeze(var, ax)
+    return mean, var
+
+
+# --------------------------------------------------------------------------
+# image ops (reference generic/images/*.cpp, generic/parity_ops/resize*)
+# --------------------------------------------------------------------------
+op("resize_bicubic")(lambda a, *, size: jax.image.resize(
+    a, (a.shape[0],) + tuple(size) + (a.shape[-1],), "cubic"))
+op("resize_area")(lambda a, *, size: jax.image.resize(
+    a, (a.shape[0],) + tuple(size) + (a.shape[-1],), "linear"))
+
+
+@op("image_resize")
+def _image_resize(a, *, size, method="bilinear"):
+    m = {"bilinear": "bilinear", "nearest": "nearest", "bicubic": "cubic",
+         "cubic": "cubic", "area": "linear", "lanczos3": "lanczos3",
+         "lanczos5": "lanczos5"}[method]
+    return jax.image.resize(
+        a, (a.shape[0],) + tuple(size) + (a.shape[-1],), m)
+
+
+@op("rgb_to_grs")
+def _rgb_to_grs(a):
+    w = jnp.asarray([0.2989, 0.5870, 0.1140], a.dtype)
+    return jnp.sum(a * w, axis=-1, keepdims=True)
+
+
+@op("rgb_to_hsv")
+def _rgb_to_hsv(a):
+    r, g, b = a[..., 0], a[..., 1], a[..., 2]
+    mx = jnp.max(a, axis=-1)
+    mn = jnp.min(a, axis=-1)
+    d = mx - mn
+    safe = jnp.where(d == 0, 1.0, d)
+    h = jnp.where(
+        mx == r, (g - b) / safe % 6.0,
+        jnp.where(mx == g, (b - r) / safe + 2.0, (r - g) / safe + 4.0))
+    h = jnp.where(d == 0, 0.0, h / 6.0)
+    s = jnp.where(mx == 0, 0.0, d / jnp.where(mx == 0, 1.0, mx))
+    return jnp.stack([h, s, mx], axis=-1)
+
+
+@op("hsv_to_rgb")
+def _hsv_to_rgb(a):
+    h, s, v = a[..., 0] * 6.0, a[..., 1], a[..., 2]
+    i = jnp.floor(h)
+    f = h - i
+    p = v * (1 - s)
+    q = v * (1 - s * f)
+    t = v * (1 - s * (1 - f))
+    i = i.astype(jnp.int32) % 6
+    r = jnp.choose(i, [v, q, p, p, t, v], mode="clip")
+    g = jnp.choose(i, [t, v, v, q, p, p], mode="clip")
+    b = jnp.choose(i, [p, p, t, v, v, q], mode="clip")
+    return jnp.stack([r, g, b], axis=-1)
+
+
+_YUV = jnp.asarray([[0.299, 0.587, 0.114],
+                    [-0.14714119, -0.28886916, 0.43601035],
+                    [0.61497538, -0.51496512, -0.10001026]])
+_YIQ = jnp.asarray([[0.299, 0.587, 0.114],
+                    [0.59590059, -0.27455667, -0.32134392],
+                    [0.21153661, -0.52273617, 0.31119955]])
+
+_YUV_INV = jnp.linalg.inv(_YUV)
+_YIQ_INV = jnp.linalg.inv(_YIQ)
+
+op("rgb_to_yuv")(lambda a: jnp.einsum("...c,rc->...r", a, _YUV))
+op("yuv_to_rgb")(lambda a: jnp.einsum("...c,rc->...r", a, _YUV_INV))
+op("rgb_to_yiq")(lambda a: jnp.einsum("...c,rc->...r", a, _YIQ))
+op("yiq_to_rgb")(lambda a: jnp.einsum("...c,rc->...r", a, _YIQ_INV))
+
+
+@op("adjust_contrast")
+def _adjust_contrast(a, *, factor):
+    mean = jnp.mean(a, axis=(-3, -2), keepdims=True)
+    return (a - mean) * factor + mean
+
+
+@op("adjust_hue")
+def _adjust_hue(a, *, delta):
+    hsv = _rgb_to_hsv(a)
+    h = (hsv[..., 0] + delta) % 1.0
+    return _hsv_to_rgb(jnp.stack([h, hsv[..., 1], hsv[..., 2]], -1))
+
+
+@op("adjust_saturation")
+def _adjust_saturation(a, *, factor):
+    hsv = _rgb_to_hsv(a)
+    s = jnp.clip(hsv[..., 1] * factor, 0.0, 1.0)
+    return _hsv_to_rgb(jnp.stack([hsv[..., 0], s, hsv[..., 2]], -1))
+
+
+def _box_iou(boxes):
+    """Pairwise IoU for (N,4) [y1,x1,y2,x2] boxes."""
+    y1, x1, y2, x2 = (boxes[:, i] for i in range(4))
+    area = (y2 - y1) * (x2 - x1)
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    inter = jnp.maximum(iy2 - iy1, 0) * jnp.maximum(ix2 - ix1, 0)
+    return inter / jnp.maximum(area[:, None] + area[None, :] - inter,
+                               1e-9)
+
+
+@op("non_max_suppression")
+def _non_max_suppression(boxes, scores, *, max_output_size,
+                         iou_threshold=0.5,
+                         score_threshold=-jnp.inf):
+    """Greedy NMS as a jittable fori_loop over static max_output_size —
+    lax control flow instead of the reference's host-side loop
+    (generic/parity_ops/non_max_suppression.cpp).  Returns indices
+    padded with -1."""
+    iou = _box_iou(boxes)
+    alive = scores > score_threshold
+
+    def body(i, state):
+        alive, out = state
+        masked = jnp.where(alive, scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        valid = masked[best] > -jnp.inf
+        out = out.at[i].set(jnp.where(valid, best, -1))
+        suppress = iou[best] > iou_threshold
+        alive = alive & ~suppress & valid
+        alive = alive.at[best].set(False)
+        return alive, out
+
+    out = jnp.full((max_output_size,), -1, jnp.int32)
+    _, out = lax.fori_loop(0, max_output_size, body, (alive, out))
+    return out
+
+
+@op("non_max_suppression_overlaps")
+def _nms_overlaps(overlaps, scores, *, max_output_size,
+                  overlap_threshold=0.5, score_threshold=-jnp.inf):
+    alive = scores > score_threshold
+
+    def body(i, state):
+        alive, out = state
+        masked = jnp.where(alive, scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        valid = masked[best] > -jnp.inf
+        out = out.at[i].set(jnp.where(valid, best, -1))
+        alive = alive & (overlaps[best] <= overlap_threshold) & valid
+        alive = alive.at[best].set(False)
+        return alive, out
+
+    out = jnp.full((max_output_size,), -1, jnp.int32)
+    _, out = lax.fori_loop(0, max_output_size, body, (alive, out))
+    return out
+
+
+@op("crop_and_resize")
+def _crop_and_resize(image, boxes, box_indices, *, crop_size):
+    """Bilinear per-box crop (reference generic/parity_ops/
+    crop_and_resize.cpp): vmapped gather-interpolate, no host loop."""
+    ch, cw = crop_size
+    H, W = image.shape[1], image.shape[2]
+
+    def one(box, bi):
+        y1, x1, y2, x2 = box
+        ys = y1 * (H - 1) + jnp.arange(ch) / max(ch - 1, 1) * (
+            (y2 - y1) * (H - 1))
+        xs = x1 * (W - 1) + jnp.arange(cw) / max(cw - 1, 1) * (
+            (x2 - x1) * (W - 1))
+        img = image[bi.astype(jnp.int32)]
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, H - 1)
+        y1i = jnp.clip(y0 + 1, 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, W - 1)
+        x1i = jnp.clip(x0 + 1, 0, W - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        a = img[y0][:, x0]
+        b = img[y0][:, x1i]
+        c = img[y1i][:, x0]
+        d = img[y1i][:, x1i]
+        return (a * (1 - wy) * (1 - wx) + b * (1 - wy) * wx
+                + c * wy * (1 - wx) + d * wy * wx)
+
+    return jax.vmap(one)(boxes, box_indices)
+
+
+@op("draw_bounding_boxes")
+def _draw_bounding_boxes(images, boxes, *, color=None):
+    """Rasterize box outlines (reference parity op) — mask-based, no loop
+    over pixels."""
+    N, H, W, C = images.shape
+    col = jnp.asarray(color if color is not None
+                      else [1.0] * C, images.dtype)
+    yy = jnp.arange(H)[:, None] / max(H - 1, 1)
+    xx = jnp.arange(W)[None, :] / max(W - 1, 1)
+
+    def one(img, bxs):
+        def draw(img, box):
+            y1, x1, y2, x2 = box
+            t = 1.0 / max(H, W)
+            on_edge = (((jnp.abs(yy - y1) < t) | (jnp.abs(yy - y2) < t))
+                       & (xx >= x1) & (xx <= x2)) | \
+                      (((jnp.abs(xx - x1) < t) | (jnp.abs(xx - x2) < t))
+                       & (yy >= y1) & (yy <= y2))
+            return jnp.where(on_edge[..., None], col, img)
+        return functools.reduce(draw, list(bxs), img)
+    return jax.vmap(one)(images, boxes)
+
+
+# --------------------------------------------------------------------------
+# random (reference generic/random/*.cpp)
+# --------------------------------------------------------------------------
+@op("random_exponential")
+def _random_exponential(*, shape, seed, lam=1.0):
+    return jax.random.exponential(jax.random.PRNGKey(seed),
+                                  tuple(shape)) / lam
+
+
+@op("random_gamma")
+def _random_gamma(*, shape, seed, alpha, beta=1.0):
+    return jax.random.gamma(jax.random.PRNGKey(seed), alpha,
+                            tuple(shape)) / beta
+
+
+@op("random_poisson")
+def _random_poisson(*, shape, seed, lam):
+    return jax.random.poisson(jax.random.PRNGKey(seed), lam,
+                              tuple(shape))
+
+
+@op("random_shuffle")
+def _random_shuffle(a, *, seed):
+    return jax.random.permutation(jax.random.PRNGKey(seed), a, axis=0)
+
+
+@op("random_multinomial")
+def _random_multinomial(logits, *, num_samples, seed):
+    s = jax.random.categorical(
+        jax.random.PRNGKey(seed), logits, axis=-1,
+        shape=(num_samples,) + logits.shape[:-1])
+    return jnp.moveaxis(s, 0, -1)
+
+
+@op("truncated_normal")
+def _truncated_normal(*, shape, seed, mean=0.0, stddev=1.0):
+    return mean + stddev * jax.random.truncated_normal(
+        jax.random.PRNGKey(seed), -2.0, 2.0, tuple(shape))
+
+
+@op("log_normal")
+def _log_normal(*, shape, seed, mean=0.0, stddev=1.0):
+    return jnp.exp(mean + stddev * jax.random.normal(
+        jax.random.PRNGKey(seed), tuple(shape)))
+
+
+@op("alpha_dropout")
+def _alpha_dropout(x, *, rate, seed, deterministic=True):
+    """SELU-preserving dropout (reference legacy random op)."""
+    if deterministic or rate <= 0.0:
+        return x
+    alpha_p = -1.7580993408473766
+    keep = 1.0 - rate
+    m = jax.random.bernoulli(jax.random.PRNGKey(seed), keep, x.shape)
+    a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+    b = -a * alpha_p * (1 - keep)
+    return a * jnp.where(m, x, alpha_p) + b
+
+
+@op("random_crop")
+def _random_crop(a, *, size, seed):
+    key = jax.random.PRNGKey(seed)
+    starts = []
+    for i, (full, want) in enumerate(zip(a.shape, size)):
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, full - want + 1))
+    return lax.dynamic_slice(a, starts, size)
+
+
+@op("dropout_inverted")
+def _dropout_inverted(x, *, rate, seed, deterministic=True):
+    return OPS["dropout"](x, rate=rate, seed=seed,
+                          deterministic=deterministic)
+
+
+# --------------------------------------------------------------------------
+# linalg extras (reference generic/blas, generic/parity_ops)
+# --------------------------------------------------------------------------
+@op("lu")
+def _lu(a):
+    import jax.scipy.linalg as jsl
+    p, l, u = jsl.lu(a)
+    return p, l, u
+
+
+op("self_adjoint_eig")(jnp.linalg.eigh)
+op("batched_gemm")(OPS["matmul"])
+
+
+@op("gemm")
+def _gemm(a, b, c=None, *, alpha=1.0, beta=0.0,
+          transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    out = alpha * jnp.matmul(a, b)
+    if c is not None and beta != 0.0:
+        out = out + beta * c
+    return out
+
+
+op("tensormmul")(OPS["tensordot"])
+op("matrix_power")(lambda a, *, n: jnp.linalg.matrix_power(a, n))
+
+
+# --------------------------------------------------------------------------
+# gradient compression (reference encode_threshold/decode_threshold,
+# encode_bitmap/decode_bitmap — libnd4j generic/compression) — delegates
+# to the Pallas-backed codec in parallel/compression.py
+# --------------------------------------------------------------------------
+@op("encode_threshold")
+def _encode_threshold(g, *, threshold):
+    from deeplearning4j_tpu.parallel import compression
+    return compression.encode_threshold(g, threshold)
+
+
+@op("decode_threshold")
+def _decode_threshold(sign, *, threshold, dtype=jnp.float32):
+    from deeplearning4j_tpu.parallel import compression
+    return compression.decode_threshold(sign, threshold, dtype)
+
+
+@op("encode_bitmap")
+def _encode_bitmap(sign):
+    from deeplearning4j_tpu.parallel import compression
+    return compression.encode_bitmap(sign)
+
+
+@op("decode_bitmap")
+def _decode_bitmap(pos, neg, *, size):
+    from deeplearning4j_tpu.parallel import compression
+    return compression.decode_bitmap(pos, neg, size)

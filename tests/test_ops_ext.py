@@ -1,0 +1,564 @@
+"""Validation for the extended declarable-op surface (ops_registry_ext).
+
+Model: the reference's OpValidation harness
+(``org.nd4j.autodiff.validation.OpValidation`` — every declarable op's
+forward checked against a trusted producer).  Here the trusted producers
+are numpy / hand-computed closed forms; gradient coverage comes from the
+existing gradcheck harness since every op is jax-differentiable.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deeplearning4j_tpu.autodiff.ops_registry import OPS
+
+rng = np.random.RandomState(7)
+
+
+def A(*shape):
+    return jnp.asarray(rng.randn(*shape).astype(np.float32))
+
+
+class TestMathTransforms:
+    def test_rint_trunc_mod(self):
+        a = jnp.asarray([1.4, -1.6, 2.5])
+        assert np.allclose(OPS["rint"](a), np.rint([1.4, -1.6, 2.5]))
+        assert np.allclose(OPS["trunc"](a), [1.0, -1.0, 2.0])
+        assert np.allclose(OPS["mod"](jnp.asarray([5.0, -5.0]),
+                                      jnp.asarray([3.0, 3.0])),
+                           [2.0, 1.0])
+
+    def test_divide_no_nan(self):
+        out = OPS["divide_no_nan"](jnp.asarray([1.0, 2.0]),
+                                   jnp.asarray([0.0, 2.0]))
+        assert np.allclose(out, [0.0, 1.0])
+
+    def test_special_functions(self):
+        import scipy.special as sp
+        x = jnp.asarray([0.5, 1.5])
+        assert np.allclose(OPS["igamma"](jnp.asarray(2.0), x),
+                           sp.gammainc(2.0, np.asarray(x)), atol=1e-5)
+        assert np.allclose(OPS["erfinv"](jnp.asarray(0.5)),
+                           sp.erfinv(0.5), atol=1e-5)
+        assert np.allclose(OPS["zeta"](jnp.asarray(2.0),
+                                       jnp.asarray(1.0)),
+                           np.pi ** 2 / 6, atol=1e-4)
+
+    def test_merge_ops(self):
+        a, b, c = A(3), A(3), A(3)
+        assert np.allclose(OPS["mergeadd"](a, b, c), a + b + c)
+        assert np.allclose(OPS["mergeavg"](a, b, c), (a + b + c) / 3)
+        assert np.allclose(OPS["mergemax"](a, b, c),
+                           np.maximum(np.maximum(a, b), c))
+        assert np.allclose(OPS["mergemaxindex"](a, b, c),
+                           np.argmax(np.stack([a, b, c]), 0))
+
+    def test_clip_by_global_norm(self):
+        a, b = jnp.ones(4) * 3, jnp.ones(4) * 4
+        ca, cb = OPS["clip_by_global_norm"](a, b, clip_norm=1.0)
+        g = np.sqrt(np.sum(np.square(ca)) + np.sum(np.square(cb)))
+        assert np.isclose(g, 1.0, atol=1e-5)
+
+    def test_clip_by_norm_zero_grad_finite(self):
+        # sqrt'(0)=inf: all-zero tensor must not NaN-poison gradients
+        for name in ("clip_by_norm", "clip_by_avg_norm"):
+            g = jax.grad(lambda a: jnp.sum(OPS[name](
+                a, clip_norm=1.0)))(jnp.zeros(3))
+            assert np.all(np.isfinite(np.asarray(g))), name
+        out = OPS["clip_by_norm"](jnp.ones(4) * 3.0, clip_norm=1.0)
+        assert np.isclose(float(jnp.linalg.norm(out)), 1.0, atol=1e-5)
+        small = jnp.asarray([0.1, 0.2])
+        assert np.allclose(OPS["clip_by_norm"](small, clip_norm=1.0),
+                           small)
+
+    def test_standardize(self):
+        x = A(4, 8)
+        out = OPS["standardize"](x, axis=-1)
+        assert np.allclose(np.mean(out, -1), 0, atol=1e-5)
+        assert np.allclose(np.std(out, -1), 1, atol=1e-4)
+
+    def test_check_numerics_eager_raises(self):
+        with pytest.raises(FloatingPointError):
+            OPS["check_numerics"](jnp.asarray([1.0, np.nan]))
+        out = OPS["check_numerics"](jnp.asarray([1.0, 2.0]))
+        assert np.allclose(out, [1.0, 2.0])
+
+
+class TestBitwise:
+    def test_basic(self):
+        a = jnp.asarray([0b1100], jnp.int32)
+        b = jnp.asarray([0b1010], jnp.int32)
+        assert int(OPS["bitwise_and"](a, b)[0]) == 0b1000
+        assert int(OPS["bitwise_or"](a, b)[0]) == 0b1110
+        assert int(OPS["bitwise_xor"](a, b)[0]) == 0b0110
+        assert int(OPS["shift_bits"](a, 1)[0]) == 0b11000
+        assert int(OPS["rshift_bits"](a, 2)[0]) == 0b11
+
+    def test_cyclic_shift(self):
+        a = jnp.asarray([1], jnp.int32)
+        out = OPS["cyclic_rshift_bits"](a, 1)
+        assert int(out[0]) == -(1 << 31)  # wraps to sign bit
+
+    def test_cyclic_shift_negative_and_zero(self):
+        # rotl(-2, 1): 0xFFFFFFFE -> 0xFFFFFFFD == -3 (logical, not
+        # sign-filling); n=0 must be the identity, not an UB 32-shift
+        a = jnp.asarray([-2], jnp.int32)
+        assert int(OPS["cyclic_shift_bits"](a, 1)[0]) == -3
+        assert int(OPS["cyclic_shift_bits"](a, 0)[0]) == -2
+        assert int(OPS["cyclic_rshift_bits"](a, 0)[0]) == -2
+
+    def test_compare_and_bitpack(self):
+        a = jnp.asarray([[1, -1, 1, -1, 1, 1, -1, -1]], jnp.float32)
+        out = OPS["compare_and_bitpack"](a, threshold=0.0)
+        assert int(out[0, 0]) == 0b10101100
+
+
+class TestReductions:
+    def test_all_any_count(self):
+        a = jnp.asarray([[1, 0, 2], [0, 0, 0]], jnp.float32)
+        assert np.array_equal(OPS["all"](a, axis=1), [False, False])
+        assert np.array_equal(OPS["any"](a, axis=1), [True, False])
+        assert np.array_equal(OPS["count_zero"](a, axis=1), [1, 3])
+
+    def test_first_last_index(self):
+        a = jnp.asarray([0.0, 0.5, 2.0, 0.1, 3.0])
+        assert int(OPS["first_index"](a, condition="gt", value=1.0)) == 2
+        assert int(OPS["last_index"](a, condition="gt", value=1.0)) == 4
+        assert int(OPS["first_index"](a, condition="gt",
+                                      value=99.0)) == -1
+
+    def test_iamax(self):
+        a = jnp.asarray([1.0, -5.0, 3.0])
+        assert int(OPS["iamax"](a)) == 1
+        assert int(OPS["iamin"](a)) == 0
+
+    def test_percentile_median(self):
+        a = A(100)
+        assert np.isclose(OPS["median"](a), np.median(a), atol=1e-5)
+        assert np.isclose(OPS["percentile"](a, q=75),
+                          np.percentile(a, 75), atol=1e-4)
+
+    def test_match_condition(self):
+        a = jnp.asarray([1.0, -2.0, 3.0, -4.0])
+        assert int(OPS["match_condition"](a, condition="lt",
+                                          value=0.0)) == 2
+
+
+class TestShapeOps:
+    def test_basics(self):
+        a = A(2, 3, 4)
+        assert int(OPS["rank"](a)) == 3
+        assert int(OPS["size"](a)) == 24
+        assert int(OPS["size_at"](a, dim=1)) == 3
+        assert OPS["flatten"](a).shape == (24,)
+        assert OPS["broadcast_to"](jnp.ones(3), shape=(2, 3)).shape == (2, 3)
+
+    def test_matrix_diag_roundtrip(self):
+        d = A(4)
+        m = OPS["matrix_diag"](d)
+        assert np.allclose(OPS["matrix_diag_part"](m), d)
+        m2 = OPS["matrix_set_diag"](jnp.zeros((4, 4)), d)
+        assert np.allclose(m, m2)
+
+    def test_matrix_band_part(self):
+        a = jnp.ones((4, 4))
+        out = OPS["matrix_band_part"](a, num_lower=0, num_upper=0)
+        assert np.allclose(out, np.eye(4))
+
+    def test_invert_permutation(self):
+        p = jnp.asarray([2, 0, 1])
+        assert np.array_equal(OPS["invert_permutation"](p), [1, 2, 0])
+
+    def test_sequence_mask(self):
+        out = OPS["sequence_mask"](jnp.asarray([1, 3]), maxlen=4)
+        assert np.allclose(out, [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+    def test_confusion_matrix(self):
+        cm = OPS["confusion_matrix"](jnp.asarray([0, 1, 1]),
+                                     jnp.asarray([0, 1, 0]),
+                                     num_classes=2)
+        assert np.array_equal(cm, [[1, 0], [1, 1]])
+
+    def test_unique(self):
+        vals, counts = OPS["unique_with_counts"](
+            jnp.asarray([3, 1, 3, 2, 1, 3]), size=3)
+        assert np.array_equal(vals, [1, 2, 3])
+        assert np.array_equal(counts, [2, 1, 3])
+
+    def test_dynamic_partition_stitch(self):
+        a = jnp.asarray([10.0, 20.0, 30.0, 40.0])
+        parts = OPS["dynamic_partition"](a, jnp.asarray([0, 1, 0, 1]),
+                                         num_partitions=2)
+        assert np.allclose(parts[0], [10, 30])
+        out = OPS["dynamic_stitch"](jnp.asarray([0, 2]),
+                                    jnp.asarray([1, 3]),
+                                    parts[0], parts[1])
+        assert np.allclose(out, a)
+
+    def test_scatter_nd(self):
+        idx = jnp.asarray([[0], [2]])
+        out = OPS["scatter_nd"](idx, jnp.asarray([1.0, 2.0]), shape=(4,))
+        assert np.allclose(out, [1, 0, 2, 0])
+
+    def test_unsorted_segments(self):
+        a = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        ids = jnp.asarray([0, 1, 0, 1])
+        assert np.allclose(OPS["unsorted_segment_sum"](
+            a, ids, num_segments=2), [4, 6])
+        assert np.allclose(OPS["unsorted_segment_mean"](
+            a, ids, num_segments=2), [2, 3])
+        assert np.allclose(OPS["unsorted_segment_prod"](
+            a, ids, num_segments=2), [3, 8])
+
+    def test_space_batch_roundtrip(self):
+        x = A(1, 4, 4, 1)
+        sb = OPS["space_to_batch"](x, block_size=2,
+                                   paddings=[[0, 0], [0, 0]])
+        assert sb.shape == (4, 2, 2, 1)
+        bs = OPS["batch_to_space"](sb, block_size=2,
+                                   crops=[[0, 0], [0, 0]])
+        assert np.allclose(bs, x, atol=1e-6)
+
+    def test_reverse_sequence(self):
+        a = jnp.arange(12.0).reshape(2, 6)
+        out = OPS["reverse_sequence"](a, jnp.asarray([3, 5]))
+        assert np.allclose(out[0], [2, 1, 0, 3, 4, 5])
+        assert np.allclose(out[1], [10, 9, 8, 7, 6, 11])
+
+    def test_nth_element(self):
+        a = jnp.asarray([5.0, 1.0, 3.0])
+        assert float(OPS["nth_element"](a, n=1)) == 3.0
+        assert float(OPS["nth_element"](a, n=0, reverse=True)) == 5.0
+
+
+class TestConvPool:
+    def test_conv1d_matches_manual(self):
+        x = A(2, 8, 3)
+        w = A(3, 3, 5)
+        out = OPS["conv1d"](x, w, padding="VALID")
+        ref = jax.lax.conv_general_dilated(
+            x, w, (1,), "VALID", dimension_numbers=("NWC", "WIO", "NWC"))
+        assert np.allclose(out, ref, atol=1e-5)
+
+    def test_conv3d_shape(self):
+        out = OPS["conv3d"](A(1, 4, 4, 4, 2), A(2, 2, 2, 2, 6),
+                            padding="VALID")
+        assert out.shape == (1, 3, 3, 3, 6)
+
+    def test_deconv2d_shape(self):
+        out = OPS["deconv2d"](A(1, 4, 4, 3), A(2, 2, 3, 8),
+                              strides=(2, 2), padding="SAME")
+        assert out.shape == (1, 8, 8, 8)
+
+    def test_sconv2d_equals_composition(self):
+        x = A(1, 6, 6, 3)
+        wd = A(3, 3, 3, 2)       # depthwise (H,W,C,M)
+        wp = A(1, 1, 6, 4)       # pointwise
+        out = OPS["sconv2d"](x, wd, wp, padding="VALID")
+        assert out.shape == (1, 4, 4, 4)
+
+    def test_pool3d(self):
+        x = A(1, 4, 4, 4, 2)
+        assert OPS["max_pooling3d"](x).shape == (1, 2, 2, 2, 2)
+        avg = OPS["avg_pooling3d"](x)
+        assert np.isclose(float(avg[0, 0, 0, 0, 0]),
+                          float(np.mean(np.asarray(
+                              x[0, :2, :2, :2, 0]))), atol=1e-5)
+
+    def test_pnorm_pool(self):
+        x = jnp.abs(A(1, 4, 4, 1))
+        out = OPS["pnormpool2d"](x, pnorm=2)
+        man = np.sqrt(np.sum(np.square(np.asarray(x[0, :2, :2, 0]))))
+        assert np.isclose(float(out[0, 0, 0, 0]), man, atol=1e-4)
+
+    def test_max_pool_with_argmax_decodes(self):
+        x = A(2, 6, 6, 3)
+        p, idx = OPS["max_pool_with_argmax"](x, kernel=(2, 2),
+                                             strides=(2, 2))
+        flat = np.asarray(x).reshape(2, -1)
+        dec = np.take_along_axis(flat, np.asarray(idx).reshape(2, -1), 1)
+        assert np.allclose(dec.reshape(p.shape), p)
+
+    def test_im2col_col2im_adjoint(self):
+        x = A(1, 5, 5, 2)
+        cols = OPS["im2col"](x, kernel=(3, 3))
+        assert cols.shape == (1, 3, 3, 18)
+        back = OPS["col2im"](jnp.ones_like(cols), input_shape=x.shape,
+                             kernel=(3, 3))
+        # center pixel is covered by all 9 windows
+        assert float(back[0, 2, 2, 0]) == 9.0
+
+    def test_lrn_identity_for_zero_alpha(self):
+        x = A(1, 4, 4, 8)
+        out = OPS["lrn"](x, alpha=0.0, beta=0.75, bias=1.0)
+        assert np.allclose(out, x, atol=1e-6)
+
+    def test_lrn_even_depth_and_value(self):
+        x = A(1, 2, 2, 8)
+        out = OPS["lrn"](x, depth=4)            # even depth: valid shape
+        assert out.shape == x.shape
+        # closed-form check at channel 0, depth=5: window = channels 0..2
+        out5 = OPS["lrn"](x, depth=5, bias=2.0, alpha=1e-2, beta=0.5)
+        xs = np.asarray(x)[0, 0, 0]
+        ref = xs[0] / np.sqrt(2.0 + 1e-2 * np.sum(xs[:3] ** 2))
+        assert np.isclose(float(out5[0, 0, 0, 0]), ref, atol=1e-5)
+
+    def test_upsampling(self):
+        x = A(1, 2, 2, 1)
+        up = OPS["upsampling2d"](x, factor=2)
+        assert up.shape == (1, 4, 4, 1)
+        assert np.allclose(up[0, :2, :2, 0], x[0, 0, 0, 0])
+
+
+class TestRecurrent:
+    def test_lstm_cell_manual(self):
+        B, I, H = 2, 3, 4
+        x, h, c = A(B, I), A(B, H), A(B, H)
+        wx, wh, b = A(I, 4 * H), A(H, 4 * H), A(4 * H)
+        hn, cn = OPS["lstm_cell"](x, h, c, wx, wh, b)
+        z = np.asarray(x) @ np.asarray(wx) + np.asarray(h) @ np.asarray(
+            wh) + np.asarray(b)
+        i_, f_, g_, o_ = np.split(z, 4, -1)
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        c_ref = sig(f_) * np.asarray(c) + sig(i_) * np.tanh(g_)
+        h_ref = sig(o_) * np.tanh(c_ref)
+        assert np.allclose(hn, h_ref, atol=1e-5)
+        assert np.allclose(cn, c_ref, atol=1e-5)
+
+    def test_lstm_layer_scan_matches_loop(self):
+        T, B, I, H = 5, 2, 3, 4
+        x = A(T, B, I)
+        h = jnp.zeros((B, H))
+        c = jnp.zeros((B, H))
+        wx, wh, b = A(I, 4 * H), A(H, 4 * H), A(4 * H)
+        hs, hT, cT = OPS["lstm_layer"](x, h, c, wx, wh, b)
+        hh, cc = h, c
+        for t in range(T):
+            hh, cc = OPS["lstm_cell"](x[t], hh, cc, wx, wh, b)
+            assert np.allclose(hs[t], hh, atol=1e-5)
+        assert np.allclose(hT, hh, atol=1e-5)
+
+    def test_gru_shapes(self):
+        T, B, I, H = 4, 2, 3, 5
+        hs, hT = OPS["gru"](A(T, B, I), jnp.zeros((B, H)),
+                            A(I, 3 * H), A(H, 3 * H), A(3 * H))
+        assert hs.shape == (T, B, H) and hT.shape == (B, H)
+
+    def test_sru_shapes(self):
+        T, B, H = 4, 2, 5
+        hs, cT = OPS["sru"](A(T, B, H), jnp.zeros((B, H)),
+                            A(H, 3 * H), A(2 * H))
+        assert hs.shape == (T, B, H)
+
+
+class TestUpdaters:
+    def test_adam_first_step(self):
+        g = jnp.ones(3)
+        u, m, v = OPS["adam_updater"](g, jnp.zeros(3), jnp.zeros(3),
+                                      lr=0.1)
+        # bias-corrected first step ≈ lr * sign(g)
+        assert np.allclose(u, 0.1, atol=1e-3)
+
+    def test_sgd(self):
+        assert np.allclose(OPS["sgd_updater"](jnp.ones(2), lr=0.5), 0.5)
+
+    def test_nesterovs_matches_reference_formula(self):
+        g, v = jnp.ones(2), jnp.zeros(2)
+        upd, v2 = OPS["nesterovs_updater"](g, v, lr=0.1, momentum=0.9)
+        assert np.allclose(v2, -0.1)
+        assert np.allclose(upd, -(0.9 * v2 - 0.1 * g))
+
+    def test_all_updaters_preserve_shape(self):
+        g = A(4)
+        z = jnp.zeros(4)
+        for name, args, kw in [
+                ("ada_max_updater", (g, z, z), dict(lr=0.1)),
+                ("nadam_updater", (g, z, z), dict(lr=0.1)),
+                ("ams_grad_updater", (g, z, z, z), dict(lr=0.1)),
+                ("ada_delta_updater", (g, z, z), {}),
+                ("ada_grad_updater", (g, z), dict(lr=0.1)),
+                ("rms_prop_updater", (g, z), dict(lr=0.1)),
+                ("ada_belief_updater", (g, z, z), dict(lr=0.1))]:
+            out = OPS[name](*args, **kw)
+            assert out[0].shape == g.shape, name
+
+
+class TestLosses:
+    def test_l2_loss(self):
+        a = A(5)
+        assert np.isclose(OPS["l2_loss"](a),
+                          np.sum(np.square(a)) / 2, atol=1e-5)
+
+    def test_hinge(self):
+        labels = jnp.asarray([1.0, 0.0])
+        logits = jnp.asarray([0.5, -2.0])
+        ref = np.mean([max(0, 1 - 0.5), max(0, 1 - 2.0)])
+        assert np.isclose(OPS["hinge_loss"](labels, logits), ref)
+
+    def test_weighted_xent_matches_plain_when_w1(self):
+        labels = jnp.asarray([1.0, 0.0, 1.0])
+        logits = A(3)
+        w = OPS["weighted_cross_entropy_with_logits"](labels, logits,
+                                                      pos_weight=1.0)
+        p = OPS["loss_sigmoid_cross_entropy"](labels, logits)
+        assert np.isclose(w, p, atol=1e-5)
+
+    def test_log_poisson(self):
+        labels = jnp.asarray([2.0])
+        logp = jnp.asarray([0.5])
+        ref = np.exp(0.5) - 2.0 * 0.5
+        assert np.isclose(OPS["log_poisson_loss"](labels, logp), ref,
+                          atol=1e-5)
+
+    def test_moments_pipeline(self):
+        a = A(3, 4)
+        cnt, s, ss = OPS["sufficient_statistics"](a, axis=[0])
+        mean, var = OPS["normalize_moments"](cnt, s, ss)
+        assert np.allclose(mean, np.mean(a, 0), atol=1e-5)
+        assert np.allclose(var, np.var(a, 0), atol=1e-4)
+
+    def test_weighted_moments_uniform(self):
+        a = A(3, 4)
+        mean, var = OPS["weighted_moments"](a, jnp.ones_like(a),
+                                            axis=(0,))
+        assert np.allclose(mean, np.mean(a, 0), atol=1e-5)
+
+
+class TestImageOps:
+    def test_hsv_roundtrip(self):
+        rgb = jnp.asarray(rng.rand(6, 6, 3).astype(np.float32))
+        back = OPS["hsv_to_rgb"](OPS["rgb_to_hsv"](rgb))
+        assert np.allclose(back, rgb, atol=1e-4)
+
+    def test_yuv_roundtrip(self):
+        rgb = jnp.asarray(rng.rand(6, 6, 3).astype(np.float32))
+        assert np.allclose(OPS["yuv_to_rgb"](OPS["rgb_to_yuv"](rgb)),
+                           rgb, atol=1e-4)
+        assert np.allclose(OPS["yiq_to_rgb"](OPS["rgb_to_yiq"](rgb)),
+                           rgb, atol=1e-4)
+
+    def test_grayscale(self):
+        rgb = jnp.ones((2, 2, 3))
+        assert np.allclose(OPS["rgb_to_grs"](rgb), 0.9999, atol=1e-3)
+
+    def test_adjust_contrast_mean_preserved(self):
+        img = jnp.asarray(rng.rand(1, 8, 8, 3).astype(np.float32))
+        out = OPS["adjust_contrast"](img, factor=2.0)
+        assert np.allclose(np.mean(out, (1, 2)), np.mean(img, (1, 2)),
+                           atol=1e-5)
+
+    def test_nms_suppresses_overlap(self):
+        boxes = jnp.asarray([[0, 0, 1, 1], [0, 0, 0.95, 0.95],
+                             [0.5, 0.5, 1.5, 1.5]], jnp.float32)
+        scores = jnp.asarray([0.9, 0.8, 0.7], jnp.float32)
+        keep = OPS["non_max_suppression"](boxes, scores,
+                                          max_output_size=3,
+                                          iou_threshold=0.5)
+        assert list(np.asarray(keep)) == [0, 2, -1]
+
+    def test_crop_and_resize_identity(self):
+        img = jnp.asarray(rng.rand(1, 5, 5, 1).astype(np.float32))
+        out = OPS["crop_and_resize"](img,
+                                     jnp.asarray([[0.0, 0.0, 1.0, 1.0]]),
+                                     jnp.asarray([0]), crop_size=(5, 5))
+        assert np.allclose(out[0], img[0], atol=1e-5)
+
+    def test_resize_bicubic_shape(self):
+        out = OPS["resize_bicubic"](A(1, 4, 4, 3), size=(8, 8))
+        assert out.shape == (1, 8, 8, 3)
+
+
+class TestRandomOps:
+    def test_shapes_and_determinism(self):
+        a = OPS["random_exponential"](shape=(100,), seed=1)
+        b = OPS["random_exponential"](shape=(100,), seed=1)
+        assert np.allclose(a, b)
+        assert float(jnp.min(a)) >= 0
+
+    def test_truncated_normal_bounds(self):
+        a = OPS["truncated_normal"](shape=(1000,), seed=0)
+        assert float(jnp.max(jnp.abs(a))) <= 2.0 + 1e-5
+
+    def test_multinomial(self):
+        logits = jnp.asarray([[0.0, 100.0]])
+        s = OPS["random_multinomial"](logits, num_samples=10, seed=0)
+        assert np.all(np.asarray(s) == 1)
+
+    def test_multinomial_batched(self):
+        logits = jnp.asarray([[100.0, 0.0, 0.0], [0.0, 0.0, 100.0]])
+        s = OPS["random_multinomial"](logits, num_samples=5, seed=0)
+        assert s.shape == (2, 5)
+        assert np.all(np.asarray(s[0]) == 0)
+        assert np.all(np.asarray(s[1]) == 2)
+
+    def test_random_crop(self):
+        out = OPS["random_crop"](A(8, 8, 3), size=(4, 4, 3), seed=3)
+        assert out.shape == (4, 4, 3)
+
+    def test_alpha_dropout_identity_when_deterministic(self):
+        x = A(10)
+        assert np.allclose(OPS["alpha_dropout"](x, rate=0.5, seed=0), x)
+
+
+class TestLinalgExtra:
+    def test_lu_reconstruct(self):
+        a = A(4, 4) + 4 * jnp.eye(4)
+        p, l, u = OPS["lu"](a)
+        assert np.allclose(p @ l @ u, a, atol=1e-4)
+
+    def test_gemm(self):
+        a, b, c = A(3, 4), A(4, 5), A(3, 5)
+        out = OPS["gemm"](a, b, c, alpha=2.0, beta=0.5)
+        assert np.allclose(out, 2 * np.asarray(a) @ np.asarray(b)
+                           + 0.5 * np.asarray(c), atol=1e-4)
+
+    def test_self_adjoint_eig(self):
+        a = A(4, 4)
+        sym = (a + a.T) / 2
+        w, v = OPS["self_adjoint_eig"](sym)
+        assert np.allclose(v @ jnp.diag(w) @ v.T, sym, atol=1e-4)
+
+    def test_matrix_power(self):
+        a = A(3, 3)
+        assert np.allclose(OPS["matrix_power"](a, n=3),
+                           np.asarray(a) @ np.asarray(a) @ np.asarray(a),
+                           atol=1e-4)
+
+
+class TestJittability:
+    """Core new ops must trace into XLA (static shapes) — the TPU path."""
+
+    def test_jit_composite(self):
+        @jax.jit
+        def f(x, w):
+            y = OPS["conv1d"](x, w, padding="SAME")
+            y = OPS["lrn"](y, depth=3)
+            y = OPS["standardize"](y, axis=-1)
+            return OPS["l2_loss"](y)
+        out = f(A(2, 8, 3), A(3, 3, 4))
+        assert np.isfinite(float(out))
+
+    def test_jit_histogram(self):
+        h = jax.jit(lambda a: OPS["histogram"](a, nbins=4))(
+            jnp.arange(8.0))
+        assert int(jnp.sum(h)) == 8
+
+    def test_jit_nms(self):
+        f = jax.jit(lambda b, s: OPS["non_max_suppression"](
+            b, s, max_output_size=4))
+        keep = f(jnp.asarray([[0, 0, 1, 1.0]] * 6),
+                 jnp.arange(6, dtype=jnp.float32))
+        assert int(keep[0]) == 5
+
+    def test_jit_lstm_layer_grad(self):
+        T, B, I, H = 3, 2, 3, 4
+
+        def loss(wx):
+            hs, _, _ = OPS["lstm_layer"](
+                jnp.ones((T, B, I)), jnp.zeros((B, H)),
+                jnp.zeros((B, H)), wx, jnp.ones((H, 4 * H)) * 0.1,
+                jnp.zeros(4 * H))
+            return jnp.sum(hs)
+        g = jax.jit(jax.grad(loss))(jnp.ones((I, 4 * H)) * 0.1)
+        assert np.all(np.isfinite(np.asarray(g)))

@@ -41,5 +41,11 @@ from deeplearning4j_tpu.nn.layers.special import (
     FrozenLayer, LambdaLayer, CapsuleLayer, PReLULayer,
 )
 from deeplearning4j_tpu.nn.layers.objdetect import Yolo2OutputLayer
+from deeplearning4j_tpu.nn.layers.extra import (
+    LocallyConnected1DLayer, LocallyConnected2DLayer, PrimaryCapsules,
+    CapsuleStrengthLayer, OCNNOutputLayer, FrozenLayerWithBackprop,
+    MaskLayer, RepeatVector, Cropping1DLayer, Cropping3DLayer,
+    ZeroPadding1DLayer, ZeroPadding3DLayer, Deconvolution3DLayer,
+)
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,388 @@
+"""Remaining reference layer families: locally-connected, capsnet
+primary/strength, one-class output, shape utilities, 1D/3D pad-crop.
+
+Reference classes (deeplearning4j-nn, org.deeplearning4j.nn.conf.layers):
+  LocallyConnected1D / LocallyConnected2D (samediff-backed upstream),
+  PrimaryCapsules / CapsuleStrengthLayer (capsnet family, with
+  CapsuleLayer in special.py), ``ocnn.OCNNOutputLayer`` (one-class NN,
+  Chalapathy et al.), ``misc.FrozenLayerWithBackprop``,
+  ``misc.RepeatVector``, ``util.MaskLayer``, Cropping1D / Cropping3D,
+  ZeroPadding1DLayer / ZeroPadding3DLayer, Deconvolution3D.
+
+TPU-native design notes: locally-connected layers extract patches once
+and run ONE batched einsum over all spatial positions (an MXU batched
+matmul) instead of the reference's per-position sliced matmuls; all
+shape ops are pure reshapes/pads that XLA fuses away.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.nn import weights as winit
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+@register_layer
+@dataclass
+class LocallyConnected2DLayer(Layer):
+    """Conv2D with UNSHARED weights per output position (reference
+    LocallyConnected2D). One einsum ``bpk,pko->bpo`` over flattened
+    positions — a single large batched matmul on the MXU."""
+    n_out: int = 0
+    kernel: Sequence[int] = (3, 3)
+    strides: Sequence[int] = (1, 1)
+    padding: str = "VALID"
+    has_bias: bool = True
+
+    def _out_hw(self, input_shape):
+        h, w, _ = input_shape
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.strides)
+        if self.padding.upper() == "SAME":
+            return -(-h // sh), -(-w // sw)
+        return (h - kh) // sh + 1, (w - kw) // sw + 1
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        c = input_shape[-1]
+        kh, kw = _pair(self.kernel)
+        oh, ow = self._out_hw(input_shape)
+        wi = winit.get(self.weight_init or "xavier")
+        params = {"W": wi(key, (oh * ow, kh * kw * c, self.n_out), dtype)}
+        if self.has_bias:
+            params["b"] = jnp.full((oh * ow, self.n_out), self.bias_init,
+                                   dtype)
+        return params, {}, (oh, ow, self.n_out)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        from deeplearning4j_tpu.autodiff.ops_registry import OPS
+        cols = OPS["im2col"](x, kernel=_pair(self.kernel),
+                             strides=_pair(self.strides),
+                             padding=self.padding.upper())
+        B, oh, ow, K = cols.shape
+        z = jnp.einsum("bpk,pko->bpo", cols.reshape(B, oh * ow, K),
+                       params["W"])
+        if self.has_bias:
+            z = z + params["b"]
+        y = self._act()(z.reshape(B, oh, ow, self.n_out))
+        return self._maybe_dropout(y, train, rng), state
+
+
+@register_layer
+@dataclass
+class LocallyConnected1DLayer(Layer):
+    """1D unshared-weight convolution (reference LocallyConnected1D).
+    Input [B, W, C]."""
+    n_out: int = 0
+    kernel: int = 3
+    stride: int = 1
+    padding: str = "VALID"
+    has_bias: bool = True
+
+    def _out_w(self, input_shape):
+        w, _ = input_shape
+        if self.padding.upper() == "SAME":
+            return -(-w // self.stride)
+        return (w - self.kernel) // self.stride + 1
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        c = input_shape[-1]
+        ow = self._out_w(input_shape)
+        wi = winit.get(self.weight_init or "xavier")
+        params = {"W": wi(key, (ow, self.kernel * c, self.n_out), dtype)}
+        if self.has_bias:
+            params["b"] = jnp.full((ow, self.n_out), self.bias_init, dtype)
+        return params, {}, (ow, self.n_out)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        from deeplearning4j_tpu.autodiff.ops_registry import OPS
+        cols = OPS["im2col"](x[:, :, None, :], kernel=(self.kernel, 1),
+                             strides=(self.stride, 1),
+                             padding=self.padding.upper())
+        B, ow = cols.shape[0], cols.shape[1]
+        z = jnp.einsum("bpk,pko->bpo",
+                       cols.reshape(B, ow, -1), params["W"])
+        if self.has_bias:
+            z = z + params["b"]
+        return self._act()(z), state
+
+
+@register_layer
+@dataclass
+class PrimaryCapsules(Layer):
+    """Conv → capsule reshape → squash (reference PrimaryCapsules,
+    capsnet family; feeds CapsuleLayer)."""
+    capsules: Optional[int] = None      # inferred from conv output
+    capsule_dim: int = 8
+    channels: int = 32                  # conv output = channels*capsule_dim
+    kernel: Sequence[int] = (9, 9)
+    strides: Sequence[int] = (2, 2)
+    padding: str = "VALID"
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        c_in = input_shape[-1]
+        kh, kw = _pair(self.kernel)
+        n_out = self.channels * self.capsule_dim
+        wi = winit.get(self.weight_init or "xavier")
+        params = {"W": wi(key, (kh, kw, c_in, n_out), dtype),
+                  "b": jnp.full((n_out,), self.bias_init, dtype)}
+        h, w, _ = input_shape
+        sh, sw = _pair(self.strides)
+        if self.padding.upper() == "SAME":
+            oh, ow = -(-h // sh), -(-w // sw)
+        else:
+            oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+        self.capsules = oh * ow * self.channels
+        return params, {}, (self.capsules, self.capsule_dim)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        z = lax.conv_general_dilated(
+            x, params["W"], window_strides=_pair(self.strides),
+            padding=self.padding.upper(),
+            dimension_numbers=("NHWC", "HWIO", "NHWC")) + params["b"]
+        caps = z.reshape(z.shape[0], -1, self.capsule_dim)
+        n2 = jnp.sum(jnp.square(caps), axis=-1, keepdims=True)
+        return (n2 / (1 + n2)) * caps / jnp.sqrt(n2 + 1e-9), state
+
+
+@register_layer
+@dataclass
+class CapsuleStrengthLayer(Layer):
+    """Capsule vector norms → class probabilities (reference
+    CapsuleStrengthLayer)."""
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        return {}, {}, (input_shape[0],)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=-1) + 1e-9), state
+
+    def has_params(self):
+        return False
+
+
+@register_layer
+@dataclass
+class OCNNOutputLayer(Layer):
+    """One-class neural network output (reference ocnn.OCNNOutputLayer,
+    Chalapathy et al. 2018): decision score w·g(Vx) − r with hinge loss
+    (1/ν)·mean(relu(r − w·g(Vx))).
+
+    The margin r lives in ``state`` (non-trainable); the reference
+    updates it each epoch to the ν-quantile of scores — call
+    :meth:`updated_r` with a batch of scores to do the same. ||V||²+||w||²
+    regularization comes from the inherited ``l2`` field."""
+    hidden_size: int = 32
+    nu: float = 0.04
+    initial_r_value: float = 0.1
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        import math
+        n_in = int(math.prod(input_shape))
+        kv, kw = jax.random.split(key)
+        wi = winit.get(self.weight_init or "xavier")
+        params = {"V": wi(kv, (n_in, self.hidden_size), dtype),
+                  "w": wi(kw, (self.hidden_size, 1), dtype)}
+        return params, {"r": jnp.asarray(self.initial_r_value, dtype)}, (1,)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if x.ndim > 2:
+            x = x.reshape(x.shape[0], -1)
+        g = self._act("sigmoid")(x @ params["V"])
+        return g @ params["w"] - state["r"], state
+
+    def compute_loss_fn(self):
+        nu = self.nu
+
+        def fn(y, out, mask=None):
+            # out = score - r; labels unused (one-class)
+            h = jax.nn.relu(-out)
+            if mask is not None:
+                h = h * mask
+            return jnp.mean(h) / nu
+        return fn
+
+    def updated_r(self, scores) -> jnp.ndarray:
+        """New margin: the ν-quantile of decision scores (call between
+        epochs, then write into the network state)."""
+        return jnp.quantile(scores, self.nu)
+
+
+@register_layer
+@dataclass
+class FrozenLayerWithBackprop(Layer):
+    """Frozen params but gradients still flow to earlier layers
+    (reference misc.FrozenLayerWithBackprop). Functionally: params are
+    lax.stop_gradient-ed inside the trace; input gradients pass through."""
+    underlying: Optional[Layer] = None
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        return self.underlying.init(key, input_shape, dtype)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        frozen = jax.tree.map(lax.stop_gradient, params)
+        return self.underlying.apply(frozen, state, x, train=train,
+                                     rng=rng, mask=mask)
+
+    def propagate_mask(self, mask, input_shape):
+        return self.underlying.propagate_mask(mask, input_shape)
+
+    @property
+    def trainable_(self):
+        return False
+
+
+@register_layer
+@dataclass
+class MaskLayer(Layer):
+    """Zeroes activations at masked timesteps (reference util.MaskLayer).
+    Input [B, T, C] with mask [B, T]."""
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        return {}, {}, tuple(input_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if mask is not None:
+            x = x * mask[..., None].astype(x.dtype)
+        return x, state
+
+    def has_params(self):
+        return False
+
+
+@register_layer
+@dataclass
+class RepeatVector(Layer):
+    """[B, C] → [B, n, C] (reference misc.RepeatVector)."""
+    n: int = 1
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        return {}, {}, (self.n,) + tuple(input_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        return jnp.repeat(x[:, None, ...], self.n, axis=1), state
+
+    def has_params(self):
+        return False
+
+
+@register_layer
+@dataclass
+class Cropping1DLayer(Layer):
+    """Crop along the single spatial axis of [B, W, C]
+    (reference Cropping1D)."""
+    cropping: Sequence[int] = (0, 0)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        lo, hi = self.cropping
+        return {}, {}, (input_shape[0] - lo - hi, input_shape[1])
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        lo, hi = self.cropping
+        return x[:, lo:x.shape[1] - hi, :], state
+
+    def has_params(self):
+        return False
+
+
+@register_layer
+@dataclass
+class Cropping3DLayer(Layer):
+    """Crop [B, D, H, W, C] (reference Cropping3D)."""
+    cropping: Sequence[int] = (0, 0, 0, 0, 0, 0)  # d1,d2,h1,h2,w1,w2
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        d1, d2, h1, h2, w1, w2 = self.cropping
+        d, h, w, c = input_shape
+        return {}, {}, (d - d1 - d2, h - h1 - h2, w - w1 - w2, c)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        d1, d2, h1, h2, w1, w2 = self.cropping
+        _, d, h, w, _ = x.shape
+        return x[:, d1:d - d2, h1:h - h2, w1:w - w2, :], state
+
+    def has_params(self):
+        return False
+
+
+@register_layer
+@dataclass
+class ZeroPadding1DLayer(Layer):
+    """Zero-pad the spatial axis of [B, W, C]
+    (reference ZeroPadding1DLayer)."""
+    padding: Sequence[int] = (1, 1)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        lo, hi = self.padding
+        return {}, {}, (input_shape[0] + lo + hi, input_shape[1])
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        lo, hi = self.padding
+        return jnp.pad(x, ((0, 0), (lo, hi), (0, 0))), state
+
+    def has_params(self):
+        return False
+
+
+@register_layer
+@dataclass
+class ZeroPadding3DLayer(Layer):
+    """Zero-pad [B, D, H, W, C] (reference ZeroPadding3DLayer)."""
+    padding: Sequence[int] = (1, 1, 1, 1, 1, 1)
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        d1, d2, h1, h2, w1, w2 = self.padding
+        d, h, w, c = input_shape
+        return {}, {}, (d + d1 + d2, h + h1 + h2, w + w1 + w2, c)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        d1, d2, h1, h2, w1, w2 = self.padding
+        return jnp.pad(x, ((0, 0), (d1, d2), (h1, h2), (w1, w2),
+                           (0, 0))), state
+
+    def has_params(self):
+        return False
+
+
+@register_layer
+@dataclass
+class Deconvolution3DLayer(Layer):
+    """Transposed 3D convolution (reference Deconvolution3D); NDHWC."""
+    n_out: int = 0
+    kernel: Sequence[int] = (2, 2, 2)
+    strides: Sequence[int] = (2, 2, 2)
+    padding: str = "SAME"
+    has_bias: bool = True
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        d, h, w, c = input_shape
+        kd, kh, kw = self.kernel
+        sd, sh, sw = self.strides
+        wi = winit.get(self.weight_init or "xavier")
+        params = {"W": wi(key, (kd, kh, kw, c, self.n_out), dtype)}
+        if self.has_bias:
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        if self.padding.upper() == "SAME":
+            od, oh, ow = d * sd, h * sh, w * sw
+        else:
+            od = (d - 1) * sd + kd
+            oh = (h - 1) * sh + kh
+            ow = (w - 1) * sw + kw
+        return params, {}, (od, oh, ow, self.n_out)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        z = lax.conv_transpose(
+            x, params["W"], strides=tuple(self.strides),
+            padding=self.padding.upper(),
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        return self._act()(z), state

@@ -1,0 +1,210 @@
+"""Gradient + shape checks for the extra layer families
+(locally-connected, capsnet primary/strength, OCNN, shape utilities).
+
+Reference analog: GradientCheckTests / CNNGradientCheckTest coverage of
+LocallyConnected*, CapsNet layers, OCNNOutputLayer (SURVEY §4).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.layers import (
+    LocallyConnected1DLayer, LocallyConnected2DLayer, PrimaryCapsules,
+    CapsuleStrengthLayer, OCNNOutputLayer, FrozenLayerWithBackprop,
+    MaskLayer, RepeatVector, Cropping1DLayer, Cropping3DLayer,
+    ZeroPadding1DLayer, ZeroPadding3DLayer, Deconvolution3DLayer,
+    DenseLayer, ConvolutionLayer, CapsuleLayer,
+)
+from deeplearning4j_tpu.nn.layers.base import layer_from_dict
+from deeplearning4j_tpu.utils import check_gradients
+
+KEY = jax.random.PRNGKey(0)
+
+
+def _run(layer, input_shape, batch=2):
+    params, state, out_shape = layer.init(KEY, input_shape)
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch,) + input_shape)
+    y, _ = layer.apply(params, state, x)
+    assert y.shape == (batch,) + tuple(out_shape), (y.shape, out_shape)
+    return params, state, x, y
+
+
+def _gradcheck(layer, input_shape, batch=2, tol=1e-4):
+    params, state, _ = layer.init(KEY, input_shape)
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch,) + input_shape)
+
+    def loss(p, xx):
+        y, _ = layer.apply(p, state, xx)
+        return jnp.sum(jnp.sin(y))
+
+    if params:
+        check_gradients(loss, params, x, max_rel_error=tol)
+    check_gradients(lambda xx, p: loss(p, xx), x, params,
+                    max_rel_error=tol)
+
+
+def test_locally_connected_2d_gradcheck():
+    _gradcheck(LocallyConnected2DLayer(n_out=2, kernel=(2, 2),
+                                       activation="tanh"), (4, 4, 3))
+
+
+def test_locally_connected_2d_differs_per_position():
+    # with unshared weights, identical input patches map to DIFFERENT
+    # outputs at different positions (the defining property vs conv)
+    layer = LocallyConnected2DLayer(n_out=1, kernel=(1, 1))
+    params, state, _ = layer.init(KEY, (3, 3, 1))
+    x = jnp.ones((1, 3, 3, 1))
+    y, _ = layer.apply(params, state, x)
+    vals = np.asarray(y).ravel()
+    assert len(np.unique(np.round(vals, 6))) > 1
+
+
+def test_locally_connected_1d_gradcheck():
+    _gradcheck(LocallyConnected1DLayer(n_out=3, kernel=2,
+                                       activation="tanh"), (6, 2))
+
+
+def test_capsnet_stack():
+    # PrimaryCapsules -> CapsuleLayer -> CapsuleStrengthLayer end-to-end
+    prim = PrimaryCapsules(capsule_dim=4, channels=2, kernel=(3, 3),
+                           strides=(2, 2))
+    p1, s1, shp1 = prim.init(KEY, (8, 8, 1))
+    caps = CapsuleLayer(capsules=3, capsule_dim=6, routings=2)
+    p2, s2, shp2 = caps.init(KEY, shp1)
+    strength = CapsuleStrengthLayer()
+    _, _, shp3 = strength.init(KEY, shp2)
+    assert shp3 == (3,)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 8, 8, 1))
+    h, _ = prim.apply(p1, s1, x)
+    # squash keeps norms < 1
+    norms = jnp.linalg.norm(h, axis=-1)
+    assert float(jnp.max(norms)) < 1.0
+    h, _ = caps.apply(p2, s2, h)
+    probs, _ = strength.apply({}, {}, h)
+    assert probs.shape == (2, 3)
+    assert float(jnp.min(probs)) >= 0
+
+
+def test_ocnn_output_layer():
+    layer = OCNNOutputLayer(hidden_size=8, nu=0.1, activation="sigmoid")
+    params, state, out_shape = layer.init(KEY, (5,))
+    assert out_shape == (1,)
+    x = jax.random.normal(jax.random.PRNGKey(3), (16, 5))
+    scores, _ = layer.apply(params, state, x)
+    loss_fn = layer.compute_loss_fn()
+    loss = loss_fn(None, scores)
+    assert np.isfinite(float(loss))
+    # gradients flow to V and w through the hinge
+    def f(p):
+        s, _ = layer.apply(p, state, x)
+        return loss_fn(None, s)
+    g = jax.grad(f)(params)
+    assert any(float(jnp.sum(jnp.abs(leaf))) > 0
+               for leaf in jax.tree.leaves(g))
+    # r update: nu-quantile of scores
+    r2 = layer.updated_r(scores)
+    frac_below = float(jnp.mean(scores <= r2))
+    assert abs(frac_below - 0.1) < 0.2
+
+
+def test_frozen_with_backprop_passes_input_grads():
+    inner = DenseLayer(n_out=3, activation="tanh")
+    layer = FrozenLayerWithBackprop(underlying=inner)
+    params, state, _ = layer.init(KEY, (4,))
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 4))
+
+    def loss_p(p):
+        y, _ = layer.apply(p, state, x)
+        return jnp.sum(y)
+
+    def loss_x(xx):
+        y, _ = layer.apply(params, state, xx)
+        return jnp.sum(y)
+
+    gp = jax.grad(loss_p)(params)
+    assert all(float(jnp.sum(jnp.abs(leaf))) == 0
+               for leaf in jax.tree.leaves(gp))      # params frozen
+    gx = jax.grad(loss_x)(x)
+    assert float(jnp.sum(jnp.abs(gx))) > 0           # input grads flow
+
+
+def test_mask_layer():
+    layer = MaskLayer()
+    _, state, _ = layer.init(KEY, (4, 3))
+    x = jnp.ones((2, 4, 3))
+    mask = jnp.asarray([[1, 1, 0, 0], [1, 1, 1, 1]], jnp.float32)
+    y, _ = layer.apply({}, state, x, mask=mask)
+    assert float(jnp.sum(y[0, 2:])) == 0
+    assert float(jnp.sum(y[1])) == 12
+
+
+def test_repeat_vector():
+    layer = RepeatVector(n=3)
+    _run(layer, (5,))
+    y, _ = layer.apply({}, {}, jnp.arange(4.0).reshape(1, 4))
+    assert np.allclose(y[0, 0], y[0, 2])
+
+
+def test_crop_pad_1d_3d():
+    _run(Cropping1DLayer(cropping=(1, 2)), (8, 3))
+    _run(ZeroPadding1DLayer(padding=(2, 1)), (8, 3))
+    _run(Cropping3DLayer(cropping=(1, 1, 0, 1, 1, 0)), (4, 5, 6, 2))
+    _run(ZeroPadding3DLayer(padding=(1, 0, 2, 0, 0, 1)), (3, 3, 3, 2))
+    # pad then crop is identity
+    pad = ZeroPadding1DLayer(padding=(2, 2))
+    crop = Cropping1DLayer(cropping=(2, 2))
+    x = jax.random.normal(KEY, (1, 4, 2))
+    y, _ = pad.apply({}, {}, x)
+    z, _ = crop.apply({}, {}, y)
+    assert np.allclose(z, x)
+
+
+def test_deconv3d_gradcheck():
+    _gradcheck(Deconvolution3DLayer(n_out=2, kernel=(2, 2, 2),
+                                    strides=(2, 2, 2),
+                                    activation="tanh"), (2, 2, 2, 3),
+               tol=5e-4)
+    _, _, shp = Deconvolution3DLayer(
+        n_out=2, strides=(2, 2, 2)).init(KEY, (2, 3, 4, 1))
+    assert shp == (4, 6, 8, 2)
+
+
+def test_extra_layers_serialization_roundtrip():
+    for layer in [LocallyConnected2DLayer(n_out=2, kernel=(2, 2)),
+                  PrimaryCapsules(capsule_dim=4, channels=2),
+                  OCNNOutputLayer(hidden_size=8),
+                  RepeatVector(n=3),
+                  Cropping3DLayer(cropping=(1, 0, 1, 0, 1, 0)),
+                  FrozenLayerWithBackprop(
+                      underlying=DenseLayer(n_out=3))]:
+        d = layer.to_dict()
+        back = layer_from_dict(d)
+        assert type(back) is type(layer)
+        assert back.to_dict() == d
+
+
+def test_locally_connected_in_network():
+    """End-to-end: locally-connected feature extractor trains."""
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import OutputLayer
+    from deeplearning4j_tpu.nn import updaters as upd
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 6, 6, 1).astype(np.float32)
+    labels = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 32)]
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater(upd.Adam(learning_rate=5e-3)).list()
+            .layer(LocallyConnected2DLayer(n_out=2, kernel=(3, 3),
+                                           activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.convolutional(6, 6, 1)).build())
+    net = MultiLayerNetwork(conf).init()
+    ds = DataSet(x, labels)
+    s0 = net.score(ds)
+    net.fit(ListDataSetIterator([ds], batch_size=32), epochs=20)
+    assert net.score(ds) < s0

@@ -1,8 +1,8 @@
 """Evaluation — reference: ``org.nd4j.evaluation`` package."""
 from deeplearning4j_tpu.eval_.evaluation import (
-    Evaluation, RegressionEvaluation, ROC, ROCMultiClass,
+    Evaluation, RegressionEvaluation, ROC, ROCMultiClass, ROCBinary,
     EvaluationBinary, EvaluationCalibration,
 )
 
 __all__ = ["Evaluation", "RegressionEvaluation", "ROC", "ROCMultiClass",
-           "EvaluationBinary", "EvaluationCalibration"]
+           "ROCBinary", "EvaluationBinary", "EvaluationCalibration"]

@@ -239,6 +239,51 @@ class ROCMultiClass:
                               for r in self.rocs.values()]))
 
 
+class ROCBinary:
+    """Per-output ROC for multi-label (sigmoid) outputs — one
+    independent binary ROC per output column (reference ROCBinary).
+    Mask columns via the per-example ``mask`` argument."""
+
+    def __init__(self):
+        self.rocs = {}
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        preds = np.asarray(predictions)
+        if labels.ndim == 1:
+            labels = labels[:, None]
+            preds = preds[:, None]
+        m = np.asarray(mask) if mask is not None else None
+        for c in range(labels.shape[-1]):
+            lc, pc = labels[..., c], preds[..., c]
+            if m is not None:
+                mc = m[..., c] if m.ndim == labels.ndim else m
+                keep = mc.ravel() > 0
+                lc, pc = lc.ravel()[keep], pc.ravel()[keep]
+            self.rocs.setdefault(c, ROC()).eval(lc, pc)
+
+    def num_labels(self) -> int:
+        return len(self.rocs)
+
+    def calculate_auc(self, output: int) -> float:
+        return self.rocs[output].calculate_auc()
+
+    def calculate_auprc(self, output: int) -> float:
+        return self.rocs[output].calculate_auprc()
+
+    def average_auc(self) -> float:
+        return float(np.mean([r.calculate_auc()
+                              for r in self.rocs.values()]))
+
+    def stats(self) -> str:
+        lines = ["ROCBinary (per-output AUC):"]
+        for c, r in sorted(self.rocs.items()):
+            lines.append(f"  out {c}: AUC={r.calculate_auc():.4f} "
+                         f"AUPRC={r.calculate_auprc():.4f}")
+        lines.append(f"  average AUC: {self.average_auc():.4f}")
+        return "\n".join(lines)
+
+
 class EvaluationCalibration:
     """Reliability/calibration histograms (reference
     EvaluationCalibration)."""

@@ -74,6 +74,9 @@ class MultiLayerConfiguration:
     tbptt_fwd_length: int = 20
     tbptt_back_length: int = 20
     mini_batch: bool = True
+    # per-layer-index input preprocessors (reference
+    # ListBuilder.inputPreProcessor(idx, proc))
+    input_preprocessors: dict = field(default_factory=dict)
 
     def __post_init__(self):
         if self.updater is None:
@@ -95,6 +98,9 @@ class MultiLayerConfiguration:
             "backprop_type": self.backprop_type,
             "tbptt_fwd_length": self.tbptt_fwd_length,
             "tbptt_back_length": self.tbptt_back_length,
+            "input_preprocessors": {
+                str(i): p.to_dict()
+                for i, p in self.input_preprocessors.items()},
         }, indent=2)
 
     @staticmethod
@@ -116,6 +122,13 @@ class MultiLayerConfiguration:
         it = d.get("input_type")
         if it:
             conf.input_type = InputType.from_dict(it)
+        pp = d.get("input_preprocessors")
+        if pp:
+            from deeplearning4j_tpu.nn.preprocessors import (
+                preprocessor_from_dict)
+            conf.input_preprocessors = {
+                int(i): preprocessor_from_dict(pd)
+                for i, pd in pp.items()}
         return conf
 
 
@@ -126,6 +139,7 @@ class ListBuilder:
         self._g = global_conf
         self._layers: List[Layer] = []
         self._input_type: Optional[InputType] = None
+        self._preprocessors: dict = {}
 
     def layer(self, *args) -> "ListBuilder":
         """layer(l) or layer(index, l) like the reference."""
@@ -147,6 +161,12 @@ class ListBuilder:
 
     def set_input_type(self, input_type: InputType) -> "ListBuilder":
         self._input_type = input_type
+        return self
+
+    def input_pre_processor(self, idx: int, proc) -> "ListBuilder":
+        """Attach an InputPreProcessor before layer ``idx`` (reference
+        ListBuilder.inputPreProcessor)."""
+        self._preprocessors[idx] = proc
         return self
 
     def backprop_type(self, t: str) -> "ListBuilder":
@@ -176,6 +196,7 @@ class ListBuilder:
             backprop_type=self._g.backprop_type_,
             tbptt_fwd_length=self._g.tbptt_fwd_,
             tbptt_back_length=self._g.tbptt_back_,
+            input_preprocessors=dict(self._preprocessors),
         )
 
 

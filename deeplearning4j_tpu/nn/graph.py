@@ -254,7 +254,10 @@ class ComputationGraph:
             m = next((masks.get(i) for i in node.inputs
                       if masks.get(i) is not None), None)
             if node.kind == "vertex":
-                acts[node.name] = node.obj.apply(xs)
+                if node.obj.needs_mask:
+                    acts[node.name] = node.obj.apply(xs, mask=m)
+                else:
+                    acts[node.name] = node.obj.apply(xs)
                 masks[node.name] = node.obj.propagate_mask(m)
                 continue
             layer = node.obj

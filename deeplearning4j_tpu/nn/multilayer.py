@@ -83,6 +83,9 @@ class MultiLayerNetwork:
         self._input_shape = shape
         self._layer_shapes = []
         for i, layer in enumerate(self.layers):
+            proc = self.conf.input_preprocessors.get(i)
+            if proc is not None:
+                shape = proc.output_shape(shape)
             key, sub = jax.random.split(key)
             p, s, shape = layer.init(sub, shape, dtype)
             self.params[_lname(i)] = p
@@ -136,9 +139,14 @@ class MultiLayerNetwork:
         new_state = {}
         rnn_states = {}
         n = len(self.layers) if stop_at is None else stop_at
+        preprocs = self.conf.input_preprocessors
         for i in range(n):
             layer = self.layers[i]
             name = _lname(i)
+            proc = preprocs.get(i)
+            if proc is not None:
+                x = proc.pre_process(x)
+                mask = proc.propagate_mask(mask)
             if rng is not None:
                 rng, sub = jax.random.split(rng)
             else:

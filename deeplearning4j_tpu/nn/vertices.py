@@ -26,12 +26,20 @@ def register_vertex(cls):
 def vertex_from_dict(d: Dict[str, Any]):
     d = dict(d)
     cls = _VERTEX_REGISTRY[d.pop("@class")]
+    if isinstance(d.get("preprocessor"), dict):
+        from deeplearning4j_tpu.nn.preprocessors import (
+            preprocessor_from_dict)
+        d["preprocessor"] = preprocessor_from_dict(d["preprocessor"])
     return cls(**{k: v for k, v in d.items()
                   if k in {f.name for f in dataclasses.fields(cls)}})
 
 
 @dataclass
 class GraphVertex:
+    #: subclasses that consume the sequence mask set this True; the
+    #: graph then calls ``apply(inputs, mask=m)``
+    needs_mask = False
+
     def apply(self, inputs: List[jax.Array]) -> jax.Array:
         raise NotImplementedError
 
@@ -229,6 +237,111 @@ class PoolHelperVertex(GraphVertex):
     def output_shape(self, shapes):
         s = shapes[0]
         return (s[0] - 1, s[1] - 1, s[2])
+
+
+@register_vertex
+@dataclass
+class L2Vertex(GraphVertex):
+    """Pairwise L2 distance between two activation tensors → [B, 1]
+    (reference L2Vertex, used by siamese/triplet setups)."""
+    eps: float = 1e-8
+
+    def apply(self, inputs):
+        a = inputs[0].reshape(inputs[0].shape[0], -1)
+        b = inputs[1].reshape(inputs[1].shape[0], -1)
+        d2 = jnp.sum(jnp.square(a - b), axis=-1, keepdims=True)
+        # guarded sqrt: finite grad when the two branches coincide
+        safe = jnp.where(d2 > 0, d2, 1.0)
+        return jnp.where(d2 > 0, jnp.sqrt(safe), self.eps)
+
+    def output_shape(self, shapes):
+        return (1,)
+
+    def propagate_mask(self, mask):
+        return None
+
+
+@register_vertex
+@dataclass
+class LastTimeStepVertex(GraphVertex):
+    """Select the last non-masked timestep of [B, T, F] → [B, F]
+    (reference LastTimeStepVertex)."""
+    needs_mask = True
+
+    def apply(self, inputs, mask=None):
+        x = inputs[0]
+        if mask is None:
+            return x[:, -1, :]
+        lengths = jnp.sum(mask.astype(jnp.int32), axis=1)
+        idx = jnp.maximum(lengths - 1, 0)
+        return jnp.take_along_axis(
+            x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0, :]
+
+    def output_shape(self, shapes):
+        return (shapes[0][-1],)
+
+    def propagate_mask(self, mask):
+        return None          # time axis is gone
+
+
+@register_vertex
+@dataclass
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """Broadcast a [B, F] vector across the time axis of a reference
+    sequence input → [B, T, F] (reference DuplicateToTimeSeriesVertex).
+    inputs = [vector, timeseries-shape-reference]."""
+
+    def apply(self, inputs):
+        vec, ts = inputs[0], inputs[1]
+        return jnp.broadcast_to(vec[:, None, :],
+                                (vec.shape[0], ts.shape[1],
+                                 vec.shape[-1]))
+
+    def output_shape(self, shapes):
+        return (shapes[1][0], shapes[0][-1])
+
+
+@register_vertex
+@dataclass
+class ReverseTimeSeriesVertex(GraphVertex):
+    """Mask-aware time reversal of [B, T, F] (reference
+    ReverseTimeSeriesVertex): only the valid prefix is reversed, padding
+    stays in place."""
+    needs_mask = True
+
+    def apply(self, inputs, mask=None):
+        x = inputs[0]
+        if mask is None:
+            return jnp.flip(x, axis=1)
+        lengths = jnp.sum(mask.astype(jnp.int32), axis=1)
+        t = jnp.arange(x.shape[1])
+        idx = jnp.where(t[None, :] < lengths[:, None],
+                        lengths[:, None] - 1 - t[None, :], t[None, :])
+        return jnp.take_along_axis(x, idx[:, :, None], axis=1)
+
+    def output_shape(self, shapes):
+        return tuple(shapes[0])
+
+
+@register_vertex
+@dataclass
+class PreprocessorVertex(GraphVertex):
+    """Wraps an InputPreProcessor as a vertex (reference
+    PreprocessorVertex)."""
+    preprocessor: Optional[Any] = None
+
+    def apply(self, inputs):
+        return self.preprocessor.pre_process(inputs[0])
+
+    def output_shape(self, shapes):
+        return self.preprocessor.output_shape(shapes[0])
+
+    def propagate_mask(self, mask):
+        return self.preprocessor.propagate_mask(mask)
+
+    def to_dict(self):
+        return {"@class": type(self).__name__,
+                "preprocessor": self.preprocessor.to_dict()}
 
 
 @register_vertex

@@ -1,0 +1,189 @@
+"""Vertices (L2/LastTimeStep/DuplicateToTimeSeries/ReverseTimeSeries/
+Preprocessor), the InputPreProcessor family, and ROCBinary.
+
+Reference analogs: ComputationGraphTestRNN / TestGraphNodes,
+preprocessor unit tests, ROCBinaryTest (SURVEY §4).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.vertices import (
+    L2Vertex, LastTimeStepVertex, DuplicateToTimeSeriesVertex,
+    ReverseTimeSeriesVertex, PreprocessorVertex, vertex_from_dict,
+)
+from deeplearning4j_tpu.nn.preprocessors import (
+    CnnToFeedForwardPreProcessor, FeedForwardToCnnPreProcessor,
+    RnnToFeedForwardPreProcessor, FeedForwardToRnnPreProcessor,
+    CnnToRnnPreProcessor, RnnToCnnPreProcessor,
+    ComposableInputPreProcessor, preprocessor_from_dict,
+)
+from deeplearning4j_tpu.eval_ import ROCBinary
+
+
+class TestVertices:
+    def test_l2_vertex(self):
+        a = jnp.asarray([[3.0, 0.0], [0.0, 0.0]])
+        b = jnp.asarray([[0.0, 4.0], [0.0, 0.0]])
+        d = L2Vertex().apply([a, b])
+        assert np.isclose(float(d[0, 0]), 5.0)
+        # coincident inputs: finite gradient (guarded sqrt)
+        g = jax.grad(lambda x: jnp.sum(L2Vertex().apply([x, x])))(a)
+        assert np.all(np.isfinite(np.asarray(g)))
+
+    def test_last_time_step_vertex_masked(self):
+        x = jnp.arange(24.0).reshape(2, 4, 3)
+        mask = jnp.asarray([[1, 1, 0, 0], [1, 1, 1, 1]], jnp.float32)
+        v = LastTimeStepVertex()
+        out = v.apply([x], mask=mask)
+        assert np.allclose(out[0], x[0, 1])     # len 2 -> step 1
+        assert np.allclose(out[1], x[1, 3])
+        assert np.allclose(v.apply([x]), x[:, -1])
+        assert v.output_shape([(4, 3)]) == (3,)
+
+    def test_duplicate_to_time_series(self):
+        vec = jnp.asarray([[1.0, 2.0]])
+        ts = jnp.zeros((1, 5, 7))
+        out = DuplicateToTimeSeriesVertex().apply([vec, ts])
+        assert out.shape == (1, 5, 2)
+        assert np.allclose(out[0, 3], [1.0, 2.0])
+
+    def test_reverse_time_series_masked(self):
+        x = jnp.arange(8.0).reshape(1, 8, 1)
+        mask = jnp.asarray([[1, 1, 1, 0, 0, 0, 0, 0]], jnp.float32)
+        out = ReverseTimeSeriesVertex().apply([x], mask=mask)
+        # valid prefix reversed, padding untouched
+        assert np.allclose(out[0, :3, 0], [2, 1, 0])
+        assert np.allclose(out[0, 3:, 0], [3, 4, 5, 6, 7])
+        full = ReverseTimeSeriesVertex().apply([x])
+        assert np.allclose(full[0, :, 0], np.arange(8.0)[::-1])
+
+    def test_preprocessor_vertex_roundtrip(self):
+        v = PreprocessorVertex(
+            preprocessor=CnnToFeedForwardPreProcessor())
+        x = jnp.ones((2, 3, 3, 2))
+        assert v.apply([x]).shape == (2, 18)
+        assert v.output_shape([(3, 3, 2)]) == (18,)
+        back = vertex_from_dict(v.to_dict())
+        assert isinstance(back.preprocessor, CnnToFeedForwardPreProcessor)
+
+
+class TestPreprocessors:
+    def test_cnn_ff_roundtrip(self):
+        x = jnp.arange(36.0).reshape(1, 3, 3, 4)
+        ff = CnnToFeedForwardPreProcessor().pre_process(x)
+        assert ff.shape == (1, 36)
+        back = FeedForwardToCnnPreProcessor(
+            height=3, width=3, channels=4).pre_process(ff)
+        assert np.allclose(back, x)
+
+    def test_rnn_ff_roundtrip(self):
+        x = jnp.arange(30.0).reshape(2, 5, 3)
+        ff = RnnToFeedForwardPreProcessor().pre_process(x)
+        assert ff.shape == (10, 3)
+        back = FeedForwardToRnnPreProcessor(
+            time_steps=5).pre_process(ff)
+        assert np.allclose(back, x)
+
+    def test_rnn_ff_mask(self):
+        mask = jnp.ones((2, 5))
+        m = RnnToFeedForwardPreProcessor().propagate_mask(mask)
+        assert m.shape == (10,)
+        m2 = FeedForwardToRnnPreProcessor(
+            time_steps=5).propagate_mask(m)
+        assert m2.shape == (2, 5)
+
+    def test_cnn_rnn(self):
+        x = jnp.ones((2, 4, 3, 5))
+        seq = CnnToRnnPreProcessor().pre_process(x)
+        assert seq.shape == (2, 4, 15)
+        back = RnnToCnnPreProcessor(width=3, channels=5).pre_process(seq)
+        assert back.shape == (2, 4, 3, 5)
+
+    def test_output_shapes_match_pre_process(self):
+        cases = [
+            (CnnToFeedForwardPreProcessor(), (4, 4, 3)),
+            (FeedForwardToCnnPreProcessor(height=2, width=2,
+                                          channels=3), (12,)),
+            (CnnToRnnPreProcessor(), (4, 4, 3)),
+            (RnnToCnnPreProcessor(width=2, channels=2), (5, 4)),
+        ]
+        for proc, shape in cases:
+            x = jnp.zeros((2,) + shape)
+            got = proc.pre_process(x).shape[1:]
+            assert tuple(got) == tuple(proc.output_shape(shape)), proc
+
+    def test_composable_and_serialization(self):
+        comp = ComposableInputPreProcessor(processors=[
+            CnnToFeedForwardPreProcessor(),
+            FeedForwardToCnnPreProcessor(height=2, width=2, channels=9)])
+        x = jnp.ones((1, 6, 6, 1))
+        assert comp.pre_process(x).shape == (1, 2, 2, 9)
+        back = preprocessor_from_dict(comp.to_dict())
+        assert isinstance(back, ComposableInputPreProcessor)
+        # nested procs rehydrate as dicts -> rebuild
+        assert len(back.processors) == 2
+
+    def test_in_network_config(self):
+        """cnn -> preprocessor -> dense end-to-end with JSON roundtrip."""
+        from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.config import (InputType,
+                                                  MultiLayerConfiguration)
+        from deeplearning4j_tpu.nn.layers import (ConvolutionLayer,
+                                                  OutputLayer)
+        from deeplearning4j_tpu.nn import updaters as upd
+
+        conf = (NeuralNetConfiguration.builder().seed(3)
+                .updater(upd.Sgd(learning_rate=1e-2)).list()
+                .layer(ConvolutionLayer(n_out=2, kernel_size=(3, 3),
+                                        padding="VALID",
+                                        activation="relu"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .input_pre_processor(1, CnnToFeedForwardPreProcessor())
+                .set_input_type(InputType.convolutional(5, 5, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).randn(4, 5, 5, 1).astype(np.float32)
+        out = net.output(x)
+        assert out.shape == (4, 2)
+        # JSON round-trip preserves the preprocessor map
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        assert isinstance(conf2.input_preprocessors[1],
+                          CnnToFeedForwardPreProcessor)
+        net2 = MultiLayerNetwork(conf2).init()
+        assert net2.output(x).shape == (4, 2)
+
+
+class TestROCBinary:
+    def test_perfect_and_random(self):
+        roc = ROCBinary()
+        labels = np.asarray([[1, 0], [1, 1], [0, 0], [0, 1]], np.float32)
+        # output 0: perfectly separable; output 1: inverted
+        # (col-1 labels are [0,1,0,1] -> scores 1-label)
+        preds = np.asarray([[0.9, 0.9], [0.8, 0.1], [0.1, 0.8],
+                            [0.2, 0.2]], np.float32)
+        roc.eval(labels, preds)
+        assert roc.num_labels() == 2
+        assert roc.calculate_auc(0) == 1.0
+        assert roc.calculate_auc(1) == 0.0
+        assert np.isclose(roc.average_auc(), 0.5)
+        assert "out 0" in roc.stats()
+
+    def test_masked_columns(self):
+        roc = ROCBinary()
+        labels = np.asarray([[1], [0], [1], [0]], np.float32)
+        preds = np.asarray([[0.9], [0.8], [0.2], [0.1]], np.float32)
+        mask = np.asarray([[1], [0], [0], [1]], np.float32)
+        roc.eval(labels, preds, mask=mask)
+        assert roc.calculate_auc(0) == 1.0   # kept rows are separable
+
+    def test_accumulates_batches(self):
+        roc = ROCBinary()
+        rng = np.random.RandomState(0)
+        for _ in range(3):
+            labels = (rng.rand(16, 3) > 0.5).astype(np.float32)
+            roc.eval(labels, labels * 0.8 + 0.1)
+        assert roc.num_labels() == 3
+        assert roc.average_auc() == 1.0

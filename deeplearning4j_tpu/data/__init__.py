@@ -10,6 +10,13 @@ from deeplearning4j_tpu.data.datasets import (
     EmnistDataSetIterator, Cifar10DataSetIterator, SvhnDataSetIterator,
     IrisDataSetIterator,
 )
+from deeplearning4j_tpu.data.records import (
+    RecordReader, CollectionRecordReader, CSVRecordReader,
+    LineRecordReader, RegexLineRecordReader, CSVSequenceRecordReader,
+    FileRecordReader, JacksonLineRecordReader, SVMLightRecordReader,
+    TransformProcessRecordReader, RecordReaderDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)
 from deeplearning4j_tpu.data.normalizers import (
     NormalizerStandardize, NormalizerMinMaxScaler,
     ImagePreProcessingScaler,
@@ -31,4 +38,9 @@ __all__ = [
     "CropImageTransform", "FlipImageTransform", "RotateImageTransform",
     "ColorConversionTransform", "EqualizeHistTransform",
     "PipelineImageTransform",
+    "RecordReader", "CollectionRecordReader", "CSVRecordReader",
+    "LineRecordReader", "RegexLineRecordReader", "CSVSequenceRecordReader",
+    "FileRecordReader", "JacksonLineRecordReader", "SVMLightRecordReader",
+    "TransformProcessRecordReader", "RecordReaderDataSetIterator",
+    "SequenceRecordReaderDataSetIterator",
 ]

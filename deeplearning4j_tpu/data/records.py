@@ -138,6 +138,79 @@ class CSVSequenceRecordReader(RecordReader):
             yield [rec for rec in rr]
 
 
+class FileRecordReader(RecordReader):
+    """Whole file content per record (reference FileRecordReader)."""
+
+    def __init__(self, paths: Sequence):
+        self.paths = list(paths)
+
+    def __iter__(self):
+        for p in self.paths:
+            yield [Path(p).read_text()]
+
+
+class JacksonLineRecordReader(RecordReader):
+    """One JSON object per line, selected fields in order (reference
+    JacksonLineRecordReader over a FieldSelection)."""
+
+    def __init__(self, path_or_text, fields: Sequence[str]):
+        self.base = LineRecordReader(path_or_text)
+        self.fields = list(fields)
+
+    def __iter__(self):
+        import json
+        for (line,) in self.base:
+            if not line.strip():
+                continue
+            obj = json.loads(line)
+            yield [obj.get(f) for f in self.fields]
+
+
+class SVMLightRecordReader(RecordReader):
+    """SVMLight/LibSVM sparse format ``label idx:val ...`` → dense row +
+    label (reference SVMLightRecordReader). 1-based indices by default;
+    ``zero_based`` for LibSVM-style 0-based files."""
+
+    def __init__(self, path_or_text, num_features: int,
+                 zero_based: bool = False):
+        self.path_or_text = path_or_text
+        self.num_features = num_features
+        self.zero_based = zero_based
+
+    def __iter__(self):
+        p = Path(str(self.path_or_text))
+        text = open(p).read() if p.exists() else str(self.path_or_text)
+        for line in text.splitlines():
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            label = float(parts[0])
+            row = np.zeros(self.num_features, np.float32)
+            for tok in parts[1:]:
+                idx, val = tok.split(":")
+                i = int(idx) - (0 if self.zero_based else 1)
+                row[i] = float(val)
+            yield list(row) + [int(label) if label.is_integer()
+                               else label]
+
+
+class TransformProcessRecordReader(RecordReader):
+    """Applies a TransformProcess to each record of an underlying
+    reader (reference TransformProcessRecordReader)."""
+
+    def __init__(self, reader: RecordReader, transform_process):
+        self.reader = reader
+        self.tp = transform_process
+
+    def __iter__(self):
+        out = self.tp.execute(list(self.reader))
+        return iter(out)
+
+    def reset(self):
+        self.reader.reset()
+
+
 class RecordReaderDataSetIterator:
     """Bridges a RecordReader into DataSet batches (reference
     org.deeplearning4j.datasets.datavec.RecordReaderDataSetIterator):
@@ -193,3 +266,82 @@ class RecordReaderDataSetIterator:
                 feats, labels = [], []
         if feats:
             yield flush()
+
+
+class SequenceRecordReaderDataSetIterator:
+    """Sequence reader(s) → padded [B, T, F] DataSet batches with masks
+    (reference SequenceRecordReaderDataSetIterator, ALIGN_END padding).
+
+    One reader with ``label_index`` (per-step labels from the same
+    rows), or a separate ``labels_reader`` whose sequences align 1:1
+    with the feature sequences."""
+
+    def __init__(self, features_reader: RecordReader, batch_size: int,
+                 num_classes: Optional[int] = None,
+                 labels_reader: Optional[RecordReader] = None,
+                 label_index: int = -1, regression: bool = False):
+        self.features_reader = features_reader
+        self.labels_reader = labels_reader
+        self.batch_size = batch_size
+        self.num_classes = num_classes
+        self.label_index = label_index
+        self.regression = regression
+        self.pre_processor = None
+
+    def reset(self):
+        self.features_reader.reset()
+        if self.labels_reader is not None:
+            self.labels_reader.reset()
+
+    def _pairs(self):
+        if self.labels_reader is not None:
+            for fseq, lseq in zip(self.features_reader,
+                                  self.labels_reader):
+                feats = [[float(v) for v in step] for step in fseq]
+                labs = [step[0] if len(step) == 1 else step
+                        for step in lseq]
+                yield feats, labs
+        else:
+            for seq in self.features_reader:
+                li = self.label_index % len(seq[0])
+                feats = [[float(v) for j, v in enumerate(step)
+                          if j != li] for step in seq]
+                labs = [step[li] for step in seq]
+                yield feats, labs
+
+    def _flush(self, batch):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        T = max(len(f) for f, _ in batch)
+        F = len(batch[0][0][0])
+        B = len(batch)
+        x = np.zeros((B, T, F), np.float32)
+        mask = np.zeros((B, T), np.float32)
+        if self.regression:
+            ydim = (np.asarray(batch[0][1][0]).size
+                    if not np.isscalar(batch[0][1][0]) else 1)
+            y = np.zeros((B, T, ydim), np.float32)
+        else:
+            y = np.zeros((B, T, self.num_classes), np.float32)
+        for b, (feats, labs) in enumerate(batch):
+            t = len(feats)
+            x[b, :t] = np.asarray(feats, np.float32)
+            mask[b, :t] = 1.0
+            if self.regression:
+                y[b, :t] = np.asarray(labs, np.float32).reshape(t, -1)
+            else:
+                y[b, :t] = np.eye(self.num_classes, dtype=np.float32)[
+                    np.asarray(labs, np.int64)]
+        ds = DataSet(x, y, features_mask=mask, labels_mask=mask)
+        if self.pre_processor is not None:
+            ds = self.pre_processor.transform_dataset(ds)
+        return ds
+
+    def __iter__(self):
+        batch = []
+        for pair in self._pairs():
+            batch.append(pair)
+            if len(batch) == self.batch_size:
+                yield self._flush(batch)
+                batch = []
+        if batch:
+            yield self._flush(batch)

@@ -6,5 +6,8 @@
 from deeplearning4j_tpu.clustering.vptree import VPTree
 from deeplearning4j_tpu.clustering.kdtree import KDTree
 from deeplearning4j_tpu.clustering.knn import BruteForceNearestNeighbors
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering, ClusterSet
+from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne
 
-__all__ = ["VPTree", "KDTree", "BruteForceNearestNeighbors"]
+__all__ = ["VPTree", "KDTree", "BruteForceNearestNeighbors",
+           "KMeansClustering", "ClusterSet", "BarnesHutTsne"]

@@ -8,7 +8,9 @@ from deeplearning4j_tpu.nlp.tokenization import (DefaultTokenizer,
 from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
 from deeplearning4j_tpu.nlp.word2vec import (Word2Vec, ParagraphVectors,
                                              WordVectorSerializer)
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.fasttext import FastText
 
 __all__ = ["DefaultTokenizer", "DefaultTokenizerFactory",
            "CommonPreprocessor", "VocabCache", "VocabWord", "Word2Vec",
-           "ParagraphVectors", "WordVectorSerializer"]
+           "ParagraphVectors", "WordVectorSerializer", "Glove", "FastText"]

@@ -1,0 +1,238 @@
+"""FastText — reference: ``org.deeplearning4j.models.fasttext.FastText``
+(+.Builder: supervised(), inputFile, outputFile, epochs, learningRate,
+dim, wordNgrams, minCount) which wraps the fastText C++ library via JNI.
+
+TPU-native design: no native wrapper — the model IS the math: hashed
+subword-ngram embedding buckets, text embedding = mean of word +
+subword vectors, linear softmax head; the whole train step (gather →
+mean → matmul → softmax xent → scatter-add grads) is one jitted XLA
+program over padded batches.  Supervised mode and word-vector lookup
+with subword OOV composition (the fastText signature feature) are both
+supported."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+_FNV_PRIME = 16777619
+_FNV_OFFSET = 2166136261
+
+
+def _fnv1a(s: str) -> int:
+    h = _FNV_OFFSET
+    for ch in s.encode("utf8"):
+        h = ((h ^ ch) * _FNV_PRIME) & 0xFFFFFFFF
+    return h
+
+
+def _subwords(word: str, minn: int, maxn: int) -> List[str]:
+    w = f"<{word}>"
+    out = []
+    for n in range(minn, maxn + 1):
+        for i in range(len(w) - n + 1):
+            out.append(w[i:i + n])
+    return out
+
+
+class FastText:
+    """Builder surface mirrors the reference; ``supervised`` selects the
+    classifier mode."""
+
+    def __init__(self, supervised: bool = False, dim: int = 100,
+                 epochs: int = 5, learning_rate: float = 0.1,
+                 min_count: int = 1, minn: int = 3, maxn: int = 6,
+                 bucket: int = 200000, word_ngrams: int = 1,
+                 batch_size: int = 64, max_len: int = 64,
+                 seed: int = 0, tokenizer_factory=None):
+        self.supervised = supervised
+        self.dim = dim
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.min_count = min_count
+        self.minn = minn
+        self.maxn = maxn
+        self.bucket = bucket
+        self.word_ngrams = word_ngrams
+        self.batch_size = batch_size
+        self.max_len = max_len
+        self.seed = seed
+        self.tokenizer_factory = (tokenizer_factory
+                                  or DefaultTokenizerFactory())
+        self.vocab: Optional[VocabCache] = None
+        self.labels_: List[str] = []
+        self._emb: Optional[np.ndarray] = None      # [V + bucket, dim]
+        self._head: Optional[np.ndarray] = None     # [dim, n_labels]
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def supervised(self, v=True):
+            self._kw["supervised"] = v; return self
+
+        def dim(self, v):
+            self._kw["dim"] = v; return self
+
+        def epochs(self, v):
+            self._kw["epochs"] = v; return self
+
+        def learning_rate(self, v):
+            self._kw["learning_rate"] = v; return self
+
+        def min_count(self, v):
+            self._kw["min_count"] = v; return self
+
+        def word_ngrams(self, v):
+            self._kw["word_ngrams"] = v; return self
+
+        def seed(self, v):
+            self._kw["seed"] = v; return self
+
+        def build(self):
+            return FastText(**self._kw)
+
+    @staticmethod
+    def builder():
+        return FastText.Builder()
+
+    # ------------------------------------------------------------------
+    def _token_ids(self, tokens: Sequence[str]) -> List[int]:
+        """Word id + hashed subword/word-ngram bucket ids (fastText's
+        input composition)."""
+        v = len(self.vocab)
+        ids = []
+        for t in tokens:
+            if t in self.vocab:
+                ids.append(self.vocab.index_of(t))
+            for sw in _subwords(t, self.minn, self.maxn):
+                ids.append(v + _fnv1a(sw) % self.bucket)
+        if self.word_ngrams > 1:
+            for n in range(2, self.word_ngrams + 1):
+                for i in range(len(tokens) - n + 1):
+                    ng = " ".join(tokens[i:i + n])
+                    ids.append(v + _fnv1a(ng) % self.bucket)
+        return ids[:self.max_len * 4]
+
+    def _pad(self, ids: List[int]) -> Tuple[np.ndarray, np.ndarray]:
+        L = self.max_len * 4
+        arr = np.zeros(L, np.int32)
+        m = np.zeros(L, np.float32)
+        arr[:len(ids)] = ids
+        m[:len(ids)] = 1.0
+        return arr, m
+
+    # ------------------------------------------------------------------
+    def fit(self, texts: List[str], labels: Optional[List[str]] = None):
+        """Supervised: texts + labels. Unsupervised: builds subword
+        vectors with a skipgram objective delegated to Word2Vec over
+        words, then enriches lookup with hashed subwords."""
+        streams = [self.tokenizer_factory.create(t).get_tokens()
+                   for t in texts]
+        self.vocab = VocabCache.build(streams,
+                                      min_word_frequency=self.min_count)
+        v = len(self.vocab)
+        rng = np.random.default_rng(self.seed)
+        self._emb = np.asarray(
+            rng.uniform(-0.5 / self.dim, 0.5 / self.dim,
+                        (v + self.bucket, self.dim)), np.float32)
+
+        if not self.supervised:
+            from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+            w2v = Word2Vec(layer_size=self.dim,
+                           min_word_frequency=self.min_count,
+                           epochs=self.epochs, seed=self.seed)
+            w2v.fit(texts)
+            self.vocab = w2v.vocab
+            v = len(self.vocab)
+            emb = np.asarray(
+                rng.uniform(-0.5 / self.dim, 0.5 / self.dim,
+                            (v + self.bucket, self.dim)), np.float32)
+            emb[:v] = w2v.syn0
+            self._emb = emb
+            return self
+
+        if labels is None:
+            raise ValueError("supervised mode needs labels")
+        self.labels_ = sorted(set(labels))
+        lab_idx = {l: i for i, l in enumerate(self.labels_)}
+        y = np.asarray([lab_idx[l] for l in labels], np.int32)
+        n_labels = len(self.labels_)
+
+        ids_all, mask_all = zip(*[self._pad(self._token_ids(s))
+                                  for s in streams])
+        ids_all = np.stack(ids_all)
+        mask_all = np.stack(mask_all)
+
+        emb = jnp.asarray(self._emb)
+        head = jnp.zeros((self.dim, n_labels), jnp.float32)
+        lr = self.learning_rate
+
+        @jax.jit
+        def step(emb, head, ids, mask, yb):
+            def loss_fn(emb, head):
+                vecs = emb[ids]                       # [B, L, D] gather
+                denom = jnp.maximum(
+                    jnp.sum(mask, axis=1, keepdims=True), 1.0)
+                text_vec = jnp.sum(vecs * mask[..., None], axis=1) / denom
+                logits = text_vec @ head
+                ll = jax.nn.log_softmax(logits, axis=-1)
+                return -jnp.mean(
+                    jnp.take_along_axis(ll, yb[:, None], axis=1))
+            loss, (ge, gh) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(emb, head)
+            return emb - lr * ge, head - lr * gh, loss
+
+        n = len(texts)
+        bs = min(self.batch_size, n)
+        for _ in range(self.epochs):
+            perm = rng.permutation(n)
+            for s in range(0, n, bs):
+                sel = perm[s:s + bs]
+                emb, head, _ = step(emb, head,
+                                    jnp.asarray(ids_all[sel]),
+                                    jnp.asarray(mask_all[sel]),
+                                    jnp.asarray(y[sel]))
+        self._emb = np.asarray(emb)
+        self._head = np.asarray(head)
+        return self
+
+    # ------------------------------------------------------------------
+    def _text_vector(self, text: str) -> np.ndarray:
+        tokens = self.tokenizer_factory.create(text).get_tokens()
+        ids = self._token_ids(tokens)
+        if not ids:
+            return np.zeros(self.dim, np.float32)
+        return self._emb[np.asarray(ids)].mean(axis=0)
+
+    def predict(self, text: str) -> str:
+        """predict(String) → label (reference predict)."""
+        logits = self._text_vector(text) @ self._head
+        return self.labels_[int(np.argmax(logits))]
+
+    def predict_probability(self, text: str) -> Dict[str, float]:
+        logits = self._text_vector(text) @ self._head
+        p = np.exp(logits - logits.max())
+        p /= p.sum()
+        return {l: float(p[i]) for i, l in enumerate(self.labels_)}
+
+    def get_word_vector(self, word: str) -> np.ndarray:
+        """Word vector with subword composition — works for OOV words
+        (the fastText signature capability)."""
+        v = len(self.vocab) if self.vocab is not None else 0
+        ids = []
+        if self.vocab is not None and word in self.vocab:
+            ids.append(self.vocab.index_of(word))
+        for sw in _subwords(word, self.minn, self.maxn):
+            ids.append(v + _fnv1a(sw) % self.bucket)
+        return self._emb[np.asarray(ids)].mean(axis=0)
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        return float(np.dot(va, vb)
+                     / (np.linalg.norm(va) * np.linalg.norm(vb) + 1e-12))

@@ -1,0 +1,176 @@
+"""GloVe — reference: ``org.deeplearning4j.models.glove.Glove``
+(+.Builder) in deeplearning4j-nlp: co-occurrence counting
+(``CoOccurrences``) followed by AdaGrad weighted-least-squares
+factorization.
+
+TPU-native design: the nonzero co-occurrence triples are one flat
+array; every epoch shuffles and processes them in large jitted batches
+— the loss/grad for a batch is a few gathers + elementwise math + a
+segment-sum scatter, one XLA program per batch size (vs the reference's
+per-pair scalar loop across threads)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+
+def _cooccurrence(streams: List[List[str]], vocab: VocabCache,
+                  window: int, symmetric: bool = True
+                  ) -> Dict[tuple, float]:
+    counts: Dict[tuple, float] = {}
+    for tokens in streams:
+        idx = [vocab.index_of(t) for t in tokens if t in vocab]
+        for i, wi in enumerate(idx):
+            for off in range(1, window + 1):
+                j = i + off
+                if j >= len(idx):
+                    break
+                wj = idx[j]
+                inc = 1.0 / off               # distance weighting
+                counts[(wi, wj)] = counts.get((wi, wj), 0.0) + inc
+                if symmetric:
+                    counts[(wj, wi)] = counts.get((wj, wi), 0.0) + inc
+    return counts
+
+
+class Glove:
+    """Reference Glove.Builder surface: xMax, alpha, learningRate,
+    epochs, layerSize, windowSize, minWordFrequency."""
+
+    def __init__(self, layer_size: int = 100, window_size: int = 5,
+                 min_word_frequency: int = 1, x_max: float = 100.0,
+                 alpha: float = 0.75, learning_rate: float = 0.05,
+                 epochs: int = 25, batch_size: int = 4096,
+                 symmetric: bool = True, seed: int = 0,
+                 tokenizer_factory=None):
+        self.layer_size = layer_size
+        self.window_size = window_size
+        self.min_word_frequency = min_word_frequency
+        self.x_max = x_max
+        self.alpha = alpha
+        self.learning_rate = learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.symmetric = symmetric
+        self.seed = seed
+        self.tokenizer_factory = (tokenizer_factory
+                                  or DefaultTokenizerFactory())
+        self.vocab: Optional[VocabCache] = None
+        self.syn0: Optional[np.ndarray] = None
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def layer_size(self, v):
+            self._kw["layer_size"] = v; return self
+
+        def window_size(self, v):
+            self._kw["window_size"] = v; return self
+
+        def min_word_frequency(self, v):
+            self._kw["min_word_frequency"] = v; return self
+
+        def x_max(self, v):
+            self._kw["x_max"] = v; return self
+
+        def alpha(self, v):
+            self._kw["alpha"] = v; return self
+
+        def learning_rate(self, v):
+            self._kw["learning_rate"] = v; return self
+
+        def epochs(self, v):
+            self._kw["epochs"] = v; return self
+
+        def seed(self, v):
+            self._kw["seed"] = v; return self
+
+        def build(self):
+            return Glove(**self._kw)
+
+    @staticmethod
+    def builder():
+        return Glove.Builder()
+
+    def fit(self, sentences: List[str]):
+        streams = [self.tokenizer_factory.create(s).get_tokens()
+                   for s in sentences]
+        self.vocab = VocabCache.build(
+            streams, min_word_frequency=self.min_word_frequency)
+        v = len(self.vocab)
+        co = _cooccurrence(streams, self.vocab, self.window_size,
+                           self.symmetric)
+        if not co:
+            raise ValueError("no co-occurrences (corpus too small?)")
+        pairs = np.asarray(list(co.keys()), np.int32)
+        xs = np.asarray(list(co.values()), np.float32)
+
+        d = self.layer_size
+        rng = np.random.default_rng(self.seed)
+        scale = 0.5 / d
+        # main + context vectors and biases, with AdaGrad accumulators
+        params = {
+            "w": jnp.asarray(rng.uniform(-scale, scale, (v, d)),
+                             jnp.float32),
+            "c": jnp.asarray(rng.uniform(-scale, scale, (v, d)),
+                             jnp.float32),
+            "bw": jnp.zeros(v), "bc": jnp.zeros(v)}
+        accs = jax.tree.map(lambda p: jnp.ones_like(p) * 1e-8, params)
+        x_max, alpha, lr = self.x_max, self.alpha, self.learning_rate
+
+        @jax.jit
+        def batch_step(params, accs, wi, wj, x):
+            def loss_fn(p):
+                dot = jnp.sum(p["w"][wi] * p["c"][wj], axis=1)
+                pred = dot + p["bw"][wi] + p["bc"][wj]
+                f = jnp.minimum((x / x_max) ** alpha, 1.0)
+                err = pred - jnp.log(x)
+                return jnp.sum(f * jnp.square(err))
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            new_accs = jax.tree.map(
+                lambda a, gr: a + jnp.square(gr), accs, g)
+            new_params = jax.tree.map(
+                lambda p, gr, a: p - lr * gr / jnp.sqrt(a),
+                params, g, new_accs)
+            return new_params, new_accs, loss
+
+        n = len(xs)
+        bs = min(self.batch_size, n)
+        for epoch in range(self.epochs):
+            perm = rng.permutation(n)
+            for s in range(0, n - bs + 1, bs):
+                sel = perm[s:s + bs]
+                params, accs, _ = batch_step(
+                    params, accs, jnp.asarray(pairs[sel, 0]),
+                    jnp.asarray(pairs[sel, 1]), jnp.asarray(xs[sel]))
+        self.syn0 = np.asarray(params["w"] + params["c"])
+        return self
+
+    # -- lookup API (matches Word2Vec surface) -----------------------------
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        if self.vocab is None or word not in self.vocab:
+            return None
+        return self.syn0[self.vocab.index_of(word)]
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        return float(np.dot(va, vb)
+                     / (np.linalg.norm(va) * np.linalg.norm(vb) + 1e-12))
+
+    def words_nearest(self, word: str, n: int = 10) -> List[str]:
+        v = self.get_word_vector(word)
+        norms = self.syn0 / (np.linalg.norm(self.syn0, axis=1,
+                                            keepdims=True) + 1e-12)
+        sims = norms @ (v / (np.linalg.norm(v) + 1e-12))
+        sims[self.vocab.index_of(word)] = -np.inf
+        top = np.argsort(-sims)[:n]
+        return [self.vocab.word_at(int(i)) for i in top]

@@ -1,0 +1,124 @@
+"""KMeansClustering, BarnesHutTsne, Glove, FastText.
+
+Reference analogs: KMeansTest (nearestneighbor-core), TsneTest
+(deeplearning4j-tsne), GloveTest / FastTextTest (deeplearning4j-nlp).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (KMeansClustering,
+                                           BarnesHutTsne)
+from deeplearning4j_tpu.nlp import Glove, FastText
+
+
+def _blobs(rng, n_per=30, centers=((0, 0), (6, 6), (0, 6))):
+    pts, labs = [], []
+    for i, c in enumerate(centers):
+        pts.append(rng.randn(n_per, 2) * 0.4 + np.asarray(c))
+        labs += [i] * n_per
+    return np.concatenate(pts).astype(np.float32), np.asarray(labs)
+
+
+class TestKMeans:
+    def test_recovers_blobs(self):
+        rng = np.random.RandomState(0)
+        x, labs = _blobs(rng)
+        km = KMeansClustering.setup(3, 50)
+        cs = km.apply_to(x)
+        # every true cluster maps to one dominant predicted cluster
+        for i in range(3):
+            assign = cs.assignments[labs == i]
+            dominant = np.bincount(assign).max()
+            assert dominant / len(assign) > 0.95
+        assert cs.inertia() < 100.0
+        assert len(cs.get_clusters()) == 3
+
+    def test_predict_consistent(self):
+        rng = np.random.RandomState(1)
+        x, _ = _blobs(rng)
+        km = KMeansClustering.setup(3, 30)
+        cs = km.apply_to(x)
+        again = km.predict(x)
+        assert np.array_equal(cs.assignments, again)
+
+    def test_cosine_distance_mode(self):
+        rng = np.random.RandomState(2)
+        x, _ = _blobs(rng)
+        cs = KMeansClustering.setup(3, 20,
+                                    distance="cosine").apply_to(x + 1.0)
+        assert len(np.unique(cs.assignments)) >= 2
+
+
+class TestTsne:
+    def test_separates_blobs(self):
+        rng = np.random.RandomState(0)
+        # two well-separated 10-D clusters
+        a = rng.randn(25, 10) * 0.3
+        b = rng.randn(25, 10) * 0.3 + 5.0
+        x = np.concatenate([a, b]).astype(np.float32)
+        tsne = (BarnesHutTsne.builder().perplexity(10.0)
+                .set_max_iter(250).number_of_dimensions(2).seed(0)
+                .build())
+        y = tsne.fit(x)
+        assert y.shape == (50, 2)
+        assert np.all(np.isfinite(y))
+        # embedded cluster centers far apart vs intra-cluster spread
+        ca, cb = y[:25].mean(0), y[25:].mean(0)
+        spread = max(y[:25].std(), y[25:].std())
+        assert np.linalg.norm(ca - cb) > 2 * spread
+        assert tsne.get_data() is y
+
+
+_CORPUS = ["the cat sat on the mat",
+           "the dog sat on the log",
+           "the cat chased the dog",
+           "a dog and a cat played",
+           "the mat was on the floor",
+           "cats and dogs are pets"] * 6
+
+
+class TestGlove:
+    def test_trains_and_looks_up(self):
+        g = (Glove.builder().layer_size(16).epochs(40)
+             .min_word_frequency(1).learning_rate(0.05).seed(0).build())
+        g.fit(_CORPUS)
+        v = g.get_word_vector("cat")
+        assert v is not None and v.shape == (16,)
+        assert np.isfinite(g.similarity("cat", "dog"))
+        nearest = g.words_nearest("cat", 3)
+        assert len(nearest) == 3 and "cat" not in nearest
+
+    def test_unknown_word(self):
+        g = Glove(layer_size=8, epochs=2)
+        g.fit(_CORPUS)
+        assert g.get_word_vector("zebra") is None
+
+
+class TestFastText:
+    def test_supervised_classification(self):
+        texts = (["good great excellent wonderful nice"] * 10
+                 + ["bad terrible awful horrible poor"] * 10)
+        labels = ["pos"] * 10 + ["neg"] * 10
+        ft = (FastText.builder().supervised().dim(16).epochs(30)
+              .learning_rate(0.5).seed(0).build())
+        ft.fit(texts, labels)
+        assert ft.predict("excellent wonderful") == "pos"
+        assert ft.predict("terrible awful") == "neg"
+        probs = ft.predict_probability("great nice")
+        assert abs(sum(probs.values()) - 1.0) < 1e-5
+        assert probs["pos"] > probs["neg"]
+
+    def test_oov_word_vector(self):
+        ft = FastText(supervised=True, dim=8, epochs=1)
+        ft.fit(["hello world", "goodbye world"], ["a", "b"])
+        v = ft.get_word_vector("helloo")     # OOV: subword composition
+        assert v.shape == (8,)
+        # shares subwords with an in-vocab word -> correlated vectors
+        assert ft.similarity("hello", "helloo") > \
+            ft.similarity("hello", "xyzzyq")
+
+    def test_unsupervised_mode(self):
+        ft = FastText(dim=12, epochs=2, min_count=1)
+        ft.fit(_CORPUS)
+        assert ft.get_word_vector("cat").shape == (12,)
+        assert np.isfinite(ft.similarity("cat", "dog"))

@@ -369,6 +369,126 @@ def _map_layer(class_name: str, cfg: dict):
     if cn == "UpSampling2D":
         return Upsampling2DLayer(name=cfg.get("name"),
                                  size=_pair(cfg.get("size", 2))), None
+    if cn in ("Conv2DTranspose", "Convolution2DTranspose"):
+        from deeplearning4j_tpu.nn.layers import Deconvolution2DLayer
+        return Deconvolution2DLayer(
+            name=cfg.get("name"), n_out=cfg["filters"],
+            kernel_size=_pair(cfg["kernel_size"]),
+            stride=_pair(cfg.get("strides", 1)),
+            padding=_pad(cfg.get("padding", "valid")),
+            activation=_act(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", True)), None
+    if cn in ("Conv3D", "Convolution3D"):
+        from deeplearning4j_tpu.nn.layers import Convolution3DLayer
+        k = tuple(int(v) for v in np.ravel(cfg["kernel_size"]))
+        s = tuple(int(v) for v in np.ravel(cfg.get("strides", (1, 1, 1))))
+        return Convolution3DLayer(
+            name=cfg.get("name"), n_out=cfg["filters"],
+            kernel_size=k if len(k) == 3 else k * 3,
+            stride=s if len(s) == 3 else s * 3,
+            padding=_pad(cfg.get("padding", "valid")),
+            activation=_act(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", True)), None
+    if cn in ("MaxPooling3D", "AveragePooling3D"):
+        from deeplearning4j_tpu.nn.layers import Subsampling3DLayer
+        ps = tuple(int(v) for v in np.ravel(cfg.get("pool_size", 2)))
+        ps = ps if len(ps) == 3 else ps * 3
+        st = cfg.get("strides")
+        st = (tuple(int(v) for v in np.ravel(st)) if st else ps)
+        return Subsampling3DLayer(
+            name=cfg.get("name"), kernel_size=ps,
+            stride=st if len(st) == 3 else st * 3,
+            padding=_pad(cfg.get("padding", "valid")),
+            pooling_type="max" if cn.startswith("Max") else "avg"), None
+    if cn == "UpSampling1D":
+        from deeplearning4j_tpu.nn.layers import Upsampling1DLayer
+        return Upsampling1DLayer(name=cfg.get("name"),
+                                 size=int(cfg.get("size", 2))), None
+    if cn == "UpSampling3D":
+        from deeplearning4j_tpu.nn.layers import Upsampling3DLayer
+        return Upsampling3DLayer(
+            name=cfg.get("name"),
+            size=tuple(int(v) for v in np.ravel(cfg.get("size", 2)))), None
+    if cn == "ZeroPadding1D":
+        from deeplearning4j_tpu.nn.layers import ZeroPadding1DLayer
+        p = cfg.get("padding", 1)
+        pads = ((p, p) if isinstance(p, int)
+                else tuple(int(v) for v in np.ravel(p)))
+        return ZeroPadding1DLayer(name=cfg.get("name"),
+                                  padding=pads), None
+    if cn == "Cropping1D":
+        from deeplearning4j_tpu.nn.layers import Cropping1DLayer
+        c = cfg.get("cropping", 0)
+        crops = ((c, c) if isinstance(c, int)
+                 else tuple(int(v) for v in np.ravel(c)))
+        return Cropping1DLayer(name=cfg.get("name"),
+                               cropping=crops), None
+    if cn == "ZeroPadding3D":
+        from deeplearning4j_tpu.nn.layers import ZeroPadding3DLayer
+        p = cfg.get("padding", 1)
+        pads = ((p,) * 6 if isinstance(p, int)
+                else tuple(int(v) for v in np.ravel(p)))
+        return ZeroPadding3DLayer(name=cfg.get("name"),
+                                  padding=pads), None
+    if cn == "Cropping3D":
+        from deeplearning4j_tpu.nn.layers import Cropping3DLayer
+        c = cfg.get("cropping", 0)
+        crops = ((c,) * 6 if isinstance(c, int)
+                 else tuple(int(v) for v in np.ravel(c)))
+        return Cropping3DLayer(name=cfg.get("name"),
+                               cropping=crops), None
+    if cn == "Masking":
+        from deeplearning4j_tpu.nn.layers import MaskLayer
+        return MaskLayer(name=cfg.get("name")), None
+    if cn == "RepeatVector":
+        from deeplearning4j_tpu.nn.layers import RepeatVector
+        return RepeatVector(name=cfg.get("name"), n=cfg["n"]), None
+    if cn in ("LocallyConnected2D", "LocallyConnected1D"):
+        from deeplearning4j_tpu.nn.layers import (
+            LocallyConnected1DLayer, LocallyConnected2DLayer)
+        if cn.endswith("2D"):
+            return LocallyConnected2DLayer(
+                name=cfg.get("name"), n_out=cfg["filters"],
+                kernel=_pair(cfg["kernel_size"]),
+                strides=_pair(cfg.get("strides", 1)),
+                padding=_pad(cfg.get("padding", "valid")),
+                activation=_act(cfg.get("activation")),
+                has_bias=cfg.get("use_bias", True)), None
+        return LocallyConnected1DLayer(
+            name=cfg.get("name"), n_out=cfg["filters"],
+            kernel=int(np.ravel(cfg["kernel_size"])[0]),
+            stride=int(np.ravel(cfg.get("strides", 1))[0]),
+            padding=_pad(cfg.get("padding", "valid")),
+            activation=_act(cfg.get("activation")),
+            has_bias=cfg.get("use_bias", True)), None
+    if cn in ("SpatialDropout1D", "SpatialDropout2D", "SpatialDropout3D"):
+        return DropoutLayer(name=cfg.get("name"),
+                            dropout=cfg.get("rate", 0.5)), None
+    if cn == "GaussianNoise":
+        from deeplearning4j_tpu.nn.layers import GaussianNoiseLayer
+        return GaussianNoiseLayer(name=cfg.get("name"),
+                                  stddev=cfg.get("stddev", 0.1)), None
+    if cn == "GaussianDropout":
+        from deeplearning4j_tpu.nn.layers import GaussianDropoutLayer
+        return GaussianDropoutLayer(name=cfg.get("name"),
+                                    rate=cfg.get("rate", 0.5)), None
+    if cn == "ELU":
+        return ActivationLayer(name=cfg.get("name"),
+                               activation="elu"), None
+    if cn == "Softmax":
+        return ActivationLayer(name=cfg.get("name"),
+                               activation="softmax"), None
+    if cn == "ThresholdedReLU":
+        from deeplearning4j_tpu.ops import activations as _acts
+        theta = cfg.get("theta", 1.0)
+        return ActivationLayer(
+            name=cfg.get("name"),
+            activation=f"thresholdedrelu:{theta}"), None
+    if cn == "TimeDistributed":
+        wrapped = cfg["layer"]
+        inner, _ = _map_layer(wrapped["class_name"], wrapped["config"])
+        return TimeDistributed(name=cfg.get("name"),
+                               underlying=inner), None
     raise ValueError(f"unsupported Keras layer class {class_name!r}")
 
 
@@ -486,6 +606,25 @@ def _map_weights(layer, kcfg: dict, w: List[np.ndarray]):
         return {"alpha": np.ravel(w[0])}, {}
     if isinstance(layer, EmbeddingSequenceLayer):
         return {"W": w[0]}, {}
+    from deeplearning4j_tpu.nn.layers import (
+        Deconvolution2DLayer, LocallyConnected1DLayer,
+        LocallyConnected2DLayer)
+    if isinstance(layer, Deconvolution2DLayer):
+        # Keras Conv2DTranspose kernel is (kh, kw, OUT, IN) with
+        # gradient-of-conv semantics; our conv_transpose path
+        # (transpose_kernel=False) needs IO swap + spatial flip
+        params = {"W": np.swapaxes(w[0], -1, -2)[::-1, ::-1]}
+        if layer.has_bias and len(w) > 1:
+            params["b"] = w[1]
+        return params, {}
+    if isinstance(layer, (LocallyConnected1DLayer,
+                          LocallyConnected2DLayer)):
+        # Keras LC kernel is already (positions, kh*kw*C, filters);
+        # bias (oh, ow, filters) flattens to (positions, filters)
+        params = {"W": w[0]}
+        if layer.has_bias and len(w) > 1:
+            params["b"] = w[1].reshape(-1, w[1].shape[-1])
+        return params, {}
     if isinstance(layer, (ConvolutionLayer, DenseLayer)):
         params = {"W": w[0]}
         if layer.has_bias and len(w) > 1:

@@ -46,6 +46,7 @@ from deeplearning4j_tpu.nn.layers.extra import (
     CapsuleStrengthLayer, OCNNOutputLayer, FrozenLayerWithBackprop,
     MaskLayer, RepeatVector, Cropping1DLayer, Cropping3DLayer,
     ZeroPadding1DLayer, ZeroPadding3DLayer, Deconvolution3DLayer,
+    GaussianNoiseLayer, GaussianDropoutLayer,
 )
 
 __all__ = [n for n in dir() if not n.startswith("_")]

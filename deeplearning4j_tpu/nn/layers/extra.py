@@ -276,6 +276,45 @@ class RepeatVector(Layer):
 
 @register_layer
 @dataclass
+class GaussianNoiseLayer(Layer):
+    """Train-time additive gaussian noise (reference dropout.GaussianNoise
+    as a dropout type; Keras GaussianNoise)."""
+    stddev: float = 0.1
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        return {}, {}, tuple(input_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if train and rng is not None and self.stddev > 0:
+            x = x + self.stddev * jax.random.normal(rng, x.shape, x.dtype)
+        return x, state
+
+    def has_params(self):
+        return False
+
+
+@register_layer
+@dataclass
+class GaussianDropoutLayer(Layer):
+    """Multiplicative gaussian noise 𝒩(1, rate/(1-rate)) (reference
+    dropout.GaussianDropout; Keras GaussianDropout)."""
+    rate: float = 0.5
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        return {}, {}, tuple(input_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        if train and rng is not None and 0 < self.rate < 1:
+            sd = (self.rate / (1.0 - self.rate)) ** 0.5
+            x = x * (1.0 + sd * jax.random.normal(rng, x.shape, x.dtype))
+        return x, state
+
+    def has_params(self):
+        return False
+
+
+@register_layer
+@dataclass
 class Cropping1DLayer(Layer):
     """Crop along the single spatial axis of [B, W, C]
     (reference Cropping1D)."""

@@ -1,0 +1,163 @@
+"""Keras import conformance for the extended mapper set:
+Conv2DTranspose, Conv3D, pooling/pad/crop/upsampling 1D/3D,
+LocallyConnected, Masking/RepeatVector, noise layers, activations.
+
+Reference analog: KerasModelEndToEndTest (import → forward → compare
+to Keras-produced activations)."""
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+
+from deeplearning4j_tpu.modelimport import KerasModelImport  # noqa: E402
+
+
+def _roundtrip(model, tmp_path, x, rtol=1e-4, atol=1e-5):
+    path = str(tmp_path / "m.h5")
+    model.save(path)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    ref = np.asarray(model(x, training=False))
+    got = np.asarray(net.output(x))
+    np.testing.assert_allclose(got, ref, rtol=rtol, atol=atol)
+    return net
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return np.random.default_rng(11)
+
+
+def test_conv2d_transpose(tmp_path, rng):
+    model = keras.Sequential([
+        keras.layers.Input((6, 6, 3)),
+        keras.layers.Conv2DTranspose(5, 2, strides=2, padding="same",
+                                     activation="relu"),
+        keras.layers.Flatten(),
+        keras.layers.Dense(4),
+    ])
+    x = rng.normal(size=(2, 6, 6, 3)).astype(np.float32)
+    _roundtrip(model, tmp_path, x)
+
+
+def test_conv3d_and_pool3d(tmp_path, rng):
+    model = keras.Sequential([
+        keras.layers.Input((6, 6, 6, 2)),
+        keras.layers.Conv3D(4, 2, activation="relu", padding="valid"),
+        keras.layers.MaxPooling3D(2),
+        keras.layers.Flatten(),
+        keras.layers.Dense(3),
+    ])
+    x = rng.normal(size=(2, 6, 6, 6, 2)).astype(np.float32)
+    _roundtrip(model, tmp_path, x)
+
+
+def test_pad_crop_upsample_1d(tmp_path, rng):
+    model = keras.Sequential([
+        keras.layers.Input((10, 3)),
+        keras.layers.ZeroPadding1D(2),
+        keras.layers.Conv1D(4, 3, activation="relu"),
+        keras.layers.Cropping1D((1, 2)),
+        keras.layers.UpSampling1D(2),
+        keras.layers.GlobalMaxPooling1D(),
+        keras.layers.Dense(2),
+    ])
+    x = rng.normal(size=(2, 10, 3)).astype(np.float32)
+    _roundtrip(model, tmp_path, x)
+
+
+def test_pad_crop_3d(tmp_path, rng):
+    model = keras.Sequential([
+        keras.layers.Input((4, 4, 4, 2)),
+        keras.layers.ZeroPadding3D(1),
+        keras.layers.Cropping3D(((1, 0), (0, 1), (1, 1))),
+        keras.layers.Flatten(),
+        keras.layers.Dense(3),
+    ])
+    x = rng.normal(size=(2, 4, 4, 4, 2)).astype(np.float32)
+    _roundtrip(model, tmp_path, x)
+
+
+def test_locally_connected_mapper(rng):
+    """Keras 3 removed LocallyConnected*; the mapper still imports
+    Keras-2-era h5 configs — checked at mapper level against a manual
+    per-position matmul."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.modelimport.keras_import import (
+        _map_layer, _map_weights)
+    layer, _ = _map_layer("LocallyConnected2D", {
+        "name": "lc", "filters": 3, "kernel_size": [2, 2],
+        "strides": [1, 1], "padding": "valid", "activation": "linear",
+        "use_bias": True})
+    oh = ow = 3   # 4x4 input, 2x2 valid kernel
+    kW = rng.normal(size=(oh * ow, 2 * 2 * 2, 3)).astype(np.float32)
+    kb = rng.normal(size=(oh, ow, 3)).astype(np.float32)
+    params, state = _map_weights(layer, {}, [kW, kb])
+    x = rng.normal(size=(1, 4, 4, 2)).astype(np.float32)
+    layer.init(__import__("jax").random.PRNGKey(0), (4, 4, 2))
+    y, _ = layer.apply({k: jnp.asarray(v) for k, v in params.items()},
+                       state, jnp.asarray(x))
+    # manual: position (i,j) uses its own kernel slice
+    patches = np.stack([x[0, i:i + 2, j:j + 2, :].reshape(-1)
+                        for i in range(3) for j in range(3)])
+    ref = np.einsum("pk,pko->po", patches, kW) + kb.reshape(9, 3)
+    np.testing.assert_allclose(np.asarray(y[0]).reshape(9, 3), ref,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_repeat_vector_and_masking(tmp_path, rng):
+    model = keras.Sequential([
+        keras.layers.Input((6,)),
+        keras.layers.Dense(5, activation="relu"),
+        keras.layers.RepeatVector(4),
+        keras.layers.LSTM(7, return_sequences=False),
+        keras.layers.Dense(2),
+    ])
+    x = rng.normal(size=(3, 6)).astype(np.float32)
+    _roundtrip(model, tmp_path, x, rtol=1e-3, atol=1e-4)
+
+
+def test_noise_layers_inference_identity(tmp_path, rng):
+    # noise layers are train-only: at inference the import must match
+    model = keras.Sequential([
+        keras.layers.Input((8,)),
+        keras.layers.GaussianNoise(0.5),
+        keras.layers.Dense(6, activation="relu"),
+        keras.layers.GaussianDropout(0.3),
+        keras.layers.Dense(3),
+    ])
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    _roundtrip(model, tmp_path, x)
+
+
+def test_activation_layers(tmp_path, rng):
+    model = keras.Sequential([
+        keras.layers.Input((7,)),
+        keras.layers.Dense(6),
+        keras.layers.ELU(),
+        keras.layers.Dense(4),
+        keras.layers.Softmax(),
+    ])
+    x = rng.normal(size=(3, 7)).astype(np.float32)
+    _roundtrip(model, tmp_path, x)
+
+
+def test_thresholded_relu_mapper():
+    """ThresholdedReLU was dropped in Keras 3; mapper-level check."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.modelimport.keras_import import _map_layer
+    layer, _ = _map_layer("ThresholdedReLU", {"theta": 0.7})
+    layer.init(__import__("jax").random.PRNGKey(0), (4,))
+    y, _ = layer.apply({}, {}, jnp.asarray([[0.5, 0.8, -1.0, 2.0]]))
+    np.testing.assert_allclose(np.asarray(y[0]), [0, 0.8, 0, 2.0])
+
+
+def test_spatial_dropout_inference(tmp_path, rng):
+    model = keras.Sequential([
+        keras.layers.Input((8, 8, 3)),
+        keras.layers.Conv2D(4, 3, activation="relu"),
+        keras.layers.SpatialDropout2D(0.4),
+        keras.layers.Flatten(),
+        keras.layers.Dense(2),
+    ])
+    x = rng.normal(size=(2, 8, 8, 3)).astype(np.float32)
+    _roundtrip(model, tmp_path, x)

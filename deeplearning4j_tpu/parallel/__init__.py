@@ -21,8 +21,14 @@ from deeplearning4j_tpu.parallel.master import (
     ParameterAveragingTrainingMaster, SharedTrainingMaster,
     SparkDl4jMultiLayer, SparkComputationGraph, ShardedDataSetIterator,
 )
+from deeplearning4j_tpu.parallel.moe import MixtureOfExperts
+from deeplearning4j_tpu.parallel.pipeline import (
+    pipeline_apply, pipeline_train_step, make_mlp_stage,
+)
 
 __all__ = [
+    "MixtureOfExperts", "pipeline_apply", "pipeline_train_step",
+    "make_mlp_stage",
     "make_mesh", "data_parallel_mesh", "initialize_distributed",
     "ParallelWrapper", "ParallelInference",
     "EncodedGradientsAccumulator", "encode_threshold", "decode_threshold",

@@ -19,9 +19,10 @@ from deeplearning4j_tpu.zoo.nasnet import NASNet
 from deeplearning4j_tpu.zoo.simple_cnn import SimpleCNN
 from deeplearning4j_tpu.zoo.textgen_lstm import TextGenerationLSTM
 from deeplearning4j_tpu.zoo.bert import Bert, BertBase, BertTiny
+from deeplearning4j_tpu.zoo.facenet import FaceNetNN4Small2
 
 __all__ = ["LeNet", "AlexNet", "VGG16", "VGG19", "ResNet50",
            "SqueezeNet", "Darknet19", "TinyYOLO", "YOLO2", "UNet",
            "Xception", "InceptionResNetV1", "NASNet", "SimpleCNN",
            "TextGenerationLSTM", "TINY_YOLO_ANCHORS", "YOLO2_ANCHORS",
-           "Bert", "BertBase", "BertTiny"]
+           "Bert", "BertBase", "BertTiny", "FaceNetNN4Small2"]

@@ -1,0 +1,120 @@
+"""FaceNet NN4-small2 — reference:
+``org.deeplearning4j.zoo.model.FaceNetNN4Small2`` (the OpenFace
+nn4.small2 variant of Szegedy-style GoogLeNet inception modules,
+trained with center loss on face identities; embeddingSize=128).
+
+ComputationGraph: conv stem → inception 3a/3b/3c → 4a/4e → 5a/5b →
+avgpool → 128-d bottleneck → L2-normalize → CenterLossOutputLayer
+(reference uses the center-loss head for the face-embedding objective).
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.config import (InputType,
+                                          NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import (BatchNormalization,
+                                          ConvolutionLayer, DenseLayer,
+                                          GlobalPoolingLayer,
+                                          CenterLossOutputLayer,
+                                          SubsamplingLayer)
+from deeplearning4j_tpu.nn.vertices import L2NormalizeVertex, MergeVertex
+from deeplearning4j_tpu.nn import updaters as upd
+
+
+class FaceNetNN4Small2:
+    def __init__(self, num_classes: int = 5749, seed: int = 123,
+                 updater=None, input_shape=(96, 96, 3),
+                 embedding_size: int = 128, lambda_center: float = 0.003):
+        self.num_classes = num_classes
+        self.seed = seed
+        self.updater = updater or upd.Adam(learning_rate=0.1)
+        self.input_shape = input_shape
+        self.embedding_size = embedding_size
+        self.lambda_center = lambda_center
+
+    def _cb(self, b, name, inp, n_out, kernel, stride=(1, 1),
+            padding="SAME"):
+        b.add_layer(f"{name}_c",
+                    ConvolutionLayer(n_out=n_out, kernel_size=kernel,
+                                     stride=stride, padding=padding,
+                                     has_bias=False,
+                                     activation="identity"), inp)
+        b.add_layer(f"{name}_bn", BatchNormalization(activation="relu"),
+                    f"{name}_c")
+        return f"{name}_bn"
+
+    def _inception(self, b, name, inp, *, c1, c3r, c3, c5r, c5, pp,
+                   pool="max", stride=(1, 1)):
+        """GoogLeNet-style module: 1×1, 3×3 (reduced), 5×5 (reduced),
+        pool-proj branches concatenated. Branch sizes of 0 are omitted
+        (nn4.small2 drops branches in later modules)."""
+        branches = []
+        if c1:
+            branches.append(self._cb(b, f"{name}_1x1", inp, c1, (1, 1),
+                                     stride))
+        if c3:
+            r = self._cb(b, f"{name}_3x3r", inp, c3r, (1, 1))
+            branches.append(self._cb(b, f"{name}_3x3", r, c3, (3, 3),
+                                     stride))
+        if c5:
+            r = self._cb(b, f"{name}_5x5r", inp, c5r, (1, 1))
+            branches.append(self._cb(b, f"{name}_5x5", r, c5, (5, 5),
+                                     stride))
+        b.add_layer(f"{name}_pool",
+                    SubsamplingLayer(kernel_size=(3, 3), stride=stride,
+                                     padding="SAME", pooling_type=pool),
+                    inp)
+        if pp:
+            branches.append(self._cb(b, f"{name}_pp", f"{name}_pool",
+                                     pp, (1, 1)))
+        else:
+            branches.append(f"{name}_pool")
+        b.add_vertex(f"{name}_cat", MergeVertex(), *branches)
+        return f"{name}_cat"
+
+    def conf(self):
+        h, w, c = self.input_shape
+        b = (NeuralNetConfiguration.builder().seed(self.seed)
+             .updater(self.updater)
+             .graph_builder().add_inputs("input"))
+        x = self._cb(b, "conv1", "input", 64, (7, 7), (2, 2))
+        b.add_layer("pool1", SubsamplingLayer(kernel_size=(3, 3),
+                                              stride=(2, 2),
+                                              padding="SAME",
+                                              pooling_type="max"), x)
+        x = self._cb(b, "conv2", "pool1", 64, (1, 1))
+        x = self._cb(b, "conv3", x, 192, (3, 3))
+        b.add_layer("pool3", SubsamplingLayer(kernel_size=(3, 3),
+                                              stride=(2, 2),
+                                              padding="SAME",
+                                              pooling_type="max"), x)
+        x = self._inception(b, "3a", "pool3", c1=64, c3r=96, c3=128,
+                            c5r=16, c5=32, pp=32)
+        x = self._inception(b, "3b", x, c1=64, c3r=96, c3=128,
+                            c5r=32, c5=64, pp=64)
+        x = self._inception(b, "3c", x, c1=0, c3r=128, c3=256,
+                            c5r=32, c5=64, pp=0, stride=(2, 2))
+        x = self._inception(b, "4a", x, c1=256, c3r=96, c3=192,
+                            c5r=32, c5=64, pp=128)
+        x = self._inception(b, "4e", x, c1=0, c3r=160, c3=256,
+                            c5r=64, c5=128, pp=0, stride=(2, 2))
+        x = self._inception(b, "5a", x, c1=256, c3r=96, c3=384,
+                            c5r=0, c5=0, pp=96)
+        x = self._inception(b, "5b", x, c1=256, c3r=96, c3=384,
+                            c5r=0, c5=0, pp=96, pool="avg")
+        b.add_layer("gap", GlobalPoolingLayer(pooling_type="avg"), x)
+        b.add_layer("bottleneck",
+                    DenseLayer(n_out=self.embedding_size,
+                               activation="identity"), "gap")
+        b.add_vertex("embeddings", L2NormalizeVertex(), "bottleneck")
+        b.add_layer("out",
+                    CenterLossOutputLayer(
+                        n_out=self.num_classes, activation="softmax",
+                        loss="mcxent", alpha=0.9,
+                        lambda_=self.lambda_center), "embeddings")
+        b.set_outputs("out")
+        b.set_input_types(input=InputType.convolutional(h, w, c))
+        return b.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()

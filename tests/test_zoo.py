@@ -170,3 +170,21 @@ def test_bert_mlm_labels_mask_scopes_loss():
     s_full = net2.score()
     assert np.isfinite(s_masked) and np.isfinite(s_full)
     assert s_masked != s_full
+
+
+def test_facenet_nn4_small2():
+    net = zoo.FaceNetNN4Small2(num_classes=6, input_shape=(64, 64, 3),
+                               embedding_size=32).init()
+    out = net.output(np.random.default_rng(0).normal(
+        size=(1, 64, 64, 3)).astype(np.float32))
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    assert np.asarray(out).shape == (1, 6)
+    # embedding activations are L2-normalized before the loss head
+    x = np.random.default_rng(1).normal(
+        size=(2, 64, 64, 3)).astype(np.float32)
+    acts, _ = net._forward(net.params, net.state, {"input": x},
+                           train=False, rng=None)
+    emb = np.asarray(acts["embeddings"])
+    assert emb.shape == (2, 32)
+    np.testing.assert_allclose(np.linalg.norm(emb, axis=1), 1.0,
+                               rtol=1e-4)

@@ -1226,3 +1226,129 @@ def _encode_bitmap(sign):
 def _decode_bitmap(pos, neg, *, size):
     from deeplearning4j_tpu.parallel import compression
     return compression.decode_bitmap(pos, neg, size)
+
+
+# --------------------------------------------------------------------------
+# batch 2: remaining parity/transform ops (reference generic/parity_ops,
+# generic/transforms, generic/compat)
+# --------------------------------------------------------------------------
+op("split_v")(lambda a, *, sizes, axis=0: tuple(
+    jnp.split(a, list(jnp.cumsum(jnp.asarray(sizes))[:-1]), axis=axis)))
+op("select")(jnp.where)
+op("choose")(lambda a, *, condition="gt", value=0.0: (
+    a[_CONDS[condition](a, value)]))
+
+
+@op("boolean_mask")
+def _boolean_mask(a, mask):
+    """Eager-only (data-dependent output size), like reference exec."""
+    import numpy as np
+    m = np.asarray(mask).astype(bool)
+    return jnp.asarray(np.asarray(a)[m])
+
+
+op("assign_add")(lambda a, b: a + b)
+op("assign_sub")(lambda a, b: a - b)
+op("axpy")(lambda x, y, *, alpha=1.0: alpha * x + y)
+op("realdiv")(lambda a, b: a / b)
+op("floordiv")(jnp.floor_divide)
+op("rot90")(lambda a, *, k=1: jnp.rot90(a, k, axes=(-3, -2)))
+op("flip_left_right")(lambda a: jnp.flip(a, axis=-2))
+op("flip_up_down")(lambda a: jnp.flip(a, axis=-3))
+op("rgb_to_bgr")(lambda a: jnp.flip(a, axis=-1))
+op("bits_hamming_distance")(lambda a, b: jnp.sum(
+    jax.lax.population_count(jnp.bitwise_xor(a, b))))
+op("ones")(lambda *, shape, dtype=jnp.float32: jnp.ones(tuple(shape),
+                                                        dtype))
+op("zeros")(lambda *, shape, dtype=jnp.float32: jnp.zeros(tuple(shape),
+                                                          dtype))
+op("empty")(lambda *, shape, dtype=jnp.float32: jnp.zeros(tuple(shape),
+                                                          dtype))
+op("to_float32")(lambda a: a.astype(jnp.float32))
+op("to_float16")(lambda a: a.astype(jnp.float16))
+op("to_bfloat16")(lambda a: a.astype(jnp.bfloat16))
+op("to_double")(lambda a: a.astype(jnp.float64))
+op("to_int32")(lambda a: a.astype(jnp.int32))
+op("to_int64")(lambda a: a.astype(jnp.int64))
+op("to_uint8")(lambda a: a.astype(jnp.uint8))
+op("logspace")(lambda *, start, stop, num, base=10.0: jnp.logspace(
+    start, stop, num, base=base))
+op("tri")(lambda *, n, m=None, k=0, dtype=jnp.float32: jnp.tri(
+    n, m, k, dtype=dtype))
+op("scatter_div")(lambda a, idx, upd: a.at[idx.astype(jnp.int32)]
+                  .divide(upd))
+op("segment_prod")(lambda a, ids, *, num_segments: jax.ops.segment_prod(
+    a, ids.astype(jnp.int32), num_segments))
+@op("cumsum_exclusive")
+def _cumsum_exclusive(a, *, axis=0, reverse=False):
+    """Exclusive (and optionally reversed) cumulative sum — the
+    exclusive/reverse iArgs of the reference cumsum op."""
+    if reverse:
+        a = jnp.flip(a, axis)
+    c = jnp.cumsum(a, axis=axis)
+    shifted = lax.slice_in_dim(c, 0, a.shape[axis] - 1, axis=axis)
+    zero = jnp.zeros_like(lax.slice_in_dim(a, 0, 1, axis=axis))
+    out = jnp.concatenate([zero, shifted], axis=axis)
+    return jnp.flip(out, axis) if reverse else out
+
+
+@op("dilation2d")
+def _dilation2d(x, w, *, strides=(1, 1), padding="SAME"):
+    """Grayscale morphological dilation (reference parity op; x NHWC,
+    w (kh, kw, C))."""
+    kh, kw, C = w.shape
+    win, (_, _, oh, ow) = _window_offsets(x, (kh, kw), tuple(strides),
+                                          padding, -jnp.inf)
+    # win: (N, oh, ow, C, kh*kw); add the kernel then take the max
+    return jnp.max(win + w.transpose(2, 0, 1).reshape(C, kh * kw),
+                   axis=-1)
+
+
+@op("ctc_greedy_decoder")
+def _ctc_greedy_decoder(logits, seq_lengths, *, blank=0,
+                        merge_repeated=True):
+    """Best-path CTC decode: argmax per frame, collapse repeats, strip
+    blanks (reference ctc_beam with width 1 / TF ctc_greedy_decoder).
+    Returns [B, T] decoded ids padded with -1 plus [B] lengths."""
+    path = jnp.argmax(logits, axis=-1)           # [B, T]
+    B, T = path.shape
+    frame_ok = jnp.arange(T)[None, :] < seq_lengths[:, None]
+    prev = jnp.concatenate([jnp.full((B, 1), -1, path.dtype),
+                            path[:, :-1]], axis=1)
+    keep = frame_ok & (path != blank)
+    if merge_repeated:
+        keep &= (path != prev)
+    # stable compaction: order valid entries first
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    vals = jnp.take_along_axis(path, order, axis=1)
+    kept = jnp.take_along_axis(keep, order, axis=1)
+    out = jnp.where(kept, vals, -1)
+    return out, jnp.sum(keep, axis=1)
+
+
+@op("static_bidirectional_rnn")
+def _static_bidirectional_rnn(x, h0_f, c0_f, h0_b, c0_b, wx_f, wh_f,
+                              b_f, wx_b, wh_b, b_b):
+    """Concat of forward and reversed-backward LSTM passes
+    (reference static_bidirectional_rnn). x: (T, B, I)."""
+    fwd, hf, cf = OPS["lstm_layer"](x, h0_f, c0_f, wx_f, wh_f, b_f)
+    bwd, hb, cb = OPS["lstm_layer"](jnp.flip(x, 0), h0_b, c0_b, wx_b,
+                                    wh_b, b_b)
+    return jnp.concatenate([fwd, jnp.flip(bwd, 0)], axis=-1), hf, hb
+
+
+op("lstmBlock")(OPS["lstm_layer"])
+
+
+@op("norm")
+def _norm(a, *, ord=2, axis=None, keepdims=False):
+    """Parameterized norm reduce (reference reduce_norm family)."""
+    if ord == 1:
+        return OPS["norm1"](a, axis=axis, keepdims=keepdims)
+    if ord == 2:
+        return OPS["norm2"](a, axis=axis, keepdims=keepdims)
+    if ord in ("inf", jnp.inf):
+        return OPS["norm_max"](a, axis=axis, keepdims=keepdims)
+    return jnp.sum(jnp.abs(a) ** ord,
+                   axis=tuple(axis) if isinstance(axis, list) else axis,
+                   keepdims=keepdims) ** (1.0 / ord)

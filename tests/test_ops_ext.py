@@ -562,3 +562,84 @@ class TestJittability:
             return jnp.sum(hs)
         g = jax.jit(jax.grad(loss))(jnp.ones((I, 4 * H)) * 0.1)
         assert np.all(np.isfinite(np.asarray(g)))
+
+
+class TestOpsBatch2:
+    def test_split_v(self):
+        parts = OPS["split_v"](jnp.arange(10.0), sizes=[3, 3, 4])
+        assert [p.shape[0] for p in parts] == [3, 3, 4]
+        assert np.allclose(parts[2], [6, 7, 8, 9])
+
+    def test_cumsum_exclusive(self):
+        a = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        assert np.allclose(OPS["cumsum_exclusive"](a), [0, 1, 3, 6])
+        assert np.allclose(OPS["cumsum_exclusive"](a, reverse=True),
+                           [9, 7, 4, 0])
+
+    def test_ctc_greedy_decoder(self):
+        # frames argmax: [1, 1, blank, 0... wait] use explicit logits
+        logits = jnp.asarray([[[0.0, 2.0], [0.0, 1.0], [3.0, 0.0],
+                               [3.0, 0.0], [0.0, 4.0]]])
+        ids, lens = OPS["ctc_greedy_decoder"](logits, jnp.asarray([5]))
+        # path 1,1,0,0,1 -> merge repeats & strip blank(0) -> [1, 1]
+        assert int(lens[0]) == 2
+        assert list(np.asarray(ids[0][:2])) == [1, 1]
+
+    def test_ctc_greedy_respects_seq_length(self):
+        logits = jnp.asarray([[[0.0, 2.0], [0.0, 2.0], [0.0, 2.0]]])
+        ids, lens = OPS["ctc_greedy_decoder"](logits, jnp.asarray([1]))
+        assert int(lens[0]) == 1
+
+    def test_boolean_mask_and_select(self):
+        a = jnp.asarray([1.0, 2.0, 3.0])
+        out = OPS["boolean_mask"](a, jnp.asarray([True, False, True]))
+        assert np.allclose(out, [1, 3])
+        sel = OPS["select"](jnp.asarray([True, False]),
+                            jnp.asarray([1.0, 1.0]),
+                            jnp.asarray([9.0, 9.0]))
+        assert np.allclose(sel, [1, 9])
+
+    def test_rot90_flips(self):
+        img = jnp.arange(4.0).reshape(1, 2, 2, 1)
+        r = OPS["rot90"](img)
+        assert r.shape == (1, 2, 2, 1)
+        lr = OPS["flip_left_right"](img)
+        assert float(lr[0, 0, 0, 0]) == 1.0
+        ud = OPS["flip_up_down"](img)
+        assert float(ud[0, 0, 0, 0]) == 2.0
+
+    def test_dilation2d_identity_kernel(self):
+        x = jnp.asarray(rng.rand(1, 4, 4, 1).astype(np.float32))
+        out = OPS["dilation2d"](x, jnp.zeros((1, 1, 1)),
+                                padding="VALID")
+        assert np.allclose(out, x)
+
+    def test_bidirectional_rnn_shapes(self):
+        T, B, I, H = 3, 2, 2, 4
+        z = jnp.zeros((B, H))
+        out, hf, hb = OPS["static_bidirectional_rnn"](
+            jnp.ones((T, B, I)), z, z, z, z,
+            A(I, 4 * H), A(H, 4 * H), A(4 * H),
+            A(I, 4 * H), A(H, 4 * H), A(4 * H))
+        assert out.shape == (T, B, 2 * H)
+
+    def test_norm_orders(self):
+        a = jnp.asarray([3.0, -4.0])
+        assert np.isclose(OPS["norm"](a, ord=1), 7.0)
+        assert np.isclose(OPS["norm"](a, ord=2), 5.0)
+        assert np.isclose(OPS["norm"](a, ord="inf"), 4.0)
+
+    def test_dtype_casts_and_creation(self):
+        assert OPS["to_int32"](jnp.asarray([1.7])).dtype == jnp.int32
+        assert OPS["to_bfloat16"](jnp.ones(2)).dtype == jnp.bfloat16
+        assert OPS["ones"](shape=(2, 3)).shape == (2, 3)
+        assert OPS["tri"](n=3)[0, 1] == 0.0
+
+    def test_segment_prod_scatter_div(self):
+        a = jnp.asarray([2.0, 3.0, 4.0, 5.0])
+        ids = jnp.asarray([0, 0, 1, 1])
+        assert np.allclose(OPS["segment_prod"](a, ids, num_segments=2),
+                           [6, 20])
+        out = OPS["scatter_div"](jnp.asarray([8.0, 9.0]),
+                                 jnp.asarray([0]), jnp.asarray([2.0]))
+        assert np.allclose(out, [4, 9])

@@ -13,8 +13,14 @@ from deeplearning4j_tpu.train.stats import (
     StatsListener, StatsStorage, InMemoryStatsStorage, FileStatsStorage,
     UIServer,
 )
+from deeplearning4j_tpu.train.solver import (
+    Solver, StochasticGradientDescent, LineGradientDescent,
+    ConjugateGradient, LBFGS, backtrack_line_search,
+)
 
 __all__ = [
+    "Solver", "StochasticGradientDescent", "LineGradientDescent",
+    "ConjugateGradient", "LBFGS", "backtrack_line_search",
     "TrainingListener", "ScoreIterationListener", "PerformanceListener",
     "CheckpointListener", "EvaluativeListener", "CollectScoresListener",
     "EarlyStoppingConfiguration", "EarlyStoppingTrainer",

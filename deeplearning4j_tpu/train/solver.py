@@ -1,0 +1,243 @@
+"""Legacy full-batch optimizers + Solver driver.
+
+Reference: ``org.deeplearning4j.optimize.Solver`` (+``.Builder``) and
+``org.deeplearning4j.optimize.solvers.*`` — StochasticGradientDescent,
+LBFGS, ConjugateGradient, LineGradientDescent, all built on
+``BackTrackLineSearch`` and driven by ``model.computeGradientAndScore``.
+
+TPU-native design: each optimizer iteration is ONE jitted update —
+LBFGS via ``optax.lbfgs`` (two-loop recursion with zoom line search
+inside the jitted update), conjugate gradient as Polak-Ribière+ with a
+jitted Armijo backtracking line search (``lax.while_loop``, so the
+whole search compiles instead of the reference's per-step host loop).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def backtrack_line_search(loss_fn: Callable, params, direction, *,
+                          initial_step: float = 1.0, c1: float = 1e-4,
+                          tau: float = 0.5, max_steps: int = 16):
+    """Armijo backtracking (reference BackTrackLineSearch.optimize):
+    shrink step until f(p + a·d) ≤ f(p) + c1·a·⟨g,d⟩. One jitted
+    while_loop. Returns (step_size, new_loss)."""
+    f0, g0 = jax.value_and_grad(loss_fn)(params)
+    slope = sum(jnp.sum(d * g) for d, g in
+                zip(jax.tree.leaves(direction), jax.tree.leaves(g0)))
+
+    def apply_step(a):
+        return jax.tree.map(lambda p, d: p + a * d, params, direction)
+
+    def cond(state):
+        a, f_new, it = state
+        return jnp.logical_and(it < max_steps,
+                               f_new > f0 + c1 * a * slope)
+
+    def body(state):
+        a, _, it = state
+        a = a * tau
+        return a, loss_fn(apply_step(a)), it + 1
+
+    a0 = jnp.asarray(initial_step)
+    state = (a0, loss_fn(apply_step(a0)), jnp.asarray(0))
+    a, f_new, _ = jax.lax.while_loop(cond, body, state)
+    return a, f_new
+
+
+class BaseOptimizer:
+    """Full-batch optimizer over a (params → scalar loss) objective."""
+
+    def __init__(self, max_iterations: int = 100, tol: float = 1e-8):
+        self.max_iterations = max_iterations
+        self.tol = tol
+        self.scores_ = []
+
+    def optimize(self, loss_fn, params):
+        raise NotImplementedError
+
+
+class StochasticGradientDescent(BaseOptimizer):
+    """Plain gradient step (reference solvers.StochasticGradientDescent).
+    """
+
+    def __init__(self, learning_rate: float = 0.1, **kw):
+        super().__init__(**kw)
+        self.learning_rate = learning_rate
+
+    def optimize(self, loss_fn, params):
+        lr = self.learning_rate
+
+        @jax.jit
+        def step(p):
+            loss, g = jax.value_and_grad(loss_fn)(p)
+            return jax.tree.map(lambda pp, gg: pp - lr * gg, p, g), loss
+
+        for _ in range(self.max_iterations):
+            params, loss = step(params)
+            self.scores_.append(float(loss))
+            if len(self.scores_) > 1 and abs(
+                    self.scores_[-2] - self.scores_[-1]) < self.tol:
+                break
+        return params
+
+
+class LineGradientDescent(BaseOptimizer):
+    """Steepest descent with Armijo line search per iteration
+    (reference solvers.LineGradientDescent)."""
+
+    def optimize(self, loss_fn, params):
+        @jax.jit
+        def step(p):
+            g = jax.grad(loss_fn)(p)
+            d = jax.tree.map(lambda x: -x, g)
+            a, loss = backtrack_line_search(loss_fn, p, d)
+            return jax.tree.map(lambda pp, dd: pp + a * dd, p, d), loss
+
+        for _ in range(self.max_iterations):
+            params, loss = step(params)
+            self.scores_.append(float(loss))
+            if len(self.scores_) > 1 and abs(
+                    self.scores_[-2] - self.scores_[-1]) < self.tol:
+                break
+        return params
+
+
+class ConjugateGradient(BaseOptimizer):
+    """Polak-Ribière+ nonlinear CG with Armijo line search
+    (reference solvers.ConjugateGradient)."""
+
+    def optimize(self, loss_fn, params):
+        @jax.jit
+        def step(p, d_prev, g_prev, first):
+            g = jax.grad(loss_fn)(p)
+            num = sum(jnp.sum(gn * (gn - go)) for gn, go in
+                      zip(jax.tree.leaves(g), jax.tree.leaves(g_prev)))
+            den = sum(jnp.sum(jnp.square(go))
+                      for go in jax.tree.leaves(g_prev))
+            beta = jnp.maximum(num / jnp.maximum(den, 1e-12), 0.0)
+            beta = jnp.where(first, 0.0, beta)
+            d = jax.tree.map(lambda gg, dd: -gg + beta * dd, g, d_prev)
+            a, loss = backtrack_line_search(loss_fn, p, d)
+            new_p = jax.tree.map(lambda pp, dd: pp + a * dd, p, d)
+            return new_p, d, g, loss
+
+        d = jax.tree.map(jnp.zeros_like, params)
+        g = jax.tree.map(jnp.ones_like, params)
+        first = jnp.asarray(True)
+        for _ in range(self.max_iterations):
+            params, d, g, loss = step(params, d, g, first)
+            first = jnp.asarray(False)
+            self.scores_.append(float(loss))
+            if len(self.scores_) > 1 and abs(
+                    self.scores_[-2] - self.scores_[-1]) < self.tol:
+                break
+        return params
+
+
+class LBFGS(BaseOptimizer):
+    """Limited-memory BFGS (reference solvers.LBFGS) via ``optax.lbfgs``
+    — two-loop recursion + zoom line search inside one jitted update."""
+
+    def __init__(self, memory: int = 10, **kw):
+        super().__init__(**kw)
+        self.memory = memory
+
+    def optimize(self, loss_fn, params):
+        opt = optax.lbfgs(memory_size=self.memory)
+        opt_state = opt.init(params)
+        value_and_grad = optax.value_and_grad_from_state(loss_fn)
+
+        @jax.jit
+        def step(p, s):
+            value, grad = value_and_grad(p, state=s)
+            updates, s = opt.update(grad, s, p, value=value, grad=grad,
+                                    value_fn=loss_fn)
+            return optax.apply_updates(p, updates), s, value
+
+        for _ in range(self.max_iterations):
+            params, opt_state, loss = step(params, opt_state)
+            self.scores_.append(float(loss))
+            if len(self.scores_) > 1 and abs(
+                    self.scores_[-2] - self.scores_[-1]) < self.tol:
+                break
+        return params
+
+
+_ALGOS = {
+    "STOCHASTIC_GRADIENT_DESCENT": StochasticGradientDescent,
+    "LINE_GRADIENT_DESCENT": LineGradientDescent,
+    "CONJUGATE_GRADIENT": ConjugateGradient,
+    "LBFGS": LBFGS,
+}
+
+
+class Solver:
+    """Reference ``Solver.Builder().model(m).build().optimize()``: runs a
+    full-batch optimizer over a network's loss on a DataSet."""
+
+    def __init__(self, net, algo: str = "STOCHASTIC_GRADIENT_DESCENT",
+                 max_iterations: int = 100, **algo_kwargs):
+        self.net = net
+        if algo.upper() not in _ALGOS:
+            raise ValueError(f"unknown optimization algo {algo!r}; "
+                             f"known: {sorted(_ALGOS)}")
+        self.optimizer = _ALGOS[algo.upper()](
+            max_iterations=max_iterations, **algo_kwargs)
+
+    class Builder:
+        def __init__(self):
+            self._net = None
+            self._algo = "STOCHASTIC_GRADIENT_DESCENT"
+            self._max_iter = 100
+            self._kw = {}
+
+        def model(self, net):
+            self._net = net
+            return self
+
+        def optimization_algo(self, algo: str):
+            self._algo = algo
+            return self
+
+        def max_iterations(self, n: int):
+            self._max_iter = n
+            return self
+
+        def configure(self, **kw):
+            self._kw.update(kw)
+            return self
+
+        def build(self) -> "Solver":
+            return Solver(self._net, self._algo, self._max_iter,
+                          **self._kw)
+
+    @staticmethod
+    def builder() -> "Solver.Builder":
+        return Solver.Builder()
+
+    def optimize(self, dataset) -> float:
+        """Full-batch optimize the network's params on `dataset`;
+        returns the final score."""
+        net = self.net
+        x = jnp.asarray(np.asarray(dataset.features))
+        y = jnp.asarray(np.asarray(dataset.labels))
+        state = net.state
+        rng = jax.random.PRNGKey(net.conf.seed)
+
+        def loss_fn(params):
+            loss, _ = net._loss_fn(params, state, x, y, None, None, rng)
+            return loss
+
+        net.params = self.optimizer.optimize(loss_fn, net.params)
+        net.score_ = self.optimizer.scores_[-1]
+        return net.score_
+
+    @property
+    def scores(self):
+        return self.optimizer.scores_

@@ -1,0 +1,102 @@
+"""Solver + legacy full-batch optimizers (LBFGS, CG, line search).
+
+Reference analog: BackTrackLineSearchTest / TestOptimizers
+(deeplearning4j-core, org.deeplearning4j.optimize.solvers).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.train.solver import (
+    Solver, backtrack_line_search, StochasticGradientDescent,
+    LineGradientDescent, ConjugateGradient, LBFGS)
+
+
+def _rosenbrock(p):
+    x, y = p["x"], p["y"]
+    return jnp.sum(100.0 * (y - x ** 2) ** 2 + (1 - x) ** 2)
+
+
+def _quadratic(p):
+    return jnp.sum(jnp.square(p["w"] - 3.0))
+
+
+class TestLineSearch:
+    def test_armijo_decreases_loss(self):
+        params = {"w": jnp.asarray([0.0, 0.0])}
+        g = jax.grad(_quadratic)(params)
+        d = jax.tree.map(lambda v: -v, g)
+        a, f_new = backtrack_line_search(_quadratic, params, d)
+        assert float(f_new) < float(_quadratic(params))
+        assert float(a) > 0
+
+
+class TestOptimizers:
+    @pytest.mark.parametrize("cls,kw", [
+        (StochasticGradientDescent, {"learning_rate": 0.2}),
+        (LineGradientDescent, {}),
+        (ConjugateGradient, {}),
+        (LBFGS, {}),
+    ])
+    def test_quadratic_converges(self, cls, kw):
+        opt = cls(max_iterations=60, **kw)
+        p0 = {"w": jnp.zeros(4)}
+        f0 = float(_quadratic(p0))
+        out = opt.optimize(_quadratic, p0)
+        assert np.allclose(np.asarray(out["w"]), 3.0, atol=1e-2), cls
+        assert opt.scores_[-1] < f0
+
+    def test_lbfgs_beats_sgd_on_rosenbrock(self):
+        p0 = {"x": jnp.asarray([-1.0]), "y": jnp.asarray([1.0])}
+        lb = LBFGS(max_iterations=80)
+        out = lb.optimize(_rosenbrock, jax.tree.map(jnp.copy, p0))
+        sgd = StochasticGradientDescent(learning_rate=1e-3,
+                                        max_iterations=80)
+        sgd.optimize(_rosenbrock, jax.tree.map(jnp.copy, p0))
+        assert lb.scores_[-1] < sgd.scores_[-1]
+        assert np.allclose(float(out["x"][0]), 1.0, atol=0.1)
+
+    def test_cg_on_rosenbrock_decreases(self):
+        p0 = {"x": jnp.asarray([-1.0]), "y": jnp.asarray([1.0])}
+        f0 = float(_rosenbrock(p0))
+        cg = ConjugateGradient(max_iterations=50)
+        cg.optimize(_rosenbrock, p0)
+        assert cg.scores_[-1] < f0 / 10
+
+
+class TestSolverDriver:
+    def _net_and_data(self):
+        from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                           NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.config import InputType
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn import updaters as upd
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 6).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 64)]
+        conf = (NeuralNetConfiguration.builder().seed(2)
+                .updater(upd.Sgd(learning_rate=0.1)).list()
+                .layer(DenseLayer(n_out=12, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(6)).build())
+        return MultiLayerNetwork(conf).init(), DataSet(x, y)
+
+    @pytest.mark.parametrize("algo", ["LBFGS", "CONJUGATE_GRADIENT",
+                                      "LINE_GRADIENT_DESCENT"])
+    def test_solver_improves_network_score(self, algo):
+        net, ds = self._net_and_data()
+        s0 = net.score(ds)
+        solver = (Solver.builder().model(net).optimization_algo(algo)
+                  .max_iterations(25).build())
+        final = solver.optimize(ds)
+        assert final < s0
+        assert net.score(ds) < s0          # params actually updated
+
+    def test_unknown_algo_raises(self):
+        net, _ = self._net_and_data()
+        with pytest.raises(ValueError):
+            Solver(net, algo="NEWTON")

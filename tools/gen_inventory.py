@@ -70,6 +70,32 @@ def main():
         if ln.startswith("## "):
             print(" ", ln[3:])
 
+    # ---- per-op API reference (docs/OPS.md) ---------------------------
+    # analog of the reference codegen's generated op documentation
+    op_lines = [
+        "# SameDiff op reference (auto-generated)", "",
+        "Every op is a pure jax-traceable function in "
+        "`autodiff.ops_registry.OPS`, callable eagerly, through "
+        "`sd.math.<name>(...)` in a SameDiff graph, or via "
+        "`Nd4j.exec`. Signatures below: positional args are arrays, "
+        "keyword args are static attributes (reference: iArgs/tArgs/"
+        "bArgs of the declarable op).", ""]
+    for name in sorted(OPS):
+        fn = OPS[name]
+        try:
+            sig = str(inspect.signature(fn))
+        except (ValueError, TypeError):
+            sig = "(...)"
+        doc = (inspect.getdoc(fn) or "").split("\n")[0].strip()
+        entry = f"- **`{name}`**`{sig}`"
+        if doc and not doc.startswith("lambda"):
+            entry += f" — {doc}"
+        op_lines.append(entry)
+    ops_out = os.path.join(os.path.dirname(out), "OPS.md")
+    with open(ops_out, "w") as f:
+        f.write("\n".join(op_lines) + "\n")
+    print(f"wrote {os.path.normpath(ops_out)} ({len(OPS)} ops)")
+
 
 if __name__ == "__main__":
     main()

@@ -64,8 +64,9 @@ class IntegerParameterSpace(ParameterSpace):
     max: int = 10
 
     def __post_init__(self):
-        if self.min >= self.max:
-            raise ValueError(f"min {self.min} >= max {self.max}")
+        # min == max is a valid degenerate space (pins the parameter)
+        if self.min > self.max:
+            raise ValueError(f"min {self.min} > max {self.max}")
 
     def sample(self, rng):
         return int(rng.integers(self.min, self.max + 1))

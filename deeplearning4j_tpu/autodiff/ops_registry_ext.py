@@ -329,7 +329,9 @@ def _dynamic_partition(a, partitions, *, num_partitions):
 def _dynamic_stitch(*args):
     half = len(args) // 2
     indices, data = args[:half], args[half:]
-    n = sum(int(i.size) for i in indices)
+    # TF/nd4j semantics: merged size = max index + 1 (indices may
+    # overlap; later data wins), NOT the sum of index counts
+    n = max(int(jnp.max(i)) for i in indices) + 1
     out = jnp.zeros((n,) + data[0].shape[1:], data[0].dtype)
     for idx, d in zip(indices, data):
         out = out.at[idx.astype(jnp.int32)].set(d)
@@ -895,8 +897,22 @@ def _weighted_moments(a, weights, *, axis=None, keepdims=False):
 # --------------------------------------------------------------------------
 op("resize_bicubic")(lambda a, *, size: jax.image.resize(
     a, (a.shape[0],) + tuple(size) + (a.shape[-1],), "cubic"))
-op("resize_area")(lambda a, *, size: jax.image.resize(
-    a, (a.shape[0],) + tuple(size) + (a.shape[-1],), "linear"))
+
+
+@op("resize_area")
+def _resize_area(a, *, size):
+    """Area (box-filter) resize: true block averaging for integer
+    downscale factors (one reduce_window), bilinear fallback otherwise
+    (XLA has no general fractional-box kernel)."""
+    oh, ow = size
+    h, w = a.shape[1], a.shape[2]
+    if h % oh == 0 and w % ow == 0:
+        fh, fw = h // oh, w // ow
+        s = lax.reduce_window(
+            a, 0.0, lax.add, (1, fh, fw, 1), (1, fh, fw, 1), "VALID")
+        return s / (fh * fw)
+    return jax.image.resize(
+        a, (a.shape[0], oh, ow, a.shape[-1]), "linear")
 
 
 @op("image_resize")
@@ -1009,7 +1025,7 @@ def _non_max_suppression(boxes, scores, *, max_output_size,
         masked = jnp.where(alive, scores, -jnp.inf)
         best = jnp.argmax(masked)
         valid = masked[best] > -jnp.inf
-        out = out.at[i].set(jnp.where(valid, best, -1))
+        out = out.at[i].set(jnp.where(valid, best, -1).astype(jnp.int32))
         suppress = iou[best] > iou_threshold
         alive = alive & ~suppress & valid
         alive = alive.at[best].set(False)
@@ -1030,7 +1046,7 @@ def _nms_overlaps(overlaps, scores, *, max_output_size,
         masked = jnp.where(alive, scores, -jnp.inf)
         best = jnp.argmax(masked)
         valid = masked[best] > -jnp.inf
-        out = out.at[i].set(jnp.where(valid, best, -1))
+        out = out.at[i].set(jnp.where(valid, best, -1).astype(jnp.int32))
         alive = alive & (overlaps[best] <= overlap_threshold) & valid
         alive = alive.at[best].set(False)
         return alive, out

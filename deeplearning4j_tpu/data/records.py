@@ -270,7 +270,10 @@ class RecordReaderDataSetIterator:
 
 class SequenceRecordReaderDataSetIterator:
     """Sequence reader(s) → padded [B, T, F] DataSet batches with masks
-    (reference SequenceRecordReaderDataSetIterator, ALIGN_END padding).
+    (reference SequenceRecordReaderDataSetIterator with
+    AlignmentMode: ``ALIGN_START`` pads at the end (default, as
+    upstream), ``ALIGN_END`` right-aligns so the final timestep is
+    always real data).
 
     One reader with ``label_index`` (per-step labels from the same
     rows), or a separate ``labels_reader`` whose sequences align 1:1
@@ -279,13 +282,18 @@ class SequenceRecordReaderDataSetIterator:
     def __init__(self, features_reader: RecordReader, batch_size: int,
                  num_classes: Optional[int] = None,
                  labels_reader: Optional[RecordReader] = None,
-                 label_index: int = -1, regression: bool = False):
+                 label_index: int = -1, regression: bool = False,
+                 alignment_mode: str = "ALIGN_START"):
         self.features_reader = features_reader
         self.labels_reader = labels_reader
         self.batch_size = batch_size
         self.num_classes = num_classes
         self.label_index = label_index
         self.regression = regression
+        self.alignment_mode = alignment_mode.upper()
+        if self.alignment_mode not in ("ALIGN_START", "ALIGN_END"):
+            raise ValueError(
+                f"unknown alignment_mode {alignment_mode!r}")
         self.pre_processor = None
 
     def reset(self):
@@ -324,12 +332,14 @@ class SequenceRecordReaderDataSetIterator:
             y = np.zeros((B, T, self.num_classes), np.float32)
         for b, (feats, labs) in enumerate(batch):
             t = len(feats)
-            x[b, :t] = np.asarray(feats, np.float32)
-            mask[b, :t] = 1.0
+            sl = (slice(T - t, T) if self.alignment_mode == "ALIGN_END"
+                  else slice(0, t))
+            x[b, sl] = np.asarray(feats, np.float32)
+            mask[b, sl] = 1.0
             if self.regression:
-                y[b, :t] = np.asarray(labs, np.float32).reshape(t, -1)
+                y[b, sl] = np.asarray(labs, np.float32).reshape(t, -1)
             else:
-                y[b, :t] = np.eye(self.num_classes, dtype=np.float32)[
+                y[b, sl] = np.eye(self.num_classes, dtype=np.float32)[
                     np.asarray(labs, np.int64)]
         ds = DataSet(x, y, features_mask=mask, labels_mask=mask)
         if self.pre_processor is not None:

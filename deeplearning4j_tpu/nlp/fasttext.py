@@ -136,11 +136,7 @@ class FastText:
                    for t in texts]
         self.vocab = VocabCache.build(streams,
                                       min_word_frequency=self.min_count)
-        v = len(self.vocab)
         rng = np.random.default_rng(self.seed)
-        self._emb = np.asarray(
-            rng.uniform(-0.5 / self.dim, 0.5 / self.dim,
-                        (v + self.bucket, self.dim)), np.float32)
 
         if not self.supervised:
             from deeplearning4j_tpu.nlp.word2vec import Word2Vec
@@ -159,6 +155,10 @@ class FastText:
 
         if labels is None:
             raise ValueError("supervised mode needs labels")
+        v = len(self.vocab)
+        self._emb = np.asarray(
+            rng.uniform(-0.5 / self.dim, 0.5 / self.dim,
+                        (v + self.bucket, self.dim)), np.float32)
         self.labels_ = sorted(set(labels))
         lab_idx = {l: i for i, l in enumerate(self.labels_)}
         y = np.asarray([lab_idx[l] for l in labels], np.int32)

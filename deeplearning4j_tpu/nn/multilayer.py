@@ -551,6 +551,9 @@ class MultiLayerNetwork:
         acts = [x]
         cur = x
         for i, layer in enumerate(self.layers):
+            proc = self.conf.input_preprocessors.get(i)
+            if proc is not None:
+                cur = proc.pre_process(cur)
             cur, _ = layer.apply(self.params[_lname(i)],
                                  self.state[_lname(i)], cur,
                                  train=train, rng=None)
@@ -560,6 +563,9 @@ class MultiLayerNetwork:
     def activate_selected_layers(self, from_: int, to: int, x):
         cur = jnp.asarray(np.asarray(x))
         for i in range(from_, to + 1):
+            proc = self.conf.input_preprocessors.get(i)
+            if proc is not None:
+                cur = proc.pre_process(cur)
             cur, _ = self.layers[i].apply(
                 self.params[_lname(i)], self.state[_lname(i)], cur,
                 train=False, rng=None)

@@ -100,7 +100,7 @@ def main():
     # ---- 5. Expert parallel: MoE with sharded experts ----------------
     emesh = make_mesh({"expert": n})
     moe = MixtureOfExperts(d_model=16, d_hidden=32, num_experts=n,
-                           top_k=2)
+                           top_k=min(2, n))
     p = moe.shard(moe.init(), emesh, axis="expert")
     xe = jnp.asarray(rng.normal(size=(4, 2 * n, 16)), jnp.float32)
     out, aux = jax.jit(moe.apply)(p, xe)

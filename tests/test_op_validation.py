@@ -267,6 +267,299 @@ case("random_uniform", g=False, shape=(3, 4), seed=1, minval=2.0,
      maxval=3.0)
 case("random_bernoulli", g=False, shape=(100,), seed=1, p=0.3)
 
+# ===========================================================================
+# extended surface (ops_registry_ext) — every op needs a case (gate below)
+# ===========================================================================
+I32 = np.array([[12, 5], [-7, 3]], np.int32)
+
+# math / transforms
+case("rint", A(3, 4), g=False, golden=np.rint)
+case("trunc", A(3, 4), g=False, golden=np.trunc)
+case("mod", A(3, 4), A(3, 4, pos=True), g=False, golden=np.mod)
+case("truncatediv", A(3, 4), A(3, 4, pos=True), g=False)
+case("truncatemod", A(3, 4), A(3, 4, pos=True), g=False,
+     golden=np.fmod)
+case("divide_no_nan", A(2, 2), np.array([[0.0, 1], [2, 0]]), g=False)
+case("igamma", A(3, pos=True), A(3, pos=True), g=False)
+case("igammac", A(3, pos=True), A(3, pos=True), g=False)
+case("betainc", A(3, pos=True), A(3, pos=True),
+     np.array([0.2, 0.5, 0.8]), g=False)
+case("polygamma", np.array([1.0, 2.0]), A(2, pos=True), g=False)
+case("zeta", np.array([2.0, 3.0]), np.array([1.0, 1.5]), g=False)
+case("erfinv", np.array([-0.5, 0.0, 0.5]))
+case("precise_gelu", A(3, 4))
+case("identity", A(3, 4), golden=lambda a: a)
+case("assign", A(3, 4), A(3, 4), g=False, golden=lambda a, b: b)
+case("assign_add", A(3, 4), A(3, 4), golden=np.add)
+case("assign_sub", A(3, 4), A(3, 4), golden=np.subtract)
+case("stop_gradient", A(3, 4), g=False, golden=lambda a: a)
+case("thresholdedrelu", A(3, 4), g=False, theta=0.5)
+case("mergeadd", A(3), A(3), A(3), golden=lambda a, b, c: a + b + c)
+case("mergeavg", A(3), A(3), golden=lambda a, b: (a + b) / 2)
+case("mergemax", A(3), A(3), g=False, golden=np.maximum)
+case("mergemaxindex", A(3), A(3), g=False)
+case("check_numerics", A(3, 4), g=False, golden=lambda a: a)
+case("standardize", A(4, 6), axis=-1)
+case("clip_by_norm", A(3, 4), clip_norm=1.0)
+case("clip_by_avg_norm", A(3, 4), clip_norm=1.0)
+case("clip_by_global_norm", A(3), A(3), g=False, clip_norm=1.0)
+case("axpy", A(3, 4), A(3, 4), alpha=2.0,
+     golden=lambda x, y: 2.0 * x + y)
+case("realdiv", A(3, 4), A(3, 4, pos=True), golden=np.divide)
+case("floordiv", A(3, 4), A(3, 4, pos=True), g=False,
+     golden=np.floor_divide)
+case("select", A(3, 4) > 0, A(3, 4), A(3, 4), g=False)
+case("choose", A(8), g=False, condition="gt", value=0.0)
+case("boolean_mask", A(5), np.array([1, 0, 1, 1, 0], bool), g=False)
+
+# bitwise
+case("bitwise_and", I32, I32 + 1, g=False, golden=np.bitwise_and)
+case("bitwise_or", I32, I32 + 1, g=False, golden=np.bitwise_or)
+case("bitwise_xor", I32, I32 + 1, g=False, golden=np.bitwise_xor)
+case("toggle_bits", I32, g=False, golden=np.bitwise_not)
+case("shift_bits", I32, np.int32(2), g=False)
+case("rshift_bits", I32, np.int32(2), g=False)
+case("cyclic_shift_bits", I32, np.int32(3), g=False)
+case("cyclic_rshift_bits", I32, np.int32(3), g=False)
+case("bitcast", np.array([1.0, 2.0], np.float32), g=False,
+     dtype="int32")
+case("compare_and_bitpack", A(2, 8), g=False, threshold=0.0)
+case("bits_hamming_distance", I32, I32 + 1, g=False)
+
+# reductions / index
+case("all", np.array([[1.0, 0], [1, 1]]), g=False, axis=1)
+case("any", np.array([[1.0, 0], [0, 0]]), g=False, axis=1)
+case("asum", A(3, 4), axis=1, g=False,
+     golden=lambda a: np.abs(a).sum(1))
+case("sqnorm", A(3, 4), axis=1, golden=lambda a: (a ** 2).sum(1))
+case("count_zero", np.array([[0.0, 1], [0, 0]]), g=False, axis=1)
+case("reduce_dot", A(3, 4), A(3, 4), axis=1,
+     golden=lambda a, b: (a * b).sum(1))
+case("percentile", A(20), g=False, q=50)
+case("median", A(21), g=False, golden=np.median)
+case("iamax", A(6), g=False, golden=lambda a: np.argmax(np.abs(a)))
+case("iamin", A(6), g=False, golden=lambda a: np.argmin(np.abs(a)))
+case("first_index", A(8), g=False, condition="gt", value=0.0)
+case("last_index", A(8), g=False, condition="gt", value=0.0)
+case("match_condition", A(8), g=False, condition="lt", value=0.0)
+case("match_condition_transform", A(8), g=False, condition="lt",
+     value=0.0)
+case("norm", A(3, 4), g=False, ord=2, axis=1)
+case("histogram", A(30), g=False, nbins=5)
+case("histogram_fixed_width", A(30), g=False, range=(-2.0, 2.0),
+     nbins=5)
+case("bincount", np.array([0, 1, 1, 3], np.int32), g=False, length=4,
+     golden=lambda a: np.bincount(a, minlength=4))
+
+# shape / gather-scatter
+case("broadcast_to", A(4), g=False, shape=(3, 4))
+case("flatten", A(3, 4), g=False, golden=np.ravel)
+case("rank", A(3, 4), g=False)
+case("size", A(3, 4), g=False)
+case("size_at", A(3, 4), g=False, dim=1)
+case("repeat", A(3), g=False, repeats=2, axis=0,
+     golden=lambda a: np.repeat(a, 2, 0))
+case("fill", g=False, shape=(2, 3), value=7.0)
+case("ones", g=False, shape=(2, 3), golden=None)
+case("zeros", g=False, shape=(2, 3))
+case("empty", g=False, shape=(2, 3))
+case("tri", g=False, n=4)
+case("logspace", g=False, start=0.0, stop=2.0, num=5)
+case("invert_permutation", np.array([2, 0, 1], np.int32), g=False)
+case("matrix_diag", A(4), g=False)
+case("matrix_diag_part", A(4, 4), g=False,
+     golden=lambda a: np.diagonal(a, axis1=-2, axis2=-1))
+case("matrix_set_diag", A(4, 4), A(4), g=False)
+case("matrix_band_part", A(4, 4), g=False, num_lower=1, num_upper=1)
+case("matrix_power", A(3, 3), g=False, n=2)
+case("reverse_sequence", A(2, 5, 3), np.array([3, 5], np.int32),
+     g=False)
+case("sequence_mask", np.array([1, 3], np.int32), g=False, maxlen=4)
+case("confusion_matrix", np.array([0, 1, 1], np.int32),
+     np.array([0, 1, 0], np.int32), g=False, num_classes=2)
+case("unique", np.array([3.0, 1, 3, 2]), g=False, size=3)
+case("unique_with_counts", np.array([3.0, 1, 3, 2]), g=False, size=3)
+case("listdiff", np.array([1.0, 2, 3, 4]), np.array([2.0, 4]),
+     g=False)
+case("dynamic_partition", A(6), np.array([0, 1, 0, 1, 0, 1]),
+     g=False, num_partitions=2)
+case("dynamic_stitch", np.array([0, 2], np.int32),
+     np.array([1, 3], np.int32), A(2), A(2), g=False)
+case("scatter_nd", np.array([[0], [2]], np.int32), A(2), g=False,
+     shape=(5,))
+case("scatter_nd_add", A(5), np.array([[0], [2]], np.int32), A(2),
+     g=False)
+case("scatter_nd_sub", A(5), np.array([[0], [2]], np.int32), A(2),
+     g=False)
+case("scatter_nd_update", A(5), np.array([[0], [2]], np.int32), A(2),
+     g=False)
+case("scatter_div", A(5, 3), np.array([0, 2]), A(2, 3, pos=True),
+     g=False)
+case("segment_prod", A(5, pos=True), seg_ids, g=False, num_segments=3)
+for _n in ["unsorted_segment_sum", "unsorted_segment_max",
+           "unsorted_segment_min", "unsorted_segment_prod",
+           "unsorted_segment_mean", "unsorted_segment_sqrt_n"]:
+    case(_n, A(5, pos=True), np.array([0, 1, 0, 2, 1]), g=False,
+         num_segments=3)
+case("nth_element", A(4, 6), g=False, n=2)
+case("batch_to_space", A(4, 2, 2, 1), g=False, block_size=2,
+     crops=[[0, 0], [0, 0]])
+case("space_to_batch", A(1, 4, 4, 1), g=False, block_size=2,
+     paddings=[[0, 0], [0, 0]])
+case("batch_to_space_nd", A(4, 2, 2, 1), g=False, block_shape=[2, 2],
+     crops=[[0, 0], [0, 0]])
+case("space_to_batch_nd", A(1, 4, 4, 1), g=False, block_shape=[2, 2],
+     paddings=[[0, 0], [0, 0]])
+case("mirror_pad", A(3, 4), g=False, paddings=((1, 1), (1, 1)),
+     golden=lambda a: np.pad(a, ((1, 1), (1, 1)), mode="reflect"))
+case("split_v", A(8), g=False, sizes=[3, 5])
+case("cumsum_exclusive", A(5), axis=0)
+case("rot90", A(1, 3, 3, 2), g=False, k=1)
+case("flip_left_right", A(1, 3, 3, 2), g=False)
+case("flip_up_down", A(1, 3, 3, 2), g=False)
+
+# nn / conv / pool / recurrent
+case("conv1d", A(1, 8, 2), A(3, 2, 4), stride=1, padding="SAME")
+case("conv3d", A(1, 4, 4, 4, 2), A(2, 2, 2, 2, 3), g=False,
+     padding="VALID")
+case("deconv2d", A(1, 4, 4, 2), A(2, 2, 2, 3), g=False,
+     strides=(2, 2))
+case("deconv3d", A(1, 2, 2, 2, 2), A(2, 2, 2, 2, 3), g=False,
+     strides=(2, 2, 2))
+case("sconv2d", A(1, 6, 6, 2), A(3, 3, 2, 2), A(1, 1, 4, 5), g=False)
+case("max_pooling3d", A(1, 4, 4, 4, 2), g=False)
+case("avg_pooling3d", A(1, 4, 4, 4, 2), g=False)
+case("pnormpool2d", A(1, 4, 4, 2, pos=True), g=False, pnorm=2)
+case("max_pool_with_argmax", A(1, 4, 4, 2), g=False)
+case("im2col", A(1, 5, 5, 2), g=False, kernel=(3, 3))
+case("col2im", A(1, 3, 3, 18), g=False, input_shape=(1, 5, 5, 2),
+     kernel=(3, 3))
+case("extract_image_patches", A(1, 5, 5, 2), g=False, kernel=(3, 3))
+case("lrn", A(1, 4, 4, 8), depth=3)
+case("fused_batch_norm", A(2, 4, 4, 3), A(3, pos=True), A(3), g=False)
+case("xw_plus_b", A(5, 3), A(3, 2), A(2))
+case("relu_layer", A(5, 3), A(3, 2), A(2), g=False)
+case("embedding_lookup", A(10, 4), np.array([0, 3, 7], np.int32),
+     g=False)
+case("upsampling2d", A(1, 3, 3, 2), g=False, factor=2)
+case("upsampling3d", A(1, 2, 2, 2, 2), g=False, factor=2)
+case("dilation2d", A(1, 5, 5, 2), A(2, 2, 2), g=False)
+case("multi_head_dot_product_attention", A(2, 4, 8), A(2, 6, 8),
+     A(2, 6, 8), A(8, 8), A(8, 8), A(8, 8), A(8, 8), g=False,
+     num_heads=2)
+case("lstm_cell", A(2, 3), A(2, 4), A(2, 4), A(3, 16), A(4, 16),
+     A(16), g=False)
+case("gru_cell", A(2, 3), A(2, 4), A(3, 12), A(4, 12), A(12), g=False)
+case("sru_cell", A(2, 4), A(2, 4), A(4, 12), A(8), g=False)
+case("lstm_layer", A(3, 2, 3), np.zeros((2, 4)), np.zeros((2, 4)),
+     A(3, 16), A(4, 16), A(16), g=False)
+case("lstmBlock", A(3, 2, 3), np.zeros((2, 4)), np.zeros((2, 4)),
+     A(3, 16), A(4, 16), A(16), g=False)
+case("gru", A(3, 2, 3), np.zeros((2, 4)), A(3, 12), A(4, 12), A(12),
+     g=False)
+case("sru", A(3, 2, 4), np.zeros((2, 4)), A(4, 12), A(8), g=False)
+case("static_bidirectional_rnn", A(3, 2, 3), np.zeros((2, 4)),
+     np.zeros((2, 4)), np.zeros((2, 4)), np.zeros((2, 4)),
+     A(3, 16), A(4, 16), A(16), A(3, 16), A(4, 16), A(16), g=False)
+case("ctc_greedy_decoder", A(2, 5, 4), np.array([5, 4], np.int32),
+     g=False)
+
+# updater ops (functional: (grad, state...) -> (update, state'...))
+_g4 = A(4)
+_z4 = np.zeros(4)
+case("sgd_updater", _g4, g=False, lr=0.1,
+     golden=lambda g: 0.1 * g)
+case("adam_updater", _g4, _z4, _z4, g=False, lr=0.1)
+case("ada_max_updater", _g4, _z4, _z4, g=False, lr=0.1)
+case("nadam_updater", _g4, _z4, _z4, g=False, lr=0.1)
+case("ams_grad_updater", _g4, _z4, _z4, _z4, g=False, lr=0.1)
+case("ada_delta_updater", _g4, _z4, _z4, g=False)
+case("ada_grad_updater", _g4, _z4, g=False, lr=0.1)
+case("rms_prop_updater", _g4, _z4, g=False, lr=0.1)
+case("nesterovs_updater", _g4, _z4, g=False, lr=0.1)
+case("ada_belief_updater", _g4, _z4, _z4, g=False, lr=0.1)
+
+# losses / moments
+_bl = (A(4, 5) > 0).astype(np.float64)
+case("absolute_difference_loss", lbl5, A(4, 5))
+case("l2_loss", A(4, 5), golden=lambda a: (a ** 2).sum() / 2)
+case("log_poisson_loss", np.abs(A(4)) + 0.5, A(4))
+case("mean_pairwssqerr_loss", lbl5, A(4, 5), g=False)
+case("weighted_cross_entropy_with_logits", _bl, A(4, 5),
+     pos_weight=2.0)
+case("hinge_loss", _bl, A(4, 5), g=False)
+case("softmax_cross_entropy_with_logits", lbl5, A(4, 5))
+case("sigmoid_cross_entropy_with_logits", _bl, A(4, 5))
+case("sufficient_statistics", A(3, 4), g=False, axis=[0])
+case("normalize_moments", np.array(12.0), A(4), A(4, pos=True) + 4,
+     g=False)
+case("weighted_moments", A(3, 4), np.abs(A(3, 4)) + 0.1, g=False,
+     axis=(0,))
+
+# image
+case("resize_bicubic", A(1, 4, 4, 2), g=False, size=(8, 8))
+case("resize_area", A(1, 4, 4, 2), g=False, size=(2, 2))
+case("image_resize", A(1, 4, 4, 2), g=False, size=(8, 8),
+     method="bilinear")
+_img = np.abs(A(2, 4, 4, 3)) % 1.0
+case("rgb_to_grs", _img, g=False)
+case("rgb_to_hsv", _img, g=False)
+case("hsv_to_rgb", _img, g=False)
+case("rgb_to_yuv", _img)
+case("yuv_to_rgb", _img)
+case("rgb_to_yiq", _img)
+case("yiq_to_rgb", _img)
+case("rgb_to_bgr", _img, g=False, golden=lambda a: a[..., ::-1])
+case("adjust_contrast", _img, g=False, factor=1.5)
+case("adjust_hue", _img, g=False, delta=0.1)
+case("adjust_saturation", _img, g=False, factor=1.2)
+_boxes = np.array([[0, 0, 1, 1], [0, 0, 0.9, 0.9], [0.5, 0.5, 1, 1]],
+                  np.float64)
+case("non_max_suppression", _boxes, np.array([0.9, 0.8, 0.7]),
+     g=False, max_output_size=2)
+case("non_max_suppression_overlaps", np.eye(3), np.array([0.9, 0.8,
+                                                          0.7]),
+     g=False, max_output_size=2)
+case("crop_and_resize", _img, np.array([[0.0, 0.0, 1.0, 1.0]]),
+     np.array([0], np.int32), g=False, crop_size=(2, 2))
+case("draw_bounding_boxes", _img,
+     np.tile(_boxes[None, :1], (2, 1, 1)), g=False)
+
+# random
+case("random_exponential", g=False, shape=(10,), seed=1)
+case("random_gamma", g=False, shape=(10,), seed=1, alpha=2.0)
+case("random_poisson", g=False, shape=(10,), seed=1, lam=3.0)
+case("random_shuffle", A(8), g=False, seed=1)
+case("random_multinomial", A(2, 5), g=False, num_samples=4, seed=1)
+case("truncated_normal", g=False, shape=(10,), seed=1)
+case("log_normal", g=False, shape=(10,), seed=1)
+case("alpha_dropout", A(4, 5), g=False, rate=0.5, seed=0,
+     deterministic=True)
+case("dropout_inverted", A(4, 5), g=False, rate=0.5, seed=0,
+     deterministic=True)
+case("random_crop", A(6, 6, 2), g=False, size=(3, 3, 2), seed=1)
+
+# linalg extras
+case("lu", spd, g=False)
+case("self_adjoint_eig", spd, g=False)
+case("batched_gemm", A(2, 3, 4), A(2, 4, 5), golden=np.matmul)
+case("gemm", A(3, 4), A(4, 5), A(3, 5), g=False, alpha=2.0, beta=0.5)
+case("tensormmul", A(3, 4), A(4, 5), g=False, axes=1)
+
+# compression codec
+_sg = A(16)
+case("encode_threshold", _sg, g=False, threshold=0.5)
+case("decode_threshold", np.sign(_sg), g=False, threshold=0.5)
+case("encode_bitmap", np.sign(_sg), g=False)
+# bitmaps are packed uint8 words (8 elements/byte): 0b0101, 0b1010
+case("decode_bitmap", np.array([5, 0], np.uint8),
+     np.array([10, 0], np.uint8), g=False, size=16)
+
+# casts
+for _cn in ["to_float32", "to_float16", "to_bfloat16", "to_double",
+            "to_int32", "to_int64", "to_uint8"]:
+    case(_cn, np.abs(A(3, 4)), g=False)
+
 
 def test_every_op_has_validation_case():
     """The coverage gate: adding an op without a validation case fails

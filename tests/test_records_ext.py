@@ -133,3 +133,16 @@ def test_sequence_iterator_trains_rnn():
     s0 = net.score(ds)
     net.fit(it, epochs=25)
     assert net.score(ds) < s0
+
+
+def test_sequence_iterator_align_end():
+    reader = CSVSequenceRecordReader(_seq_sources())
+    it = SequenceRecordReaderDataSetIterator(
+        reader, batch_size=2, num_classes=2, label_index=-1,
+        alignment_mode="ALIGN_END")
+    ds = next(iter(it))
+    # shorter sequence (len 2, padded to 3) is right-aligned: last
+    # timestep is real data, first is padding
+    assert np.allclose(ds.features_mask, [[1, 1, 1], [0, 1, 1]])
+    assert np.allclose(ds.features[1, 1], [0.7, 0.8])
+    assert float(ds.features[1, 0].sum()) == 0

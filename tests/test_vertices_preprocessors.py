@@ -187,3 +187,33 @@ class TestROCBinary:
             roc.eval(labels, labels * 0.8 + 0.1)
         assert roc.num_labels() == 3
         assert roc.average_auc() == 1.0
+
+
+def test_feed_forward_applies_preprocessors():
+    """Regression: feed_forward/activate_selected_layers must honour
+    conf.input_preprocessors like _forward does."""
+    import numpy as np
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import (ConvolutionLayer,
+                                              DenseLayer, OutputLayer)
+    from deeplearning4j_tpu.nn import updaters as upd
+
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater(upd.Sgd(learning_rate=1e-2)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(ConvolutionLayer(n_out=2, kernel_size=(2, 2),
+                                    padding="VALID",
+                                    activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .input_pre_processor(1, FeedForwardToCnnPreProcessor(
+                height=4, width=4, channels=1))
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.RandomState(0).randn(3, 8).astype(np.float32)
+    acts = net.feed_forward(x)
+    assert acts[-1].shape == (3, 2)
+    mid = net.activate_selected_layers(0, 1, x)
+    assert mid.ndim == 4                    # conv activation map

@@ -1368,3 +1368,197 @@ def _norm(a, *, ord=2, axis=None, keepdims=False):
     return jnp.sum(jnp.abs(a) ** ord,
                    axis=tuple(axis) if isinstance(axis, list) else axis,
                    keepdims=keepdims) ** (1.0 / ord)
+
+
+# --------------------------------------------------------------------------
+# batch 3: native declarable-name aliases + quantization + rnn compat
+# (the reference registers these exact names in OpRegistrator.cpp; the
+# aliases keep graph-import name resolution 1:1)
+# --------------------------------------------------------------------------
+for _alias, _target in [
+    ("greater", "gt"), ("greater_equal", "gte"), ("less", "lt"),
+    ("less_equal", "lte"), ("equals", "eq"), ("not_equals", "neq"),
+    ("reduce_mean", "mean"), ("reduce_sum", "sum"),
+    ("reduce_max", "max"), ("reduce_min", "min"),
+    ("reduce_prod", "prod"), ("reduce_variance", "variance"),
+    ("reduce_stdev", "std"), ("reduce_logsumexp", "logsumexp"),
+    ("reduce_norm1", "norm1"), ("reduce_norm2", "norm2"),
+    ("reduce_norm_max", "norm_max"), ("reduce_sqnorm", "sqnorm"),
+    ("maxpool2d", "max_pooling2d"), ("avgpool2d", "avg_pooling2d"),
+    ("maxpool3dnew", "max_pooling3d"), ("avgpool3dnew", "avg_pooling3d"),
+    ("conv3dnew", "conv3d"), ("batchnorm", "batch_norm"),
+    ("zeros_as", "zeros_like"), ("ones_as", "ones_like"),
+    ("lin_space", "linspace"), ("range", "arange"),
+    ("randomuniform", "random_uniform"), ("onehot", "one_hot"),
+    ("reversev2", "reverse"), ("logdet", "log_matrix_determinant"),
+    ("det", "matrix_determinant"), ("solve_ls", "lstsq"),
+    ("batch_matmul", "batched_gemm"),
+    ("resize_neighbor", "resize_nearest"),
+    ("resize_linear", "resize_bilinear"),
+    ("adjust_contrast_v2", "adjust_contrast"),
+    ("apply_gradient_descent", "sgd_updater"),
+    ("huber_loss", "loss_huber"), ("log_loss", "loss_log"),
+    ("mean_sqerr_loss", "loss_mse"),
+    ("cosine_distance_loss", "loss_cosine_distance"),
+    ("softmax_cross_entropy_loss", "loss_softmax_cross_entropy"),
+    ("sparse_softmax_cross_entropy_loss",
+     "loss_sparse_softmax_cross_entropy"),
+    ("sigm_cross_entropy_loss", "loss_sigmoid_cross_entropy"),
+]:
+    op(_alias)(OPS[_target])
+
+op("is_finite")(jnp.isfinite)
+op("is_numeric_tensor")(lambda a: jnp.asarray(
+    jnp.issubdtype(a.dtype, jnp.number)))
+op("equals_with_eps")(lambda a, b, *, eps=1e-5: jnp.all(
+    jnp.abs(a - b) <= eps))
+
+
+@op("where_np")
+def _where_np(cond, a=None, b=None):
+    """numpy-style where: 3-arg select, or (eager-only) 1-arg nonzero
+    coordinates (reference compat/where_np)."""
+    if a is not None:
+        return jnp.where(cond, a, b)
+    import numpy as np
+    return jnp.asarray(np.argwhere(np.asarray(cond)))
+
+
+@op("Assert")
+def _assert(cond, *, message="assertion failed"):
+    try:
+        if not bool(jnp.all(cond)):
+            raise AssertionError(message)
+    except jax.errors.TracerBoolConversionError:
+        pass                      # under jit: no-op (XLA can't throw)
+    return cond
+
+
+_RNG_SEED_STATE = {"seed": 0}
+
+
+@op("set_seed")
+def _set_seed(*, seed):
+    """Default-rng seed for seedless random ops (reference set_seed)."""
+    _RNG_SEED_STATE["seed"] = int(seed)
+    return jnp.asarray(int(seed), jnp.int64)
+
+
+@op("get_seed")
+def _get_seed():
+    return jnp.asarray(_RNG_SEED_STATE["seed"], jnp.int64)
+
+
+# --- quantization (reference generic/parity_ops/fake_quant_*) -------------
+def _fake_quant(x, minv, maxv, num_bits=8, narrow_range=False):
+    qmin = 1 if narrow_range else 0
+    qmax = 2 ** num_bits - 1
+    # nudge the range so zero is exactly representable (TF semantics)
+    scale = (maxv - minv) / (qmax - qmin)
+    zero_point = qmin - minv / scale
+    nudged_zp = jnp.clip(jnp.round(zero_point), qmin, qmax)
+    nudged_min = (qmin - nudged_zp) * scale
+    nudged_max = (qmax - nudged_zp) * scale
+    clamped = jnp.clip(x, nudged_min, nudged_max)
+    q = jnp.round((clamped - nudged_min) / scale)
+    return q * scale + nudged_min
+
+
+op("fake_quant_with_min_max_args")(
+    lambda x, *, min=-6.0, max=6.0, num_bits=8, narrow_range=False:
+    _fake_quant(x, min, max, num_bits, narrow_range))
+op("fake_quant_with_min_max_vars")(
+    lambda x, minv, maxv, *, num_bits=8, narrow_range=False:
+    _fake_quant(x, minv, maxv, num_bits, narrow_range))
+op("fake_quant_with_min_max_vars_per_channel")(
+    lambda x, minv, maxv, *, num_bits=8, narrow_range=False:
+    _fake_quant(x, minv, maxv, num_bits, narrow_range))
+
+
+# --- simple/elman rnn compat ops (reference generic/recurrent) ------------
+@op("static_rnn")
+def _static_rnn(x, h0, wx, wh, b):
+    """Elman RNN over time: h_t = tanh(x_t Wx + h Wh + b)
+    (reference static_rnn). x: (T, B, I)."""
+    def step(h, xt):
+        h = jnp.tanh(xt @ wx + h @ wh + b)
+        return h, h
+    hT, hs = lax.scan(step, h0, x)
+    return hs, hT
+
+
+@op("dynamic_rnn")
+def _dynamic_rnn(x, h0, wx, wh, b, seq_lengths=None):
+    """static_rnn + per-example lengths: state freezes past each
+    sequence end (reference dynamic_rnn)."""
+    T = x.shape[0]
+
+    def step(carry, inp):
+        h, t = carry
+        xt = inp
+        h_new = jnp.tanh(xt @ wx + h @ wh + b)
+        if seq_lengths is not None:
+            active = (t < seq_lengths)[:, None]
+            h_new = jnp.where(active, h_new, h)
+        return (h_new, t + 1), h_new
+    (hT, _), hs = lax.scan(step, (h0, jnp.asarray(0)), x)
+    return hs, hT
+
+
+@op("dynamic_bidirectional_rnn")
+def _dynamic_bidirectional_rnn(x, h0_f, h0_b, wx_f, wh_f, b_f, wx_b,
+                               wh_b, b_b, seq_lengths=None):
+    fwd, hf = _dynamic_rnn(x, h0_f, wx_f, wh_f, b_f, seq_lengths)
+    bwd, hb = _dynamic_rnn(jnp.flip(x, 0), h0_b, wx_b, wh_b, b_b,
+                           seq_lengths)
+    return jnp.concatenate([fwd, jnp.flip(bwd, 0)], -1), hf, hb
+
+
+@op("ctc_beam")
+def _ctc_beam(logits, seq_lengths, *, beam_width=4, blank=0,
+              top_paths=1):
+    """CTC prefix beam-search decode (reference ctc_beam) — eager
+    numpy implementation (data-dependent prefix set; the reference's
+    is a host-side loop too). Returns ([B, top_paths, T] ids padded
+    -1, [B, top_paths] log-probs)."""
+    import numpy as np
+    lp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), -1))
+    lens = np.asarray(seq_lengths).astype(int)
+    B, T, C = lp.shape
+    out = np.full((B, top_paths, T), -1, np.int32)
+    scores = np.full((B, top_paths), -np.inf, np.float64)
+    for b in range(B):
+        beams = {(): (0.0, -np.inf)}      # prefix -> (lp_blank, lp_nb)
+        for t in range(lens[b]):
+            new = {}
+            for prefix, (pb, pnb) in beams.items():
+                total = np.logaddexp(pb, pnb)
+                for c in range(C):
+                    p = lp[b, t, c]
+                    if c == blank:
+                        key = prefix
+                        lpb, lpn = new.get(key, (-np.inf, -np.inf))
+                        new[key] = (np.logaddexp(lpb, total + p), lpn)
+                    else:
+                        key = prefix + (c,)
+                        lpb, lpn = new.get(key, (-np.inf, -np.inf))
+                        if prefix and prefix[-1] == c:
+                            add = pb + p         # repeat needs a blank
+                            lpn2 = np.logaddexp(lpn, add)
+                            new[key] = (lpb, lpn2)
+                            lpb0, lpn0 = new.get(prefix,
+                                                 (-np.inf, -np.inf))
+                            new[prefix] = (lpb0,
+                                           np.logaddexp(lpn0, pnb + p))
+                        else:
+                            new[key] = (lpb,
+                                        np.logaddexp(lpn, total + p))
+            beams = dict(sorted(
+                new.items(),
+                key=lambda kv: -np.logaddexp(*kv[1]))[:beam_width])
+        ranked = sorted(beams.items(),
+                        key=lambda kv: -np.logaddexp(*kv[1]))
+        for r, (prefix, (pb, pnb)) in enumerate(ranked[:top_paths]):
+            out[b, r, :len(prefix)] = prefix
+            scores[b, r] = np.logaddexp(pb, pnb)
+    return jnp.asarray(out), jnp.asarray(scores)

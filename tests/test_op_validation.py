@@ -560,6 +560,74 @@ for _cn in ["to_float32", "to_float16", "to_bfloat16", "to_double",
             "to_int32", "to_int64", "to_uint8"]:
     case(_cn, np.abs(A(3, 4)), g=False)
 
+# batch 3: native declarable-name aliases (same args as their targets)
+for _an in ["greater", "greater_equal", "less", "less_equal", "equals",
+            "not_equals"]:
+    case(_an, A(3, 4), A(3, 4), g=False)
+for _an in ["reduce_mean", "reduce_sum", "reduce_max", "reduce_min",
+            "reduce_variance", "reduce_stdev", "reduce_logsumexp",
+            "reduce_norm1", "reduce_norm2", "reduce_norm_max",
+            "reduce_sqnorm"]:
+    case(_an, A(3, 4), g=False, axis=1)
+case("reduce_prod", A(3, 4, pos=True), g=False, axis=1)
+case("maxpool2d", A(1, 4, 4, 2), g=False)
+case("avgpool2d", A(1, 4, 4, 2), g=False)
+case("maxpool3dnew", A(1, 4, 4, 4, 2), g=False)
+case("avgpool3dnew", A(1, 4, 4, 4, 2), g=False)
+case("conv3dnew", A(1, 4, 4, 4, 2), A(2, 2, 2, 2, 3), g=False,
+     padding="VALID")
+case("batchnorm", A(4, 6), A(6), A(6, pos=True), A(6, pos=True), A(6),
+     g=False)
+case("zeros_as", A(2, 2), g=False, golden=np.zeros_like)
+case("ones_as", A(2, 2), g=False, golden=np.ones_like)
+case("lin_space", g=False, start=0.0, stop=1.0, num=5)
+case("range", g=False, start=0, stop=6, step=2)
+case("randomuniform", g=False, shape=(3,), seed=1)
+case("onehot", np.array([0, 2, 1]), g=False, depth=3)
+case("reversev2", A(3, 4), g=False, axis=1)
+case("logdet", spd, g=False)
+case("det", spd, g=False, golden=np.linalg.det)
+case("solve_ls", A(5, 3), A(5, 2), g=False)
+case("batch_matmul", A(2, 3, 4), A(2, 4, 5), g=False,
+     golden=np.matmul)
+case("resize_neighbor", A(1, 4, 4, 2), g=False, size=(8, 8))
+case("resize_linear", A(1, 4, 4, 2), g=False, size=(8, 8))
+case("adjust_contrast_v2", _img, g=False, factor=1.5)
+case("apply_gradient_descent", _g4, g=False, lr=0.1)
+case("huber_loss", lbl5, A(4, 5), g=False)
+case("log_loss", (A(4, 5) > 0).astype(np.float64),
+     A(4, 5, lo=0.05, hi=0.95), g=False)
+case("mean_sqerr_loss", lbl5, A(4, 5), g=False)
+case("cosine_distance_loss", lbl5, A(4, 5), g=False)
+case("softmax_cross_entropy_loss", lbl5, A(4, 5), g=False)
+case("sparse_softmax_cross_entropy_loss",
+     R.integers(0, 5, 4).astype(np.float64), A(4, 5), g=False)
+case("sigm_cross_entropy_loss", _bl, A(4, 5), g=False)
+
+# batch 3: new implementations
+case("is_finite", A(3, 4), g=False, golden=np.isfinite)
+case("is_numeric_tensor", A(3, 4), g=False)
+case("equals_with_eps", A(3, 4), A(3, 4), g=False, eps=1e-5)
+case("where_np", A(3, 4) > 0, A(3, 4), A(3, 4), g=False)
+case("Assert", np.array([True, True]), g=False)
+case("set_seed", g=False, seed=42)
+case("get_seed", g=False)
+case("fake_quant_with_min_max_args", A(3, 4), g=False, min=-3.0,
+     max=3.0)
+case("fake_quant_with_min_max_vars", A(3, 4), np.array(-3.0),
+     np.array(3.0), g=False)
+case("fake_quant_with_min_max_vars_per_channel", A(3, 4),
+     np.full(4, -3.0), np.full(4, 3.0), g=False)
+case("static_rnn", A(3, 2, 3), np.zeros((2, 4)), A(3, 4), A(4, 4),
+     A(4), g=False)
+case("dynamic_rnn", A(3, 2, 3), np.zeros((2, 4)), A(3, 4), A(4, 4),
+     A(4), np.array([2, 3]), g=False)
+case("dynamic_bidirectional_rnn", A(3, 2, 3), np.zeros((2, 4)),
+     np.zeros((2, 4)), A(3, 4), A(4, 4), A(4), A(3, 4), A(4, 4),
+     A(4), np.array([2, 3]), g=False)
+case("ctc_beam", A(1, 4, 3), np.array([4], np.int32), g=False,
+     beam_width=3)
+
 
 def test_every_op_has_validation_case():
     """The coverage gate: adding an op without a validation case fails

@@ -1562,3 +1562,120 @@ def _ctc_beam(logits, seq_lengths, *, beam_width=4, blank=0,
             out[b, r, :len(prefix)] = prefix
             scores[b, r] = np.logaddexp(pb, pnb)
     return jnp.asarray(out), jnp.asarray(scores)
+
+
+# --------------------------------------------------------------------------
+# batch 4: tensor-array list ops, embeddings training ops, final aliases
+# --------------------------------------------------------------------------
+# TensorArray ops (reference generic/list/*.cpp: create_list,
+# write_list, read_list, stack_list, unstack_list, size_list,
+# gather_list, scatter_list, split_list). The "list" value is an
+# immutable python tuple of arrays — eager-mode only, like the
+# reference's graph-interpreter TensorArray.
+op("create_list")(lambda: ())
+op("write_list")(lambda ta, val, *, idx: (
+    tuple(ta[:idx]) + ((None,) * max(0, idx - len(ta))) + (val,)
+    + tuple(ta[idx + 1:])))
+op("read_list")(lambda ta, *, idx: ta[idx])
+op("size_list")(lambda ta: jnp.asarray(len(ta), jnp.int32))
+op("stack_list")(lambda ta: jnp.stack([t for t in ta if t is not None]))
+op("unstack_list")(lambda a: tuple(a[i] for i in range(a.shape[0])))
+op("gather_list")(lambda ta, indices: jnp.stack(
+    [ta[int(i)] for i in jnp.ravel(indices)]))
+op("scatter_list")(lambda a, indices: tuple(
+    a[int(j)] for j in jnp.argsort(jnp.ravel(indices))))
+op("split_list")(lambda a, *, sizes: tuple(OPS["split_v"](
+    a, sizes=sizes)))
+
+# word2vec training ops (reference generic/nn/embeddings: skipgram,
+# cbow — here functional: tables in, updated tables out, one jitted
+# negative-sampling step like nlp/word2vec's batched trainer)
+@op("skipgram")
+def _skipgram_op(syn0, syn1, centers, contexts, negatives, *, lr=0.025):
+    def loss_fn(tables):
+        s0, s1 = tables
+        c = s0[centers.astype(jnp.int32)]
+        pos = s1[contexts.astype(jnp.int32)]
+        neg = s1[negatives.astype(jnp.int32)]
+        pos_score = jnp.sum(c * pos, axis=-1)
+        neg_score = jnp.einsum("bd,bkd->bk", c, neg)
+        return -jnp.sum(jax.nn.log_sigmoid(pos_score)
+                        + jnp.sum(jax.nn.log_sigmoid(-neg_score), -1))
+    loss, (g0, g1) = jax.value_and_grad(loss_fn)((syn0, syn1))
+    return syn0 - lr * g0, syn1 - lr * g1, loss
+
+
+@op("cbow")
+def _cbow_op(syn0, syn1, context_windows, targets, negatives, *,
+             lr=0.025):
+    def loss_fn(tables):
+        s0, s1 = tables
+        ctx = jnp.mean(s0[context_windows.astype(jnp.int32)], axis=1)
+        pos = s1[targets.astype(jnp.int32)]
+        neg = s1[negatives.astype(jnp.int32)]
+        pos_score = jnp.sum(ctx * pos, axis=-1)
+        neg_score = jnp.einsum("bd,bkd->bk", ctx, neg)
+        return -jnp.sum(jax.nn.log_sigmoid(pos_score)
+                        + jnp.sum(jax.nn.log_sigmoid(-neg_score), -1))
+    loss, (g0, g1) = jax.value_and_grad(loss_fn)((syn0, syn1))
+    return syn0 - lr * g0, syn1 - lr * g1, loss
+
+
+@op("eig")
+def _eig(a):
+    """General (non-symmetric) eigendecomposition — eager/CPU path
+    (XLA TPU has no nonsymmetric eig; reference runs it on host too)."""
+    import numpy as np
+    w, v = np.linalg.eig(np.asarray(a))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+@op("hashcode")
+def _hashcode(a):
+    """Deterministic int64 tensor hash (reference parity op hashcode)."""
+    b = jnp.ravel(lax.bitcast_convert_type(
+        a.astype(jnp.float32), jnp.int32)).astype(jnp.int_)
+    mult = jnp.asarray(31, jnp.int_)
+
+    def body(h, x):
+        return h * mult + x, None
+    h, _ = lax.scan(body, jnp.asarray(17, jnp.int_), b)
+    return h
+
+
+@op("random_flip_left_right")
+def _random_flip_lr(a, *, seed):
+    flip = jax.random.bernoulli(jax.random.PRNGKey(seed))
+    return jnp.where(flip, jnp.flip(a, axis=-2), a)
+
+
+@op("random_flip_up_down")
+def _random_flip_ud(a, *, seed):
+    flip = jax.random.bernoulli(jax.random.PRNGKey(seed))
+    return jnp.where(flip, jnp.flip(a, axis=-3), a)
+
+
+@op("per_image_standardization")
+def _per_image_standardization(a):
+    axes = tuple(range(1, a.ndim))
+    mu = jnp.mean(a, axis=axes, keepdims=True)
+    n = 1
+    for d in a.shape[1:]:
+        n *= d
+    sd = jnp.maximum(jnp.std(a, axis=axes, keepdims=True),
+                     1.0 / jnp.sqrt(float(n)))
+    return (a - mu) / sd
+
+
+for _alias, _target in [
+    ("subtract", "sub"), ("multiply", "mul"), ("divide", "div"),
+    ("fmod", "truncatemod"), ("scatter_upd", "scatter_update"),
+    ("parallel_stack", "stack"), ("lup", "lu"),
+    ("clipbyvalue", "clip_by_value"), ("clipbynorm", "clip_by_norm"),
+    ("clipbyavgnorm", "clip_by_avg_norm"),
+    ("clipbyglobalnorm", "clip_by_global_norm"),
+    ("lstmCell", "lstm_cell"), ("gruCell", "gru_cell"),
+    ("sruCell", "sru_cell"), ("lstmLayer", "lstm_layer"),
+    ("dot_product_attention_v2", "dot_product_attention"),
+]:
+    op(_alias)(OPS[_target])

@@ -628,6 +628,46 @@ case("dynamic_bidirectional_rnn", A(3, 2, 3), np.zeros((2, 4)),
 case("ctc_beam", A(1, 4, 3), np.array([4], np.int32), g=False,
      beam_width=3)
 
+# batch 4: list ops, embeddings training, final aliases
+case("create_list", g=False)
+case("size_list", (A(3), A(3)), g=False)
+case("read_list", (A(3), A(3)), g=False, idx=1)
+case("stack_list", (A(3), A(3)), g=False)
+case("unstack_list", A(3, 4), g=False)
+case("gather_list", (A(3), A(3), A(3)), np.array([2, 0]), g=False)
+case("scatter_list", A(3, 4), np.array([2, 0, 1]), g=False)
+case("split_list", A(8), g=False, sizes=[3, 5])
+case("write_list", (A(3),), A(3), g=False, idx=1)
+_emb0 = np.abs(A(10, 4)) * 0.1
+case("skipgram", _emb0, _emb0, np.array([1, 2]), np.array([3, 4]),
+     np.array([[5, 6], [7, 8]]), g=False)
+case("cbow", _emb0, _emb0, np.array([[1, 2], [3, 4]]),
+     np.array([5, 6]), np.array([[7, 8], [0, 9]]), g=False)
+case("eig", A(3, 3), g=False)
+case("hashcode", A(3, 3), g=False)
+case("random_flip_left_right", _img, g=False, seed=0)
+case("random_flip_up_down", _img, g=False, seed=0)
+case("per_image_standardization", _img, g=False)
+case("subtract", A(3, 4), A(3, 4), g=False, golden=np.subtract)
+case("multiply", A(3, 4), A(3, 4), g=False, golden=np.multiply)
+case("divide", A(3, 4), A(3, 4, pos=True), g=False, golden=np.divide)
+case("fmod", A(3, 4), A(3, 4, pos=True), g=False, golden=np.fmod)
+case("scatter_upd", A(5, 3), np.array([0, 2]), A(2, 3), g=False)
+case("parallel_stack", A(2, 3), A(2, 3), g=False, axis=0)
+case("lup", spd, g=False)
+case("clipbyvalue", A(3, 4), g=False, min=-0.5, max=0.5)
+case("clipbynorm", A(3, 4), g=False, clip_norm=1.0)
+case("clipbyavgnorm", A(3, 4), g=False, clip_norm=1.0)
+case("clipbyglobalnorm", A(3), A(3), g=False, clip_norm=1.0)
+case("lstmCell", A(2, 3), A(2, 4), A(2, 4), A(3, 16), A(4, 16),
+     A(16), g=False)
+case("gruCell", A(2, 3), A(2, 4), A(3, 12), A(4, 12), A(12), g=False)
+case("sruCell", A(2, 4), A(2, 4), A(4, 12), A(8), g=False)
+case("lstmLayer", A(3, 2, 3), np.zeros((2, 4)), np.zeros((2, 4)),
+     A(3, 16), A(4, 16), A(16), g=False)
+case("dot_product_attention_v2", A(2, 4, 8), A(2, 6, 8), A(2, 6, 8),
+     g=False)
+
 
 def test_every_op_has_validation_case():
     """The coverage gate: adding an op without a validation case fails

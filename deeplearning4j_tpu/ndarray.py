@@ -442,6 +442,96 @@ class NDArray:
                      / (jnp.linalg.norm(self._a)
                         * jnp.linalg.norm(b) + 1e-12))
 
+    # -- shape predicates / host exports (reference INDArray) ------------
+    def rows(self) -> int:
+        return int(self._a.shape[0])
+
+    def columns(self) -> int:
+        return int(self._a.shape[1])
+
+    def is_row_vector(self) -> bool:
+        return self._a.ndim == 1 or (self._a.ndim == 2
+                                     and self._a.shape[0] == 1)
+
+    def is_column_vector(self) -> bool:
+        return self._a.ndim == 2 and self._a.shape[1] == 1
+
+    def is_square(self) -> bool:
+        return (self._a.ndim == 2
+                and self._a.shape[0] == self._a.shape[1])
+
+    def to_int_vector(self):
+        return [int(v) for v in np.asarray(self._a).ravel()]
+
+    def to_double_vector(self):
+        return [float(v) for v in np.asarray(self._a).ravel()]
+
+    def to_float_matrix(self):
+        return np.asarray(self._a, np.float32).tolist()
+
+    # -- number reductions missing from the commit-fae4081 set -----------
+    def median_number(self) -> float:
+        return float(jnp.median(self._a))
+
+    def percentile_number(self, q) -> float:
+        return float(jnp.percentile(self._a, q))
+
+    def entropy_number(self) -> float:
+        import jax.scipy.special as jsp
+        return float(-jnp.sum(jsp.xlogy(self._a, self._a)))
+
+    def var_number(self) -> float:
+        return float(jnp.var(self._a))
+
+    def prod_number(self) -> float:
+        return float(jnp.prod(self._a))
+
+    # -- conditional replace (reference replaceWhere/getWhere/cond) ------
+    def replace_where(self, replacement, condition) -> "NDArray":
+        """Elements matching ``condition`` replaced from ``replacement``
+        (reference BooleanIndexing.replaceWhere)."""
+        m = condition(self._a) if callable(condition) else condition
+        return NDArray(jnp.where(jnp.asarray(_unwrap(m)),
+                                 jnp.asarray(_unwrap(replacement)),
+                                 self._a))
+
+    def get_where(self, comp, condition):
+        """Eager boolean select (reference getWhere) — returns the
+        matching elements as a flat NDArray."""
+        m = condition(self._a) if callable(condition) else condition
+        return NDArray(self._a[jnp.asarray(_unwrap(m))])
+
+    def cond(self, condition) -> "NDArray":
+        """Boolean mask of elements matching condition (reference
+        MatchConditionTransform)."""
+        m = condition(self._a) if callable(condition) else condition
+        return NDArray(jnp.asarray(_unwrap(m)).astype(self._a.dtype))
+
+    # -- tensor-along-dimension (reference TAD API) ----------------------
+    def tensors_along_dimension(self, *dims) -> int:
+        n = self._a.size
+        for d in dims:
+            n //= self._a.shape[d]
+        return int(n)
+
+    def tensor_along_dimension(self, index, *dims) -> "NDArray":
+        """The index-th sub-tensor spanning ``dims`` (reference
+        tensorAlongDimension): iterate the remaining axes C-order."""
+        other = [d for d in range(self._a.ndim) if d not in dims]
+        moved = jnp.moveaxis(self._a, other,
+                             list(range(len(other))))
+        lead = 1
+        for d in other:
+            lead *= self._a.shape[d]
+        flat = moved.reshape((lead,) + moved.shape[len(other):])
+        return NDArray(flat[index])
+
+    def vector_along_dimension(self, index, dim) -> "NDArray":
+        return self.tensor_along_dimension(index, dim)
+
+    def vectors_along_dimension(self, dim) -> int:
+        return self.tensors_along_dimension(dim)
+
 
 def _ndarray_unflatten(_, children):
     # Rebind the leaf directly: transforms (eval_shape, jit tracing) pass
@@ -460,6 +550,19 @@ jax.tree_util.register_pytree_node(
 
 class Nd4j:
     """Static factory — reference: ``org.nd4j.linalg.factory.Nd4j``."""
+
+    @staticmethod
+    def exec(op_name: str, *args, **kwargs):
+        """Run any registered declarable op eagerly on NDArrays
+        (reference ``Nd4j.exec(DynamicCustomOp)`` — name + args into the
+        op registry instead of a JNI dispatch). Returns NDArray(s)."""
+        from deeplearning4j_tpu.autodiff.ops_registry import get_op
+        fn = get_op(op_name)
+        out = fn(*[_unwrap(a) for a in args], **kwargs)
+        if isinstance(out, tuple):
+            return tuple(NDArray(o) if hasattr(o, "dtype") else o
+                         for o in out)
+        return NDArray(out) if hasattr(out, "dtype") else out
 
     @staticmethod
     def create(data=None, shape=None, dtype=None) -> NDArray:

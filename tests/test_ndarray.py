@@ -206,3 +206,53 @@ def test_nd4j_factory_extras():
     assert Nd4j.argsort(Nd4j.create([3.0, 1.0, 2.0])).numpy().tolist() \
         == [1, 2, 0]
     assert Nd4j.empty().length() == 0
+
+
+class TestFacadeExtensions:
+    """Nd4j.exec bridge + INDArray surface additions (replaceWhere,
+    TAD API, host exports)."""
+
+    def test_nd4j_exec_runs_registry_ops(self):
+        out = Nd4j.exec("softmax", Nd4j.create([1.0, 2.0, 3.0]))
+        assert abs(float(out.sum_number()) - 1.0) < 1e-5
+        pooled, idx = Nd4j.exec(
+            "max_pool_with_argmax", Nd4j.randn((1, 4, 4, 2)))
+        assert pooled.shape == (1, 2, 2, 2)
+        with pytest.raises(KeyError):
+            Nd4j.exec("not_an_op", Nd4j.create([1.0]))
+
+    def test_replace_where_and_cond(self):
+        a = Nd4j.create([1.0, -2.0, 3.0, -4.0])
+        out = a.replace_where(0.0, lambda x: x < 0)
+        assert np.allclose(out.numpy(), [1, 0, 3, 0])
+        m = a.cond(lambda x: x > 0)
+        assert np.allclose(m.numpy(), [1, 0, 1, 0])
+        got = a.get_where(None, lambda x: x < 0)
+        assert np.allclose(got.numpy(), [-2, -4])
+
+    def test_tad_api(self):
+        a = Nd4j.create(np.arange(24.0).reshape(2, 3, 4))
+        assert a.tensors_along_dimension(2) == 6
+        t0 = a.tensor_along_dimension(0, 2)
+        assert np.allclose(t0.numpy(), [0, 1, 2, 3])
+        t1 = a.tensor_along_dimension(1, 2)
+        assert np.allclose(t1.numpy(), [4, 5, 6, 7])
+        v = a.vector_along_dimension(0, 2)
+        assert v.length() == 4
+
+    def test_predicates_and_exports(self):
+        a = Nd4j.create([[1.0, 2.0, 3.0]])
+        assert a.is_row_vector() and not a.is_column_vector()
+        assert Nd4j.create([[1.0], [2.0]]).is_column_vector()
+        assert Nd4j.eye(3).is_square()
+        assert a.to_int_vector() == [1, 2, 3]
+        assert a.rows() == 1 and a.columns() == 3
+        m = Nd4j.create([[1.0, 2.0], [3.0, 4.0]])
+        assert m.to_float_matrix() == [[1.0, 2.0], [3.0, 4.0]]
+
+    def test_number_reductions(self):
+        a = Nd4j.create([1.0, 2.0, 3.0, 4.0, 5.0])
+        assert a.median_number() == 3.0
+        assert abs(a.percentile_number(50) - 3.0) < 1e-6
+        assert a.prod_number() == 120.0
+        assert abs(a.var_number() - 2.0) < 1e-6

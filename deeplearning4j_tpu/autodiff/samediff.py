@@ -240,8 +240,16 @@ class SameDiff:
         self._train_step = None
         self._opt_state = None
         self._training_config: Optional[TrainingConfig] = None
+        # reference op-namespace classes SDMath/SDNN/SDCNN/SDRNN/SDLoss/
+        # SDImage/SDRandom/SDBitwise/SDLinalg — all views over the one
+        # registry (prefixed where the reference prefixes op names)
         self.math = _Namespace(self)
         self.nn = _Namespace(self)
+        self.cnn = _Namespace(self)
+        self.rnn = _Namespace(self)
+        self.image = _Namespace(self)
+        self.linalg = _Namespace(self)
+        self.bitwise = _Namespace(self)
         self.loss = _Namespace(self, prefix="loss_")
         self.random = _Namespace(self, prefix="random_")
 
@@ -451,7 +459,15 @@ class SameDiff:
     _NON_DIFF_OPS = frozenset({
         "argmax", "argmin", "shape_of",
         "eq", "neq", "gt", "gte", "lt", "lte", "is_nan", "is_inf",
-        "logical_and", "logical_or", "logical_not"})
+        "logical_and", "logical_or", "logical_not",
+        # extended-surface int/bool producers
+        "iamax", "iamin", "first_index", "last_index", "rank", "size",
+        "size_at", "is_finite", "all", "any", "count_zero",
+        "match_condition", "match_condition_transform",
+        "invert_permutation", "confusion_matrix", "bincount",
+        "greater", "greater_equal", "less", "less_equal", "equals",
+        "not_equals", "equals_with_eps", "hashcode",
+        "bitwise_and", "bitwise_or", "bitwise_xor", "toggle_bits"})
 
     def _infer_dtype(self, name: str, _memo=None):
         """Propagate dtypes through producers so int-derived chains

@@ -179,3 +179,32 @@ def test_default_loss_skips_int_chains():
         sd.calculate_gradients(
             {"a": np.ones(4, np.float32), "b": np.ones(4, np.float32)},
             ["w"])
+
+
+def test_namespace_views_cover_reference_families():
+    """sd.cnn/sd.rnn/sd.image/sd.linalg/sd.bitwise (reference SDCNN,
+    SDRNN, SDImage, SDLinalg, SDBitwise namespace classes)."""
+    sd = SameDiff.create()
+    x = sd.var("x", np.random.RandomState(0).randn(1, 8, 8, 2)
+               .astype(np.float32))
+    w = sd.var("w", np.random.RandomState(1).randn(3, 3, 2, 4)
+               .astype(np.float32) * 0.1)
+    y = sd.cnn.conv2d(x, w, padding="SAME", name="conv")
+    p = sd.cnn.max_pooling2d(y, kernel=(2, 2), strides=(2, 2),
+                             name="pool")
+    out = sd.output({}, ["pool"])["pool"]
+    assert out.shape == (1, 4, 4, 4)
+
+    sd2 = SameDiff.create()
+    m = sd2.var("m", np.random.RandomState(2).randn(3, 3)
+                .astype(np.float32))
+    sd2.linalg.matrix_inverse(m, name="inv")
+    inv = sd2.output({}, ["inv"])["inv"]
+    assert np.allclose(np.asarray(m.eval()) @ inv, np.eye(3),
+                       atol=1e-4)
+
+    sd3 = SameDiff.create()
+    a = sd3.var("a", np.array([12, 10], np.int32))
+    b = sd3.var("b", np.array([10, 3], np.int32))
+    sd3.bitwise.bitwise_and(a, b, name="band")
+    assert list(sd3.output({}, ["band"])["band"]) == [8, 2]

@@ -1,0 +1,152 @@
+"""Frozen-output integration tests.
+
+Reference: ``dl4j-integration-tests`` IntegrationTestRunner — full
+pipelines (train N iters, eval, serialize) compared against frozen
+outputs checked into test resources, guarding regression across
+releases. Goldens live in ``tests/resources/integration_goldens.json``
+and are regenerated with ``python tests/test_integration_frozen.py``.
+
+Runs on the CPU backend (conftest pins platform+seed), so values are
+deterministic across rounds on the same jax version; comparisons use
+loose-enough tolerances to survive fusion-order drift.
+"""
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+
+GOLDEN_PATH = Path(__file__).parent / "resources" / \
+    "integration_goldens.json"
+
+
+def _mlp_pipeline():
+    """Train a fixed-seed MLP 30 iters; return loss curve ends +
+    output fingerprint."""
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn import updaters as upd
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+
+    rng = np.random.RandomState(12345)
+    x = rng.randn(64, 10).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 64)]
+    conf = (NeuralNetConfiguration.builder().seed(12345)
+            .updater(upd.Adam(learning_rate=5e-3)).list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=4, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(10)).build())
+    net = MultiLayerNetwork(conf).init()
+    s0 = net.score(DataSet(x, y))
+    net.fit(ListDataSetIterator([DataSet(x, y)], batch_size=64),
+            epochs=30)
+    out = np.asarray(net.output(x[:4]))
+    return {
+        "initial_score": float(s0),
+        "final_score": float(net.score(DataSet(x, y))),
+        "output_sample": [float(v) for v in out.ravel()],
+        "param_l2": float(np.sqrt(sum(
+            float((np.asarray(p) ** 2).sum())
+            for p in __import__("jax").tree.leaves(net.params)))),
+    }
+
+
+def _cnn_pipeline():
+    """Conv net forward fingerprint after a few fixed-seed steps."""
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import (ConvolutionLayer,
+                                              SubsamplingLayer,
+                                              OutputLayer)
+    from deeplearning4j_tpu.nn import updaters as upd
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+
+    rng = np.random.RandomState(777)
+    x = rng.randn(16, 8, 8, 1).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)]
+    conf = (NeuralNetConfiguration.builder().seed(777)
+            .updater(upd.Sgd(learning_rate=1e-2)).list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                    pooling_type="max"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(ListDataSetIterator([DataSet(x, y)], batch_size=16),
+            epochs=10)
+    out = np.asarray(net.output(x[:2]))
+    return {"output_sample": [float(v) for v in out.ravel()],
+            "final_score": float(net.score(DataSet(x, y)))}
+
+
+def _serialization_pipeline():
+    """Save→restore→identical outputs (the serialize leg of the
+    reference integration tests)."""
+    import tempfile
+    from deeplearning4j_tpu.serialization import ModelSerializer
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn import updaters as upd
+
+    rng = np.random.RandomState(5)
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater(upd.Adam(learning_rate=1e-3)).list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = rng.randn(4, 6).astype(np.float32)
+    before = np.asarray(net.output(x))
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m.zip")
+        ModelSerializer.write_model(net, path, save_updater=True)
+        back = ModelSerializer.restore_multi_layer_network(path)
+        after = np.asarray(back.output(x))
+    return {"roundtrip_max_abs_diff": float(np.abs(before
+                                                   - after).max())}
+
+
+PIPELINES = {"mlp": _mlp_pipeline, "cnn": _cnn_pipeline,
+             "serialization": _serialization_pipeline}
+
+
+def _generate():
+    goldens = {name: fn() for name, fn in PIPELINES.items()}
+    GOLDEN_PATH.parent.mkdir(exist_ok=True)
+    GOLDEN_PATH.write_text(json.dumps(goldens, indent=2))
+    print(f"wrote {GOLDEN_PATH}")
+
+
+def test_frozen_goldens():
+    assert GOLDEN_PATH.exists(), \
+        "regenerate goldens: python tests/test_integration_frozen.py"
+    goldens = json.loads(GOLDEN_PATH.read_text())
+    for name, fn in PIPELINES.items():
+        got = fn()
+        want = goldens[name]
+        for key, val in want.items():
+            if isinstance(val, list):
+                np.testing.assert_allclose(
+                    got[key], val, rtol=1e-3, atol=1e-5,
+                    err_msg=f"{name}.{key}")
+            else:
+                assert abs(got[key] - val) <= max(1e-3,
+                                                  1e-3 * abs(val)), \
+                    f"{name}.{key}: {got[key]} != frozen {val}"
+
+
+if __name__ == "__main__":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    _generate()

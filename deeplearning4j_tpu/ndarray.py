@@ -23,6 +23,10 @@ import numpy as np
 
 from deeplearning4j_tpu import dtypes
 
+#: open-workspace depth; bumped by utils.workspace scopes so the hot
+#: eager path pays only an int check when no workspace is active
+_WS_DEPTH = 0
+
 
 def _unwrap(x):
     return x.jax() if isinstance(x, NDArray) else x
@@ -34,7 +38,7 @@ class NDArray:
     Reference parity: org.nd4j.linalg.api.ndarray.BaseNDArray.
     """
 
-    __slots__ = ("_a",)
+    __slots__ = ("_a", "__weakref__")
     __array_priority__ = 100  # beat numpy in mixed expressions
 
     def __init__(self, value, dtype=None):
@@ -44,6 +48,10 @@ class NDArray:
             self._a = jnp.asarray(value, dtype=dtypes.resolve(dtype))
         else:
             self._a = jnp.asarray(value)
+        if _WS_DEPTH:                    # workspace tracking (utils.workspace)
+            from deeplearning4j_tpu.utils.workspace import \
+                register_allocation
+            register_allocation(self)
 
     # -- interop ----------------------------------------------------------
     def jax(self) -> jax.Array:

@@ -2,6 +2,12 @@ from deeplearning4j_tpu.utils.gradcheck import check_gradients
 from deeplearning4j_tpu.utils.profiler import (OpProfiler,
                                                PerformanceTracker, trace)
 from deeplearning4j_tpu.utils import crashreport
+from deeplearning4j_tpu.utils.workspace import (
+    MemoryWorkspace, WorkspaceConfiguration, WorkspaceManager,
+    AllocationsTracker, get_workspace_manager, scope_out_of_workspaces,
+)
 
 __all__ = ["check_gradients", "OpProfiler", "PerformanceTracker", "trace",
-           "crashreport"]
+           "crashreport", "MemoryWorkspace", "WorkspaceConfiguration",
+           "WorkspaceManager", "AllocationsTracker",
+           "get_workspace_manager", "scope_out_of_workspaces"]

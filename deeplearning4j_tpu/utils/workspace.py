@@ -1,0 +1,241 @@
+"""Memory workspaces — scoped allocation tracking + leak debug mode.
+
+Reference: ``org.nd4j.linalg.api.memory.MemoryWorkspace`` /
+``Nd4jWorkspace`` (scoped arena allocator with enter/leave cycles),
+``conf.WorkspaceConfiguration``, ``AllocationsTracker`` counters, and
+the workspace ``DebugMode`` that throws "not in scope" on
+use-after-scope of arena memory (SURVEY §5: the reference's closest
+analog to a sanitizer).
+
+TPU-native design: XLA owns device memory (BFC arena inside the
+runtime), so a Python workspace does not allocate — it ACCOUNTS.
+Entering a workspace makes every ``NDArray`` constructed inside it
+register with the scope (count + bytes, the AllocationsTracker
+numbers); ``detach()`` mirrors the reference API; after the scope
+closes, ``assert_no_leaks()`` replaces the reference's debug-mode
+scope exception: arrays still strongly referenced outside their closed
+cyclic workspace are reported with their shapes. The perf story the
+reference used workspaces for (no per-iteration malloc) is already the
+jit story here — buffers are reused by XLA across steps."""
+from __future__ import annotations
+
+import gc
+import threading
+import weakref
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_TLS = threading.local()
+
+
+def _stack() -> list:
+    if not hasattr(_TLS, "stack"):
+        _TLS.stack = []
+    return _TLS.stack
+
+
+def current_workspace() -> Optional["MemoryWorkspace"]:
+    s = _stack()
+    return s[-1] if s else None
+
+
+def register_allocation(arr) -> None:
+    """Called by NDArray.__init__; no-op unless a workspace is open."""
+    ws = current_workspace()
+    if ws is not None:
+        ws._register(arr)
+
+
+@dataclass
+class WorkspaceConfiguration:
+    """API-parity config bean (reference WorkspaceConfiguration.builder):
+    sizing/policy fields are accepted and recorded; XLA's arena makes
+    them advisory."""
+    initial_size: int = 0
+    max_size: int = 0
+    overallocation_limit: float = 0.0
+    policy_allocation: str = "OVERALLOCATE"
+    policy_learning: str = "FIRST_LOOP"
+    policy_spill: str = "EXTERNAL"
+    policy_reset: str = "BLOCK_LEFT"
+
+
+class MemoryWorkspace:
+    """Scoped allocation-tracking context (reference Nd4jWorkspace).
+
+    >>> with ws_mgr.get_and_activate_workspace("WS_LOOP") as ws:
+    ...     y = net.output(x)          # tracked
+    >>> ws.total_allocations, ws.total_bytes
+    """
+
+    def __init__(self, workspace_id: str = "WS",
+                 config: Optional[WorkspaceConfiguration] = None):
+        self.id = workspace_id
+        self.config = config or WorkspaceConfiguration()
+        self.generation = 0           # enter/leave cycles
+        self.total_allocations = 0
+        self.total_bytes = 0
+        self._live: List[weakref.ref] = []
+        self._closed = True
+
+    # -- scope management ----------------------------------------------
+    def __enter__(self) -> "MemoryWorkspace":
+        from deeplearning4j_tpu import ndarray as _nd
+        self._closed = False
+        self.generation += 1
+        self._live = []
+        _stack().append(self)
+        _nd._WS_DEPTH += 1
+        AllocationsTracker.instance()._opened(self)
+        return self
+
+    def __exit__(self, *exc):
+        from deeplearning4j_tpu import ndarray as _nd
+        _stack().remove(self)
+        _nd._WS_DEPTH -= 1
+        self._closed = True
+        return False
+
+    def notify_scope_entered(self):
+        return self.__enter__()
+
+    def notify_scope_left(self):
+        self.__exit__()
+
+    def is_scope_active(self) -> bool:
+        return not self._closed
+
+    # -- allocation accounting -----------------------------------------
+    def _register(self, arr):
+        self.total_allocations += 1
+        try:
+            nb = arr._a.size * arr._a.dtype.itemsize
+        except Exception:
+            nb = 0
+        self.total_bytes += nb
+        AllocationsTracker.instance()._allocated(self, nb)
+        try:
+            self._live.append(weakref.ref(arr))
+        except TypeError:
+            pass
+
+    @staticmethod
+    def detach(arr):
+        """Copy an array out of the workspace (reference
+        INDArray.detach): the copy is not tracked by the scope."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.ndarray import NDArray
+        return NDArray(jnp.array(arr._a, copy=True))
+
+    # -- leak detection (reference DebugMode / "not in scope") ----------
+    def leaked_arrays(self) -> List[tuple]:
+        """Arrays allocated in this (now closed) scope that are still
+        strongly referenced — the use-after-scope condition the
+        reference's debug mode throws on."""
+        if not self._closed:
+            raise RuntimeError("workspace scope still active")
+        gc.collect()
+        out = []
+        for ref in self._live:
+            arr = ref()
+            if arr is not None:
+                out.append((type(arr).__name__,
+                            tuple(getattr(arr._a, "shape", ()))))
+        return out
+
+    def assert_no_leaks(self):
+        leaks = self.leaked_arrays()
+        if leaks:
+            raise RuntimeError(
+                f"workspace {self.id!r}: {len(leaks)} array(s) outlive "
+                f"their scope (use detach() to keep results): {leaks}")
+
+
+class AllocationsTracker:
+    """Global per-workspace counters (reference AllocationsTracker)."""
+    _instance: Optional["AllocationsTracker"] = None
+
+    def __init__(self):
+        self.opens: Dict[str, int] = {}
+        self.bytes: Dict[str, int] = {}
+
+    @classmethod
+    def instance(cls) -> "AllocationsTracker":
+        if cls._instance is None:
+            cls._instance = AllocationsTracker()
+        return cls._instance
+
+    def _opened(self, ws: MemoryWorkspace):
+        self.opens[ws.id] = self.opens.get(ws.id, 0) + 1
+
+    def _allocated(self, ws: MemoryWorkspace, nb: int):
+        self.bytes[ws.id] = self.bytes.get(ws.id, 0) + nb
+
+    def report(self) -> str:
+        lines = ["AllocationsTracker:"]
+        for wid in sorted(self.opens):
+            lines.append(f"  {wid}: {self.opens[wid]} cycles, "
+                         f"{self.bytes.get(wid, 0):,} bytes tracked")
+        return "\n".join(lines)
+
+
+class WorkspaceManager:
+    """Per-thread workspace registry (reference
+    ``Nd4j.getWorkspaceManager()``)."""
+
+    def __init__(self):
+        self._tls = threading.local()
+
+    def _map(self) -> Dict[str, MemoryWorkspace]:
+        if not hasattr(self._tls, "ws"):
+            self._tls.ws = {}
+        return self._tls.ws
+
+    def get_workspace_for_current_thread(
+            self, workspace_id: str,
+            config: Optional[WorkspaceConfiguration] = None
+    ) -> MemoryWorkspace:
+        ws = self._map().get(workspace_id)
+        if ws is None:
+            ws = MemoryWorkspace(workspace_id, config)
+            self._map()[workspace_id] = ws
+        return ws
+
+    def get_and_activate_workspace(
+            self, workspace_id: str,
+            config: Optional[WorkspaceConfiguration] = None
+    ) -> MemoryWorkspace:
+        ws = self.get_workspace_for_current_thread(workspace_id, config)
+        return ws          # used as context manager by the caller
+
+    def destroy_workspace(self, workspace_id: str):
+        self._map().pop(workspace_id, None)
+
+    def destroy_all_workspaces_for_current_thread(self):
+        self._map().clear()
+
+
+class scope_out_of_workspaces:
+    """Temporarily suspend tracking (reference
+    ``MemoryWorkspace.scopeOutOfWorkspaces``)."""
+
+    def __enter__(self):
+        from deeplearning4j_tpu import ndarray as _nd
+        self._saved = _stack()[:]
+        self._saved_depth = _nd._WS_DEPTH
+        _nd._WS_DEPTH = 0
+        _stack().clear()
+        return self
+
+    def __exit__(self, *exc):
+        from deeplearning4j_tpu import ndarray as _nd
+        _stack().extend(self._saved)
+        _nd._WS_DEPTH = self._saved_depth
+        return False
+
+
+_manager = WorkspaceManager()
+
+
+def get_workspace_manager() -> WorkspaceManager:
+    return _manager

@@ -1,0 +1,97 @@
+"""Memory workspace tests (allocation tracking + leak debug mode).
+
+Reference analog: workspace tests under nd4j-backends
+(``org.nd4j.linalg.workspace.*`` — scoped enter/leave, leak DebugMode,
+AllocationsTracker counters).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ndarray import Nd4j
+from deeplearning4j_tpu.utils import (
+    MemoryWorkspace, WorkspaceConfiguration, AllocationsTracker,
+    get_workspace_manager, scope_out_of_workspaces)
+
+
+def test_tracks_allocations_inside_scope():
+    ws = MemoryWorkspace("WS_T1")
+    with ws:
+        a = Nd4j.zeros((4, 4))
+        b = a.add(1.0)
+    assert ws.total_allocations >= 2
+    assert ws.total_bytes >= 2 * 4 * 4 * 4
+    # outside the scope: no tracking
+    n0 = ws.total_allocations
+    _ = Nd4j.zeros((8,))
+    assert ws.total_allocations == n0
+
+
+def test_leak_detection():
+    ws = MemoryWorkspace("WS_LEAK")
+    kept = {}
+    with ws:
+        kept["x"] = Nd4j.ones((3, 3))      # escapes the scope
+        _tmp = Nd4j.ones((2,))             # dies with the scope
+    del _tmp
+    leaks = ws.leaked_arrays()
+    assert any(shape == (3, 3) for _, shape in leaks)
+    with pytest.raises(RuntimeError, match="outlive"):
+        ws.assert_no_leaks()
+    # detach() is the sanctioned way out
+    ws2 = MemoryWorkspace("WS_LEAK2")
+    with ws2:
+        y = MemoryWorkspace.detach(Nd4j.ones((3, 3)))
+    del y
+    # the tracked original died; the detached copy was never tracked
+
+
+def test_no_leaks_passes_when_clean():
+    ws = MemoryWorkspace("WS_CLEAN")
+    with ws:
+        s = float(Nd4j.ones((4,)).sum_number())
+    ws.assert_no_leaks()
+    assert s == 4.0
+
+
+def test_cyclic_generations():
+    ws = MemoryWorkspace("WS_CYCLE")
+    for _ in range(3):
+        with ws:
+            Nd4j.zeros((2,))
+    assert ws.generation == 3
+    assert not ws.is_scope_active()
+
+
+def test_manager_and_tracker():
+    mgr = get_workspace_manager()
+    ws = mgr.get_workspace_for_current_thread(
+        "WS_MGR", WorkspaceConfiguration(initial_size=1 << 20))
+    assert mgr.get_workspace_for_current_thread("WS_MGR") is ws
+    with mgr.get_and_activate_workspace("WS_MGR"):
+        Nd4j.ones((16,))
+    rep = AllocationsTracker.instance().report()
+    assert "WS_MGR" in rep
+    mgr.destroy_workspace("WS_MGR")
+    assert mgr.get_workspace_for_current_thread("WS_MGR") is not ws
+
+
+def test_scope_out_of_workspaces():
+    ws = MemoryWorkspace("WS_OUT")
+    with ws:
+        n0 = ws.total_allocations
+        with scope_out_of_workspaces():
+            Nd4j.zeros((64,))              # not tracked
+        assert ws.total_allocations == n0
+        Nd4j.zeros((2,))                   # tracked again
+        assert ws.total_allocations == n0 + 1
+
+
+def test_nested_workspaces_track_innermost():
+    outer = MemoryWorkspace("WS_OUTER")
+    inner = MemoryWorkspace("WS_INNER")
+    with outer:
+        with inner:
+            Nd4j.zeros((4,))
+        assert inner.total_allocations == 1
+        # current policy: innermost scope owns the allocation
+        assert outer.total_allocations == 0

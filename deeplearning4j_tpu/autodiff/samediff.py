@@ -391,9 +391,16 @@ class SameDiff:
 
     def _replay(self, values: Dict[str, Any],
                 out_names: Sequence[str]) -> Tuple:
+        from deeplearning4j_tpu.utils.profiler import OpProfiler
+        prof = OpProfiler.get_instance()
         for node in self._ancestors(out_names):
             args = [values[i] for i in node.inputs]
             fn = node.fn if node.op == "_lambda" else get_op(node.op)
+            if prof.verbose or prof.enabled:
+                # reference profilingHookIn/verbose native-op logging;
+                # under jit this fires once per trace (per-op device
+                # timing then comes from jax.profiler, §SURVEY 5)
+                prof.op_executed(node.op, args, node.kwargs)
             res = fn(*args, **node.kwargs)
             if len(node.outputs) == 1:
                 values[node.outputs[0]] = res

@@ -565,7 +565,11 @@ class Nd4j:
         (reference ``Nd4j.exec(DynamicCustomOp)`` — name + args into the
         op registry instead of a JNI dispatch). Returns NDArray(s)."""
         from deeplearning4j_tpu.autodiff.ops_registry import get_op
+        from deeplearning4j_tpu.utils.profiler import OpProfiler
         fn = get_op(op_name)
+        prof = OpProfiler.get_instance()
+        if prof.verbose or prof.enabled:
+            prof.op_executed(op_name, args, kwargs)
         out = fn(*[_unwrap(a) for a in args], **kwargs)
         if isinstance(out, tuple):
             return tuple(NDArray(o) if hasattr(o, "dtype") else o

@@ -42,6 +42,23 @@ class OpProfiler:
     def __init__(self):
         self._stats: Dict[str, _Stat] = defaultdict(_Stat)
         self.enabled = False
+        #: print every op execution (reference enableVerboseMode —
+        #: libnd4j's per-native-op execution logging)
+        self.verbose = False
+
+    def enable_verbose_mode(self, on: bool = True):
+        self.verbose = on
+
+    def op_executed(self, name: str, args=(), kwargs=None):
+        """Hook called by op dispatch sites (SameDiff executor,
+        Nd4j.exec) — reference DefaultOpExecutioner.profilingHookIn."""
+        if self.verbose:
+            shapes = [tuple(getattr(a, "shape", ()))
+                      for a in args if hasattr(a, "shape")]
+            print(f"[op] {name} shapes={shapes} "
+                  f"kwargs={sorted((kwargs or {}))}")
+        if self.enabled:
+            self._stats[f"op:{name}"].count += 1
 
     @classmethod
     def get_instance(cls) -> "OpProfiler":

@@ -297,3 +297,31 @@ def test_crash_report_contents(tmp_path, rng):
     assert "RESOURCE_EXHAUSTED" in open(path).read()
     assert crashreport.is_oom(RuntimeError("RESOURCE_EXHAUSTED: x"))
     assert not crashreport.is_oom(RuntimeError("bad shapes"))
+
+
+def test_verbose_op_execution_mode(capsys):
+    """Reference enableVerboseMode: every op execution printed; opcount
+    stats when profiling is enabled (SURVEY §5 tracing)."""
+    import numpy as np
+    from deeplearning4j_tpu.ndarray import Nd4j
+    from deeplearning4j_tpu.utils.profiler import OpProfiler
+    from deeplearning4j_tpu.autodiff import SameDiff
+
+    prof = OpProfiler.get_instance()
+    prof.reset()
+    prof.enable_verbose_mode(True)
+    prof.enabled = True
+    try:
+        Nd4j.exec("softmax", Nd4j.create([1.0, 2.0]))
+        sd = SameDiff.create()
+        x = sd.var("x", np.ones((2, 2), np.float32))
+        sd.math.exp(x, name="e")
+        sd.output({}, ["e"])
+        out = capsys.readouterr().out
+        assert "[op] softmax" in out
+        assert "[op] exp" in out
+        assert prof.stats().get("op:softmax", {}).get("count", 0) >= 1
+    finally:
+        prof.enable_verbose_mode(False)
+        prof.enabled = False
+        prof.reset()

@@ -29,5 +29,12 @@ __version__ = "0.1.0"
 
 from deeplearning4j_tpu import dtypes as dtypes
 from deeplearning4j_tpu.ndarray import NDArray, Nd4j
+from deeplearning4j_tpu import environment as environment
 
-__all__ = ["NDArray", "Nd4j", "dtypes", "__version__"]
+# tier-2 runtime flags (env vars — reference ND4JEnvironmentVars)
+if environment.get_flag("DL4J_TPU_DEFAULT_DTYPE") != "float32":
+    dtypes.set_default_dtype(
+        environment.get_flag("DL4J_TPU_DEFAULT_DTYPE"))
+environment.apply_startup_flags()
+
+__all__ = ["NDArray", "Nd4j", "dtypes", "environment", "__version__"]

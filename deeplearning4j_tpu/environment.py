@@ -1,0 +1,96 @@
+"""Runtime flags — the central environment-variable registry.
+
+Reference: ``org.nd4j.config.ND4JEnvironmentVars`` /
+``ND4JSystemProperties`` / ``DL4JSystemProperties`` — the reference's
+tier-2 config system (SURVEY §5 "Config / flag system"): runtime
+behavior toggles separate from model configs (tier 1, JSON beans) and
+backend selection (tier 3, here JAX platform selection).
+
+Every supported variable is declared here with type, default, and
+purpose, and read through :func:`get_flag` so the full surface is
+greppable and ``describe()`` prints the live values (the analog of the
+reference's documented constants class).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+def _bool(v: str) -> bool:
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclass(frozen=True)
+class Flag:
+    name: str
+    default: Any
+    parse: Callable[[str], Any]
+    doc: str
+
+
+FLAGS: Dict[str, Flag] = {}
+
+
+def _register(name, default, parse, doc):
+    FLAGS[name] = Flag(name, default, parse, doc)
+
+
+# -- data / resources (reference ND4JSystemProperties resources dir) -------
+_register("DL4J_TPU_DATA_DIR", os.path.expanduser("~/.dl4j_tpu/data"),
+          str, "dataset fetcher cache root (MNIST/EMNIST/CIFAR/...)")
+_register("DL4J_TPU_CRASH_DUMP_DIR", ".", str,
+          "directory for HBM-OOM crash dumps (DL4JSystemProperties "
+          "crash-dump location analog)")
+
+# -- precision / execution (reference dtype + workspace debug props) -------
+_register("DL4J_TPU_DEFAULT_DTYPE", "float32", str,
+          "default NDArray float dtype (float32|bfloat16|float64)")
+_register("DL4J_TPU_VERBOSE_OPS", False, _bool,
+          "print every op execution (libnd4j verbose mode analog)")
+_register("DL4J_TPU_PROFILING", False, _bool,
+          "enable OpProfiler aggregation from startup")
+
+# -- distributed bring-up (reference parameter-server/Spark env) -----------
+_register("DL4J_TPU_COORD", None, str,
+          "jax.distributed coordinator address host:port")
+_register("DL4J_TPU_NPROC", None, int,
+          "number of processes in the multi-host job")
+_register("DL4J_TPU_PROC_ID", None, int,
+          "this process's rank in the multi-host job")
+
+# -- UI / examples ---------------------------------------------------------
+_register("DL4J_TPU_UI_PORT", 9000, int,
+          "training dashboard HTTP port (DL4JSystemProperties UI port)")
+_register("DL4J_TPU_EXAMPLE_FAST", False, _bool,
+          "examples run in seconds-scale FAST mode (CI smoke)")
+
+
+def get_flag(name: str) -> Any:
+    """Read a declared flag from the environment (typed, defaulted)."""
+    flag = FLAGS[name]
+    raw = os.environ.get(name)
+    if raw is None:
+        return flag.default
+    return flag.parse(raw)
+
+
+def describe() -> str:
+    """Live flag table (the documented-constants-class analog)."""
+    lines = [f"{'variable':<28} {'value':<24} purpose"]
+    for name, flag in sorted(FLAGS.items()):
+        val = get_flag(name)
+        lines.append(f"{name:<28} {str(val):<24} {flag.doc}")
+    return "\n".join(lines)
+
+
+def apply_startup_flags() -> None:
+    """Apply flags that configure global singletons (called lazily from
+    package __init__; safe to call repeatedly)."""
+    from deeplearning4j_tpu.utils.profiler import OpProfiler
+    prof = OpProfiler.get_instance()
+    if get_flag("DL4J_TPU_VERBOSE_OPS"):
+        prof.enable_verbose_mode(True)
+    if get_flag("DL4J_TPU_PROFILING"):
+        prof.enabled = True

@@ -325,3 +325,24 @@ def test_verbose_op_execution_mode(capsys):
         prof.enable_verbose_mode(False)
         prof.enabled = False
         prof.reset()
+
+
+def test_environment_flag_registry(monkeypatch):
+    """Tier-2 runtime flags (reference ND4JEnvironmentVars analog)."""
+    from deeplearning4j_tpu import environment as env
+    assert env.get_flag("DL4J_TPU_UI_PORT") == 9000
+    monkeypatch.setenv("DL4J_TPU_UI_PORT", "8123")
+    assert env.get_flag("DL4J_TPU_UI_PORT") == 8123
+    monkeypatch.setenv("DL4J_TPU_VERBOSE_OPS", "true")
+    assert env.get_flag("DL4J_TPU_VERBOSE_OPS") is True
+    desc = env.describe()
+    assert "DL4J_TPU_DEFAULT_DTYPE" in desc and "8123" in desc
+    # apply_startup_flags applies verbose to the profiler singleton
+    from deeplearning4j_tpu.utils.profiler import OpProfiler
+    prof = OpProfiler.get_instance()
+    was = prof.verbose
+    try:
+        env.apply_startup_flags()
+        assert prof.verbose is True
+    finally:
+        prof.verbose = was

@@ -397,10 +397,11 @@ class SameDiff:
             args = [values[i] for i in node.inputs]
             fn = node.fn if node.op == "_lambda" else get_op(node.op)
             if prof.verbose or prof.enabled:
-                # reference profilingHookIn/verbose native-op logging;
-                # under jit this fires once per trace (per-op device
-                # timing then comes from jax.profiler, §SURVEY 5)
-                prof.op_executed(node.op, args, node.kwargs)
+                # fires once per TRACE (cached executables skip it) —
+                # counted as op_trace:; per-op device timing comes from
+                # jax.profiler (SURVEY §5)
+                prof.op_executed(node.op, args, node.kwargs,
+                                 trace_time=True)
             res = fn(*args, **node.kwargs)
             if len(node.outputs) == 1:
                 values[node.outputs[0]] = res

@@ -23,9 +23,12 @@ import numpy as np
 
 from deeplearning4j_tpu import dtypes
 
-#: open-workspace depth; bumped by utils.workspace scopes so the hot
-#: eager path pays only an int check when no workspace is active
+#: process-wide open-workspace count (hint only — the authoritative
+#: scope lookup in utils.workspace is thread-local): the hot eager path
+#: pays one int check when no workspace is open anywhere
 _WS_DEPTH = 0
+import threading as _threading  # noqa: E402
+_WS_HINT_LOCK = _threading.Lock()
 
 
 def _unwrap(x):

@@ -49,16 +49,20 @@ class OpProfiler:
     def enable_verbose_mode(self, on: bool = True):
         self.verbose = on
 
-    def op_executed(self, name: str, args=(), kwargs=None):
+    def op_executed(self, name: str, args=(), kwargs=None,
+                    trace_time: bool = False):
         """Hook called by op dispatch sites (SameDiff executor,
-        Nd4j.exec) — reference DefaultOpExecutioner.profilingHookIn."""
+        Nd4j.exec) — reference DefaultOpExecutioner.profilingHookIn.
+        ``trace_time=True`` marks jit-trace-time firing: counted under
+        ``op_trace:`` since a cached executable won't re-fire it."""
         if self.verbose:
             shapes = [tuple(getattr(a, "shape", ()))
                       for a in args if hasattr(a, "shape")]
             print(f"[op] {name} shapes={shapes} "
                   f"kwargs={sorted((kwargs or {}))}")
         if self.enabled:
-            self._stats[f"op:{name}"].count += 1
+            key = f"op_trace:{name}" if trace_time else f"op:{name}"
+            self._stats[key].count += 1
 
     @classmethod
     def get_instance(cls) -> "OpProfiler":

@@ -80,19 +80,29 @@ class MemoryWorkspace:
 
     # -- scope management ----------------------------------------------
     def __enter__(self) -> "MemoryWorkspace":
+        if self in _stack():
+            # idempotent re-entry: with-statement around an already
+            # activated workspace (get_and_activate_workspace)
+            return self
         from deeplearning4j_tpu import ndarray as _nd
         self._closed = False
         self.generation += 1
         self._live = []
         _stack().append(self)
-        _nd._WS_DEPTH += 1
+        with _nd._WS_HINT_LOCK:
+            _nd._WS_DEPTH += 1
         AllocationsTracker.instance()._opened(self)
         return self
 
     def __exit__(self, *exc):
+        if self not in _stack():
+            raise RuntimeError(
+                f"workspace {self.id!r}: scope not active on this "
+                f"thread (double close, or opened on another thread)")
         from deeplearning4j_tpu import ndarray as _nd
         _stack().remove(self)
-        _nd._WS_DEPTH -= 1
+        with _nd._WS_HINT_LOCK:
+            _nd._WS_DEPTH -= 1
         self._closed = True
         return False
 
@@ -125,7 +135,8 @@ class MemoryWorkspace:
         INDArray.detach): the copy is not tracked by the scope."""
         import jax.numpy as jnp
         from deeplearning4j_tpu.ndarray import NDArray
-        return NDArray(jnp.array(arr._a, copy=True))
+        with scope_out_of_workspaces():      # copy must NOT register
+            return NDArray(jnp.array(arr._a, copy=True))
 
     # -- leak detection (reference DebugMode / "not in scope") ----------
     def leaked_arrays(self) -> List[tuple]:
@@ -205,8 +216,13 @@ class WorkspaceManager:
             self, workspace_id: str,
             config: Optional[WorkspaceConfiguration] = None
     ) -> MemoryWorkspace:
+        """Returns the workspace with its scope ENTERED (reference
+        getAndActivateWorkspace). Close with ``notify_scope_left()``,
+        or use it in a ``with`` block — re-entry is idempotent, the
+        block's exit closes the scope."""
         ws = self.get_workspace_for_current_thread(workspace_id, config)
-        return ws          # used as context manager by the caller
+        ws.notify_scope_entered()
+        return ws
 
     def destroy_workspace(self, workspace_id: str):
         self._map().pop(workspace_id, None)
@@ -216,21 +232,19 @@ class WorkspaceManager:
 
 
 class scope_out_of_workspaces:
-    """Temporarily suspend tracking (reference
-    ``MemoryWorkspace.scopeOutOfWorkspaces``)."""
+    """Temporarily suspend tracking on THIS thread (reference
+    ``MemoryWorkspace.scopeOutOfWorkspaces``). Only the thread-local
+    workspace stack is cleared; the global fast-path hint stays put so
+    other threads' tracking is unaffected (register_allocation resolves
+    the actual scope per thread)."""
 
     def __enter__(self):
-        from deeplearning4j_tpu import ndarray as _nd
         self._saved = _stack()[:]
-        self._saved_depth = _nd._WS_DEPTH
-        _nd._WS_DEPTH = 0
         _stack().clear()
         return self
 
     def __exit__(self, *exc):
-        from deeplearning4j_tpu import ndarray as _nd
         _stack().extend(self._saved)
-        _nd._WS_DEPTH = self._saved_depth
         return False
 
 

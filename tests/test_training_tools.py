@@ -321,6 +321,8 @@ def test_verbose_op_execution_mode(capsys):
         assert "[op] softmax" in out
         assert "[op] exp" in out
         assert prof.stats().get("op:softmax", {}).get("count", 0) >= 1
+        # samediff fires at trace time -> op_trace: bucket
+        assert prof.stats().get("op_trace:exp", {}).get("count", 0) >= 1
     finally:
         prof.enable_verbose_mode(False)
         prof.enabled = False

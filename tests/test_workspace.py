@@ -37,12 +37,13 @@ def test_leak_detection():
     assert any(shape == (3, 3) for _, shape in leaks)
     with pytest.raises(RuntimeError, match="outlive"):
         ws.assert_no_leaks()
-    # detach() is the sanctioned way out
+    # detach() is the sanctioned way out: the copy is NOT tracked,
+    # so keeping it past the scope is clean
     ws2 = MemoryWorkspace("WS_LEAK2")
     with ws2:
         y = MemoryWorkspace.detach(Nd4j.ones((3, 3)))
-    del y
-    # the tracked original died; the detached copy was never tracked
+    ws2.assert_no_leaks()          # y escapes legally
+    assert float(y.sum_number()) == 9.0
 
 
 def test_no_leaks_passes_when_clean():
@@ -69,6 +70,7 @@ def test_manager_and_tracker():
     assert mgr.get_workspace_for_current_thread("WS_MGR") is ws
     with mgr.get_and_activate_workspace("WS_MGR"):
         Nd4j.ones((16,))
+    assert not ws.is_scope_active()   # with-exit closed the scope
     rep = AllocationsTracker.instance().report()
     assert "WS_MGR" in rep
     mgr.destroy_workspace("WS_MGR")
@@ -95,3 +97,43 @@ def test_nested_workspaces_track_innermost():
         assert inner.total_allocations == 1
         # current policy: innermost scope owns the allocation
         assert outer.total_allocations == 0
+
+
+def test_get_and_activate_enters_scope():
+    """Regression: get_and_activate must actually activate (reference
+    getAndActivateWorkspace), and notify_scope_left closes it."""
+    mgr = get_workspace_manager()
+    ws = mgr.get_and_activate_workspace("WS_ACT")
+    try:
+        assert ws.is_scope_active()
+        Nd4j.ones((4,))
+        assert ws.total_allocations == 1
+    finally:
+        ws.notify_scope_left()
+    assert not ws.is_scope_active()
+    with pytest.raises(RuntimeError, match="not active"):
+        ws.notify_scope_left()        # double close: clear error
+    mgr.destroy_workspace("WS_ACT")
+
+
+def test_scope_out_does_not_disturb_other_threads():
+    """Regression: scope_out_of_workspaces on one thread must not
+    disable tracking on another thread's active workspace."""
+    import threading
+    ws = MemoryWorkspace("WS_THREAD")
+    inside = threading.Event()
+    release = threading.Event()
+
+    def other():
+        with scope_out_of_workspaces():
+            inside.set()
+            release.wait(timeout=10)
+
+    t = threading.Thread(target=other)
+    with ws:
+        t.start()
+        assert inside.wait(timeout=10)
+        Nd4j.ones((2,))               # tracked despite thread B's scope-out
+        release.set()
+        t.join()
+    assert ws.total_allocations == 1

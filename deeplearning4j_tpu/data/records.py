@@ -27,6 +27,17 @@ class RecordReader:
         pass
 
 
+def _as_path(path_or_text) -> Optional[Path]:
+    """Path if the argument names an existing file, else None (inline
+    text). Long/invalid strings (inline CSV blobs) are text, not an
+    OSError from os.stat."""
+    try:
+        p = Path(str(path_or_text))
+        return p if p.exists() else None
+    except (OSError, ValueError):
+        return None
+
+
 class CollectionRecordReader(RecordReader):
     """In-memory records (reference CollectionRecordReader)."""
 
@@ -49,8 +60,8 @@ class CSVRecordReader(RecordReader):
         self.parse_numbers = parse_numbers
 
     def _lines(self):
-        p = Path(str(self.path_or_text))
-        if p.exists():
+        p = _as_path(self.path_or_text)
+        if p is not None:
             with open(p, newline="") as f:
                 yield from f
         else:
@@ -81,8 +92,8 @@ class CSVRecordReader(RecordReader):
         callers then fall back to the row iterator."""
         from deeplearning4j_tpu import native as _native
 
-        p = Path(str(self.path_or_text))
-        if p.exists():
+        p = _as_path(self.path_or_text)
+        if p is not None:
             data = p.read_bytes()
         else:
             data = str(self.path_or_text).encode()
@@ -97,8 +108,8 @@ class LineRecordReader(RecordReader):
         self.path_or_text = path_or_text
 
     def __iter__(self):
-        p = Path(str(self.path_or_text))
-        lines = (open(p).read() if p.exists()
+        p = _as_path(self.path_or_text)
+        lines = (open(p).read() if p is not None
                  else str(self.path_or_text)).splitlines()
         for line in lines:
             yield [line]
@@ -178,8 +189,8 @@ class SVMLightRecordReader(RecordReader):
         self.zero_based = zero_based
 
     def __iter__(self):
-        p = Path(str(self.path_or_text))
-        text = open(p).read() if p.exists() else str(self.path_or_text)
+        p = _as_path(self.path_or_text)
+        text = open(p).read() if p is not None else str(self.path_or_text)
         for line in text.splitlines():
             line = line.split("#")[0].strip()
             if not line:

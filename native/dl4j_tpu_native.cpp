@@ -164,7 +164,10 @@ struct Workspace {
 void* ws_create(int64_t bytes) {
     auto* ws = new (std::nothrow) Workspace();
     if (!ws) return nullptr;
-    ws->base = static_cast<char*>(std::malloc(bytes));
+    // 64-byte-aligned base: offset alignment in ws_alloc only yields
+    // aligned POINTERS if the base itself is aligned (malloc is 16)
+    int64_t rounded = (bytes + 63) & ~int64_t(63);
+    ws->base = static_cast<char*>(std::aligned_alloc(64, rounded));
     if (!ws->base) { delete ws; return nullptr; }
     ws->capacity = bytes;
     ws->offset = 0;

@@ -1,0 +1,242 @@
+// Native-runtime unit tests — reference analog: the libnd4j googletest
+// suites (tests_cpu/layers_tests/*, run_tests.sh). gtest is not in
+// this image, so a minimal CHECK harness covers the same ground:
+// exact-value + shape assertions per exported component.
+//
+// Build & run:  make test
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+int csv_parse_f32(const char*, int64_t, char, int, float*, int64_t,
+                  int64_t*, int64_t*);
+int64_t encode_threshold_f32(const float*, int64_t, float, int8_t*,
+                             float*);
+void decode_threshold_f32(const int8_t*, int64_t, float, float*);
+void bitmap_encode(const int8_t*, int64_t, uint8_t*, uint8_t*);
+void bitmap_decode(const uint8_t*, const uint8_t*, int64_t, float,
+                   float*);
+void* ws_create(int64_t);
+void* ws_alloc(void*, int64_t);
+int64_t ws_reset(void*);
+int64_t ws_capacity(void*);
+void ws_destroy(void*);
+void* ring_create(int64_t);
+int ring_push(void*, int64_t);
+int ring_pop(void*, int64_t*);
+int64_t ring_size(void*);
+void ring_close(void*);
+void ring_destroy(void*);
+int img_batch_normalize_u8(const uint8_t*, int64_t, int64_t, int64_t,
+                           int64_t, const int32_t*, const int32_t*,
+                           const uint8_t*, int64_t, int64_t,
+                           const float*, const float*, float*, int);
+uint32_t dl4j_crc32(const uint8_t*, int64_t);
+int64_t chunk_count(int64_t, int64_t);
+int64_t chunk_frame_bytes(int64_t, int64_t);
+int64_t chunk_message(uint64_t, const uint8_t*, int64_t, int64_t,
+                      uint8_t*);
+int64_t chunk_parse_frame(const uint8_t*, int64_t, uint64_t*, uint32_t*,
+                          uint32_t*, uint32_t*, int64_t*);
+int dl4j_tpu_native_abi_version();
+}
+
+static int failures = 0;
+#define CHECK(cond)                                                   \
+  do {                                                                \
+    if (!(cond)) {                                                    \
+      std::printf("FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond);     \
+      ++failures;                                                     \
+    }                                                                 \
+  } while (0)
+#define CHECK_NEAR(a, b, tol) CHECK(std::fabs((a) - (b)) <= (tol))
+
+static void test_csv_parser() {
+  const char* txt = "# header\n1.5,2.5,3\n-4,5e1,0.25\n";
+  float out[16];
+  int64_t rows = 0, cols = 0;
+  int rc = csv_parse_f32(txt, (int64_t)std::strlen(txt), ',', 1, out,
+                         16, &rows, &cols);
+  CHECK(rc == 0);
+  CHECK(rows == 2 && cols == 3);
+  CHECK_NEAR(out[0], 1.5f, 1e-6f);
+  CHECK_NEAR(out[4], 50.0f, 1e-6f);
+  CHECK_NEAR(out[5], 0.25f, 1e-6f);
+  // ragged input must be rejected, not silently padded
+  const char* ragged = "1,2\n3\n";
+  rc = csv_parse_f32(ragged, (int64_t)std::strlen(ragged), ',', 0, out,
+                     16, &rows, &cols);
+  CHECK(rc == -3);
+  // non-numeric -> fall back signal
+  const char* alpha = "1,x\n";
+  rc = csv_parse_f32(alpha, (int64_t)std::strlen(alpha), ',', 0, out,
+                     16, &rows, &cols);
+  CHECK(rc == -2);
+  // overflow of out buffer
+  rc = csv_parse_f32(txt, (int64_t)std::strlen(txt), ',', 1, out, 3,
+                     &rows, &cols);
+  CHECK(rc == -1);
+}
+
+static void test_threshold_codec() {
+  const float g[6] = {0.9f, -0.7f, 0.1f, -0.05f, 2.0f, -3.0f};
+  int8_t sign[6];
+  float residual[6];
+  int64_t nz = encode_threshold_f32(g, 6, 0.5f, sign, residual);
+  CHECK(nz == 4);                       // |g| > tau at 4 positions
+  CHECK(sign[0] == 1 && sign[1] == -1 && sign[2] == 0 && sign[3] == 0);
+  float dec[6];
+  decode_threshold_f32(sign, 6, 0.5f, dec);
+  CHECK_NEAR(dec[0], 0.5f, 1e-6f);
+  CHECK_NEAR(dec[2], 0.0f, 1e-6f);
+  // residual + decoded == original (the accumulator invariant)
+  for (int i = 0; i < 6; ++i)
+    CHECK_NEAR(residual[i] + dec[i], g[i], 1e-6f);
+}
+
+static void test_bitmap_roundtrip() {
+  int8_t sign[16];
+  for (int i = 0; i < 16; ++i) sign[i] = (int8_t)((i % 3) - 1);
+  uint8_t pos[2], neg[2];
+  bitmap_encode(sign, 16, pos, neg);
+  float back[16];
+  const float tau = 0.25f;
+  bitmap_decode(pos, neg, 16, tau, back);
+  for (int i = 0; i < 16; ++i)
+    CHECK_NEAR(back[i], tau * (float)sign[i], 1e-6f);
+}
+
+static void test_workspace_arena() {
+  void* ws = ws_create(1024);
+  CHECK(ws != nullptr);
+  void* a = ws_alloc(ws, 100);
+  void* b = ws_alloc(ws, 100);
+  CHECK(a != nullptr && b != nullptr && a != b);
+  CHECK(((uintptr_t)a % 64) == 0 && ((uintptr_t)b % 64) == 0);
+  // spill path: bigger than the arena
+  void* big = ws_alloc(ws, 4096);
+  CHECK(big != nullptr);
+  int64_t high_water = ws_reset(ws);
+  CHECK(high_water >= 200 + 4096);
+  void* c = ws_alloc(ws, 100);
+  CHECK(c == a);                        // cyclic reuse after reset
+  CHECK(ws_capacity(ws) == 1024);
+  ws_destroy(ws);
+}
+
+static void test_ring_queue_threaded() {
+  void* q = ring_create(64);
+  std::atomic<int64_t> sum(0);
+  std::thread consumer([&] {
+    int64_t tok;
+    while (ring_pop(q, &tok) == 0) sum += tok;
+  });
+  int64_t want = 0;
+  for (int64_t i = 1; i <= 1000; ++i) {
+    CHECK(ring_push(q, i) == 0);
+    want += i;
+  }
+  ring_close(q);
+  consumer.join();
+  CHECK(sum.load() == want);
+  CHECK(ring_size(q) == 0);
+  ring_destroy(q);
+}
+
+static void test_image_normalize() {
+  // 1 image, 2x2x1, mean (in 0-1 units) 100/255, std 50/255:
+  // out = (px/255 - mean)/std
+  uint8_t in[4] = {100, 150, 50, 200};
+  float mean[1] = {100.0f / 255.0f}, sd[1] = {50.0f / 255.0f};
+  float out[4];
+  int rc = img_batch_normalize_u8(in, 1, 2, 2, 1, nullptr, nullptr,
+                                  nullptr, 2, 2, mean, sd, out, 1);
+  CHECK(rc == 0);
+  CHECK_NEAR(out[0], 0.0f, 1e-5f);
+  CHECK_NEAR(out[1], 1.0f, 1e-5f);
+  CHECK_NEAR(out[3], 2.0f, 1e-5f);
+  // horizontal flip swaps columns
+  uint8_t fl = 1;
+  rc = img_batch_normalize_u8(in, 1, 2, 2, 1, nullptr, nullptr, &fl, 2,
+                              2, mean, sd, out, 1);
+  CHECK(rc == 0);
+  CHECK_NEAR(out[0], 1.0f, 1e-5f);      // was column 1
+  CHECK_NEAR(out[1], 0.0f, 1e-5f);
+}
+
+static const int64_t kFirstPayloadByte = 24;  // header is 24 bytes
+
+static void test_chunked_framing() {
+  const int64_t payload_len = 1000, chunk = 256;
+  std::vector<uint8_t> payload(payload_len);
+  for (int64_t i = 0; i < payload_len; ++i)
+    payload[i] = (uint8_t)(i * 7);
+  int64_t n_chunks = chunk_count(payload_len, chunk);
+  CHECK(n_chunks == 4);
+  int64_t total = chunk_frame_bytes(payload_len, chunk);
+  std::vector<uint8_t> wire(total);
+  int64_t frames = chunk_message(42u, payload.data(), payload_len,
+                                 chunk, wire.data());
+  CHECK(frames == n_chunks);
+  // reassemble
+  std::vector<uint8_t> got(payload_len);
+  const uint8_t* p = wire.data();
+  int64_t remaining = total;
+  for (int64_t c = 0; c < n_chunks; ++c) {
+    uint64_t msg_id;
+    uint32_t seq, tot, plen;
+    int64_t off;
+    int64_t consumed =
+        chunk_parse_frame(p, remaining, &msg_id, &seq, &tot, &plen,
+                          &off);
+    CHECK(consumed > 0);
+    CHECK(msg_id == 42u && tot == (uint32_t)n_chunks &&
+          seq == (uint32_t)c);
+    std::memcpy(got.data() + (int64_t)seq * chunk, p + off, plen);
+    p += consumed;
+    remaining -= consumed;
+  }
+  CHECK(std::memcmp(got.data(), payload.data(),
+                    (size_t)payload_len) == 0);
+  // corrupted payload byte must be rejected by crc
+  wire[kFirstPayloadByte] ^= 0xFF;
+  uint64_t msg_id;
+  uint32_t seq, tot, plen;
+  int64_t off;
+  CHECK(chunk_parse_frame(wire.data(), total, &msg_id, &seq, &tot,
+                          &plen, &off) == -2);
+  // truncated header
+  CHECK(chunk_parse_frame(wire.data(), 10, &msg_id, &seq, &tot, &plen,
+                          &off) == -1);
+}
+
+static void test_crc() {
+  const uint8_t a[4] = {'a', 'b', 'c', 'd'};
+  uint32_t c1 = dl4j_crc32(a, 4);
+  CHECK(c1 == dl4j_crc32(a, 4));
+  const uint8_t b[4] = {'a', 'b', 'c', 'e'};
+  CHECK(dl4j_crc32(b, 4) != c1);
+}
+
+int main() {
+  CHECK(dl4j_tpu_native_abi_version() == 2);
+  test_csv_parser();
+  test_threshold_codec();
+  test_bitmap_roundtrip();
+  test_workspace_arena();
+  test_ring_queue_threaded();
+  test_image_normalize();
+  test_chunked_framing();
+  test_crc();
+  if (failures == 0) {
+    std::printf("native tests: ALL PASSED\n");
+    return 0;
+  }
+  std::printf("native tests: %d FAILURES\n", failures);
+  return 1;
+}

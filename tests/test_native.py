@@ -295,3 +295,15 @@ def test_chunk_message_rejects_bad_chunk_bytes():
     for bad in (0, -1):
         with _pytest.raises(ValueError):
             native.chunk_message(1, b"abc", chunk_bytes=bad)
+
+
+def test_native_cpp_suite_passes():
+    """Build and run the native C++ unit tests (reference: libnd4j
+    googletest suites / run_tests.sh)."""
+    import subprocess
+    from pathlib import Path
+    native_dir = Path(__file__).parent.parent / "native"
+    res = subprocess.run(["make", "test"], cwd=native_dir,
+                         capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "ALL PASSED" in res.stdout

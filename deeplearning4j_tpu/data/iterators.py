@@ -81,6 +81,9 @@ class AsyncDataSetIterator(DataSetIterator):
         super().__init__(getattr(base, "batch_size", None))
         self.base = base
         self.queue_size = queue_size
+        #: cumulative seconds the consumer blocked waiting on ETL
+        #: (reference PerformanceListener's ETL-wait metric)
+        self.etl_wait_seconds = 0.0
 
     def __len__(self):
         return len(self.base)
@@ -107,12 +110,16 @@ class AsyncDataSetIterator(DataSetIterator):
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
+        import time as _time
         try:
             while True:
+                t0 = _time.perf_counter()
                 try:
                     item = q.get()
                 except StopIteration:
                     break
+                finally:
+                    self.etl_wait_seconds += _time.perf_counter() - t0
                 yield item
         finally:
             q.close()                      # unblock producer on break

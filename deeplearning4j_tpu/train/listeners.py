@@ -42,13 +42,19 @@ class ScoreIterationListener(TrainingListener):
 class PerformanceListener(TrainingListener):
     """Throughput/ETL timing (reference PerformanceListener)."""
 
-    def __init__(self, frequency: int = 10, report=None):
+    def __init__(self, frequency: int = 10, report=None,
+                 iterator=None):
+        """``iterator``: pass the AsyncDataSetIterator feeding fit() to
+        include its cumulative ETL-wait in the report (the reference's
+        ETL-time column)."""
         self.frequency = frequency
         self._last_time = None
         self._last_iter = None
         self.samples_per_sec = None
         self._report = report or (lambda msg: logger.info("%s", msg))
         self._batch = None
+        self._iterator = iterator
+        self._last_etl = 0.0
 
     def iteration_done(self, net, iteration, epoch):
         now = time.perf_counter()
@@ -57,9 +63,14 @@ class PerformanceListener(TrainingListener):
             dt = now - self._last_time
             iters = iteration - self._last_iter
             if dt > 0 and iters > 0:
-                self._report(
-                    f"iter {iteration}: {iters / dt:.1f} iter/sec, "
-                    f"score {net.score():.5f}")
+                msg = (f"iter {iteration}: {iters / dt:.1f} iter/sec, "
+                       f"score {net.score():.5f}")
+                etl = getattr(self._iterator, "etl_wait_seconds", None)
+                if etl is not None:
+                    msg += (f", ETL wait "
+                            f"{(etl - self._last_etl) * 1e3:.1f} ms")
+                    self._last_etl = etl
+                self._report(msg)
         if iteration % self.frequency == 0:
             self._last_time = now
             self._last_iter = iteration

@@ -313,3 +313,48 @@ def test_dataset_fetchers_synthetic():
     net.fit(ir, epochs=60)
     ev = net.evaluate(ir)
     assert ev.accuracy() > 0.9, ev.accuracy()
+
+
+def test_async_iterator_tracks_etl_wait():
+    """Reference PerformanceListener ETL-wait metric: the async wrapper
+    accumulates consumer block time."""
+    import time as _t
+    from deeplearning4j_tpu.data import AsyncDataSetIterator, DataSet
+
+    class SlowBase:
+        batch_size = 4
+
+        def __iter__(self):
+            for _ in range(3):
+                _t.sleep(0.02)
+                yield DataSet(np.zeros((4, 2), np.float32),
+                              np.zeros((4, 2), np.float32))
+
+    it = AsyncDataSetIterator(SlowBase(), queue_size=1)
+    n = sum(1 for _ in it)
+    assert n == 3
+    assert it.etl_wait_seconds > 0.01
+
+
+def test_performance_listener_reports_etl(capsys):
+    from deeplearning4j_tpu.train.listeners import PerformanceListener
+    from deeplearning4j_tpu.data import AsyncDataSetIterator
+
+    class _B:
+        batch_size = 1
+
+        def __iter__(self):
+            return iter([])
+
+    it = AsyncDataSetIterator(_B())
+    it.etl_wait_seconds = 0.5
+    msgs = []
+    pl = PerformanceListener(frequency=1, report=msgs.append,
+                             iterator=it)
+
+    class FakeNet:
+        def score(self):
+            return 1.0
+    pl.iteration_done(FakeNet(), 1, 0)
+    pl.iteration_done(FakeNet(), 2, 0)
+    assert any("ETL wait" in m for m in msgs)

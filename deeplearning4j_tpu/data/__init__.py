@@ -4,7 +4,7 @@ iterators, normalizers) + datavec ETL (``data.records`` / ``transform``).
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.data.iterators import (
     DataSetIterator, ListDataSetIterator, AsyncDataSetIterator,
-    TfDataSetIterator,
+    TfDataSetIterator, BucketedSequenceIterator,
 )
 from deeplearning4j_tpu.data.datasets import (
     EmnistDataSetIterator, Cifar10DataSetIterator, SvhnDataSetIterator,
@@ -30,7 +30,7 @@ from deeplearning4j_tpu.data.image import (
 
 __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
-    "TfDataSetIterator", "EmnistDataSetIterator", "Cifar10DataSetIterator", "SvhnDataSetIterator", "IrisDataSetIterator",
+    "TfDataSetIterator", "BucketedSequenceIterator", "EmnistDataSetIterator", "Cifar10DataSetIterator", "SvhnDataSetIterator", "IrisDataSetIterator",
     "AsyncDataSetIterator", "NormalizerStandardize",
     "NormalizerMinMaxScaler", "ImagePreProcessingScaler",
     "NativeImageLoader", "ImageRecordReader", "ParentPathLabelGenerator",

@@ -182,3 +182,61 @@ class TfDataSetIterator(DataSetIterator):
             yield self._apply_pp(DataSet(np.asarray(x),
                                          None if y is None
                                          else np.asarray(y)))
+
+
+class BucketedSequenceIterator(DataSetIterator):
+    """Pads each sequence batch's time axis UP to a fixed bucket length.
+
+    TPU-native necessity with no reference equivalent (SURVEY §7 hard
+    part (c)): the reference's eager kernels take any [B,T,F]; here
+    every distinct T triggers a retrace+recompile of the jitted train
+    step. Snapping T to a small bucket set (e.g. 32/64/128/256) bounds
+    the number of compiled programs while masks keep the math exact —
+    the standard variable-length recipe for XLA.
+    """
+
+    def __init__(self, base, buckets=(32, 64, 128, 256)):
+        super().__init__(getattr(base, "batch_size", None))
+        self.base = base
+        self.buckets = sorted(buckets)
+
+    def reset(self):
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+    def _bucket_for(self, t: int) -> int:
+        for b in self.buckets:
+            if t <= b:
+                return b
+        return t                       # beyond the largest: leave as-is
+
+    def __iter__(self):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        for ds in self.base:
+            t = ds.features.shape[1]
+            tb = self._bucket_for(t)
+            if tb == t:
+                yield ds
+                continue
+            pad = tb - t
+
+            def pad_time(a, pad=pad):
+                if a is None:
+                    return None
+                width = [(0, 0)] * a.ndim
+                width[1] = (0, pad)
+                return np.pad(np.asarray(a), width)
+
+            fm = ds.features_mask
+            if fm is None:            # padding NEEDS a mask to be exact
+                fm = np.ones(ds.features.shape[:2], np.float32)
+            lm = ds.labels_mask
+            if lm is None and ds.labels is not None and \
+                    ds.labels.ndim >= 3:
+                lm = np.ones(ds.labels.shape[:2], np.float32)
+            yield DataSet(pad_time(ds.features),
+                          pad_time(ds.labels)
+                          if ds.labels is not None
+                          and ds.labels.ndim >= 3 else ds.labels,
+                          features_mask=pad_time(fm),
+                          labels_mask=pad_time(lm))

@@ -358,3 +358,34 @@ def test_performance_listener_reports_etl(capsys):
     pl.iteration_done(FakeNet(), 1, 0)
     pl.iteration_done(FakeNet(), 2, 0)
     assert any("ETL wait" in m for m in msgs)
+
+
+def test_bucketed_sequence_iterator():
+    """Variable-T batches snap to bucket lengths (bounded retraces),
+    masks keep semantics exact."""
+    from deeplearning4j_tpu.data import (BucketedSequenceIterator,
+                                         DataSet, ListDataSetIterator)
+    batches = []
+    for t in (5, 17, 33, 300):
+        batches.append(DataSet(np.ones((2, t, 3), np.float32),
+                               np.ones((2, t, 4), np.float32)))
+    it = BucketedSequenceIterator(ListDataSetIterator(batches),
+                                  buckets=(16, 32, 64))
+    out = list(it)
+    assert [d.features.shape[1] for d in out] == [16, 32, 64, 300]
+    # padded region masked out, real region mask 1
+    d0 = out[0]
+    assert d0.features_mask.shape == (2, 16)
+    assert d0.features_mask[:, :5].all()
+    assert not d0.features_mask[:, 5:].any()
+    assert d0.labels.shape == (2, 16, 4)
+    assert d0.labels_mask[:, 5:].sum() == 0
+    # pre-masked input: original mask preserved under padding
+    masked = DataSet(np.ones((1, 10, 3), np.float32),
+                     np.ones((1, 10, 4), np.float32),
+                     features_mask=np.concatenate(
+                         [np.ones((1, 7)), np.zeros((1, 3))], 1))
+    out2 = list(BucketedSequenceIterator(
+        ListDataSetIterator([masked]), buckets=(16,)))[0]
+    assert out2.features_mask[0, :7].all()
+    assert not out2.features_mask[0, 7:].any()

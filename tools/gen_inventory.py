@@ -60,6 +60,21 @@ def main():
                   or (callable(getattr(zoo, n)) and n[:1].isupper())]
     section("Zoo models", zoo_models)
 
+    from deeplearning4j_tpu.nn.vertices import _VERTEX_REGISTRY
+    section("Graph vertices", list(_VERTEX_REGISTRY))
+    from deeplearning4j_tpu.nn.preprocessors import _PREPROC_REGISTRY
+    section("Input preprocessors", list(_PREPROC_REGISTRY))
+    from deeplearning4j_tpu import clustering as _cl
+    section("Clustering / manifold / ANN",
+            [n for n in _cl.__all__])
+    from deeplearning4j_tpu import nlp as _nlp
+    section("NLP", [n for n in _nlp.__all__])
+    from deeplearning4j_tpu.train import solver as _sv
+    section("Solvers", [c.__name__ for c in
+                        _sv.BaseOptimizer.__subclasses__()])
+    from deeplearning4j_tpu import eval_ as _ev
+    section("Evaluation", [n for n in _ev.__all__])
+
     out = os.path.join(os.path.dirname(__file__), "..", "docs",
                        "INVENTORY.md")
     os.makedirs(os.path.dirname(out), exist_ok=True)

@@ -453,6 +453,20 @@ class NDArray:
                      / (jnp.linalg.norm(self._a)
                         * jnp.linalg.norm(b) + 1e-12))
 
+    # -- NDArrayIndex DSL (reference get(INDArrayIndex...)/put) ----------
+    def get(self, *indices) -> "NDArray":
+        """arr.get(NDArrayIndex.point(0), NDArrayIndex.interval(1, 3))
+        (reference INDArray.get with the indexing DSL)."""
+        from deeplearning4j_tpu.ndarray_index import resolve_indices
+        return NDArray(self._a[resolve_indices(indices)])
+
+    def put_indices(self, indices, value) -> "NDArray":
+        """Functional put at DSL indices (reference INDArray.put(
+        INDArrayIndex[], INDArray)) — returns the updated array."""
+        from deeplearning4j_tpu.ndarray_index import resolve_indices
+        return NDArray(self._a.at[resolve_indices(tuple(indices))]
+                       .set(jnp.asarray(_unwrap(value))))
+
     # -- shape predicates / host exports (reference INDArray) ------------
     def rows(self) -> int:
         return int(self._a.shape[0])

@@ -1,0 +1,82 @@
+"""NDArrayIndex — the reference's indexing DSL.
+
+Reference: ``org.nd4j.linalg.indexing.NDArrayIndex`` (+
+``INDArrayIndex`` impls: ``interval``, ``point``, ``all``,
+``newAxis``) used as ``arr.get(NDArrayIndex.point(0),
+NDArrayIndex.interval(1, 3))``.
+
+TPU-native: each index resolves to a numpy-style basic index, so
+``get`` stays a pure (jit-traceable, zero-copy view) gather and
+``put`` is one functional ``.at[...].set``."""
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+
+class _Index:
+    def resolve(self):
+        raise NotImplementedError
+
+
+class _Interval(_Index):
+    def __init__(self, start, end, step=1, inclusive=False):
+        self.start, self.end, self.step = start, end, step
+        self.inclusive = inclusive
+
+    def resolve(self):
+        end = self.end + 1 if self.inclusive else self.end
+        return slice(self.start, end, self.step)
+
+
+class _Point(_Index):
+    def __init__(self, i):
+        self.i = i
+
+    def resolve(self):
+        return int(self.i)
+
+
+class _All(_Index):
+    def resolve(self):
+        return slice(None)
+
+
+class _NewAxis(_Index):
+    def resolve(self):
+        return None
+
+
+class NDArrayIndex:
+    """Factory (reference NDArrayIndex static methods)."""
+
+    @staticmethod
+    def interval(start: int, end: int, step: int = 1,
+                 inclusive: bool = False) -> _Index:
+        return _Interval(start, end, step, inclusive)
+
+    @staticmethod
+    def point(i: int) -> _Index:
+        return _Point(i)
+
+    @staticmethod
+    def all() -> _Index:
+        return _All()
+
+    @staticmethod
+    def new_axis() -> _Index:
+        return _NewAxis()
+
+
+def resolve_indices(indices: Tuple[Any, ...]):
+    out = []
+    for ix in indices:
+        if isinstance(ix, _Index):
+            r = ix.resolve()
+            out.append(None if isinstance(ix, _NewAxis) else r)
+            if isinstance(ix, _NewAxis):
+                out[-1] = None
+        elif isinstance(ix, (int, slice)):
+            out.append(ix)
+        else:
+            out.append(ix)          # array index
+    return tuple(out)

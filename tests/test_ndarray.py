@@ -256,3 +256,21 @@ class TestFacadeExtensions:
         assert abs(a.percentile_number(50) - 3.0) < 1e-6
         assert a.prod_number() == 120.0
         assert abs(a.var_number() - 2.0) < 1e-6
+
+
+def test_ndarray_index_dsl():
+    """Reference NDArrayIndex.interval/point/all over get/put."""
+    from deeplearning4j_tpu.ndarray_index import NDArrayIndex as I
+    a = Nd4j.create(np.arange(24.0).reshape(4, 6))
+    sub = a.get(I.point(1), I.interval(2, 5))
+    assert np.allclose(sub.numpy(), [8, 9, 10])
+    inc = a.get(I.point(1), I.interval(2, 5, inclusive=True))
+    assert np.allclose(inc.numpy(), [8, 9, 10, 11])
+    col = a.get(I.all(), I.point(0))
+    assert np.allclose(col.numpy(), [0, 6, 12, 18])
+    strided = a.get(I.interval(0, 4, 2), I.all())
+    assert strided.shape == (2, 6)
+    up = a.put_indices((I.point(0), I.interval(0, 2)), Nd4j.create([9.0, 9.0]))
+    assert np.allclose(up.numpy()[0, :3], [9, 9, 2])
+    # original untouched (functional semantics)
+    assert float(a.get_double(0, 0)) == 0.0

@@ -47,6 +47,7 @@ from deeplearning4j_tpu.nn.layers.extra import (
     MaskLayer, RepeatVector, Cropping1DLayer, Cropping3DLayer,
     ZeroPadding1DLayer, ZeroPadding3DLayer, Deconvolution3DLayer,
     GaussianNoiseLayer, GaussianDropoutLayer,
+    SameDiffLayer, SameDiffOutputLayer,
 )
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -17,7 +17,7 @@ shape ops are pure reshapes/pads that XLA fuses away.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -272,6 +272,89 @@ class RepeatVector(Layer):
 
     def has_params(self):
         return False
+
+
+@register_layer
+@dataclass
+class SameDiffLayer(Layer):
+    """User-defined custom layer — declare parameter shapes and a pure
+    forward function; the backward pass comes from autodiff.
+
+    Reference: ``org.deeplearning4j.nn.conf.layers.samediff.SameDiffLayer``
+    (defineParameters + defineLayer(sd, input, paramTable)): the
+    mechanism for custom layers without hand-written backprop. Here the
+    forward is any jax-traceable ``fn(params, x) -> y`` (NDArray/registry
+    ops welcome) and ``jax.grad`` through the whole-network step replaces
+    the reference's per-layer doDiff graph.
+
+    >>> layer = SameDiffLayer(
+    ...     param_shapes={"W": (4, 8), "b": (8,)},
+    ...     fn=lambda p, x: jnp.tanh(x @ p["W"] + p["b"]),
+    ...     output_shape_fn=lambda s: (8,))
+    """
+    param_shapes: Optional[dict] = None
+    fn: Optional[Callable] = None
+    output_shape_fn: Optional[Callable] = None
+    mask_fn: Optional[Callable] = None
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        params = {}
+        wi = winit.get(self.weight_init or "xavier")
+        for name, shape in (self.param_shapes or {}).items():
+            key, sub = jax.random.split(key)
+            shape = tuple(shape)
+            if name.startswith("b") or len(shape) == 1:
+                params[name] = jnp.full(shape, self.bias_init, dtype)
+            else:
+                params[name] = wi(sub, shape, dtype)
+        out = (tuple(self.output_shape_fn(tuple(input_shape)))
+               if self.output_shape_fn else tuple(input_shape))
+        return params, {}, out
+
+    def apply(self, params, state, x, *, train=False, rng=None,
+              mask=None):
+        try:
+            y = self.fn(params, x, mask=mask)    # mask-aware variant
+        except TypeError:
+            y = self.fn(params, x)
+        return self._act()(y), state
+
+    def propagate_mask(self, mask, input_shape):
+        if self.mask_fn is not None:
+            return self.mask_fn(mask)
+        return mask
+
+    def to_dict(self):
+        d = super().to_dict()
+        d["fn"] = None                  # re-attach after load
+        d["output_shape_fn"] = None
+        d["mask_fn"] = None
+        d["param_shapes"] = {k: list(v)
+                             for k, v in (self.param_shapes or
+                                          {}).items()}
+        return d
+
+
+@register_layer
+@dataclass
+class SameDiffOutputLayer(SameDiffLayer):
+    """Custom output layer with a user loss (reference
+    samediff.SameDiffOutputLayer): ``loss_fn(labels, out) -> scalar``.
+    """
+    loss_fn: Optional[Callable] = None
+
+    def compute_loss_fn(self):
+        lf = self.loss_fn
+
+        def fn(y, out, mask=None):
+            loss = lf(y, out)
+            return loss
+        return fn
+
+    def to_dict(self):
+        d = super().to_dict()
+        d["loss_fn"] = None
+        return d
 
 
 @register_layer

@@ -208,3 +208,55 @@ def test_locally_connected_in_network():
     s0 = net.score(ds)
     net.fit(ListDataSetIterator([ds], batch_size=32), epochs=20)
     assert net.score(ds) < s0
+
+
+def test_samediff_layer_custom_forward():
+    """Custom layer via param shapes + pure fn (reference SameDiffLayer):
+    trains end-to-end with autodiff-provided backprop."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.layers import (SameDiffLayer,
+                                              SameDiffOutputLayer)
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import OutputLayer
+    from deeplearning4j_tpu.nn import updaters as upd
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+
+    custom = SameDiffLayer(
+        param_shapes={"W": (6, 10), "b": (10,)},
+        fn=lambda p, x: jnp.tanh(x @ p["W"] + p["b"]),
+        output_shape_fn=lambda s: (10,))
+    conf = (NeuralNetConfiguration.builder().seed(4)
+            .updater(upd.Adam(learning_rate=1e-2)).list()
+            .layer(custom)
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf).init()
+    assert net.params["layer_0"]["W"].shape == (6, 10)
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 6).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    ds = DataSet(x, y)
+    s0 = net.score(ds)
+    net.fit(ListDataSetIterator([ds], batch_size=32), epochs=20)
+    assert net.score(ds) < s0
+
+    # custom OUTPUT layer with custom loss
+    out_layer = SameDiffOutputLayer(
+        param_shapes={"W": (6, 1)},
+        fn=lambda p, x: x @ p["W"],
+        output_shape_fn=lambda s: (1,),
+        loss_fn=lambda labels, out: jnp.mean((labels - out) ** 2))
+    conf2 = (NeuralNetConfiguration.builder().seed(4)
+             .updater(upd.Sgd(learning_rate=0.05)).list()
+             .layer(out_layer)
+             .set_input_type(InputType.feed_forward(6)).build())
+    net2 = MultiLayerNetwork(conf2).init()
+    yreg = (x @ rng.randn(6, 1)).astype(np.float32)
+    ds2 = DataSet(x, yreg)
+    s0 = net2.score(ds2)
+    net2.fit(ListDataSetIterator([ds2], batch_size=32), epochs=30)
+    assert net2.score(ds2) < s0 / 2

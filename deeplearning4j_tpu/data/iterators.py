@@ -230,13 +230,16 @@ class BucketedSequenceIterator(DataSetIterator):
             fm = ds.features_mask
             if fm is None:            # padding NEEDS a mask to be exact
                 fm = np.ones(ds.features.shape[:2], np.float32)
+            seq_labels = (ds.labels is not None
+                          and ds.labels.ndim >= 3)
             lm = ds.labels_mask
-            if lm is None and ds.labels is not None and \
-                    ds.labels.ndim >= 3:
+            if lm is None and seq_labels:
                 lm = np.ones(ds.labels.shape[:2], np.float32)
             yield DataSet(pad_time(ds.features),
-                          pad_time(ds.labels)
-                          if ds.labels is not None
-                          and ds.labels.ndim >= 3 else ds.labels,
+                          pad_time(ds.labels) if seq_labels
+                          else ds.labels,
                           features_mask=pad_time(fm),
-                          labels_mask=pad_time(lm))
+                          # per-sequence labels keep their mask as-is:
+                          # only sequence labels pad along time
+                          labels_mask=pad_time(lm) if seq_labels
+                          else lm)

@@ -68,15 +68,6 @@ class NDArrayIndex:
 
 
 def resolve_indices(indices: Tuple[Any, ...]):
-    out = []
-    for ix in indices:
-        if isinstance(ix, _Index):
-            r = ix.resolve()
-            out.append(None if isinstance(ix, _NewAxis) else r)
-            if isinstance(ix, _NewAxis):
-                out[-1] = None
-        elif isinstance(ix, (int, slice)):
-            out.append(ix)
-        else:
-            out.append(ix)          # array index
-    return tuple(out)
+    # _NewAxis.resolve() is None, which IS numpy's new-axis index
+    return tuple(ix.resolve() if isinstance(ix, _Index) else ix
+                 for ix in indices)

@@ -303,7 +303,9 @@ class SameDiffLayer(Layer):
         for name, shape in (self.param_shapes or {}).items():
             key, sub = jax.random.split(key)
             shape = tuple(shape)
-            if name.startswith("b") or len(shape) == 1:
+            # vectors are biases (constant init); anything with rank ≥ 2
+            # needs symmetry breaking regardless of its name
+            if len(shape) == 1:
                 params[name] = jnp.full(shape, self.bias_init, dtype)
             else:
                 params[name] = wi(sub, shape, dtype)
@@ -311,11 +313,18 @@ class SameDiffLayer(Layer):
                if self.output_shape_fn else tuple(input_shape))
         return params, {}, out
 
+    def _fn_takes_mask(self) -> bool:
+        import inspect
+        try:
+            return "mask" in inspect.signature(self.fn).parameters
+        except (TypeError, ValueError):
+            return False
+
     def apply(self, params, state, x, *, train=False, rng=None,
               mask=None):
-        try:
-            y = self.fn(params, x, mask=mask)    # mask-aware variant
-        except TypeError:
+        if self._fn_takes_mask():
+            y = self.fn(params, x, mask=mask)
+        else:
             y = self.fn(params, x)
         return self._act()(y), state
 
@@ -344,11 +353,23 @@ class SameDiffOutputLayer(SameDiffLayer):
     loss_fn: Optional[Callable] = None
 
     def compute_loss_fn(self):
+        import inspect
         lf = self.loss_fn
+        takes_mask = "mask" in inspect.signature(lf).parameters
 
         def fn(y, out, mask=None):
-            loss = lf(y, out)
-            return loss
+            if takes_mask:
+                return lf(y, out, mask=mask)
+            if mask is not None:
+                # padded timesteps must not contribute to the loss;
+                # mask-unaware user losses get a masked-mean fallback
+                m = mask
+                while m.ndim < out.ndim:
+                    m = m[..., None]
+                denom = jnp.maximum(jnp.sum(m), 1.0)
+                scale = m.size / denom
+                return lf(y * m, out * m) * scale
+            return lf(y, out)
         return fn
 
     def to_dict(self):

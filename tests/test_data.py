@@ -389,3 +389,17 @@ def test_bucketed_sequence_iterator():
         ListDataSetIterator([masked]), buckets=(16,)))[0]
     assert out2.features_mask[0, :7].all()
     assert not out2.features_mask[0, 7:].any()
+
+
+def test_bucketing_preserves_per_sequence_label_mask():
+    """Regression: 2D (per-sequence) labels keep their mask unpadded."""
+    from deeplearning4j_tpu.data import (BucketedSequenceIterator,
+                                         DataSet, ListDataSetIterator)
+    ds = DataSet(np.ones((2, 5, 3), np.float32),
+                 np.ones((2, 4), np.float32),          # per-sequence
+                 labels_mask=np.ones((2, 1), np.float32))
+    out = list(BucketedSequenceIterator(
+        ListDataSetIterator([ds]), buckets=(8,)))[0]
+    assert out.features.shape == (2, 8, 3)
+    assert out.labels.shape == (2, 4)                  # untouched
+    assert out.labels_mask.shape == (2, 1)             # untouched

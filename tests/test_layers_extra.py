@@ -260,3 +260,42 @@ def test_samediff_layer_custom_forward():
     s0 = net2.score(ds2)
     net2.fit(ListDataSetIterator([ds2], batch_size=32), epochs=30)
     assert net2.score(ds2) < s0 / 2
+
+
+def test_samediff_layer_bias_heuristic_and_mask():
+    """Regressions: rank-2 params named b* still get random init; the
+    mask kwarg reaches mask-aware fns; mask-unaware losses get a
+    masked fallback."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.nn.layers import (SameDiffLayer,
+                                              SameDiffOutputLayer)
+    layer = SameDiffLayer(
+        param_shapes={"blend": (4, 8), "bias": (8,)},
+        fn=lambda p, x: x @ p["blend"] + p["bias"],
+        output_shape_fn=lambda s: (8,))
+    params, _, _ = layer.init(jax.random.PRNGKey(0), (4,))
+    assert float(jnp.abs(params["blend"]).sum()) > 0    # NOT zero-init
+    assert float(jnp.abs(params["bias"]).sum()) == 0
+
+    seen = {}
+
+    def mask_fn(p, x, mask=None):
+        seen["mask"] = mask
+        return x
+
+    ml = SameDiffLayer(param_shapes={}, fn=mask_fn)
+    ml.init(jax.random.PRNGKey(0), (4, 3))
+    m = jnp.ones((2, 4))
+    ml.apply({}, {}, jnp.ones((2, 4, 3)), mask=m)
+    assert seen["mask"] is m
+
+    # mask-unaware loss: padded steps do not change the loss
+    out_layer = SameDiffOutputLayer(
+        param_shapes={}, fn=lambda p, x: x,
+        loss_fn=lambda labels, out: jnp.mean((labels - out) ** 2))
+    lf = out_layer.compute_loss_fn()
+    y = jnp.ones((2, 3, 1))
+    out = jnp.zeros((2, 3, 1))
+    mask = jnp.asarray([[1, 1, 0], [1, 0, 0]], jnp.float32)
+    masked = float(lf(y, out, mask=mask))
+    assert abs(masked - 1.0) < 1e-6       # mean over REAL steps only

@@ -12,7 +12,7 @@ EXAMPLES = Path(__file__).parent.parent / "examples"
 @pytest.mark.parametrize("name", [
     "lenet_mnist", "char_rnn_textgen", "bert_finetune",
     "distributed_data_parallel", "samediff_autodiff",
-    "parallelism_modes",
+    "parallelism_modes", "hyperparameter_search", "transfer_learning",
 ])
 def test_example_runs(name, monkeypatch, capsys):
     monkeypatch.setenv("DL4J_TPU_EXAMPLE_FAST", "1")

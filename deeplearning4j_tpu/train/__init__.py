@@ -13,12 +13,16 @@ from deeplearning4j_tpu.train.stats import (
     StatsListener, StatsStorage, InMemoryStatsStorage, FileStatsStorage,
     UIServer,
 )
+from deeplearning4j_tpu.train.fault_tolerance import (
+    FaultTolerantTrainer, resume_or_init, newest_checkpoint,
+)
 from deeplearning4j_tpu.train.solver import (
     Solver, StochasticGradientDescent, LineGradientDescent,
     ConjugateGradient, LBFGS, backtrack_line_search,
 )
 
 __all__ = [
+    "FaultTolerantTrainer", "resume_or_init", "newest_checkpoint",
     "Solver", "StochasticGradientDescent", "LineGradientDescent",
     "ConjugateGradient", "LBFGS", "backtrack_line_search",
     "TrainingListener", "ScoreIterationListener", "PerformanceListener",

@@ -1,0 +1,111 @@
+"""Fault-tolerant training: failure injection → checkpoint restore →
+continuation (reference analog: Spark task retry + CheckpointListener
+recovery; in-process fault-injection like the parameter-server tests
+that kill in-JVM nodes, SURVEY §4/§5)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.config import InputType
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn import updaters as upd
+from deeplearning4j_tpu.train.fault_tolerance import (
+    FaultTolerantTrainer, newest_checkpoint, resume_or_init)
+
+
+def _factory(seed=11):
+    def make():
+        conf = (NeuralNetConfiguration.builder().seed(seed)
+                .updater(upd.Adam(learning_rate=5e-3)).list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(5)).build())
+        return MultiLayerNetwork(conf).init()
+    return make
+
+
+def _data():
+    rng = np.random.RandomState(1)
+    x = rng.randn(24, 5).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+    return DataSet(x, y)
+
+
+class _FailAt:
+    """Listener that raises once at a given global iteration —
+    in-process fault injection."""
+
+    def __init__(self, at_iteration):
+        self.at = at_iteration
+        self.fired = False
+
+    def iteration_done(self, net, iteration, epoch):
+        if not self.fired and iteration >= self.at:
+            self.fired = True
+            raise ConnectionError("simulated chip failure")
+
+    def on_epoch_start(self, net):
+        pass
+
+    def on_epoch_end(self, net):
+        pass
+
+
+def test_recovers_from_midtraining_failure(tmp_path):
+    net = _factory()()
+    ds = _data()
+    it = ListDataSetIterator([ds] * 4, batch_size=24)  # 4 iters/epoch
+    trainer = FaultTolerantTrainer(net, tmp_path,
+                                   save_every_n_iterations=2)
+    bomb = _FailAt(at_iteration=6)          # mid-epoch-2 failure
+    net.listeners.append(bomb)
+    trainer.fit(it, epochs=5)
+    assert bomb.fired                       # the failure DID happen
+    assert trainer.restarts == 1
+    assert net.epoch == 5                   # training completed anyway
+    assert np.isfinite(net.score(ds))
+    assert newest_checkpoint(tmp_path) is not None
+
+
+def test_gives_up_after_max_restarts(tmp_path):
+    net = _factory()()
+    it = ListDataSetIterator([_data()] * 2, batch_size=24)
+
+    class AlwaysFail:
+        def iteration_done(self, net, iteration, epoch):
+            raise OSError("persistent failure")
+
+        def on_epoch_start(self, net):
+            pass
+
+        def on_epoch_end(self, net):
+            pass
+
+    net.listeners.append(AlwaysFail())
+    trainer = FaultTolerantTrainer(net, tmp_path, max_restarts=2,
+                                   save_every_n_iterations=1)
+    with pytest.raises(RuntimeError, match="failed 3 times"):
+        trainer.fit(it, epochs=3)
+
+
+def test_resume_or_init_restart_idempotent(tmp_path):
+    """The slice-restart pattern: re-running the same script resumes."""
+    factory = _factory()
+    ds = _data()
+    # "process 1": train and checkpoint
+    net1 = resume_or_init(factory, tmp_path)
+    assert net1.iteration == 0              # fresh start
+    t1 = FaultTolerantTrainer(net1, tmp_path, save_every_n_iterations=1)
+    t1.fit(ListDataSetIterator([ds] * 3, batch_size=24), epochs=2)
+    iters_done = net1.iteration
+    # "process 2" (after a simulated slice restart): resumes counters
+    net2 = resume_or_init(factory, tmp_path)
+    assert net2.iteration > 0
+    assert net2.iteration <= iters_done
+    t2 = FaultTolerantTrainer(net2, tmp_path, save_every_n_iterations=1)
+    t2.fit(ListDataSetIterator([ds] * 3, batch_size=24), epochs=1)
+    assert net2.epoch >= 3

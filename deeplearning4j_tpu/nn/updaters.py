@@ -52,6 +52,8 @@ def register_schedule(cls):
 def schedule_from_dict(d):
     d = dict(d)
     cls = _SCHEDULE_REGISTRY[d.pop("@class")]
+    if isinstance(d.get("base"), dict) and "@class" in d["base"]:
+        d["base"] = schedule_from_dict(d["base"])   # nested warmup base
     return cls(**d)
 
 
@@ -151,9 +153,10 @@ class CosineSchedule(Schedule):
 @register_schedule
 @dataclass
 class WarmupSchedule(Schedule):
-    """Linear warmup into another schedule (transformer-era addition)."""
+    """Linear warmup into another schedule (transformer-era addition).
+    ``base``: a constant rate or any Schedule; defaults to 1e-3."""
     warmup_steps: int = 1000
-    base: Any = None
+    base: Any = 1e-3
 
     def __call__(self, step):
         base = (self.base(step) if callable(self.base)

@@ -1,0 +1,53 @@
+"""Zoo-wide config JSON round-trip: every zoo model's configuration
+serializes and rehydrates to an identical, runnable network
+(reference: Jackson round-trip of every zoo model's
+MultiLayerConfiguration/ComputationGraphConfiguration — the arch half
+of the model format)."""
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import zoo
+
+# model name -> (factory kwargs shrunk for test speed, input shape)
+SPECS = {
+    "LeNet": (dict(num_classes=5), (28, 28, 1)),
+    "SimpleCNN": (dict(num_classes=4, input_shape=(16, 16, 3)),
+                  (16, 16, 3)),
+    "AlexNet": (dict(num_classes=6, input_shape=(64, 64, 3)),
+                (64, 64, 3)),
+    "Darknet19": (dict(num_classes=5, input_shape=(32, 32, 3)),
+                  (32, 32, 3)),
+    "SqueezeNet": (dict(num_classes=5, input_shape=(48, 48, 3)),
+                   (48, 48, 3)),
+    "VGG16": (dict(num_classes=4, input_shape=(32, 32, 3)),
+              (32, 32, 3)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(SPECS))
+def test_zoo_conf_roundtrip(name):
+    kwargs, in_shape = SPECS[name]
+    model = getattr(zoo, name)(**kwargs)
+    conf = model.conf()
+    is_graph = hasattr(conf, "inputs")
+    if is_graph:
+        from deeplearning4j_tpu.nn.graph import (
+            ComputationGraph, ComputationGraphConfiguration)
+        conf2 = ComputationGraphConfiguration.from_json(conf.to_json())
+        assert json.loads(conf2.to_json()) == json.loads(conf.to_json())
+        net = ComputationGraph(conf2).init()
+        x = np.zeros((1,) + in_shape, np.float32)
+        out = net.output(x)
+        out = out[0] if isinstance(out, (list, tuple)) else out
+    else:
+        from deeplearning4j_tpu.nn import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        assert json.loads(conf2.to_json()) == json.loads(conf.to_json())
+        net = MultiLayerNetwork(conf2).init()
+        out = net.output(np.zeros((1,) + in_shape, np.float32))
+    n_cls = kwargs.get("num_classes")
+    assert np.asarray(out).shape[-1] == n_cls
+    assert np.all(np.isfinite(np.asarray(out)))

@@ -217,3 +217,49 @@ def test_feed_forward_applies_preprocessors():
     assert acts[-1].shape == (3, 2)
     mid = net.activate_selected_layers(0, 1, x)
     assert mid.ndim == 4                    # conv activation map
+
+
+VERTEX_SPECS = {
+    "MergeVertex": ({}, [(2, 3), (2, 3)]),
+    "ElementWiseVertex": (dict(op="add"), [(4,), (4,)]),
+    "SubsetVertex": (dict(from_=0, to=1), [(4,)]),
+    "StackVertex": ({}, [(3,), (3,)]),
+    "UnstackVertex": (dict(index=0, num=2), [(4,)]),
+    "ScaleVertex": (dict(scale=2.0), [(4,)]),
+    "ShiftVertex": (dict(shift=1.0), [(4,)]),
+    "L2NormalizeVertex": ({}, [(4,)]),
+    "ReshapeVertex": (dict(shape=(2, 2)), [(4,)]),
+    "FlattenVertex": ({}, [(2, 2)]),
+    "PoolHelperVertex": ({}, [(3, 3, 2)]),
+    "AttentionVertex": (dict(n_heads=1), [(4, 6), (4, 6), (4, 6)]),
+    "L2Vertex": ({}, [(4,), (4,)]),
+    "LastTimeStepVertex": ({}, [(4, 3)]),
+    "DuplicateToTimeSeriesVertex": ({}, [(3,), (5, 3)]),
+    "ReverseTimeSeriesVertex": ({}, [(4, 3)]),
+    "PreprocessorVertex": (dict(
+        preprocessor=CnnToFeedForwardPreProcessor()), [(3, 3, 2)]),
+}
+
+
+def test_every_registered_vertex_has_spec():
+    from deeplearning4j_tpu.nn.vertices import _VERTEX_REGISTRY
+    missing = sorted(set(_VERTEX_REGISTRY) - set(VERTEX_SPECS))
+    assert not missing, f"vertices without round-trip spec: {missing}"
+
+
+def test_vertex_registry_roundtrip():
+    from deeplearning4j_tpu.nn.vertices import (_VERTEX_REGISTRY,
+                                                vertex_from_dict)
+    for name, (kwargs, in_shapes) in sorted(VERTEX_SPECS.items()):
+        v = _VERTEX_REGISTRY[name](**kwargs)
+        back = vertex_from_dict(v.to_dict())
+        assert type(back) is type(v), name
+        xs = [jnp.asarray(np.random.RandomState(1)
+                          .randn(2, *s).astype(np.float32))
+              for s in in_shapes]
+        if getattr(v, "needs_mask", False):
+            y1, y2 = v.apply(xs, mask=None), back.apply(xs, mask=None)
+        else:
+            y1, y2 = v.apply(xs), back.apply(xs)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                                   rtol=1e-6, err_msg=name)

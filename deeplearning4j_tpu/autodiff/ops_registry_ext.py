@@ -1679,3 +1679,6 @@ for _alias, _target in [
     ("dot_product_attention_v2", "dot_product_attention"),
 ]:
     op(_alias)(OPS[_target])
+
+
+op("einsum")(lambda *arrs, equation: jnp.einsum(equation, *arrs))

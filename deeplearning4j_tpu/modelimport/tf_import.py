@@ -104,6 +104,9 @@ class _Ctx:
         self.sd = sd
         self.vars: Dict[str, SDVariable] = {}      # node name -> SDVariable
         self.consts: Dict[str, np.ndarray] = {}    # statically-known values
+        #: opportunistically-known static shapes (consts, placeholders)
+        #: for Shape/Slice resolution at import time
+        self.shapes: Dict[str, tuple] = {}
         self.trainable = set(trainable)
 
     def static(self, name: str) -> np.ndarray:
@@ -138,6 +141,7 @@ def _rec(ctx, opname, ins, node, **kwargs):
 def _m_const(ctx, node, ins):
     arr = _attr(node, "value")
     ctx.consts[node.name] = np.asarray(arr)
+    ctx.shapes[node.name] = tuple(np.asarray(arr).shape)
     if node.name in ctx.trainable:
         # fine-tune path (reference: BERT fine-tune config imports the
         # frozen graph then marks weight consts trainable)
@@ -150,6 +154,7 @@ def _m_placeholder(ctx, node, ins):
     shape = _attr(node, "shape", [])
     dtype = _attr(node, "dtype", "float32")
     shape = [(-1 if s in (-1, 0) else s) for s in (shape or [])]
+    ctx.shapes[node.name] = tuple(shape)
     return ctx.sd.placeholder(node.name, np.dtype(dtype).type, *shape)
 
 
@@ -408,6 +413,125 @@ def _m_fused_bn(ctx, node, ins):
                 eps=eps)
 
 
+# --- transformer-era ops (BERT-style frozen graphs) ------------------------
+
+for _tf, _ours in {"Less": "lt", "LessEqual": "lte", "Greater": "gt",
+                   "GreaterEqual": "gte", "Equal": "eq",
+                   "NotEqual": "neq", "LogicalAnd": "logical_and",
+                   "LogicalOr": "logical_or",
+                   "LogicalNot": "logical_not"}.items():
+    _MAPPERS[_tf] = (lambda ours: lambda ctx, node, ins:
+                     _rec(ctx, ours, ins, node))(_ours)
+
+
+@_maps("Select", "SelectV2")
+def _m_select(ctx, node, ins):
+    return _rec(ctx, "where", ins[:3], node)
+
+
+@_maps("Einsum")
+def _m_einsum(ctx, node, ins):
+    eq = _attr(node, "equation")
+    if isinstance(eq, bytes):
+        eq = eq.decode()
+    return _rec(ctx, "einsum", ins, node, equation=eq)
+
+
+@_maps("OneHot")
+def _m_onehot(ctx, node, ins):
+    depth = int(ctx.static(_ref(node.input[1])[0]))
+    return _rec(ctx, "one_hot", ins[:1], node, depth=depth)
+
+
+@_maps("Shape")
+def _m_shape(ctx, node, ins):
+    # static-shape world: Shape outputs a const so downstream
+    # Reshape/Fill nodes can resolve at import time
+    src, _ = _ref(node.input[0])
+    shape = ctx.shapes.get(src)
+    if shape is None or any(s is None or s < 0 for s in shape):
+        return _rec(ctx, "shape_of", ins[:1], node)
+    arr = np.asarray(shape, np.int32)
+    ctx.consts[node.name] = arr
+    return ctx.sd.constant(name=node.name, arr=arr)
+
+
+@_maps("Range")
+def _m_range(ctx, node, ins):
+    start = float(ctx.static(_ref(node.input[0])[0]))
+    stop = float(ctx.static(_ref(node.input[1])[0]))
+    step = float(ctx.static(_ref(node.input[2])[0]))
+    arr = np.arange(start, stop, step)
+    ctx.consts[node.name] = arr
+    return ctx.sd.constant(name=node.name, arr=arr)
+
+
+@_maps("Slice")
+def _m_slice(ctx, node, ins):
+    begin = [int(v) for v in ctx.static(_ref(node.input[1])[0])]
+    size = [int(v) for v in ctx.static(_ref(node.input[2])[0])]
+    # TF size=-1 means "to the end"
+    shape = ctx.shapes.get(_ref(node.input[0])[0])
+    if shape is not None:
+        size = [shape[i] - begin[i] if s == -1 else s
+                for i, s in enumerate(size)]
+    return _rec(ctx, "slice", ins[:1], node, begin=begin, size=size)
+
+
+@_maps("Split")
+def _m_split(ctx, node, ins):
+    axis = int(ctx.static(_ref(node.input[0])[0]))
+    num = int(_attr(node, "num_split"))
+    return ctx.sd._rec("split", ins[1:2], name=node.name,
+                       kwargs=dict(num=num, axis=axis), n_out=num)
+
+
+@_maps("SplitV")
+def _m_splitv(ctx, node, ins):
+    sizes = [int(v) for v in ctx.static(_ref(node.input[1])[0])]
+    axis = int(ctx.static(_ref(node.input[2])[0]))
+    return ctx.sd._rec("split_v", ins[:1], name=node.name,
+                       kwargs=dict(sizes=sizes, axis=axis),
+                       n_out=len(sizes))
+
+
+@_maps("Unpack")
+def _m_unpack(ctx, node, ins):
+    axis = int(_attr(node, "axis", 0))
+    num = int(_attr(node, "num"))
+    return ctx.sd._rec("unstack", ins[:1], name=node.name,
+                       kwargs=dict(axis=axis, num=num), n_out=num)
+
+
+@_maps("MatrixBandPart")
+def _m_band_part(ctx, node, ins):
+    lo = int(ctx.static(_ref(node.input[1])[0]))
+    hi = int(ctx.static(_ref(node.input[2])[0]))
+    return _rec(ctx, "matrix_band_part", ins[:1], node, num_lower=lo,
+                num_upper=hi)
+
+
+@_maps("Cumsum")
+def _m_cumsum(ctx, node, ins):
+    axis = int(ctx.static(_ref(node.input[1])[0]))
+    if _attr(node, "exclusive", False) or _attr(node, "reverse", False):
+        return _rec(ctx, "cumsum_exclusive", ins[:1], node, axis=axis,
+                    reverse=bool(_attr(node, "reverse", False)))
+    return _rec(ctx, "cumsum", ins[:1], node, axis=axis)
+
+
+@_maps("TopKV2")
+def _m_topk(ctx, node, ins):
+    k = int(ctx.static(_ref(node.input[1])[0]))
+    return ctx.sd._rec("top_k", ins[:1], name=node.name,
+                       kwargs=dict(k=k), n_out=2)
+
+
+@_maps("Rank")
+def _m_rank(ctx, node, ins):
+    return _rec(ctx, "rank", ins[:1], node)
+
+
 # ---------------------------------------------------------------------------
 # public API
 
@@ -475,16 +599,23 @@ class TFImporter:
                 src_name, idx = _ref(inp)
                 if idx < 0:            # control edge
                     continue
-                if idx > 0:
-                    raise ValueError(
-                        f"node {name!r} consumes output :{idx} of "
-                        f"{src_name!r}; only single-output ops are "
-                        "importable")
                 if src_name not in ctx.vars:
                     raise ValueError(
                         f"node {name!r} references {src_name!r}, which "
                         "is missing from the GraphDef")
-                ins.append(ctx.vars[src_name])
+                v = ctx.vars[src_name]
+                if isinstance(v, tuple):          # multi-output producer
+                    if idx >= len(v):
+                        raise ValueError(
+                            f"node {name!r} consumes output :{idx} of "
+                            f"{src_name!r}, which has {len(v)} outputs")
+                    ins.append(v[idx])
+                elif idx > 0:
+                    raise ValueError(
+                        f"node {name!r} consumes output :{idx} of "
+                        f"single-output node {src_name!r}")
+                else:
+                    ins.append(v)
             mapper = _MAPPERS.get(node.op)
             if mapper is None:
                 raise ValueError(

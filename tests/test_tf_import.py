@@ -178,10 +178,10 @@ def test_prunes_unreachable_unsupported_branch(rng):
     # splice in an unreachable dynamic-shape side branch (the freezer
     # dead-code-eliminates one written in the fn itself)
     dead = gd.node.add()
-    dead.name = "dead/Shape"
-    dead.op = "Shape"
+    dead.name = "dead/TensorArray"
+    dead.op = "TensorArrayV3"
     dead.input.append(in_names[0])
-    assert any(n.op == "Shape" for n in gd.node)
+    assert any(n.op == "TensorArrayV3" for n in gd.node)
     with pytest.raises(ValueError, match="unsupported TF op"):
         TFImporter.import_graph_def(gd)            # unpruned: fails
     sd, vars_ = TFImporter.import_graph_def(gd, out_names)
@@ -229,3 +229,80 @@ def test_gradients_through_imported_graph(rng):
     ref = tape.gradient(loss, xt)
     np.testing.assert_allclose(grads[in_names[0]], np.asarray(ref),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_einsum_attention_block(rng):
+    """Transformer-style einsum path (newer BERT exports)."""
+    wq = tf.Variable(rng.normal(size=(8, 8)).astype(np.float32) * 0.3)
+
+    def fn(q, k, v):
+        qh = tf.einsum("btf,fh->bth", q, wq)
+        scores = tf.einsum("bqh,bkh->bqk", qh, k) / 8.0 ** 0.5
+        attn = tf.nn.softmax(scores, axis=-1)
+        return tf.einsum("bqk,bkh->bqh", attn, v)
+
+    _check(fn, [rng.normal(size=(2, 5, 8)).astype(np.float32)
+                for _ in range(3)])
+
+
+def test_comparison_select_onehot(rng):
+    def fn(x, ids):
+        mask = tf.cast(tf.greater(x, 0.0), tf.float32)
+        sel = tf.where(tf.less(x, 1.0), x * 2.0, x)
+        oh = tf.one_hot(ids, depth=5)
+        return sel * mask + oh
+
+    _check(fn, [rng.normal(size=(4, 5)).astype(np.float32),
+                rng.integers(0, 5, (4,)).astype(np.int32)])
+
+
+def test_split_concat_roundtrip(rng):
+    def fn(x):
+        a, b, c = tf.split(x, 3, axis=1)
+        return tf.concat([c, a, b], axis=1) + x
+
+    _check(fn, [rng.normal(size=(2, 9)).astype(np.float32)])
+
+
+def test_unstack_stack(rng):
+    def fn(x):
+        rows = tf.unstack(x, axis=1)
+        return tf.stack(rows[::-1], axis=1)
+
+    _check(fn, [rng.normal(size=(2, 4, 3)).astype(np.float32)])
+
+
+def test_slice_and_band_part(rng):
+    def fn(x):
+        s = tf.slice(x, [0, 1, 0], [-1, 3, -1])
+        causal = tf.linalg.band_part(tf.ones((3, 3)), -1, 0)
+        return tf.einsum("btf,ts->bsf", s, causal)
+
+    _check(fn, [rng.normal(size=(2, 5, 4)).astype(np.float32)])
+
+
+def test_cumsum_variants(rng):
+    def fn(x):
+        return (tf.cumsum(x, axis=1)
+                + tf.cumsum(x, axis=1, exclusive=True)
+                + tf.cumsum(x, axis=1, reverse=True))
+
+    _check(fn, [rng.normal(size=(3, 6)).astype(np.float32)])
+
+
+def test_topk_values(rng):
+    def fn(x):
+        vals, idx = tf.math.top_k(x, k=3)
+        return vals + tf.cast(idx, tf.float32) * 0.001
+
+    _check(fn, [rng.normal(size=(4, 10)).astype(np.float32)])
+
+
+def test_shape_driven_reshape(rng):
+    def fn(x):
+        s = tf.shape(x)
+        flat = tf.reshape(x, [s[0], -1])
+        return tf.reduce_sum(flat, axis=1)
+
+    # static input shape -> Shape folds to a const at import
+    _check(fn, [rng.normal(size=(3, 4, 5)).astype(np.float32)])

@@ -124,7 +124,11 @@ op("norm2")(_red(lambda a, axis, keepdims: jnp.sqrt(
     jnp.sum(jnp.square(a), axis=axis, keepdims=keepdims))))
 op("argmax")(lambda a, *, axis=-1: jnp.argmax(a, axis=axis))
 op("argmin")(lambda a, *, axis=-1: jnp.argmin(a, axis=axis))
-op("cumsum")(lambda a, *, axis=0: jnp.cumsum(a, axis=axis))
+@op("cumsum")
+def _cumsum(a, *, axis=0, reverse=False):
+    if reverse:
+        return jnp.flip(jnp.cumsum(jnp.flip(a, axis), axis=axis), axis)
+    return jnp.cumsum(a, axis=axis)
 op("cumprod")(lambda a, *, axis=0: jnp.cumprod(a, axis=axis))
 op("logsumexp")(lambda a, *, axis=None, keepdims=False:
                 jax.scipy.special.logsumexp(a, axis=axis, keepdims=keepdims))

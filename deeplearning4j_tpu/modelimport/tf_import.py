@@ -108,6 +108,10 @@ class _Ctx:
         #: for Shape/Slice resolution at import time
         self.shapes: Dict[str, tuple] = {}
         self.trainable = set(trainable)
+        #: names whose values are compile-time constants safe to fold
+        #: (excludes trainable consts — folding through them would
+        #: disconnect the gradient)
+        self.foldable: set = set()
 
     def static(self, name: str) -> np.ndarray:
         """The value of a node that must be known at import time
@@ -142,6 +146,8 @@ def _m_const(ctx, node, ins):
     arr = _attr(node, "value")
     ctx.consts[node.name] = np.asarray(arr)
     ctx.shapes[node.name] = tuple(np.asarray(arr).shape)
+    if node.name not in ctx.trainable:
+        ctx.foldable.add(node.name)
     if node.name in ctx.trainable:
         # fine-tune path (reference: BERT fine-tune config imports the
         # frozen graph then marks weight consts trainable)
@@ -164,6 +170,8 @@ def _m_identity(ctx, node, ins):
     src, _ = _ref(node.input[0])
     if src in ctx.consts:
         ctx.consts[node.name] = ctx.consts[src]
+        if src in ctx.foldable:
+            ctx.foldable.add(node.name)
     return ctx.vars[src]
 
 
@@ -324,26 +332,36 @@ def _m_pad(ctx, node, ins):
     return _rec(ctx, "pad", ins[:1], node, paddings=pads, value=value)
 
 
+def _strided_slice_spec(node, begin, end, strides):
+    """Decode StridedSlice mask attrs into a per-dim int/slice spec
+    (shared by the op mapper and the import-time const folder).
+    Returns None for ellipsis/new-axis masks, which neither supports."""
+    if _attr(node, "ellipsis_mask", 0) or _attr(node, "new_axis_mask", 0):
+        return None
+    bm = int(_attr(node, "begin_mask", 0))
+    em = int(_attr(node, "end_mask", 0))
+    sm = int(_attr(node, "shrink_axis_mask", 0))
+    spec = []
+    for i in range(len(begin)):
+        if sm & (1 << i):
+            spec.append({"t": "int", "v": int(begin[i])})
+        else:
+            spec.append({"t": "slice",
+                         "start": None if bm & (1 << i) else int(begin[i]),
+                         "stop": None if em & (1 << i) else int(end[i]),
+                         "step": int(strides[i])})
+    return spec
+
+
 @_maps("StridedSlice")
 def _m_strided_slice(ctx, node, ins):
     begin = [int(v) for v in ctx.static(_ref(node.input[1])[0])]
     end = [int(v) for v in ctx.static(_ref(node.input[2])[0])]
     strides = [int(v) for v in ctx.static(_ref(node.input[3])[0])]
-    bm = int(_attr(node, "begin_mask", 0))
-    em = int(_attr(node, "end_mask", 0))
-    sm = int(_attr(node, "shrink_axis_mask", 0))
-    if _attr(node, "ellipsis_mask", 0) or _attr(node, "new_axis_mask", 0):
+    spec = _strided_slice_spec(node, begin, end, strides)
+    if spec is None:
         raise ValueError("StridedSlice with ellipsis/new-axis masks is "
                          "not importable")
-    spec = []
-    for i in range(len(begin)):
-        if sm & (1 << i):
-            spec.append({"t": "int", "v": begin[i]})
-        else:
-            spec.append({"t": "slice",
-                         "start": None if bm & (1 << i) else begin[i],
-                         "stop": None if em & (1 << i) else end[i],
-                         "step": strides[i]})
     return _rec(ctx, "getitem", ins[:1], node, spec=spec)
 
 
@@ -358,6 +376,7 @@ def _m_fill(ctx, node, ins):
     value = ctx.static(_ref(node.input[1])[0])
     arr = np.full(shape, value)
     ctx.consts[node.name] = arr
+    ctx.foldable.add(node.name)
     return ctx.sd.constant(name=node.name, arr=arr)
 
 
@@ -453,6 +472,7 @@ def _m_shape(ctx, node, ins):
         return _rec(ctx, "shape_of", ins[:1], node)
     arr = np.asarray(shape, np.int32)
     ctx.consts[node.name] = arr
+    ctx.foldable.add(node.name)
     return ctx.sd.constant(name=node.name, arr=arr)
 
 
@@ -463,6 +483,7 @@ def _m_range(ctx, node, ins):
     step = float(ctx.static(_ref(node.input[2])[0]))
     arr = np.arange(start, stop, step)
     ctx.consts[node.name] = arr
+    ctx.foldable.add(node.name)
     return ctx.sd.constant(name=node.name, arr=arr)
 
 
@@ -514,10 +535,12 @@ def _m_band_part(ctx, node, ins):
 @_maps("Cumsum")
 def _m_cumsum(ctx, node, ins):
     axis = int(ctx.static(_ref(node.input[1])[0]))
-    if _attr(node, "exclusive", False) or _attr(node, "reverse", False):
+    reverse = bool(_attr(node, "reverse", False))
+    if _attr(node, "exclusive", False):
         return _rec(ctx, "cumsum_exclusive", ins[:1], node, axis=axis,
-                    reverse=bool(_attr(node, "reverse", False)))
-    return _rec(ctx, "cumsum", ins[:1], node, axis=axis)
+                    reverse=reverse)
+    return _rec(ctx, "cumsum", ins[:1], node, axis=axis,
+                reverse=reverse)
 
 
 @_maps("TopKV2")
@@ -530,6 +553,85 @@ def _m_topk(ctx, node, ins):
 @_maps("Rank")
 def _m_rank(ctx, node, ins):
     return _rec(ctx, "rank", ins[:1], node)
+
+
+# ---------------------------------------------------------------------------
+# import-time constant folding
+#
+# Frozen graphs routinely compute shapes *in the graph*:
+# Shape -> StridedSlice -> Pack -> Reshape.  The Shape mapper already
+# emits a const for static input shapes; these folders propagate
+# constness through the shape-arithmetic ops that follow so Reshape's
+# ``ctx.static`` lookup succeeds (reference:
+# samediff-import-tensorflow constant-folding prepass).
+
+def _fold_strided_slice(node, vals):
+    x, begin, end, strides = vals[0], vals[1], vals[2], vals[3]
+    spec = _strided_slice_spec(node, begin, end, strides)
+    if spec is None:
+        return None
+    idx = tuple(s["v"] if s["t"] == "int"
+                else slice(s["start"], s["stop"], s["step"])
+                for s in spec)
+    return np.asarray(x)[idx]
+
+
+_FOLDERS: Dict[str, Callable] = {
+    "StridedSlice": _fold_strided_slice,
+    "Pack": lambda node, vals: np.stack(
+        vals, axis=int(_attr(node, "axis", 0))),
+    "ConcatV2": lambda node, vals: np.concatenate(
+        vals[:-1], axis=int(vals[-1])),
+    "Cast": lambda node, vals: vals[0].astype(
+        np.dtype(_attr(node, "DstT"))),
+    "Add": lambda node, vals: vals[0] + vals[1],
+    "AddV2": lambda node, vals: vals[0] + vals[1],
+    "Sub": lambda node, vals: vals[0] - vals[1],
+    "Mul": lambda node, vals: vals[0] * vals[1],
+    "FloorDiv": lambda node, vals: vals[0] // vals[1],
+    "FloorMod": lambda node, vals: vals[0] % vals[1],
+    "Maximum": lambda node, vals: np.maximum(vals[0], vals[1]),
+    "Minimum": lambda node, vals: np.minimum(vals[0], vals[1]),
+    "Neg": lambda node, vals: -vals[0],
+    "Prod": lambda node, vals: np.prod(
+        vals[0], axis=tuple(np.atleast_1d(vals[1]).tolist())
+        if len(node.input) > 1 else None,
+        keepdims=bool(_attr(node, "keep_dims", False))),
+    "Squeeze": lambda node, vals: np.squeeze(
+        vals[0], axis=tuple(_attr(node, "squeeze_dims", []) or [])
+        or None),
+    "ExpandDims": lambda node, vals: np.expand_dims(
+        vals[0], int(vals[1])),
+    "Reshape": lambda node, vals: np.reshape(
+        vals[0], [int(s) for s in vals[1]]),
+    "Size": lambda node, vals: np.asarray(vals[0].size, np.int32),
+    "Rank": lambda node, vals: np.asarray(vals[0].ndim, np.int32),
+}
+
+
+def _try_fold(ctx, node):
+    """If every data input of ``node`` is a known (non-trainable)
+    constant and the op is pure shape arithmetic, evaluate it with
+    numpy now and register the result as a const.  Returns the
+    SDVariable (or tuple) on success, None to fall through to the
+    normal mapper."""
+    folder = _FOLDERS.get(node.op)
+    if folder is None:
+        return None
+    srcs = [_ref(inp) for inp in node.input]
+    srcs = [s for s, i in srcs if i >= 0]
+    if not srcs or not all(s in ctx.foldable for s in srcs):
+        return None
+    try:
+        out = folder(node, [np.asarray(ctx.consts[s]) for s in srcs])
+    except Exception:
+        return None              # odd dtype/attr combo: emit graph ops
+    if out is None:
+        return None
+    ctx.consts[node.name] = out
+    ctx.shapes[node.name] = tuple(np.asarray(out).shape)
+    ctx.foldable.add(node.name)
+    return ctx.sd.constant(name=node.name, arr=out)
 
 
 # ---------------------------------------------------------------------------
@@ -593,6 +695,10 @@ class TFImporter:
         for name in order:
             node = nodes[name]
             if node.op == "NoOp":
+                continue
+            folded = _try_fold(ctx, node)
+            if folded is not None:
+                ctx.vars[name] = folded
                 continue
             ins = []
             for inp in node.input:

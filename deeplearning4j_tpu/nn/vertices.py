@@ -68,8 +68,17 @@ class MergeVertex(GraphVertex):
         return jnp.concatenate(inputs, axis=self.axis)
 
     def output_shape(self, shapes):
+        # shapes are batchless; ``apply`` sees batched arrays, so
+        # normalize the axis against the batched rank and shift down by
+        # one (batched axis 0 = batch, unmergeable)
         out = list(shapes[0])
-        out[-1] = sum(s[-1] for s in shapes)
+        batched_rank = len(out) + 1
+        ax = self.axis if self.axis >= 0 else self.axis + batched_rank
+        if ax == 0:
+            raise ValueError("MergeVertex cannot concatenate along "
+                             "the batch axis")
+        ax -= 1
+        out[ax] = sum(s[ax] for s in shapes)
         return tuple(out)
 
 

@@ -77,12 +77,22 @@ class MemoryWorkspace:
         self.total_bytes = 0
         self._live: List[weakref.ref] = []
         self._closed = True
+        self._reenter_depth = 0       # nested `with` on an active scope
+        self._handed_off = False      # get_and_activate → `with` pairing
 
     # -- scope management ----------------------------------------------
     def __enter__(self) -> "MemoryWorkspace":
         if self in _stack():
-            # idempotent re-entry: with-statement around an already
-            # activated workspace (get_and_activate_workspace)
+            if self._handed_off:
+                # `with mgr.get_and_activate_workspace(...)`: this
+                # with-block takes ownership of the pending activation,
+                # so its exit closes the scope (one enter, one close)
+                self._handed_off = False
+                return self
+            # genuinely nested `with ws:` on an active scope: count the
+            # nesting so only the matching outer __exit__ pops the
+            # scope (reference Nd4jWorkspace enter/leave cycle counts)
+            self._reenter_depth += 1
             return self
         from deeplearning4j_tpu import ndarray as _nd
         self._closed = False
@@ -95,6 +105,13 @@ class MemoryWorkspace:
         return self
 
     def __exit__(self, *exc):
+        if self._reenter_depth > 0:
+            self._reenter_depth -= 1
+            # a get_and_activate whose activation was closed directly
+            # (notify_scope_left) must not leave a stale hand-off for a
+            # later unrelated `with ws:`
+            self._handed_off = False
+            return False
         if self not in _stack():
             raise RuntimeError(
                 f"workspace {self.id!r}: scope not active on this "
@@ -104,6 +121,7 @@ class MemoryWorkspace:
         with _nd._WS_HINT_LOCK:
             _nd._WS_DEPTH -= 1
         self._closed = True
+        self._handed_off = False
         return False
 
     def notify_scope_entered(self):
@@ -222,6 +240,7 @@ class WorkspaceManager:
         block's exit closes the scope."""
         ws = self.get_workspace_for_current_thread(workspace_id, config)
         ws.notify_scope_entered()
+        ws._handed_off = True
         return ws
 
     def destroy_workspace(self, workspace_id: str):

@@ -160,3 +160,24 @@ def test_graph_checkpoint_without_input_types(tmp_path):
     np.testing.assert_allclose(np.asarray(g.output(XOR_X)[0]),
                                np.asarray(g2.output(XOR_X)[0]),
                                rtol=1e-6)
+
+
+def test_merge_vertex_output_shape_nondefault_axis():
+    """Regression (ADVICE r1): output_shape must honour the configured
+    axis (batchless convention), not hard-code the last dim."""
+    import jax.numpy as jnp
+    # batched rank-3 arrays: merging on axis=1 (time), batchless idx 0
+    a = jnp.ones((2, 3, 4))
+    b = jnp.ones((2, 5, 4))
+    v = MergeVertex(axis=1)
+    assert v.apply([a, b]).shape == (2, 8, 4)
+    assert v.output_shape([(3, 4), (5, 4)]) == (8, 4)
+    # negative axis indexes the same dim in batched and batchless forms
+    v2 = MergeVertex(axis=-2)
+    assert v2.apply([a, b]).shape == (2, 8, 4)
+    assert v2.output_shape([(3, 4), (5, 4)]) == (8, 4)
+    # default (-1) unchanged
+    assert MergeVertex().output_shape([(3, 4), (3, 6)]) == (3, 10)
+    import pytest
+    with pytest.raises(ValueError, match="batch axis"):
+        MergeVertex(axis=0).output_shape([(3, 4), (5, 4)])

@@ -101,6 +101,10 @@ case("where", b1, A(3, 4), A(3, 4), g=False)
 # --- matmul / linalg --------------------------------------------------------
 case("matmul", A(3, 4), A(4, 5), golden=np.matmul)
 case("matmul", A(3, 4), A(5, 4), transpose_b=True)
+case("einsum", A(3, 4), A(4, 5), equation="ij,jk->ik",
+     golden=lambda a, b: np.einsum("ij,jk->ik", a, b))
+case("einsum", A(2, 3, 4), A(2, 4, 5), equation="bij,bjk->bik",
+     golden=lambda a, b: np.einsum("bij,bjk->bik", a, b))
 case("dot", A(4), A(4), golden=np.dot)
 case("tensordot", A(3, 4), A(4, 5), axes=1)
 case("linear", A(5, 3), A(3, 2), A(2))

@@ -137,3 +137,18 @@ def test_scope_out_does_not_disturb_other_threads():
         release.set()
         t.join()
     assert ws.total_allocations == 1
+
+
+def test_nested_reentry_of_same_workspace():
+    """Regression (ADVICE r1): a nested `with ws:` on an already-active
+    workspace must not pop the scope at the inner block's exit — the
+    outer block keeps tracking, and the outer exit closes cleanly."""
+    ws = MemoryWorkspace("WS_REENTER")
+    with ws:
+        with ws:                      # idempotent re-entry
+            Nd4j.zeros((4,))
+        assert ws.is_scope_active()   # outer scope still active
+        Nd4j.zeros((4,))              # still tracked, no RuntimeError
+        assert ws.total_allocations == 2
+    assert not ws.is_scope_active()
+    assert ws.generation == 1         # one real enter/leave cycle

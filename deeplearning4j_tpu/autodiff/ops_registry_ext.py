@@ -20,6 +20,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from deeplearning4j_tpu.autodiff.ops_registry import OPS, op, _red
@@ -1248,8 +1249,39 @@ def _decode_bitmap(pos, neg, *, size):
 # batch 2: remaining parity/transform ops (reference generic/parity_ops,
 # generic/transforms, generic/compat)
 # --------------------------------------------------------------------------
+@op("reshape_sym")
+def _reshape_sym(a, *srcs, entries):
+    """Reshape whose target mixes literal dims with dims read off other
+    tensors at trace time (``entries`` item = int, or ``[src_idx,
+    axis]`` meaning ``srcs[src_idx].shape[axis]``).  This keeps
+    dynamic-batch TF imports inside XLA's static-shape world AND
+    JSON-serializable (no python closures in the graph)."""
+    tgt = [e if isinstance(e, int)
+           else srcs[int(e[0])].shape[int(e[1])] for e in entries]
+    return jnp.reshape(a, tgt)
+
+
+@op("reshape_dynamic")
+def _reshape_dynamic(a, s):
+    """Reshape where the target arrives as a tensor computed from
+    ``shape_of`` chains (TF dynamic-batch graphs).  Inside jit the
+    chain is concrete — ``shape_of`` embeds the trace-time static
+    shape — so the target resolves to ints at trace time; genuinely
+    data-dependent targets cannot compile for TPU and get a clear
+    error."""
+    try:
+        tgt = [int(v) for v in np.asarray(s)]
+    except Exception as e:
+        raise ValueError(
+            "reshape target is data-dependent — XLA needs static "
+            "shapes; compute the target from input shapes/constants "
+            f"instead ({e})") from None
+    return jnp.reshape(a, tgt)
+
+
 op("split_v")(lambda a, *, sizes, axis=0: tuple(
-    jnp.split(a, list(jnp.cumsum(jnp.asarray(sizes))[:-1]), axis=axis)))
+    # sizes is static config — split points must stay concrete under jit
+    jnp.split(a, np.cumsum(np.asarray(sizes))[:-1].tolist(), axis=axis)))
 op("select")(jnp.where)
 op("choose")(lambda a, *, condition="gt", value=0.0: (
     a[_CONDS[condition](a, value)]))

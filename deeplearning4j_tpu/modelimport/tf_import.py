@@ -112,6 +112,11 @@ class _Ctx:
         #: (excludes trainable consts — folding through them would
         #: disconnect the gradient)
         self.foldable: set = set()
+        #: partially-known shape vectors (dynamic-batch graphs):
+        #: name -> list of int | _SymDim entries
+        self.symshapes: Dict[str, list] = {}
+        #: symshape names whose runtime value is a scalar (shrink-sliced)
+        self.symscalars: set = set()
 
     def static(self, name: str) -> np.ndarray:
         """The value of a node that must be known at import time
@@ -122,6 +127,19 @@ class _Ctx:
                 "constant — dynamic shapes cannot be imported (freeze "
                 "the graph with constant folding first)")
         return self.consts[name]
+
+
+class _SymDim:
+    """A shape entry that is only known at jit-trace time: dimension
+    ``axis`` of tensor ``src`` (dynamic batch in a frozen graph)."""
+
+    __slots__ = ("src", "axis")
+
+    def __init__(self, src: str, axis: int):
+        self.src, self.axis = src, axis
+
+    def __repr__(self):
+        return f"dim({self.src}[{self.axis}])"
 
 
 _MAPPERS: Dict[str, Callable] = {}
@@ -167,12 +185,15 @@ def _m_placeholder(ctx, node, ins):
 @_maps("Identity", "StopGradient", "PreventGradient", "Snapshot",
        "CheckNumerics")
 def _m_identity(ctx, node, ins):
-    src, _ = _ref(node.input[0])
-    if src in ctx.consts:
+    src, idx = _ref(node.input[0])
+    if idx <= 0 and src in ctx.consts:
         ctx.consts[node.name] = ctx.consts[src]
         if src in ctx.foldable:
             ctx.foldable.add(node.name)
-    return ctx.vars[src]
+    # ins[0] is already resolved to the right output of a multi-output
+    # producer (Identity(TopKV2:1) must forward the indices, not the
+    # whole tuple)
+    return ins[0]
 
 
 # --- elementwise -----------------------------------------------------------
@@ -272,8 +293,31 @@ def _m_argmax(ctx, node, ins):
 
 @_maps("Reshape")
 def _m_reshape(ctx, node, ins):
-    shape = [int(s) for s in ctx.static(_ref(node.input[1])[0])]
-    return _rec(ctx, "reshape", ins[:1], node, shape=shape)
+    src = _ref(node.input[1])[0]
+    if src in ctx.consts:
+        shape = [int(s) for s in ctx.consts[src]]
+        return _rec(ctx, "reshape", ins[:1], node, shape=shape)
+    if src in ctx.symshapes:
+        # dynamic-batch graphs: target came from a Shape→slice→Pack
+        # chain whose unknown entries are dims of live tensors.  Those
+        # dims are static at jit-trace time, so a lambda node that
+        # reads them from the referenced tensors keeps XLA's
+        # static-shape world intact.
+        sym = list(ctx.symshapes[src])
+        order = []
+        for e in sym:
+            if isinstance(e, _SymDim) and e.src not in order:
+                order.append(e.src)
+        extra = [ctx.vars[s] for s in order]
+        if any(isinstance(v, tuple) for v in extra):
+            raise ValueError(f"reshape target of {node.name!r} "
+                             "references a multi-output node")
+        entries = [e if not isinstance(e, _SymDim)
+                   else [order.index(e.src), e.axis] for e in sym]
+        return _rec(ctx, "reshape_sym", [ins[0]] + extra, node,
+                    entries=entries)
+    # last resort: works when the target is concrete at trace time
+    return _rec(ctx, "reshape_dynamic", ins[:2], node)
 
 
 @_maps("Transpose")
@@ -298,11 +342,39 @@ def _m_squeeze(ctx, node, ins):
 @_maps("ConcatV2")
 def _m_concat(ctx, node, ins):
     axis = int(ctx.static(_ref(node.input[-1])[0]))
+    if axis == 0:                       # shape-vector concatenation
+        parts = [_sym_entries(ctx, i) for i in node.input[:-1]]
+        if (all(p is not None for p in parts)
+                and any(_ref(i)[0] in ctx.symshapes
+                        for i in node.input[:-1])):
+            ctx.symshapes[node.name] = [e for p in parts for e in p]
     return _rec(ctx, "concat", ins[:-1], node, axis=axis)
+
+
+def _sym_entries(ctx, inp, scalar_only=False):
+    """Entries an input contributes to a packed/concatenated shape
+    vector: its symbolic view, its const value, or None if unknown.
+    ``scalar_only`` (Pack) additionally requires the input to be a
+    runtime scalar so stacking really builds a 1-D shape vector."""
+    src, _ = _ref(inp)
+    if src in ctx.symshapes:
+        if scalar_only and src not in ctx.symscalars:
+            return None
+        return ctx.symshapes[src]
+    if src in ctx.consts:
+        c = ctx.consts[src]
+        if np.ndim(c) > 1 or (scalar_only and np.ndim(c) != 0):
+            return None
+        return [int(v) for v in np.atleast_1d(c)]
+    return None
 
 
 @_maps("Pack")
 def _m_pack(ctx, node, ins):
+    parts = [_sym_entries(ctx, i, scalar_only=True) for i in node.input]
+    if (int(_attr(node, "axis", 0)) == 0
+            and all(p is not None for p in parts)):
+        ctx.symshapes[node.name] = [e for p in parts for e in p]
     return _rec(ctx, "stack", ins, node, axis=int(_attr(node, "axis", 0)))
 
 
@@ -362,11 +434,27 @@ def _m_strided_slice(ctx, node, ins):
     if spec is None:
         raise ValueError("StridedSlice with ellipsis/new-axis masks is "
                          "not importable")
+    src = _ref(node.input[0])[0]
+    if src in ctx.symshapes and len(spec) == 1:
+        s = spec[0]                     # 1-D slice of a symbolic shape
+        entries = ctx.symshapes[src]
+        if s["t"] == "int":             # shrink: scalar dim extraction
+            ctx.symshapes[node.name] = [entries[s["v"]]]
+            ctx.symscalars.add(node.name)
+        else:
+            ctx.symshapes[node.name] = entries[
+                slice(s["start"], s["stop"], s["step"])]
     return _rec(ctx, "getitem", ins[:1], node, spec=spec)
 
 
 @_maps("Cast")
 def _m_cast(ctx, node, ins):
+    src = _ref(node.input[0])[0]
+    if src in ctx.symshapes and np.issubdtype(
+            np.dtype(_attr(node, "DstT")), np.integer):
+        ctx.symshapes[node.name] = ctx.symshapes[src]
+        if src in ctx.symscalars:
+            ctx.symscalars.add(node.name)
     return _rec(ctx, "cast", ins, node, dtype=_attr(node, "DstT"))
 
 
@@ -469,6 +557,12 @@ def _m_shape(ctx, node, ins):
     src, _ = _ref(node.input[0])
     shape = ctx.shapes.get(src)
     if shape is None or any(s is None or s < 0 for s in shape):
+        if shape is not None:
+            # dynamic-batch graph: keep a symbolic view so Reshape
+            # targets can still resolve at jit-trace time
+            ctx.symshapes[node.name] = [
+                _SymDim(src, i) if (s is None or s < 0) else int(s)
+                for i, s in enumerate(shape)]
         return _rec(ctx, "shape_of", ins[:1], node)
     arr = np.asarray(shape, np.int32)
     ctx.consts[node.name] = arr

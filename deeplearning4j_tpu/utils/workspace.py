@@ -94,6 +94,19 @@ class MemoryWorkspace:
             # scope (reference Nd4jWorkspace enter/leave cycle counts)
             self._reenter_depth += 1
             return self
+        return self._enter_scope()
+
+    def _activate(self) -> "MemoryWorkspace":
+        """Activation that is NOT a with-statement claim: a nested
+        get_and_activate on an active scope always counts a nesting
+        level (it must never consume a pending hand-off — that belongs
+        to the first activation's with-block)."""
+        if self in _stack():
+            self._reenter_depth += 1
+            return self
+        return self._enter_scope()
+
+    def _enter_scope(self) -> "MemoryWorkspace":
         from deeplearning4j_tpu import ndarray as _nd
         self._closed = False
         self.generation += 1
@@ -125,7 +138,7 @@ class MemoryWorkspace:
         return False
 
     def notify_scope_entered(self):
-        return self.__enter__()
+        return self._activate()
 
     def notify_scope_left(self):
         self.__exit__()

@@ -101,6 +101,10 @@ case("where", b1, A(3, 4), A(3, 4), g=False)
 # --- matmul / linalg --------------------------------------------------------
 case("matmul", A(3, 4), A(4, 5), golden=np.matmul)
 case("matmul", A(3, 4), A(5, 4), transpose_b=True)
+case("reshape_dynamic", A(2, 6), np.array([3, 4], np.int32), g=False,
+     golden=lambda a, s: np.reshape(a, [3, 4]))
+case("reshape_sym", A(2, 6), A(3, 9), entries=[[0, 0], -1], g=False,
+     golden=lambda a, s: np.reshape(a, [3, -1]))
 case("einsum", A(3, 4), A(4, 5), equation="ij,jk->ik",
      golden=lambda a, b: np.einsum("ij,jk->ik", a, b))
 case("einsum", A(2, 3, 4), A(2, 4, 5), equation="bij,bjk->bik",

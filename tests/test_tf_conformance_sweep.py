@@ -1,0 +1,561 @@
+"""TF GraphDef conformance sweep (reference: TFGraphTestAllSameDiff —
+thousands of tiny frozen TF graphs executed and compared per-op).
+
+Instead of checked-in graph assets (the reference ships ~2k frozen
+protobufs), cases are *generated*: every mapped TF op is swept across
+parameterized shapes/dtypes/attrs, the golden outputs are minted
+in-process by running the same function under TF eager, and the
+imported SameDiff graph must match within per-op tolerance.  A final
+coverage test reports mapped-vs-swept ops and fails if a mapped op
+family is missing from the sweep.
+"""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from deeplearning4j_tpu.modelimport.tf_import import (  # noqa: E402
+    TFImporter, _MAPPERS)
+
+
+RNG = np.random.default_rng(2026)
+
+#: TF op types observed across all swept graphs (filled as cases run)
+SWEPT_OPS = set()
+
+#: per-TF-op tolerance overrides (float32 default 1e-4/1e-5)
+OP_TOL = {
+    "Conv2D": (2e-3, 1e-4),
+    "DepthwiseConv2dNative": (2e-3, 1e-4),
+    "MatMul": (1e-3, 1e-5),
+    "BatchMatMulV2": (1e-3, 1e-5),
+    "Einsum": (1e-3, 1e-5),
+    "Erfc": (1e-4, 1e-6),
+    "Log": (1e-3, 1e-5),
+    "Pow": (1e-3, 1e-5),
+    "Rsqrt": (1e-3, 1e-5),
+    "FusedBatchNormV3": (1e-3, 1e-4),
+    "Softmax": (1e-4, 1e-6),
+}
+
+
+def _freeze(fn, *specs):
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+
+    conc = tf.function(fn).get_concrete_function(*specs)
+    frozen = convert_variables_to_constants_v2(conc)
+    gd = frozen.graph.as_graph_def()
+    in_names = [t.name.split(":")[0] for t in frozen.inputs]
+    out_names = [t.name.split(":")[0] for t in frozen.outputs]
+    return gd, in_names, out_names
+
+
+def _run_case(fn, args, rtol=1e-4, atol=1e-5):
+    specs = [tf.TensorSpec(a.shape, a.dtype) for a in args]
+    gd, in_names, out_names = _freeze(fn, *specs)
+    ops_here = {n.op for n in gd.node}
+    SWEPT_OPS.update(ops_here)
+    for op_ in ops_here:            # widest tolerance of any op present
+        r, a_ = OP_TOL.get(op_, (0, 0))
+        rtol, atol = max(rtol, r), max(atol, a_)
+    ref = fn(*[tf.constant(a) for a in args])
+    if not isinstance(ref, (list, tuple)):
+        ref = [ref]
+    sd, vars_ = TFImporter.import_graph_def(gd, out_names)
+    feed = {n: a for n, a in zip(in_names, args)}
+    out_vars = [vars_[n] for n in out_names]
+    res = sd.output(feed, out_vars)
+    assert len(out_vars) == len(ref)
+    for o, r in zip(out_vars, ref):
+        got, want = res[o.name], np.asarray(r)
+        assert got.shape == want.shape, (got.shape, want.shape)
+        if want.dtype.kind in "fc":
+            np.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
+        else:
+            np.testing.assert_array_equal(got, want)
+
+
+def F(*shape, lo=None, hi=None, scale=1.0):
+    a = (RNG.normal(size=shape) * scale).astype(np.float32)
+    if lo is not None:
+        a = np.clip(a, lo, hi).astype(np.float32)
+    return a
+
+
+def I(*shape, hi=4):
+    return RNG.integers(0, hi, size=shape).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# case generation: (id, fn, args) triples
+
+CASES = []
+
+
+def case(cid, fn, *args):
+    CASES.append(pytest.param(fn, list(args), id=cid))
+
+
+# --- unary elementwise: every mapped op × 4 ranks --------------------------
+# op -> (tf fn, clip-lo, clip-hi); None = unrestricted domain
+_UNARY_TF = {
+    "neg": (tf.negative, None, None), "abs": (tf.abs, None, None),
+    "exp": (tf.exp, None, None), "square": (tf.square, None, None),
+    "sign": (tf.sign, None, None), "floor": (tf.floor, None, None),
+    "ceil": (tf.math.ceil, None, None), "round": (tf.round, None, None),
+    "sin": (tf.sin, None, None), "cos": (tf.cos, None, None),
+    "tan": (tf.tan, None, None), "atan": (tf.atan, None, None),
+    "sinh": (tf.sinh, None, None), "cosh": (tf.cosh, None, None),
+    "tanh": (tf.tanh, None, None), "erf": (tf.math.erf, None, None),
+    "erfc": (tf.math.erfc, None, None),
+    "sigmoid": (tf.sigmoid, None, None), "relu": (tf.nn.relu, None, None),
+    "relu6": (tf.nn.relu6, None, None), "elu": (tf.nn.elu, None, None),
+    "selu": (tf.nn.selu, None, None),
+    "softplus": (tf.nn.softplus, None, None),
+    "softsign": (tf.nn.softsign, None, None),
+    "log": (tf.math.log, 0.1, 9.0), "log1p": (tf.math.log1p, -0.5, 9.0),
+    "sqrt": (tf.sqrt, 0.0, 9.0), "rsqrt": (tf.math.rsqrt, 0.2, 9.0),
+    "asin": (tf.asin, -0.9, 0.9), "acos": (tf.acos, -0.9, 0.9),
+    "reciprocal": (tf.math.reciprocal, 0.3, 5.0),
+}
+_UNARY_SHAPES = [("r1", (7,)), ("r2", (3, 4)), ("r3", (2, 3, 5)),
+                 ("r4", (2, 2, 3, 2))]
+for name, (op_, lo, hi) in _UNARY_TF.items():
+    for sid, shp in _UNARY_SHAPES:
+        case(f"unary-{name}-{sid}",
+             (lambda op_: lambda x: op_(x))(op_),
+             F(*shp, lo=lo, hi=hi, scale=0.8))
+case("unary-leakyrelu", lambda x: tf.nn.leaky_relu(x, alpha=0.3),
+     F(3, 4))
+case("unary-leakyrelu-default", lambda x: tf.nn.leaky_relu(x), F(4,))
+
+# --- binary elementwise: each op × same-shape + broadcast ------------------
+_BINARY_TF = {
+    "add": tf.add, "sub": tf.subtract, "mul": tf.multiply,
+    "realdiv": tf.divide, "maximum": tf.maximum, "minimum": tf.minimum,
+    "squared_difference": tf.math.squared_difference,
+}
+for name, op_ in _BINARY_TF.items():
+    case(f"binary-{name}", (lambda op_: lambda a, b: op_(a, b))(op_),
+         F(3, 4), F(3, 4))
+    case(f"binary-{name}-bcast",
+         (lambda op_: lambda a, b: op_(a, b))(op_), F(2, 3, 4), F(4))
+case("binary-pow", lambda a, b: tf.pow(a, b),
+     F(3, 4, lo=0.2, hi=3.0), F(3, 4, lo=-2.0, hi=2.0))
+case("binary-floormod", lambda a, b: tf.math.floormod(a, b),
+     F(3, 4), F(3, 4, lo=0.5, hi=3.0))
+case("binary-addn", lambda a, b, c: tf.add_n([a, b, c]),
+     F(3, 4), F(3, 4), F(3, 4))
+# scalar-const broadcast flavor (frozen graphs are full of these)
+for name, op_ in _BINARY_TF.items():
+    case(f"binary-{name}-scalar",
+         (lambda op_: lambda a: op_(a, tf.constant(1.5)))(op_), F(3, 4))
+# int32 arithmetic keeps exact semantics
+for name, op_ in [("add", tf.add), ("sub", tf.subtract),
+                  ("mul", tf.multiply), ("maximum", tf.maximum),
+                  ("minimum", tf.minimum)]:
+    case(f"binary-{name}-int",
+         (lambda op_: lambda a, b: op_(a, b))(op_),
+         I(3, 4, hi=9), I(3, 4, hi=9))
+
+# --- comparisons / logical -------------------------------------------------
+_CMP_TF = {"less": tf.less, "less_equal": tf.less_equal,
+           "greater": tf.greater, "greater_equal": tf.greater_equal,
+           "equal": tf.equal, "not_equal": tf.not_equal}
+for name, op_ in _CMP_TF.items():
+    case(f"cmp-{name}",
+         (lambda op_: lambda a, b: tf.cast(op_(a, b), tf.float32))(op_),
+         F(3, 4), F(3, 4))
+    case(f"cmp-{name}-bcast",
+         (lambda op_: lambda a, b: tf.cast(op_(a, b), tf.float32))(op_),
+         F(2, 3, 4), F(4))
+case("cmp-logical", lambda a, b: tf.cast(
+    tf.logical_and(a > 0, tf.logical_or(b > 0, tf.logical_not(a < b))),
+    tf.float32), F(3, 4), F(3, 4))
+case("cmp-select", lambda c, a, b: tf.where(c > 0, a, b),
+     F(3, 4), F(3, 4), F(3, 4))
+case("cmp-select-scalar", lambda c, a: tf.where(c > 0, a, 0.0),
+     F(2, 5), F(2, 5))
+
+# --- reductions: op × axis × keepdims --------------------------------------
+_RED_TF = {"sum": tf.reduce_sum, "mean": tf.reduce_mean,
+           "max": tf.reduce_max, "min": tf.reduce_min,
+           "prod": tf.reduce_prod}
+for name, op_ in _RED_TF.items():
+    for ax, kd in [(0, False), (1, True), ((0, 2), False),
+                   ((-1,), True)]:
+        axid = str(ax).replace(" ", "")
+        case(f"reduce-{name}-ax{axid}-kd{kd}",
+             (lambda op_, ax, kd: lambda x: op_(
+                 x, axis=ax, keepdims=kd))(op_, ax, kd),
+             F(2, 3, 4, scale=0.5))
+
+# --- matmul family ---------------------------------------------------------
+case("matmul-plain", lambda a, b: tf.matmul(a, b), F(3, 4), F(4, 5))
+case("matmul-ta", lambda a, b: tf.matmul(a, b, transpose_a=True),
+     F(4, 3), F(4, 5))
+case("matmul-tb", lambda a, b: tf.matmul(a, b, transpose_b=True),
+     F(3, 4), F(5, 4))
+case("matmul-tatb", lambda a, b: tf.matmul(
+    a, b, transpose_a=True, transpose_b=True), F(4, 3), F(5, 4))
+case("matmul-batch", lambda a, b: tf.matmul(a, b),
+     F(2, 3, 4), F(2, 4, 5))
+case("matmul-batch-tb", lambda a, b: tf.matmul(a, b, transpose_b=True),
+     F(2, 3, 4), F(2, 5, 4))
+for eq in ["ij,jk->ik", "bij,bjk->bik", "bth,hd->btd",
+           "bhtd,bhsd->bhts", "ij->ji"]:
+    eqid = eq.replace(",", "_").replace("->", "-")
+    if eq == "ij->ji":
+        case(f"einsum-{eqid}",
+             (lambda eq: lambda a: tf.einsum(eq, a))(eq), F(3, 4))
+    elif eq == "bhtd,bhsd->bhts":
+        case(f"einsum-{eqid}",
+             (lambda eq: lambda a, b: tf.einsum(eq, a, b))(eq),
+             F(2, 2, 3, 4), F(2, 2, 5, 4))
+    else:
+        shapes = {"ij,jk->ik": [(3, 4), (4, 5)],
+                  "bij,bjk->bik": [(2, 3, 4), (2, 4, 5)],
+                  "bth,hd->btd": [(2, 3, 4), (4, 5)]}[eq]
+        case(f"einsum-{eqid}",
+             (lambda eq: lambda a, b: tf.einsum(eq, a, b))(eq),
+             *[F(*s) for s in shapes])
+
+# --- shape manipulation ----------------------------------------------------
+case("reshape-const", lambda x: tf.reshape(x, [4, 6]), F(2, 3, 4))
+case("reshape-minus1", lambda x: tf.reshape(x, [2, -1]), F(2, 3, 4))
+case("reshape-shapedriven", lambda x: tf.reshape(
+    x, [tf.shape(x)[0], -1]), F(3, 4, 5))
+case("transpose-r2", lambda x: tf.transpose(x), F(3, 4))
+case("transpose-perm", lambda x: tf.transpose(x, [0, 2, 1]), F(2, 3, 4))
+case("transpose-perm2", lambda x: tf.transpose(x, [2, 0, 1]), F(2, 3, 4))
+case("expanddims-0", lambda x: tf.expand_dims(x, 0), F(3, 4))
+case("expanddims-neg", lambda x: tf.expand_dims(x, -1), F(3, 4))
+case("squeeze-all", lambda x: tf.squeeze(x), F(1, 3, 1, 4))
+case("squeeze-ax", lambda x: tf.squeeze(x, axis=2), F(2, 3, 1, 4))
+case("concat-ax0", lambda a, b: tf.concat([a, b], 0), F(2, 4), F(3, 4))
+case("concat-ax1", lambda a, b: tf.concat([a, b], 1), F(3, 2), F(3, 5))
+case("concat-neg", lambda a, b: tf.concat([a, b], -1),
+     F(2, 3, 2), F(2, 3, 4))
+case("pack-ax0", lambda a, b: tf.stack([a, b]), F(3, 4), F(3, 4))
+case("pack-ax1", lambda a, b: tf.stack([a, b], axis=1), F(3, 4), F(3, 4))
+case("unpack", lambda x: tf.add_n(tf.unstack(x, axis=1)), F(3, 4, 2))
+case("tile", lambda x: tf.tile(x, [2, 3]), F(2, 3))
+case("tile-r3", lambda x: tf.tile(x, [1, 2, 2]), F(2, 2, 3))
+case("gather-ax0", lambda x, i: tf.gather(x, i), F(5, 3), I(4, hi=5))
+case("gather-ax1", lambda x, i: tf.gather(x, i, axis=1),
+     F(3, 6), I(2, hi=6))
+case("pad-zero", lambda x: tf.pad(x, [[1, 0], [0, 2]]), F(2, 3))
+case("pad-value", lambda x: tf.pad(
+    x, [[1, 1], [2, 0]], constant_values=3.5), F(2, 3))
+case("slice-basic", lambda x: tf.slice(x, [1, 0], [2, 3]), F(4, 5))
+case("slice-neg1", lambda x: tf.slice(x, [0, 2], [-1, -1]), F(3, 6))
+case("stridedslice-basic", lambda x: x[1:3, ::2], F(4, 6))
+case("stridedslice-shrink", lambda x: x[:, 1], F(4, 6))
+case("stridedslice-negstep", lambda x: x[::-1], F(5, 3))
+case("stridedslice-open", lambda x: x[1:], F(5, 3))
+case("split-even", lambda x: tf.add_n(tf.split(x, 3, axis=1)), F(2, 9))
+case("splitv", lambda x: tf.concat(
+    tf.split(x, [2, 4], axis=1)[::-1], 1), F(3, 6))
+case("shape-of", lambda x: tf.cast(tf.shape(x), tf.float32), F(3, 5))
+case("size-rank", lambda x: tf.cast(
+    tf.size(x) + tf.rank(x), tf.float32), F(2, 3))
+case("fill-shapechain", lambda x: x + tf.fill([3, 4], 2.0), F(3, 4))
+case("range-chain", lambda x: x * tf.range(4.0), F(3, 4))
+case("cast-int", lambda x: tf.cast(tf.cast(x, tf.int32), tf.float32),
+     F(3, 4, scale=3.0))
+case("onehot", lambda i: tf.one_hot(i, 5), I(6, hi=5))
+case("argmax-ax1", lambda x: tf.cast(tf.argmax(x, 1), tf.float32),
+     F(4, 6))
+case("matrixbandpart", lambda x: tf.linalg.band_part(x, 1, 2), F(5, 5))
+case("cumsum-plain", lambda x: tf.cumsum(x, axis=1), F(3, 6))
+case("cumsum-excl", lambda x: tf.cumsum(x, axis=0, exclusive=True),
+     F(4, 3))
+case("cumsum-rev", lambda x: tf.cumsum(x, axis=1, reverse=True),
+     F(3, 6))
+case("cumsum-exclrev", lambda x: tf.cumsum(
+    x, axis=1, exclusive=True, reverse=True), F(3, 6))
+case("topk", lambda x: tf.math.top_k(x, k=2)[0], F(4, 7))
+case("topk-k1", lambda x: tf.math.top_k(x, k=1)[0], F(3, 5))
+case("topk-indices", lambda x: tf.cast(
+    tf.math.top_k(x, k=3)[1], tf.float32), F(2, 9))
+case("argmax-ax0", lambda x: tf.cast(tf.argmax(x, 0), tf.float32),
+     F(4, 6))
+case("argmax-r3", lambda x: tf.cast(tf.argmax(x, 2), tf.float32),
+     F(2, 3, 5))
+case("onehot-r2", lambda i: tf.one_hot(i, 3), I(2, 4, hi=3))
+case("cast-bool-roundtrip", lambda x: tf.cast(
+    tf.cast(x, tf.bool), tf.float32), F(3, 4))
+case("stridedslice-step3", lambda x: x[::3], F(9, 2))
+case("stridedslice-negbegin", lambda x: x[-2:], F(5, 3))
+case("stridedslice-mixed", lambda x: x[1:-1, 2], F(4, 6))
+case("stridedslice-r3", lambda x: x[:, 1:3, ::2], F(2, 4, 6))
+case("gather-r3", lambda x, i: tf.gather(x, i, axis=2),
+     F(2, 3, 6), I(4, hi=6))
+case("pad-r3", lambda x: tf.pad(x, [[0, 0], [1, 1], [2, 2]]),
+     F(2, 3, 4))
+case("transpose-r4", lambda x: tf.transpose(x, [0, 3, 1, 2]),
+     F(2, 3, 4, 2))
+case("tile-r1", lambda x: tf.tile(x, [4]), F(3))
+case("concat-three", lambda a, b, c: tf.concat([a, b, c], 1),
+     F(2, 1), F(2, 2), F(2, 3))
+case("pack-neg", lambda a, b: tf.stack([a, b], axis=-1),
+     F(3, 4), F(3, 4))
+case("range-int", lambda x: x + tf.cast(
+    tf.range(2, 10, 2), tf.float32), F(3, 4))
+
+# --- nn ops ----------------------------------------------------------------
+case("biasadd", lambda x, b: tf.nn.bias_add(x, b), F(4, 6), F(6))
+case("softmax", lambda x: tf.nn.softmax(x), F(4, 6))
+case("softmax-r3", lambda x: tf.nn.softmax(x), F(2, 3, 5))
+case("logsoftmax", lambda x: tf.nn.log_softmax(x), F(4, 6))
+for strides, pad in [(1, "SAME"), (1, "VALID"), (2, "SAME"),
+                     (2, "VALID")]:
+    case(f"conv2d-s{strides}-{pad}",
+         (lambda s, p: lambda x, w: tf.nn.conv2d(
+             x, w, strides=[1, s, s, 1], padding=p))(strides, pad),
+         F(2, 8, 8, 3, scale=0.5), F(3, 3, 3, 4, scale=0.3))
+case("conv2d-dilated", lambda x, w: tf.nn.conv2d(
+    x, w, strides=[1, 1, 1, 1], padding="SAME", dilations=2),
+    F(1, 10, 10, 2, scale=0.5), F(3, 3, 2, 3, scale=0.3))
+case("depthwise", lambda x, w: tf.nn.depthwise_conv2d(
+    x, w, strides=[1, 1, 1, 1], padding="SAME"),
+    F(2, 8, 8, 3, scale=0.5), F(3, 3, 3, 2, scale=0.3))
+for pool, pad in [("max", "SAME"), ("max", "VALID"), ("avg", "SAME"),
+                  ("avg", "VALID")]:
+    fn_ = tf.nn.max_pool2d if pool == "max" else tf.nn.avg_pool2d
+    case(f"pool-{pool}-{pad}",
+         (lambda fn_, p: lambda x: fn_(x, 2, 2, p))(fn_, pad),
+         F(2, 8, 8, 3))
+case("fusedbn-inference", lambda x: tf.compat.v1.nn.fused_batch_norm(
+    x, scale=np.ones(3, np.float32) * 1.5,
+    offset=np.ones(3, np.float32) * 0.2,
+    mean=np.zeros(3, np.float32), variance=np.ones(3, np.float32),
+    is_training=False)[0], F(2, 4, 4, 3))
+case("conv2d-1x1", lambda x, w: tf.nn.conv2d(
+    x, w, strides=[1, 1, 1, 1], padding="VALID"),
+    F(2, 5, 5, 4, scale=0.5), F(1, 1, 4, 6, scale=0.3))
+case("conv2d-5x5", lambda x, w: tf.nn.conv2d(
+    x, w, strides=[1, 1, 1, 1], padding="SAME"),
+    F(1, 9, 9, 2, scale=0.5), F(5, 5, 2, 3, scale=0.2))
+case("conv2d-rect-stride", lambda x, w: tf.nn.conv2d(
+    x, w, strides=[1, 2, 1, 1], padding="SAME"),
+    F(1, 8, 8, 2, scale=0.5), F(3, 3, 2, 3, scale=0.3))
+case("pool-max-k3", lambda x: tf.nn.max_pool2d(x, 3, 1, "VALID"),
+     F(2, 7, 7, 2))
+case("pool-avg-k3s1", lambda x: tf.nn.avg_pool2d(x, 3, 1, "SAME"),
+     F(2, 7, 7, 2))
+case("biasadd-nhwc", lambda x, b: tf.nn.bias_add(x, b),
+     F(2, 4, 4, 3), F(3))
+case("softmax-ax-neg", lambda x: tf.nn.softmax(x, axis=1), F(3, 4, 5))
+case("logsoftmax-r3", lambda x: tf.nn.log_softmax(x), F(2, 3, 5))
+
+# --- int dtype paths -------------------------------------------------------
+case("int-arith", lambda a, b: tf.cast(a + b * 2, tf.float32),
+     I(3, 4), I(3, 4))
+case("int-reduce", lambda a: tf.cast(tf.reduce_sum(a, 1), tf.float32),
+     I(3, 4, hi=9))
+case("int-gather-concat", lambda x, i: tf.concat(
+    [tf.gather(x, i), x[:2]], 0), F(5, 3), I(3, hi=5))
+
+# --- composite graphs (multi-op, shape-arithmetic heavy) -------------------
+case("composite-mlp", lambda x, w1, w2: tf.nn.softmax(
+    tf.matmul(tf.nn.relu(tf.matmul(x, w1)), w2)),
+    F(4, 8), F(8, 16, scale=0.3), F(16, 3, scale=0.3))
+case("composite-norm", lambda x: (x - tf.reduce_mean(x, -1, True))
+     / tf.sqrt(tf.math.reduce_variance(x, -1, True) + 1e-5)
+     if hasattr(tf.math, "reduce_variance_unused") else
+     (x - tf.reduce_mean(x, -1, True)) * tf.math.rsqrt(
+         tf.reduce_mean(tf.square(x - tf.reduce_mean(x, -1, True)),
+                        -1, True) + 1e-5), F(3, 8))
+case("composite-attention", lambda q, k, v: tf.matmul(tf.nn.softmax(
+    tf.matmul(q, k, transpose_b=True)
+    / tf.sqrt(tf.cast(tf.shape(q)[-1], tf.float32))), v),
+    F(2, 5, 4), F(2, 5, 4), F(2, 5, 4))
+case("composite-flatten-dense", lambda x, w: tf.matmul(
+    tf.reshape(x, [tf.shape(x)[0], -1]), w),
+    F(3, 4, 5), F(20, 6, scale=0.3))
+case("composite-mean-sub", lambda x: x - tf.reduce_mean(x, 0),
+     F(6, 4))
+case("composite-cumsum-mask", lambda x: x * tf.cast(
+    tf.cumsum(tf.ones_like(x), 1) <= 3.0, tf.float32), F(2, 6))
+case("composite-gelu", lambda x: 0.5 * x * (1.0 + tf.math.erf(
+    x / tf.sqrt(2.0))), F(4, 6))
+case("composite-residual", lambda x, w: x + tf.matmul(
+    tf.nn.relu(tf.matmul(x, w)), tf.transpose(w)),
+    F(3, 6), F(6, 6, scale=0.3))
+case("composite-minmax-norm", lambda x: (x - tf.reduce_min(x, 0)) / (
+    tf.reduce_max(x, 0) - tf.reduce_min(x, 0) + 1e-6), F(5, 3))
+case("composite-swish", lambda x: x * tf.sigmoid(x), F(4, 6))
+case("composite-clip", lambda x: tf.minimum(tf.maximum(x, -1.0), 1.0),
+     F(4, 6, scale=2.0))
+case("composite-conv-bn-relu", lambda x, w: tf.nn.relu(
+    tf.compat.v1.nn.fused_batch_norm(
+        tf.nn.conv2d(x, w, strides=[1, 1, 1, 1], padding="SAME"),
+        scale=np.ones(4, np.float32), offset=np.zeros(4, np.float32),
+        mean=np.zeros(4, np.float32),
+        variance=np.ones(4, np.float32), is_training=False)[0]),
+    F(1, 6, 6, 2, scale=0.5), F(3, 3, 2, 4, scale=0.3))
+case("composite-pool-flatten", lambda x, w: tf.matmul(tf.reshape(
+    tf.nn.max_pool2d(x, 2, 2, "VALID"), [tf.shape(x)[0], -1]), w),
+    F(2, 4, 4, 3), F(12, 5, scale=0.3))
+case("composite-masked-mean", lambda x, m: tf.reduce_sum(x * m, 1)
+     / (tf.reduce_sum(m, 1) + 1e-6), F(3, 6), (RNG.random((3, 6)) > 0.4)
+     .astype(np.float32))
+case("composite-embedding-lookup", lambda e, i: tf.reduce_mean(
+    tf.gather(e, i), axis=1), F(10, 4, scale=0.5), I(3, 5, hi=10))
+
+
+@pytest.mark.parametrize("fn,args", CASES)
+def test_conformance(fn, args):
+    _run_case(fn, args)
+
+
+# --- TF1-era raw graphs ----------------------------------------------------
+# TF2 tracing emits AddV2/SelectV2 and constant-folds Shape/Rank of
+# static-shape inputs, so the legacy ops only appear in v1 GraphDefs.
+# Build those directly with raw_ops and mint goldens via GraphRunner
+# (the same path the reference's TF runner plays for golden minting).
+
+RAW_CASES = []
+
+
+def raw_case(cid, builder, args):
+    RAW_CASES.append(pytest.param(builder, args, id=cid))
+
+
+raw_case("raw-add-select-rank-shape", lambda x, y: (
+    tf.raw_ops.Select(
+        condition=tf.raw_ops.Greater(x=x, y=y),
+        x=tf.raw_ops.Add(x=x, y=y),
+        # v1 Select does not broadcast: expand the scalar to x's shape.
+        # Shape(x)[0] also exercises import-time StridedSlice folding
+        # over a genuine (un-folded-by-TF) Shape node.
+        y=x * 0.0 + tf.cast(tf.raw_ops.Rank(input=x)
+                            + tf.raw_ops.Shape(input=x)[0],
+                            tf.float32))),
+    [F(3, 4), F(3, 4)])
+raw_case("raw-div-inv", lambda x, y: tf.raw_ops.Div(
+    x=tf.raw_ops.Inv(x=x), y=y),
+    [F(3, 4, lo=0.5, hi=4.0), F(3, 4, lo=0.5, hi=4.0)])
+raw_case("raw-gather-pad", lambda x, i: tf.raw_ops.Pad(
+    input=tf.raw_ops.Gather(params=x, indices=i),
+    paddings=tf.constant([[0, 1], [1, 0]])), [F(5, 3), I(4, hi=5)])
+
+
+@pytest.mark.parametrize("builder,args", RAW_CASES)
+def test_conformance_raw_v1(builder, args):
+    from deeplearning4j_tpu.modelimport.graph_runner import GraphRunner
+
+    g = tf.compat.v1.Graph()
+    with g.as_default():
+        phs = [tf.compat.v1.placeholder(
+            tf.as_dtype(a.dtype), a.shape, name=f"in{k}")
+            for k, a in enumerate(args)]
+        out = builder(*phs)
+        out = tf.identity(out, name="out")
+    gd = g.as_graph_def()
+    SWEPT_OPS.update(n.op for n in gd.node)
+    in_names = [f"in{k}" for k in range(len(args))]
+    runner = GraphRunner(gd, input_names=in_names, output_names=["out"])
+    golden = runner.run({n: a for n, a in zip(in_names, args)})["out"]
+    sd, vars_ = TFImporter.import_graph_def(gd, ["out"])
+    out_var = vars_["out"]           # Identity aliases its producer,
+    res = sd.output({n: a for n, a in zip(in_names, args)},
+                    [out_var])       # so key results by .name
+    np.testing.assert_allclose(res[out_var.name], golden,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sweep_size_and_coverage_report():
+    """The sweep must stay ≥300 cases and cover every mapped op family.
+
+    Structural/source ops that freezing itself emits (Const,
+    Placeholder, Identity...) are exempt; everything else in _MAPPERS
+    must appear in at least one swept graph.
+    """
+    assert len(CASES) >= 300, f"sweep shrank to {len(CASES)} cases"
+    if not SWEPT_OPS:
+        pytest.skip("conformance cases did not run in this session")
+    exempt = {
+        "Const", "Placeholder", "PlaceholderWithDefault", "Identity",
+        "StopGradient", "PreventGradient", "Snapshot", "CheckNumerics",
+        # aliases TF2 tracing never emits (exercised via raw v1 cases
+        # where constructible, kept for TF1 graphs otherwise)
+        "BatchMatMul", "FusedBatchNorm", "FusedBatchNormV2",
+    }
+    mapped = set(_MAPPERS) - exempt
+    unswept = sorted(mapped - SWEPT_OPS)
+    assert not unswept, (
+        f"mapped TF ops never exercised by the sweep: {unswept}")
+
+
+def test_dynamic_batch_shape_driven_reshape():
+    """Frozen graphs traced with a None batch dim keep real Shape nodes
+    (TF cannot fold them); the importer must resolve the
+    Shape→StridedSlice→Pack→Reshape chain symbolically at trace time."""
+    x = F(4, 5, 6)
+
+    def fn(t):
+        s = tf.shape(t)
+        flat = tf.reshape(t, [s[0], -1])
+        return tf.nn.softmax(flat)
+
+    gd, in_names, out_names = _freeze(
+        fn, tf.TensorSpec((None, 5, 6), tf.float32))
+    assert "Shape" in {n.op for n in gd.node}   # really dynamic
+    SWEPT_OPS.update(n.op for n in gd.node)
+    golden = fn(tf.constant(x)).numpy()
+    sd, vars_ = TFImporter.import_graph_def(gd, out_names)
+    out = vars_[out_names[0]]
+    res = sd.output({in_names[0]: x}, [out])
+    np.testing.assert_allclose(res[out.name], golden,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_batch_concat_shape_target():
+    """Shape-vector built by ConcatV2([batch_slice, const_tail])."""
+    x = F(3, 4, 5)
+
+    def fn(t):
+        tail = tf.constant([20], tf.int32)
+        tgt = tf.concat([tf.shape(t)[:1], tail], 0)
+        return tf.reshape(t, tgt) * 2.0
+
+    gd, in_names, out_names = _freeze(
+        fn, tf.TensorSpec((None, 4, 5), tf.float32))
+    SWEPT_OPS.update(n.op for n in gd.node)
+    golden = fn(tf.constant(x)).numpy()
+    sd, vars_ = TFImporter.import_graph_def(gd, out_names)
+    out = vars_[out_names[0]]
+    res = sd.output({in_names[0]: x}, [out])
+    np.testing.assert_allclose(res[out.name], golden,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_dynamic_batch_import_serializes():
+    """reshape_sym keeps dynamic-batch imports JSON-serializable (no
+    python closures in the graph): save → load → same outputs."""
+    import os
+    import tempfile
+
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+    x = F(4, 5, 6)
+
+    def fn(t):
+        s = tf.shape(t)
+        return tf.nn.softmax(tf.reshape(t, [s[0], -1]))
+
+    gd, in_names, out_names = _freeze(
+        fn, tf.TensorSpec((None, 5, 6), tf.float32))
+    golden = fn(tf.constant(x)).numpy()
+    sd, vars_ = TFImporter.import_graph_def(gd, out_names)
+    out = vars_[out_names[0]]
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "g.zip")
+        sd.save(p)
+        sd2 = SameDiff.load(p)
+        res = sd2.output({in_names[0]: x}, [sd2.get_variable(out.name)])
+    np.testing.assert_allclose(res[out.name], golden,
+                               rtol=1e-4, atol=1e-5)

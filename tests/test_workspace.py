@@ -152,3 +152,18 @@ def test_nested_reentry_of_same_workspace():
         assert ws.total_allocations == 2
     assert not ws.is_scope_active()
     assert ws.generation == 1         # one real enter/leave cycle
+
+
+def test_nested_get_and_activate_pairs():
+    """Regression (r2 review): two stacked get_and_activate/
+    notify_scope_left pairs must nest — the inner close may not pop the
+    outer activation's scope."""
+    mgr = get_workspace_manager()
+    outer = mgr.get_and_activate_workspace("WS_NEST2")
+    inner = mgr.get_and_activate_workspace("WS_NEST2")
+    assert inner is outer
+    inner.notify_scope_left()
+    assert outer.is_scope_active()
+    outer.notify_scope_left()
+    assert not outer.is_scope_active()
+    mgr.destroy_workspace("WS_NEST2")

@@ -67,9 +67,13 @@ def _jnp_fallback(*xs) -> bool:
 # ---------------------------------------------------------------------------
 # flash attention
 # ---------------------------------------------------------------------------
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc, m, l, *,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                   scale: float, causal: bool, t_real: int,
                   block_q: int, block_k: int):
+    # rest = (lse_ref?, acc, m, l): the lse output only exists on the
+    # differentiated path (inference pays no extra HBM writes)
+    lse_ref = rest[0] if len(rest) == 4 else None
+    acc, m, l = rest[-3:]
     j = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -124,20 +128,34 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc, m, l, *,
     def _():
         den = jnp.maximum(l[:, :1], 1e-30)
         o_ref[0] = (acc[:] / den).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # per-row logsumexp residual for the backward kernels
+            # (FlashAttention-2: p = exp(s - lse) recomputed per
+            # block); -inf for rows with no live keys
+            lse_ref[0] = jnp.broadcast_to(m[:, :1] + jnp.log(den),
+                                          lse_ref.shape[1:])
 
 
-def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
-    """q,k,v: [BH, T, D] (heads folded). Returns [BH, T, D]."""
-    if _jnp_fallback(q, k, v):
-        return _reference_scan(q, k, v, causal)
-    bh, t, d = q.shape
-    scale = 1.0 / (d ** 0.5)
+def _flash_blocks(t: int, d: int, block_q: int, block_k: int):
     t128 = -(-t // 128) * 128
     block_q = min(block_q, t128)              # don't block past the data
     block_k = min(block_k, t128)
     tq = -(-t // block_q) * block_q           # q and kv padded separately
     tk = -(-t // block_k) * block_k           # (≤ one partial block each)
     dp = max(-(-d // 128) * 128, 128)         # lane-align head dim
+    return block_q, block_k, tq, tk, dp
+
+
+def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
+               return_lse: bool = False):
+    """q,k,v: [BH, T, D] (heads folded). Returns [BH, T, D] (and, for
+    the vjp, the padded per-row [BH, Tq, 1] logsumexp)."""
+    if _jnp_fallback(q, k, v):
+        out = _reference_scan(q, k, v, causal)
+        return (out, None) if return_lse else out
+    bh, t, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    block_q, block_k, tq, tk, dp = _flash_blocks(t, d, block_q, block_k)
 
     def pad(x, tpad):
         return jnp.pad(x, ((0, 0), (0, tpad - t), (0, dp - d)))
@@ -147,19 +165,21 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
     kp = _align_vma(pad(k, tk), vma)
     vp = _align_vma(pad(v, tk), vma)
     nq, nk = tq // block_q, tk // block_k
-    out = pl.pallas_call(
+    oshape = jax.ShapeDtypeStruct((bh, tq, dp), q.dtype, vma=vma)
+    ospec = pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, i, 0))
+    lshape = jax.ShapeDtypeStruct((bh, tq, 128), jnp.float32, vma=vma)
+    lspec = pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
+    res = pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale, causal=causal,
                           t_real=t, block_q=block_q, block_k=block_k),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, dp), q.dtype,
-                                       vma=vma),
+        out_shape=(oshape, lshape) if return_lse else oshape,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, dp), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, dp), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dp),
-                               lambda b, i, j: (b, i, 0)),
+        out_specs=(ospec, lspec) if return_lse else ospec,
         scratch_shapes=[
             pltpu.VMEM((block_q, dp), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
@@ -167,7 +187,12 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int):
         ],
         interpret=_interpret(),
     )(qp, kp, vp)
-    return out[:, :t, :d]
+    if return_lse:
+        out, lse = res
+        # keep one lane per row as the residual (128x smaller);
+        # _flash_bwd re-broadcasts before its kernels
+        return out[:, :t, :d], lse[:, :, :1]
+    return res[:, :t, :d]
 
 
 def _reference_scan(q, k, v, causal: bool, block: int = 512):
@@ -209,21 +234,164 @@ def _reference_scan(q, k, v, causal: bool, block: int = 512):
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 
 
+def _flash_bwd_masks(i, j, t_real, block_q, block_k, causal):
+    """(q,kv) validity mask for one [block_q, block_k] tile."""
+    q_idx = i * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kv_idx = j * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = jnp.logical_and(q_idx < t_real, kv_idx < t_real)
+    if causal:
+        mask = jnp.logical_and(mask, kv_idx <= q_idx)
+    return mask
+
+
+def _flash_bwd_p_ds(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, mask,
+                    scale):
+    """Recompute the probability tile and dS for the backward pass
+    (FlashAttention-2 eq. dS = P ∘ (dP − Δ), Δ = rowsum(dO ∘ O))."""
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    lse = lse_ref[0][:, :1]
+    lse = jnp.where(jnp.isfinite(lse), lse, 0.0)
+    p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+    delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1,
+                    keepdims=True)
+    dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    return q, k, do, p, ds
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                         dq_ref, acc, *, scale, causal, t_real,
+                         block_q, block_k):
+    i, j = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc[:])
+
+    live = j * block_k < t_real
+    if causal:
+        live = jnp.logical_and(
+            live, j * block_k <= i * block_q + block_q - 1)
+
+    @pl.when(live)
+    def _():
+        mask = _flash_bwd_masks(i, j, t_real, block_q, block_k, causal)
+        _, k, _, _, ds = _flash_bwd_p_ds(
+            q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, mask, scale)
+        acc[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[0] = acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                          dk_ref, dv_ref, acck, accv, *, scale, causal,
+                          t_real, block_q, block_k):
+    j, i = pl.program_id(1), pl.program_id(2)   # kv outer, q inner
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _():
+        acck[:] = jnp.zeros_like(acck[:])
+        accv[:] = jnp.zeros_like(accv[:])
+
+    live = i * block_q < t_real
+    if causal:
+        live = jnp.logical_and(
+            live, i * block_q + block_q - 1 >= j * block_k)
+
+    @pl.when(live)
+    def _():
+        mask = _flash_bwd_masks(i, j, t_real, block_q, block_k, causal)
+        q, _, do, p, ds = _flash_bwd_p_ds(
+            q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, mask, scale)
+        accv[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        acck[:] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = acck[:].astype(dk_ref.dtype)
+        dv_ref[0] = accv[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k):
+    bh, t, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    block_q, block_k, tq, tk, dp = _flash_blocks(t, d, block_q, block_k)
+
+    def pad(x, tpad):
+        return jnp.pad(x, ((0, 0), (0, tpad - t), (0, dp - d)))
+
+    vma = _vma(q, k, v, g)
+    qp = _align_vma(pad(q, tq), vma)
+    kp = _align_vma(pad(k, tk), vma)
+    vp = _align_vma(pad(v, tk), vma)
+    dop = _align_vma(pad(g, tq), vma)
+    op = _align_vma(pad(out, tq), vma)
+    # residual is [BH, Tq, 1]; kernels read a full 128-lane block
+    lsep = _align_vma(jnp.broadcast_to(lse, (bh, tq, 128)), vma)
+    nq, nk = tq // block_q, tk // block_k
+    kw = dict(scale=scale, causal=causal, t_real=t,
+              block_q=block_q, block_k=block_k)
+    qspec = pl.BlockSpec((1, block_q, dp), lambda b, x, y: (b, x, 0))
+    lspec = pl.BlockSpec((1, block_q, 128), lambda b, x, y: (b, x, 0))
+    kspec = pl.BlockSpec((1, block_k, dp), lambda b, x, y: (b, y, 0))
+    # grid (bh, i, j): q-side blocks follow grid axis 1, kv axis 2
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **kw),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, dp), q.dtype, vma=vma),
+        grid=(bh, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, qspec, lspec],
+        out_specs=qspec,
+        scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, op, lsep)
+    # grid (bh, j, i): kv-side blocks follow grid axis 1, q axis 2
+    qspec2 = pl.BlockSpec((1, block_q, dp), lambda b, y, x: (b, x, 0))
+    lspec2 = pl.BlockSpec((1, block_q, 128), lambda b, y, x: (b, x, 0))
+    kspec2 = pl.BlockSpec((1, block_k, dp), lambda b, y, x: (b, y, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **kw),
+        out_shape=(jax.ShapeDtypeStruct((bh, tk, dp), k.dtype, vma=vma),
+                   jax.ShapeDtypeStruct((bh, tk, dp), v.dtype,
+                                        vma=vma)),
+        grid=(bh, nk, nq),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, qspec2, lspec2],
+        out_specs=(kspec2, kspec2),
+        scratch_shapes=[pltpu.VMEM((block_k, dp), jnp.float32),
+                        pltpu.VMEM((block_k, dp), jnp.float32)],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, op, lsep)
+    return dq[:, :t, :d], dk[:, :t, :d], dv[:, :t, :d]
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash(q, k, v, causal, block_q, block_k):
     return _flash_fwd(q, k, v, causal, block_q, block_k)
 
 
 def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
-    return _flash_fwd(q, k, v, causal, block_q, block_k), (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k,
+                          return_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, res, g):
-    q, k, v = res
-    # recompute-based backward through the O(T)-memory scan reference
-    _, vjp = jax.vjp(lambda a, b, c: _reference_scan(a, b, c, causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    if lse is None:
+        # shard_map-on-CPU fallback: recompute through the scan path
+        _, vjp = jax.vjp(
+            lambda a, b, c: _reference_scan(a, b, c, causal), q, k, v)
+        return vjp(g)
+    return _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -232,7 +400,10 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 def flash_attention(q, k, v, causal: bool = False,
                     block_q: int = 256, block_k: int = 1024):
     """Blockwise attention, [B, T, H, D] layout (head axis 2) like
-    ``scaled_dot_attention``. Differentiable (recompute backward)."""
+    ``scaled_dot_attention``. Differentiable: the backward is a pair of
+    Pallas kernels (dQ; dK/dV) that recompute the probability tile per
+    block from the saved logsumexp — FlashAttention-2 style, no [T,T]
+    materialisation in either direction."""
     b, t, h, d = q.shape
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, -1)
     o = _flash(fold(q), fold(k), fold(v), causal, block_q, block_k)

@@ -1,8 +1,14 @@
 """Model zoo — reference: ``deeplearning4j-zoo``
 (``org.deeplearning4j.zoo.model.*``: LeNet, AlexNet, VGG16/19, ResNet50,
 SqueezeNet, InceptionResNetV1, Darknet19, TinyYOLO/YOLO2, UNet,
-Xception, NASNet, SimpleCNN, TextGenerationLSTM). Pretrained-weight
-download is not reproducible here (no egress); architectures + init are.
+Xception, NASNet, SimpleCNN, TextGenerationLSTM).
+
+Pretrained weights: every architecture derives from ``ZooModel``
+whose ``init_pretrained(dataset)`` restores checksum-verified weights
+from a local repository (``zoo.pretrained`` — the DL4JResources
+analog; HTTP download is refused since this environment has no
+egress, but the export/manifest/verify/restore contract is identical
+and tiny goldens ship under ``resources/pretrained``).
 """
 from deeplearning4j_tpu.zoo.lenet import LeNet
 from deeplearning4j_tpu.zoo.alexnet import AlexNet
@@ -20,9 +26,14 @@ from deeplearning4j_tpu.zoo.simple_cnn import SimpleCNN
 from deeplearning4j_tpu.zoo.textgen_lstm import TextGenerationLSTM
 from deeplearning4j_tpu.zoo.bert import Bert, BertBase, BertTiny
 from deeplearning4j_tpu.zoo.facenet import FaceNetNN4Small2
+from deeplearning4j_tpu.zoo.pretrained import (DL4JResources, ZooModel,
+                                               export_pretrained,
+                                               fetch_pretrained)
 
 __all__ = ["LeNet", "AlexNet", "VGG16", "VGG19", "ResNet50",
            "SqueezeNet", "Darknet19", "TinyYOLO", "YOLO2", "UNet",
            "Xception", "InceptionResNetV1", "NASNet", "SimpleCNN",
            "TextGenerationLSTM", "TINY_YOLO_ANCHORS", "YOLO2_ANCHORS",
-           "Bert", "BertBase", "BertTiny", "FaceNetNN4Small2"]
+           "Bert", "BertBase", "BertTiny", "FaceNetNN4Small2",
+           "ZooModel", "DL4JResources", "export_pretrained",
+           "fetch_pretrained"]

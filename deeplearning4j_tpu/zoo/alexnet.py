@@ -6,6 +6,7 @@ though BatchNormalization is the modern substitute.
 """
 from __future__ import annotations
 
+from deeplearning4j_tpu.zoo.pretrained import ZooModel
 from deeplearning4j_tpu.nn.config import (InputType,
                                           NeuralNetConfiguration)
 from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DenseLayer,
@@ -15,7 +16,7 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn import updaters as upd
 
 
-class AlexNet:
+class AlexNet(ZooModel):
     def __init__(self, num_classes: int = 1000, seed: int = 123,
                  updater=None, input_shape=(224, 224, 3)):
         self.num_classes = num_classes

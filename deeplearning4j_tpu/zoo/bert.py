@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+from deeplearning4j_tpu.zoo.pretrained import ZooModel
 from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
 from deeplearning4j_tpu.nn.graph import ComputationGraph
 from deeplearning4j_tpu.nn.layers import (ClsTokenPoolLayer, DropoutLayer,
@@ -30,7 +31,7 @@ from deeplearning4j_tpu.nn.vertices import ElementWiseVertex
 from deeplearning4j_tpu.nn import updaters as upd
 
 
-class Bert:
+class Bert(ZooModel):
     """Configurable BERT encoder. ``BertBase()`` / ``BertTiny()`` give
     the standard sizes."""
 

@@ -6,6 +6,7 @@ append the ``Yolo2OutputLayer`` detection head (anchors in grid units).
 """
 from __future__ import annotations
 
+from deeplearning4j_tpu.zoo.pretrained import ZooModel
 from deeplearning4j_tpu.nn.config import (InputType,
                                           NeuralNetConfiguration)
 from deeplearning4j_tpu.nn.layers import (BatchNormalization,
@@ -58,7 +59,7 @@ def _darknet19_backbone(b):
     return b
 
 
-class Darknet19:
+class Darknet19(ZooModel):
     """Classification backbone (ImageNet head)."""
 
     def __init__(self, num_classes: int = 1000, seed: int = 123,
@@ -87,7 +88,7 @@ class Darknet19:
         return MultiLayerNetwork(self.conf()).init()
 
 
-class TinyYOLO:
+class TinyYOLO(ZooModel):
     """Tiny YOLOv2 VOC detector (reference TinyYOLO zoo model)."""
 
     def __init__(self, num_classes: int = 20, seed: int = 123,
@@ -124,7 +125,7 @@ class TinyYOLO:
         return MultiLayerNetwork(self.conf()).init()
 
 
-class YOLO2:
+class YOLO2(ZooModel):
     """Full YOLOv2 detector: Darknet19 backbone + detection head.
 
     Reference YOLO2 zoo model (the passthrough/reorg skip of the paper

@@ -9,6 +9,7 @@ avgpool → 128-d bottleneck → L2-normalize → CenterLossOutputLayer
 """
 from __future__ import annotations
 
+from deeplearning4j_tpu.zoo.pretrained import ZooModel
 from deeplearning4j_tpu.nn.config import (InputType,
                                           NeuralNetConfiguration)
 from deeplearning4j_tpu.nn.graph import ComputationGraph
@@ -21,7 +22,7 @@ from deeplearning4j_tpu.nn.vertices import L2NormalizeVertex, MergeVertex
 from deeplearning4j_tpu.nn import updaters as upd
 
 
-class FaceNetNN4Small2:
+class FaceNetNN4Small2(ZooModel):
     def __init__(self, num_classes: int = 5749, seed: int = 123,
                  updater=None, input_shape=(96, 96, 3),
                  embedding_size: int = 128, lambda_center: float = 0.003):

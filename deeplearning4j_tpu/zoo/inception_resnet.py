@@ -8,6 +8,7 @@ ComputationGraph; residual branches concat then 1×1-project then add
 """
 from __future__ import annotations
 
+from deeplearning4j_tpu.zoo.pretrained import ZooModel
 from deeplearning4j_tpu.nn.config import (InputType,
                                           NeuralNetConfiguration)
 from deeplearning4j_tpu.nn.graph import ComputationGraph
@@ -22,7 +23,7 @@ from deeplearning4j_tpu.nn.vertices import (ElementWiseVertex, MergeVertex,
 from deeplearning4j_tpu.nn import updaters as upd
 
 
-class InceptionResNetV1:
+class InceptionResNetV1(ZooModel):
     def __init__(self, num_classes: int = 1000, seed: int = 123,
                  updater=None, input_shape=(160, 160, 3),
                  embedding_size: int = 128,

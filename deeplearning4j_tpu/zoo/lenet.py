@@ -6,6 +6,7 @@ softmax(10) on 28×28×1, per the dl4j-examples LeNetMnistExample.
 """
 from __future__ import annotations
 
+from deeplearning4j_tpu.zoo.pretrained import ZooModel
 from deeplearning4j_tpu.nn.config import (InputType,
                                           NeuralNetConfiguration)
 from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DenseLayer,
@@ -14,7 +15,7 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn import updaters as upd
 
 
-class LeNet:
+class LeNet(ZooModel):
     def __init__(self, num_classes: int = 10, seed: int = 123,
                  updater=None, input_shape=(28, 28, 1)):
         self.num_classes = num_classes

@@ -9,6 +9,7 @@ post-adjustment so shapes line up.
 """
 from __future__ import annotations
 
+from deeplearning4j_tpu.zoo.pretrained import ZooModel
 from deeplearning4j_tpu.nn.config import (InputType,
                                           NeuralNetConfiguration)
 from deeplearning4j_tpu.nn.graph import ComputationGraph
@@ -22,7 +23,7 @@ from deeplearning4j_tpu.nn.vertices import ElementWiseVertex, MergeVertex
 from deeplearning4j_tpu.nn import updaters as upd
 
 
-class NASNet:
+class NASNet(ZooModel):
     def __init__(self, num_classes: int = 1000, seed: int = 123,
                  updater=None, input_shape=(224, 224, 3),
                  penultimate_filters: int = 1056, n_cells: int = 4):

@@ -7,6 +7,7 @@ ONE jitted train step (vs the reference's per-layer cuDNN calls).
 """
 from __future__ import annotations
 
+from deeplearning4j_tpu.zoo.pretrained import ZooModel
 from deeplearning4j_tpu.nn.config import (InputType,
                                           NeuralNetConfiguration)
 from deeplearning4j_tpu.nn.graph import ComputationGraph
@@ -19,7 +20,7 @@ from deeplearning4j_tpu.nn.vertices import ElementWiseVertex
 from deeplearning4j_tpu.nn import updaters as upd
 
 
-class ResNet50:
+class ResNet50(ZooModel):
     def __init__(self, num_classes: int = 1000, seed: int = 123,
                  input_shape=(224, 224, 3), updater=None,
                  compute_dtype=None):

@@ -1,6 +1,7 @@
 """SimpleCNN — reference: ``org.deeplearning4j.zoo.model.SimpleCNN``."""
 from __future__ import annotations
 
+from deeplearning4j_tpu.zoo.pretrained import ZooModel
 from deeplearning4j_tpu.nn.config import (InputType,
                                           NeuralNetConfiguration)
 from deeplearning4j_tpu.nn.layers import (BatchNormalization,
@@ -10,7 +11,7 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn import updaters as upd
 
 
-class SimpleCNN:
+class SimpleCNN(ZooModel):
     def __init__(self, num_classes: int = 10, seed: int = 123,
                  input_shape=(48, 48, 3)):
         self.num_classes = num_classes

@@ -3,6 +3,7 @@
 BASELINE.json config #3 (cuDNN RNN helper path → here lax.scan LSTM)."""
 from __future__ import annotations
 
+from deeplearning4j_tpu.zoo.pretrained import ZooModel
 from deeplearning4j_tpu.nn.config import (InputType,
                                           NeuralNetConfiguration)
 from deeplearning4j_tpu.nn.layers import GravesLSTM, RnnOutputLayer
@@ -10,7 +11,7 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn import updaters as upd
 
 
-class TextGenerationLSTM:
+class TextGenerationLSTM(ZooModel):
     def __init__(self, vocab_size: int = 77, hidden: int = 256,
                  layers: int = 2, seed: int = 123, tbptt: int = 50):
         self.vocab_size = vocab_size

@@ -7,6 +7,7 @@ Output: per-pixel sigmoid (binary mask), xent loss.
 """
 from __future__ import annotations
 
+from deeplearning4j_tpu.zoo.pretrained import ZooModel
 from deeplearning4j_tpu.nn.config import (InputType,
                                           NeuralNetConfiguration)
 from deeplearning4j_tpu.nn.graph import ComputationGraph
@@ -17,7 +18,7 @@ from deeplearning4j_tpu.nn.vertices import MergeVertex
 from deeplearning4j_tpu.nn import updaters as upd
 
 
-class UNet:
+class UNet(ZooModel):
     def __init__(self, n_channels_out: int = 1, seed: int = 123,
                  updater=None, input_shape=(128, 128, 3),
                  base_filters: int = 64, depth: int = 4):

@@ -6,6 +6,7 @@ stack is bf16-ready. All 3×3 SAME convs → MXU-shaped matmuls under XLA.
 """
 from __future__ import annotations
 
+from deeplearning4j_tpu.zoo.pretrained import ZooModel
 from deeplearning4j_tpu.nn.config import (InputType,
                                           NeuralNetConfiguration)
 from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DenseLayer,
@@ -17,7 +18,7 @@ _VGG16_BLOCKS = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
 _VGG19_BLOCKS = [(2, 64), (2, 128), (4, 256), (4, 512), (4, 512)]
 
 
-class _VGG:
+class _VGG(ZooModel):
     _blocks = _VGG16_BLOCKS
 
     def __init__(self, num_classes: int = 1000, seed: int = 123,

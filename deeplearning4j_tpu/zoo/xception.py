@@ -6,6 +6,7 @@ flow. ComputationGraph with strided 1×1 conv shortcuts.
 """
 from __future__ import annotations
 
+from deeplearning4j_tpu.zoo.pretrained import ZooModel
 from deeplearning4j_tpu.nn.config import (InputType,
                                           NeuralNetConfiguration)
 from deeplearning4j_tpu.nn.graph import ComputationGraph
@@ -20,7 +21,7 @@ from deeplearning4j_tpu.nn.vertices import ElementWiseVertex
 from deeplearning4j_tpu.nn import updaters as upd
 
 
-class Xception:
+class Xception(ZooModel):
     def __init__(self, num_classes: int = 1000, seed: int = 123,
                  updater=None, input_shape=(299, 299, 3),
                  middle_blocks: int = 8):

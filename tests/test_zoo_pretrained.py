@@ -1,0 +1,81 @@
+"""Pretrained-zoo machinery (reference ZooModel.initPretrained +
+DL4JResources checksum gate): checked-in goldens restore and reproduce
+their minting forward pass; corruption and absence fail loudly."""
+import json
+import shutil
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.zoo import (LeNet, SimpleCNN,
+                                    TextGenerationLSTM)
+from deeplearning4j_tpu.zoo.pretrained import (DL4JResources,
+                                               export_pretrained,
+                                               fetch_pretrained)
+
+GOLDENS = Path(__file__).resolve().parents[1] / "resources" / \
+    "pretrained"
+
+
+@pytest.mark.parametrize("cls", [LeNet, SimpleCNN, TextGenerationLSTM])
+def test_init_pretrained_matches_golden_forward(cls):
+    """load-pretrained → forward == the outputs captured at minting.
+    base_dir pinned to the checked-in goldens so an ambient
+    DL4J_TPU_RESOURCES cannot redirect the test."""
+    net = cls.init_pretrained(base_dir=GOLDENS)
+    io = np.load(GOLDENS / cls.model_name() / "default_golden_io.npz")
+    got = np.asarray(net.output(io["x"]))
+    np.testing.assert_allclose(got, io["y"], rtol=1e-5, atol=1e-6)
+
+
+def test_pretrained_available():
+    assert LeNet.pretrained_available(base_dir=GOLDENS)
+    assert not LeNet.pretrained_available("imagenet", base_dir=GOLDENS)
+
+
+def test_checksum_gate_rejects_corruption(tmp_path):
+    src = GOLDENS / "TextGenerationLSTM"
+    dst = tmp_path / "TextGenerationLSTM"
+    shutil.copytree(src, dst)
+    art = dst / "default.zip"
+    blob = bytearray(art.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    art.write_bytes(bytes(blob))
+    with pytest.raises(IOError, match="checksum mismatch"):
+        fetch_pretrained("TextGenerationLSTM", "default", tmp_path)
+
+
+def test_missing_weights_error_names_alternatives(tmp_path):
+    with pytest.raises(FileNotFoundError, match="no pretrained"):
+        fetch_pretrained("LeNet", "default", tmp_path)
+
+
+def test_export_then_init_pretrained_roundtrip(tmp_path):
+    """Publishing side: export into a fresh repository, point the
+    resolver at it, restore, compare outputs."""
+    rng = np.random.default_rng(3)
+    net = LeNet(num_classes=10, seed=5, input_shape=(14, 14, 1)).init()
+    x = rng.normal(size=(2, 14, 14, 1)).astype(np.float32)
+    want = np.asarray(net.output(x))
+    export_pretrained(net, "LeNet", "mytask", tmp_path)
+    manifest = json.loads(
+        (tmp_path / "LeNet" / "manifest.json").read_text())
+    assert manifest["mytask"]["format"] == "multilayer"
+    DL4JResources.set_base_directory(str(tmp_path))
+    try:
+        net2 = LeNet.init_pretrained("mytask")
+    finally:
+        DL4JResources.set_base_directory(None)
+    np.testing.assert_allclose(np.asarray(net2.output(x)), want,
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_http_refused():
+    with pytest.raises(RuntimeError, match="no network egress"):
+        DL4JResources.resolve("https://dl4jdata.example/model.zip")
+
+
+def test_file_url_resolves(tmp_path):
+    p = DL4JResources.resolve(f"file://{tmp_path}/x.zip")
+    assert p == Path(f"{tmp_path}/x.zip")

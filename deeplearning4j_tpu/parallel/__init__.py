@@ -12,7 +12,8 @@ formation for multi-host.
 from deeplearning4j_tpu.parallel.mesh import (make_mesh, data_parallel_mesh,
                                               initialize_distributed)
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
-from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.inference import (ParallelInference,
+                                                   shard_model_params)
 from deeplearning4j_tpu.parallel.compression import (
     EncodedGradientsAccumulator, encode_threshold, decode_threshold,
     encode_bitmap, decode_bitmap, AdaptiveThresholdAlgorithm,
@@ -30,7 +31,7 @@ __all__ = [
     "MixtureOfExperts", "pipeline_apply", "pipeline_train_step",
     "make_mlp_stage",
     "make_mesh", "data_parallel_mesh", "initialize_distributed",
-    "ParallelWrapper", "ParallelInference",
+    "ParallelWrapper", "ParallelInference", "shard_model_params",
     "EncodedGradientsAccumulator", "encode_threshold", "decode_threshold",
     "encode_bitmap", "decode_bitmap", "AdaptiveThresholdAlgorithm",
     "ParameterAveragingTrainingMaster", "SharedTrainingMaster",

@@ -109,30 +109,34 @@ class EncodedGradientsAccumulator:
             "tau": self.algo.init_state(),
         }
 
-    def exchange(self, grads, state, axis_name: str = "data"):
-        """Inside shard_map/pmap: returns (averaged decoded grads,
-        new state)."""
+    def _encode_leaves(self, grads, state):
+        """Shared per-leaf encode loop: threshold-encode each gradient
+        leaf against its residual, clip the residual
+        (ResidualClippingPostProcessor: ±k·τ), and account the encoded
+        fraction for τ adaptation.  Returns
+        ``(treedef, signs, residuals, nnz, total)``."""
         tau = state["tau"]
-
-        def enc(g, r):
-            g = g + r
-            sign, res = encode_threshold(g, tau)
-            # ResidualClippingPostProcessor: clip residual at k·τ
-            res = jnp.clip(res, -self.residual_clip * tau,
-                           self.residual_clip * tau)
-            return sign, res
-
         flat, treedef = jax.tree.flatten(grads)
         rflat = jax.tree.leaves(state["residual"])
         signs, residuals = [], []
         total = 0.0
         nnz = 0.0
         for g, r in zip(flat, rflat):
-            s, res = enc(g, r)
-            signs.append(s)
+            sign, res = encode_threshold(g + r, tau)
+            res = jnp.clip(res, -self.residual_clip * tau,
+                           self.residual_clip * tau)
+            signs.append(sign)
             residuals.append(res)
             total += float(np.prod(g.shape))
-            nnz = nnz + jnp.sum(jnp.abs(s).astype(jnp.float32))
+            nnz = nnz + jnp.sum(jnp.abs(sign).astype(jnp.float32))
+        return treedef, signs, residuals, nnz, total
+
+    def exchange(self, grads, state, axis_name: str = "data"):
+        """Inside shard_map/pmap: returns (averaged decoded grads,
+        new state)."""
+        tau = state["tau"]
+        treedef, signs, residuals, nnz, total = \
+            self._encode_leaves(grads, state)
         n_dev = jax.lax.psum(1, axis_name)
         decoded = [
             jax.lax.psum(decode_threshold(s, tau), axis_name) / n_dev
@@ -145,6 +149,43 @@ class EncodedGradientsAccumulator:
         }
         return jax.tree.unflatten(treedef, decoded), new_state
 
+
+    def init_async_state(self, params):
+        """State for ``exchange_async``: residuals + the in-flight
+        decoded update each replica has broadcast but peers have not
+        yet applied (one-step staleness)."""
+        return {
+            "residual": jax.tree.map(jnp.zeros_like, params),
+            "inflight": jax.tree.map(jnp.zeros_like, params),
+            "tau": self.algo.init_state(),
+        }
+
+    def exchange_async(self, grads, state, axis_name: str = "data"):
+        """Async-flavor exchange (reference ``SharedTrainingMaster``'s
+        asynchronous gradient passing, SURVEY §2.5 "YES (async
+        flavor)"): each replica encodes its gradients against its local
+        residual and applies its OWN decoded update immediately, but
+        peer updates arrive with a staleness of one step — this step's
+        psum delivers the messages encoded during the *previous* step
+        (the ``inflight`` state), exactly like the reference's
+        IndexedTail queues where workers drain whatever peers published
+        earlier.  Per-replica parameters therefore drift within a
+        τ-bounded envelope between steps, as in the reference."""
+        tau = state["tau"]
+        treedef, signs, residuals, nnz, total = \
+            self._encode_leaves(grads, state)
+        inflight = jax.tree.leaves(state["inflight"])
+        own = [decode_threshold(s, tau) for s in signs]
+        n_dev = jax.lax.psum(1, axis_name)
+        combined = [
+            (o + jax.lax.psum(f, axis_name) - f) / n_dev
+            for o, f in zip(own, inflight)]
+        new_state = {
+            "residual": jax.tree.unflatten(treedef, residuals),
+            "inflight": jax.tree.unflatten(treedef, own),
+            "tau": self.algo.update(tau, nnz / total),
+        }
+        return jax.tree.unflatten(treedef, combined), new_state
 
     def exchange_packed(self, grads, state, axis_name: str = "data"):
         """Compressed-wire variant: encode with the fused Pallas kernel

@@ -9,6 +9,14 @@ TPU-native: one jitted forward per bucketed batch size (padding to the
 bucket avoids retrace storms), a single dispatch queue (the TPU runs
 async; replica-per-device fan-out is replaced by batch-axis sharding
 when a mesh is given).
+
+Model-parallel serving (SURVEY §2.5 "shard large models with pjit"):
+``shard_model_params`` lays each weight out over a mesh ``model`` axis
+with per-leaf ``NamedSharding`` specs, so a network whose parameters
+exceed one chip's HBM serves across the mesh — XLA propagates the
+input shardings through the jitted forward and inserts the collectives
+over ICI.  ``ParallelInference(mesh=..., shard_params=True)`` turns it
+on for the serving queue.
 """
 from __future__ import annotations
 
@@ -19,6 +27,42 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def shard_model_params(net, mesh, axis: str = "model"):
+    """Shard a network's parameters over ``mesh[axis]`` for serving.
+
+    Placement policy: every weight with ndim ≥ 2 is sharded along its
+    largest dimension divisible by the axis size (column-sharding
+    dense [in, out] weights, output-channel-sharding conv kernels);
+    biases/scalars and indivisible leaves replicate.  Mutable state
+    (BN statistics) replicates.  Returns ``net`` with its params
+    re-placed; per-device parameter bytes drop to ~1/len(axis).
+    """
+    n = mesh.shape[axis]
+
+    def spec_for(leaf) -> P:
+        if leaf.ndim < 2:
+            return P()
+        for i in sorted(range(leaf.ndim), key=lambda i: -leaf.shape[i]):
+            if leaf.shape[i] % n == 0 and leaf.shape[i] >= n:
+                parts = [None] * leaf.ndim
+                parts[i] = axis
+                return P(*parts)
+        return P()
+
+    def place(leaf):
+        leaf = jnp.asarray(leaf)
+        return jax.device_put(leaf, NamedSharding(mesh, spec_for(leaf)))
+
+    def replicate(leaf):
+        return jax.device_put(jnp.asarray(leaf),
+                              NamedSharding(mesh, P()))
+
+    net.params = jax.tree_util.tree_map(place, net.params)
+    net.state = jax.tree_util.tree_map(replicate, net.state)
+    return net
 
 
 class _Observable:
@@ -52,12 +96,18 @@ class ParallelInference:
 
     def __init__(self, net, mode: str = BATCHED, batch_limit: int = 32,
                  queue_limit: int = 64, buckets=(1, 2, 4, 8, 16, 32),
-                 mesh=None):
+                 mesh=None, shard_params: bool = False,
+                 model_axis: str = "model"):
         self.net = net
         self.mode = mode
         self.batch_limit = batch_limit
         self.buckets = tuple(sorted(buckets))
         self.mesh = mesh
+        if shard_params:
+            if mesh is None:
+                raise ValueError("shard_params=True needs a mesh with "
+                                 f"a {model_axis!r} axis")
+            shard_model_params(net, mesh, model_axis)
         self._q: queue.Queue = queue.Queue(maxsize=queue_limit)
         self._stop = threading.Event()
         self._worker = None

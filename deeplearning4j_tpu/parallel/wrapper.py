@@ -20,6 +20,13 @@ hops. One jitted SPMD train step over a ``Mesh``:
    replicas (params carry a leading device axis), trained locally and
    ``pmean``-averaged every ``averaging_frequency`` iterations via
    lax.cond — divergence between averages matches the reference.
+ - ASYNC (≙ SharedTrainingMaster's asynchronous gradient exchange):
+   per-device replicas apply their own threshold-encoded update
+   immediately and their peers' updates one step late
+   (``EncodedGradientsAccumulator.exchange_async``) with residuals
+   accumulating locally — the Hogwild-flavor DP the reference runs
+   over Aeron, expressed as one SPMD step with carried in-flight
+   state.
 """
 from __future__ import annotations
 
@@ -43,6 +50,7 @@ class ParallelWrapper:
     SYNC = "sync"
     ENCODED = "encoded"
     AVERAGING = "averaging"
+    ASYNC = "async"
 
     def __init__(self, net, workers: Optional[int] = None,
                  mode: str = SYNC,
@@ -56,7 +64,8 @@ class ParallelWrapper:
         self.mode = mode
         self.averaging_frequency = averaging_frequency
         self.accumulator = accumulator or (
-            EncodedGradientsAccumulator() if mode == self.ENCODED else None)
+            EncodedGradientsAccumulator()
+            if mode in (self.ENCODED, self.ASYNC) else None)
         self.prefetch_buffer = prefetch_buffer
         self._step = None
         self._dp_state = None  # mode-specific device state
@@ -96,7 +105,13 @@ class ParallelWrapper:
 
         def gradients_accumulator(self, acc):
             self._kw["accumulator"] = acc
-            self._kw["mode"] = ParallelWrapper.ENCODED
+            # an accumulator implies an encoded-family mode; a prior
+            # explicit ASYNC choice is kept, anything else (including
+            # an explicit SYNC/AVERAGING, which cannot consume an
+            # accumulator) becomes ENCODED — reference Builder behavior
+            if self._kw.get("mode") not in (ParallelWrapper.ENCODED,
+                                            ParallelWrapper.ASYNC):
+                self._kw["mode"] = ParallelWrapper.ENCODED
             return self
 
         def prefetch_buffer(self, k):
@@ -161,6 +176,40 @@ class ParallelWrapper:
             check_vma=False)
         return jax.jit(smapped, donate_argnums=(0, 1, 2, 3))
 
+    def _build_async_step(self):
+        net = self.net
+        mesh = self.mesh
+        optimizer = net._optimizer
+        acc = self.accumulator
+
+        def local_step(params, opt_state, state, acc_state, x, y, rng):
+            # per-replica params/opt + per-replica residual/inflight
+            params = jax.tree.map(lambda a: a[0], params)
+            opt_state = jax.tree.map(lambda a: a[0], opt_state)
+            acc_state = jax.tree.map(lambda a: a[0], acc_state)
+            (loss, new_state), grads = jax.value_and_grad(
+                self._loss, has_aux=True)(params, state, x, y, rng)
+            grads, acc_state = acc.exchange_async(grads, acc_state,
+                                                  "data")
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+            params = optax.apply_updates(params, updates)
+            params = net._apply_constraints(params)
+            loss = jax.lax.pmean(loss, "data")
+            lead = lambda a: a[None]
+            return (jax.tree.map(lead, params),
+                    jax.tree.map(lead, opt_state), new_state,
+                    jax.tree.map(lead, acc_state), loss)
+
+        pdev = P("data")
+        repl = P()
+        smapped = shard_map(
+            local_step, mesh=mesh,
+            in_specs=(pdev, pdev, repl, pdev, pdev, pdev, repl),
+            out_specs=(pdev, pdev, repl, pdev, repl),
+            check_vma=False)
+        return jax.jit(smapped, donate_argnums=(0, 1, 3))
+
     def _build_averaging_step(self):
         net = self.net
         mesh = self.mesh
@@ -223,6 +272,18 @@ class ParallelWrapper:
                         a[None], (self.n,) + a.shape), net.params),
                     jax.tree.map(lambda a: jnp.broadcast_to(
                         a[None], (self.n,) + a.shape), net.opt_state),
+                )
+        elif self.mode == self.ASYNC:
+            self._step = self._build_async_step()
+            if self._dp_state is None:
+                stack = lambda a: jnp.broadcast_to(
+                    a[None], (self.n,) + a.shape)
+                self._dp_state = (
+                    jax.tree.map(stack, net.params),
+                    jax.tree.map(stack, net.opt_state),
+                    jax.tree.map(stack,
+                                 self.accumulator.init_async_state(
+                                     net.params)),
                 )
         else:
             raise ValueError(f"unknown mode {self.mode!r}")
@@ -315,6 +376,11 @@ class ParallelWrapper:
                      self._dp_state, loss) = self._step(
                         net.params, net.opt_state, net.state,
                         self._dp_state, x, y, rng)
+                elif self.mode == self.ASYNC:
+                    p, o, a = self._dp_state
+                    p, o, net.state, a, loss = self._step(
+                        p, o, net.state, a, x, y, rng)
+                    self._dp_state = (p, o, a)
                 else:  # AVERAGING
                     p, o = self._dp_state
                     p, o, net.state, loss = self._step(
@@ -326,13 +392,14 @@ class ParallelWrapper:
                 for l in net.listeners:
                     l.iteration_done(net, net.iteration, net.epoch)
             net.epoch += 1
-        if self.mode == self.AVERAGING:
+        if self.mode in (self.AVERAGING, self.ASYNC):
             self._sync_back()
         return net
 
     def _sync_back(self):
-        """After averaging-mode training, fold replicas back into the
-        wrapped net (reference: ParallelWrapper final params copy)."""
-        p, o = self._dp_state
+        """After averaging/async-mode training, fold replicas back into
+        the wrapped net (reference: ParallelWrapper final params
+        copy)."""
+        p, o = self._dp_state[0], self._dp_state[1]
         self.net.params = jax.tree.map(lambda a: jnp.mean(a, axis=0), p)
         self.net.opt_state = jax.tree.map(lambda a: a[0], o)

@@ -110,6 +110,67 @@ def test_parallel_wrapper_encoded():
     assert (preds == ds.labels.argmax(1)).mean() > 0.85
 
 
+def test_parallel_wrapper_async_converges_vs_sync():
+    """ASYNC mode (reference SharedTrainingMaster async exchange,
+    staleness-1 peer updates + local residuals) must converge to the
+    same quality as SYNC on the toy task."""
+    net_async = _net()
+    acc = EncodedGradientsAccumulator(
+        AdaptiveThresholdAlgorithm(initial_threshold=1e-4))
+    w = (ParallelWrapper.builder(net_async).workers(8)
+         .training_mode(ParallelWrapper.ASYNC)
+         .gradients_accumulator(acc).build())
+    it = ListDataSetIterator(_toy_data(), batch_size=64)
+    w.fit(it, epochs=10)
+
+    net_sync = _net()
+    ws = ParallelWrapper.builder(net_sync).workers(8).build()
+    ws.fit(ListDataSetIterator(_toy_data(), batch_size=64), epochs=10)
+
+    ds = _toy_data(64, seed=3)
+    acc_async = (np.asarray(net_async.output(ds.features)).argmax(1)
+                 == ds.labels.argmax(1)).mean()
+    acc_sync = (np.asarray(net_sync.output(ds.features)).argmax(1)
+                == ds.labels.argmax(1)).mean()
+    assert acc_async > 0.85, acc_async
+    assert acc_async >= acc_sync - 0.1, (acc_async, acc_sync)
+
+
+def test_async_exchange_staleness_semantics():
+    """Step 1 must deliver ONLY the replica's own update (peers'
+    in-flight queues are empty); step 2 must deliver step-1 peer
+    messages — the one-step staleness contract."""
+    from jax.sharding import Mesh
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    acc = EncodedGradientsAccumulator(
+        AdaptiveThresholdAlgorithm(initial_threshold=0.5))
+    devs = np.array(jax.devices()[:2])
+    mesh = Mesh(devs, ("data",))
+    g = jnp.stack([jnp.full((4,), 1.0), jnp.full((4,), -1.0)])  # per-dev
+
+    def two_steps(g):
+        g = g[0]
+        st = acc.init_async_state(g)
+        out1, st = acc.exchange_async(g, st, "data")
+        out2, st = acc.exchange_async(jnp.zeros_like(g), st, "data")
+        return out1[None], out2[None]
+
+    o1, o2 = shard_map(
+        two_steps, mesh=mesh, in_specs=(P("data"),),
+        out_specs=(P("data"), P("data")), check_vma=False)(g)
+    tau = 0.5
+    # step 1: own update only, averaged over 2 devices: ±tau/2
+    np.testing.assert_allclose(np.asarray(o1[0]), tau / 2, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(o1[1]), -tau / 2, atol=1e-6)
+    # step 2: peer's step-1 message arrives (grad now zero, residual
+    # 1-tau stays below the adapted threshold)
+    np.testing.assert_allclose(np.asarray(o2[0]),
+                               np.asarray(-o2[1]), atol=1e-6)
+    assert abs(float(o2[0][0])) > 0  # something did arrive late
+
+
 def test_threshold_encode_decode_roundtrip():
     g = jnp.asarray(np.random.default_rng(0).normal(size=(64,)) * 0.01)
     tau = 0.005

@@ -16,7 +16,6 @@ on-device, only scalars cross to host.
 from __future__ import annotations
 
 import json
-import math
 import threading
 import time
 from pathlib import Path
@@ -99,55 +98,121 @@ def _tree_norms(tree) -> Dict[str, float]:
     return out
 
 
+def _rss_mb() -> Optional[float]:
+    """Host resident set size in MB (reference StatsListener system
+    metrics: JVM/offheap memory → host RSS here)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    try:                  # no /proc (macOS): peak RSS from getrusage —
+        import resource   # bytes on darwin, kilobytes elsewhere
+        import sys as _sys
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return rss / (1024.0 ** 2 if _sys.platform == "darwin"
+                      else 1024.0)
+    except Exception:
+        return None
+
+
 class StatsListener(TrainingListener):
     """Streams per-iteration stats into a StatsStorage (reference
     StatsListener; update:param ratios are the reference's headline
-    training-health diagnostic)."""
+    training-health diagnostic).
+
+    Collected per record: score, per-layer param/update norms and
+    update:param ratios, optional per-layer parameter AND update
+    histograms, optional activation histograms (extra forward on a
+    held sample batch — the reference collects them from the training
+    pass), and system metrics (host RSS, wall step time, ETL wait read
+    off an ``AsyncDataSetIterator`` when one is provided).
+    """
 
     def __init__(self, storage: StatsStorage, frequency: int = 1,
                  session_id: Optional[str] = None,
-                 collect_histograms: bool = False):
+                 collect_histograms: bool = False,
+                 activation_sample=None, iterator=None):
         self.storage = storage
         self.frequency = max(1, frequency)
         self.session_id = session_id or f"session_{int(time.time())}"
         self.collect_histograms = collect_histograms
+        self.activation_sample = activation_sample
+        self.iterator = iterator
         self._prev_params: Optional[Dict[str, Any]] = None
         self._t0 = time.time()
+        self._last_rec: Optional[tuple] = None   # (time, iteration)
+        self._last_etl = 0.0
 
     def iteration_done(self, net, iteration, epoch):
         if iteration % self.frequency:
             return          # keep _prev_params from the last recorded iter
+        now = time.time()
+        # per-iteration averages over the recording interval, so step
+        # time and ETL wait stay comparable at any frequency
+        step_ms = None
+        iters = self.frequency
+        if self._last_rec is not None:
+            t_prev, it_prev = self._last_rec
+            iters = max(1, iteration - it_prev)
+            step_ms = (now - t_prev) * 1e3 / iters
+        self._last_rec = (now, iteration)
         rec: Dict[str, Any] = {
             "iteration": iteration,
             "epoch": epoch,
-            "time": time.time() - self._t0,
+            "time": now - self._t0,
             "score": float(net.score_)
             if np.isfinite(net.score_) else None,
             "param_norms": _tree_norms(net.params),
         }
+        sys_rec: Dict[str, Any] = {"mem_rss_mb": _rss_mb(),
+                                   "step_time_ms": step_ms}
+        etl = getattr(self.iterator, "etl_wait_seconds", None)
+        if etl is not None:
+            sys_rec["etl_wait_ms"] = (etl - self._last_etl) * 1e3 / iters
+            self._last_etl = etl
+        rec["sys"] = sys_rec
         if self._prev_params is not None:
             import jax
             import jax.numpy as jnp
             ratios = {}
+            updates = {}
             for name, sub in net.params.items():
                 prev = self._prev_params.get(name)
                 if prev is None:
                     continue
                 upd = jax.tree.map(lambda a, b: a - b, sub, prev)
+                updates[name] = upd
                 un = float(jnp.sqrt(sum(jnp.sum(jnp.square(l))
                                         for l in jax.tree.leaves(upd))))
                 pn = rec["param_norms"].get(name, 0.0)
                 ratios[name] = un / pn if pn > 0 else 0.0
             rec["update_ratios"] = ratios
+            if self.collect_histograms:
+                rec["update_histograms"] = {
+                    name: self._hist(sub)
+                    for name, sub in updates.items()}
         if self.collect_histograms:
             rec["histograms"] = {
                 name: self._hist(sub) for name, sub in net.params.items()}
+        if self.activation_sample is not None:
+            rec["activation_histograms"] = self._activation_hists(net)
         # keep a COPY — the net's next jitted step donates (deletes) the
         # current param buffers
         import jax
         import jax.numpy as jnp
         self._prev_params = jax.tree.map(jnp.array, net.params)
         self.storage.put_record(self.session_id, rec)
+
+    def _activation_hists(self, net):
+        try:
+            acts = net.feed_forward(self.activation_sample)
+        except Exception:
+            return None
+        return {f"layer_{i-1}" if i else "input": self._hist([a])
+                for i, a in enumerate(acts)}
 
     @staticmethod
     def _hist(sub, bins: int = 20):
@@ -156,35 +221,141 @@ class StatsListener(TrainingListener):
         if not leaves:
             return None
         flat = np.concatenate(leaves)
-        counts, edges = np.histogram(flat, bins=bins)
-        return {"counts": counts.tolist(),
-                "min": float(edges[0]), "max": float(edges[-1])}
+        finite = flat[np.isfinite(flat)]
+        if finite.size == 0:
+            # diverged (all NaN/Inf): report emptiness, never crash the
+            # training loop the dashboard is meant to diagnose
+            return {"counts": [0] * bins, "min": 0.0, "max": 0.0,
+                    "nonfinite": int(flat.size)}
+        counts, edges = np.histogram(finite, bins=bins)
+        out = {"counts": counts.tolist(),
+               "min": float(edges[0]), "max": float(edges[-1])}
+        if finite.size != flat.size:
+            out["nonfinite"] = int(flat.size - finite.size)
+        return out
 
 
 # --- dashboard --------------------------------------------------------------
 
-def _svg_line(points, w=640, h=180, color="#2563eb"):
-    if len(points) < 2:
-        return "<svg></svg>"
-    xs = [p[0] for p in points]
-    ys = [p[1] for p in points if p[1] is not None]
-    if not ys:
-        return "<svg></svg>"
-    x0, x1 = min(xs), max(xs) or 1
-    y0, y1 = min(ys), max(ys)
-    span_x = (x1 - x0) or 1
-    span_y = (y1 - y0) or 1
-    pts = " ".join(
-        f"{(p[0]-x0)/span_x*w:.1f},{h-(p[1]-y0)/span_y*h:.1f}"
-        for p in points if p[1] is not None)
-    return (f'<svg viewBox="0 0 {w} {h}" width="{w}" height="{h}">'
-            f'<polyline fill="none" stroke="{color}" stroke-width="1.5" '
-            f'points="{pts}"/></svg>')
+_DASH_JS = """
+const qs = new URLSearchParams(location.search);
+let session = qs.get('session');
+function line(el, series, opts) {      // series: [{name, pts:[[x,y]]}]
+  const w = 640, h = 180, pad = 34;
+  let xs = [], ys = [];
+  series.forEach(s => s.pts.forEach(p => {
+    if (p[1] != null && isFinite(p[1])) { xs.push(p[0]); ys.push(p[1]); }
+  }));
+  if (!xs.length) { el.innerHTML = ''; return; }
+  const mn = a => a.reduce((p, c) => Math.min(p, c), Infinity);
+  const mx = a => a.reduce((p, c) => Math.max(p, c), -Infinity);
+  const x0 = mn(xs), x1 = mx(xs) || 1;
+  const y0 = mn(ys), y1 = mx(ys);
+  const sx = (x1 - x0) || 1, sy = (y1 - y0) || 1;
+  const colors = ['#2563eb','#dc2626','#16a34a','#9333ea','#ea580c',
+                  '#0891b2','#4b5563','#ca8a04'];
+  let svg = '';
+  series.forEach((s, i) => {
+    const pts = s.pts.filter(p => p[1] != null && isFinite(p[1])).map(p =>
+      (pad + (p[0]-x0)/sx*(w-pad-4)).toFixed(1) + ',' +
+      (h - 18 - (p[1]-y0)/sy*(h-26)).toFixed(1)).join(' ');
+    svg += `<polyline fill="none" stroke="${colors[i%8]}"
+            stroke-width="1.5" points="${pts}"/>`;
+  });
+  svg += `<text x="2" y="12" font-size="10">${y1.toPrecision(4)}</text>`;
+  svg += `<text x="2" y="${h-6}" font-size="10">${y0.toPrecision(4)}</text>`;
+  const legend = series.map((s, i) =>
+    `<tspan fill="${colors[i%8]}">&#9644;${s.name}</tspan>`).join(' ');
+  svg += `<text x="${pad}" y="12" font-size="10">${legend}</text>`;
+  el.innerHTML = svg;
+}
+function bars(el, hist) {
+  const w = 240, h = 80;
+  if (!hist || !hist.counts) { el.innerHTML = ''; return; }
+  const m = Math.max(...hist.counts) || 1;
+  const bw = w / hist.counts.length;
+  el.innerHTML = hist.counts.map((c, i) =>
+    `<rect x="${(i*bw).toFixed(1)}" y="${(h-c/m*h).toFixed(1)}"
+     width="${(bw-1).toFixed(1)}" height="${(c/m*h).toFixed(1)}"
+     fill="#2563eb"/>`).join('') +
+    `<text x="0" y="${h-2}" font-size="9">${hist.min.toPrecision(3)}
+     </text><text x="${w-50}" y="${h-2}" font-size="9">
+     ${hist.max.toPrecision(3)}</text>`;
+}
+function histBlock(containerId, byLayer) {
+  const c = document.getElementById(containerId);
+  if (!byLayer) { c.innerHTML = ''; return; }
+  c.innerHTML = Object.keys(byLayer).map(k =>
+    `<div class="hist"><div class="hl">${k}</div>
+     <svg viewBox="0 0 240 80" width="240" height="80"
+      id="${containerId}-${k}"></svg></div>`).join('');
+  Object.keys(byLayer).forEach(k =>
+    bars(document.getElementById(`${containerId}-${k}`), byLayer[k]));
+}
+async function tick() {
+  if (!session) {
+    const ss = await (await fetch('/sessions')).json();
+    if (ss.length) session = ss[ss.length-1]; else return;
+  }
+  const recs = await (await fetch(
+    '/json?session=' + encodeURIComponent(session))).json();
+  if (!recs.length) return;
+  document.getElementById('sess').textContent = session;
+  line(document.getElementById('score'),
+       [{name:'score', pts: recs.map(r => [r.iteration, r.score])}]);
+  const layers = Object.keys(recs[recs.length-1].update_ratios || {});
+  line(document.getElementById('ratios'), layers.map(l => ({
+    name: l,
+    pts: recs.map(r => [r.iteration,
+      r.update_ratios && r.update_ratios[l] > 0 ?
+      Math.log10(r.update_ratios[l]) : null])})));
+  line(document.getElementById('steptime'),
+       [{name:'step ms', pts: recs.map(r =>
+          [r.iteration, r.sys ? r.sys.step_time_ms : null])},
+        {name:'etl ms', pts: recs.map(r =>
+          [r.iteration, r.sys ? r.sys.etl_wait_ms : null])}]);
+  const last = recs[recs.length-1];
+  const sysEl = document.getElementById('sys');
+  if (last.sys) sysEl.textContent =
+    `host RSS ${last.sys.mem_rss_mb ?
+       last.sys.mem_rss_mb.toFixed(0) : '?'} MB · step ` +
+    `${last.sys.step_time_ms ?
+       last.sys.step_time_ms.toFixed(1) : '?'} ms · ETL wait ` +
+    `${last.sys.etl_wait_ms != null ?
+       last.sys.etl_wait_ms.toFixed(1) : '–'} ms · iter ` +
+    last.iteration;
+  histBlock('phist', last.histograms);
+  histBlock('uhist', last.update_histograms);
+  histBlock('ahist', last.activation_histograms);
+}
+tick(); setInterval(tick, 2000);
+"""
+
+_DASH_HTML = """<html><head><title>deeplearning4j_tpu training UI</title>
+<style>body{{font-family:sans-serif;margin:2em;}}h2{{margin-top:1.2em;}}
+.hist{{display:inline-block;margin:4px;}}.hl{{font-size:11px;}}
+#sys{{color:#4b5563;}}</style></head><body>
+<h1>Training dashboard</h1>
+<p>Session: <b id="sess">–</b> · sessions: {sessions}</p>
+<p id="sys">collecting…</p>
+<h2>Score</h2>
+<svg id="score" viewBox="0 0 640 180" width="640" height="180"></svg>
+<h2>update:param ratio per layer (log10)</h2>
+<svg id="ratios" viewBox="0 0 640 180" width="640" height="180"></svg>
+<h2>step time / ETL wait (ms)</h2>
+<svg id="steptime" viewBox="0 0 640 180" width="640" height="180"></svg>
+<h2>parameter histograms (latest)</h2><div id="phist"></div>
+<h2>update histograms (latest)</h2><div id="uhist"></div>
+<h2>activation histograms (latest)</h2><div id="ahist"></div>
+<script>{js}</script></body></html>"""
 
 
 class UIServer:
-    """Minimal training dashboard (reference UIServer/VertxUIServer):
-    score chart, update:param ratio chart, session picker. Stdlib-only.
+    """Training dashboard (reference UIServer/VertxUIServer): live
+    2-second polling of ``/json``, client-rendered score chart,
+    per-layer update:param ratio chart, step-time/ETL chart, and
+    parameter/update/activation histograms, plus host system metrics.
+    Stdlib-only server, dependency-free inline JS.
     """
 
     _instance = None
@@ -206,44 +377,17 @@ class UIServer:
         return self
 
     # -- html --------------------------------------------------------------
-    def _render(self, session: Optional[str]) -> str:
-        sessions = [s for st in self._storages
-                    for s in st.list_session_ids()]
-        if session is None and sessions:
-            session = sessions[-1]
-        records = []
-        for st in self._storages:
-            records.extend(st.get_records(session) if session else [])
-        records.sort(key=lambda r: r.get("iteration", 0))
-        score = [(r["iteration"], r.get("score")) for r in records]
-        parts = [
-            "<html><head><title>deeplearning4j_tpu training UI</title>",
-            "<style>body{font-family:sans-serif;margin:2em;}"
-            "h2{margin-top:1.5em;}</style></head><body>",
-            "<h1>Training dashboard</h1>",
-            "<p>Sessions: " + " | ".join(
-                f'<a href="/?session={s}">{s}</a>' for s in sessions)
-            + "</p>",
-        ]
-        if records:
-            parts.append(f"<h2>Score — {session}</h2>")
-            parts.append(_svg_line(score))
-            last = records[-1]
-            if "update_ratios" in last:
-                parts.append("<h2>update:param ratio (last iter, "
-                             "log10)</h2><ul>")
-                for name, v in last["update_ratios"].items():
-                    lg = math.log10(v) if v > 0 else float("-inf")
-                    parts.append(f"<li>{name}: {lg:.2f}</li>")
-                parts.append("</ul>")
-            parts.append("<h2>param norms (last iter)</h2><ul>")
-            for name, v in last.get("param_norms", {}).items():
-                parts.append(f"<li>{name}: {v:.4f}</li>")
-            parts.append("</ul>")
-        else:
-            parts.append("<p>No records yet.</p>")
-        parts.append("</body></html>")
-        return "".join(parts)
+    def _sessions(self) -> List[str]:
+        return [s for st in self._storages
+                for s in st.list_session_ids()]
+
+    def _render(self) -> str:
+        # session selection happens client-side (the JS reads
+        # location.search and polls /json)
+        links = " | ".join(
+            f'<a href="/?session={s}">{s}</a>' for s in self._sessions())
+        return _DASH_HTML.format(sessions=links or "none yet",
+                                 js=_DASH_JS)
 
     # -- server ------------------------------------------------------------
     def start(self):
@@ -262,10 +406,23 @@ class UIServer:
                     for st in ui._storages:
                         if session:
                             recs.extend(st.get_records(session))
+                    recs.sort(key=lambda r: r.get("iteration", 0))
+                    # the dashboard renders histograms only for the
+                    # final record — strip them elsewhere so the poll
+                    # payload stays O(scalars), not O(layers·bins)
+                    bulky = ("histograms", "update_histograms",
+                             "activation_histograms")
+                    recs = [
+                        {k: v for k, v in r.items() if k not in bulky}
+                        if i < len(recs) - 1 else r
+                        for i, r in enumerate(recs)]
                     body = json.dumps(recs).encode()
                     ctype = "application/json"
+                elif q.path == "/sessions":
+                    body = json.dumps(ui._sessions()).encode()
+                    ctype = "application/json"
                 else:
-                    body = ui._render(session).encode()
+                    body = ui._render().encode()
                     ctype = "text/html"
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)

@@ -248,12 +248,56 @@ def test_ui_server(rng):
             f"http://127.0.0.1:{ui.port}/?session=ui1",
             timeout=5).read().decode()
         assert "Training dashboard" in html and "svg" in html
+        # live dashboard: polling script + chart containers present
+        assert "setInterval(tick, 2000)" in html
+        for cid in ("score", "ratios", "steptime", "phist", "uhist",
+                    "ahist", "sys"):
+            assert f'id="{cid}"' in html, cid
         data = json.loads(urllib.request.urlopen(
             f"http://127.0.0.1:{ui.port}/json?session=ui1",
             timeout=5).read())
         assert data and data[0]["iteration"] >= 1
+        sessions = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{ui.port}/sessions", timeout=5).read())
+        assert "ui1" in sessions
     finally:
         ui.stop()
+
+
+def test_stats_listener_full_collection(rng):
+    """Histogram/activation/system-metric collection (reference
+    StatsListener parity: per-layer param/update/activation histograms
+    + memory/step-time/ETL system metrics)."""
+    from deeplearning4j_tpu.data.iterators import AsyncDataSetIterator
+    from deeplearning4j_tpu.train import (InMemoryStatsStorage,
+                                          StatsListener)
+
+    storage = InMemoryStatsStorage()
+    net = _mk_net()
+    ds = _data(rng)
+    base = ListDataSetIterator(ds, batch_size=64)
+    it = AsyncDataSetIterator(base, 2)
+    net.set_listeners(StatsListener(
+        storage, session_id="full1", collect_histograms=True,
+        activation_sample=ds.features[:8], iterator=it))
+    net.fit(it, epochs=2)
+    recs = storage.get_records("full1")
+    assert len(recs) >= 2
+    last = recs[-1]
+    # system metrics
+    assert last["sys"]["mem_rss_mb"] > 0
+    assert last["sys"]["step_time_ms"] > 0
+    assert "etl_wait_ms" in last["sys"]
+    # param + update histograms per layer
+    for key in ("histograms", "update_histograms"):
+        assert set(last[key]) == set(net.params), key
+        h = next(iter(last[key].values()))
+        assert sum(h["counts"]) > 0 and h["min"] < h["max"]
+    # activation histograms: input + every layer
+    ah = last["activation_histograms"]
+    assert "input" in ah and len(ah) == len(net.layers) + 1
+    # ratios present from the second record on
+    assert all(v >= 0 for v in last["update_ratios"].values())
 
 
 # --- profiler / crash report -----------------------------------------------

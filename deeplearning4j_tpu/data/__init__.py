@@ -10,6 +10,8 @@ from deeplearning4j_tpu.data.datasets import (
     EmnistDataSetIterator, Cifar10DataSetIterator, SvhnDataSetIterator,
     IrisDataSetIterator,
 )
+from deeplearning4j_tpu.data.digits import (RealDigitsDataSetIterator,
+                                            load_real_digits)
 from deeplearning4j_tpu.data.records import (
     RecordReader, CollectionRecordReader, CSVRecordReader,
     LineRecordReader, RegexLineRecordReader, CSVSequenceRecordReader,
@@ -31,6 +33,7 @@ from deeplearning4j_tpu.data.image import (
 __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
     "TfDataSetIterator", "BucketedSequenceIterator", "EmnistDataSetIterator", "Cifar10DataSetIterator", "SvhnDataSetIterator", "IrisDataSetIterator",
+    "RealDigitsDataSetIterator", "load_real_digits",
     "AsyncDataSetIterator", "NormalizerStandardize",
     "NormalizerMinMaxScaler", "ImagePreProcessingScaler",
     "NativeImageLoader", "ImageRecordReader", "ParentPathLabelGenerator",

@@ -7,6 +7,7 @@ experience replay; policies.
 from deeplearning4j_tpu.rl.mdp import (CartPole, DiscreteSpace,
                                        GridWorld, MDP, ObservationSpace,
                                        VectorizedMDP)
+from deeplearning4j_tpu.rl.gym_adapter import GymEnvAdapter
 from deeplearning4j_tpu.rl.replay import ExpReplay
 from deeplearning4j_tpu.rl.network import (
     ActorCriticFactorySeparateStdDense, DQNFactoryStdDense)
@@ -21,6 +22,7 @@ from deeplearning4j_tpu.rl.a3c import (A3CConfiguration, A3CDiscrete,
                                        AsyncNStepQLearningDiscrete)
 
 __all__ = [
+    "GymEnvAdapter",
     "MDP", "ObservationSpace", "DiscreteSpace", "CartPole", "GridWorld",
     "VectorizedMDP", "ExpReplay", "DQNFactoryStdDense",
     "ActorCriticFactorySeparateStdDense", "Policy", "Greedy",

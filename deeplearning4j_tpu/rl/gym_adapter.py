@@ -1,0 +1,101 @@
+"""Environment-adapter SPI — plug external RL environments into the
+learners.
+
+Reference: ``rl4j-gym``'s ``GymEnv`` (the gym-java-client adapter that
+wraps an OpenAI Gym HTTP environment as an ``MDP``).  TPU-side the
+adapter is in-process and duck-typed: anything exposing the
+Gym/Gymnasium API (``reset``/``step``/``action_space``/
+``observation_space``) adapts to :class:`deeplearning4j_tpu.rl.mdp.MDP`
+— both the classic 4-tuple ``(obs, reward, done, info)`` step and the
+Gymnasium 5-tuple ``(obs, reward, terminated, truncated, info)`` are
+accepted, so no particular gym package is required (and none is
+imported here).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.rl.mdp import (DiscreteSpace, MDP,
+                                       ObservationSpace)
+
+
+class GymEnvAdapter(MDP):
+    """Wrap a Gym/Gymnasium-API environment object as an MDP.
+
+    >>> import gymnasium
+    >>> mdp = GymEnvAdapter(lambda: gymnasium.make("CartPole-v1"))
+    >>> learner = QLearningDiscreteDense(mdp, cfg)
+
+    ``env_or_factory`` may be the environment itself or a zero-arg
+    factory; a factory is required for ``new_instance`` (the reference
+    ``MDP.newInstance`` used by async learners to give each thread its
+    own environment).
+    """
+
+    def __init__(self, env_or_factory, seed: Optional[int] = None):
+        # an env CLASS is a zero-arg factory too (its instances carry
+        # reset(), the class itself is just a callable that builds one)
+        if callable(env_or_factory) and (
+                isinstance(env_or_factory, type)
+                or not hasattr(env_or_factory, "reset")):
+            self._factory: Optional[Callable] = env_or_factory
+            self.env = env_or_factory()
+        else:
+            self._factory = None
+            self.env = env_or_factory
+        self._seed = seed
+        self._done = True
+        n = getattr(self.env.action_space, "n", None)
+        if n is None:
+            raise ValueError(
+                "GymEnvAdapter supports discrete action spaces "
+                "(reference gym-java-client scope); got "
+                f"{self.env.action_space!r}")
+        self.action_space = DiscreteSpace(int(n))
+        os_ = self.env.observation_space
+        self.observation_space = ObservationSpace(
+            shape=tuple(getattr(os_, "shape", ()) or ()),
+            low=np.asarray(os_.low) if hasattr(os_, "low") else None,
+            high=np.asarray(os_.high) if hasattr(os_, "high") else None)
+
+    # -- MDP interface -----------------------------------------------------
+    def reset(self) -> np.ndarray:
+        if self._seed is not None:
+            try:
+                out = self.env.reset(seed=self._seed)
+            except TypeError:          # classic API: reset() takes no
+                out = self.env.reset()  # seed kwarg
+        else:
+            out = self.env.reset()
+        self._seed = None              # gym semantics: seed once
+        self._done = False
+        # gymnasium returns (obs, info); classic gym returns obs
+        obs = out[0] if isinstance(out, tuple) else out
+        return np.asarray(obs)
+
+    def step(self, action: int):
+        out = self.env.step(action)
+        if len(out) == 5:              # gymnasium API
+            obs, reward, terminated, truncated, info = out
+            done = bool(terminated or truncated)
+        else:                          # classic 4-tuple API
+            obs, reward, done, info = out
+            done = bool(done)
+        self._done = done
+        return np.asarray(obs), float(reward), done, dict(info)
+
+    def is_done(self) -> bool:
+        return self._done
+
+    def close(self):
+        if hasattr(self.env, "close"):
+            self.env.close()
+
+    def new_instance(self) -> "GymEnvAdapter":
+        if self._factory is None:
+            raise ValueError(
+                "new_instance needs GymEnvAdapter(factory) — pass a "
+                "zero-arg callable that builds a fresh environment")
+        return GymEnvAdapter(self._factory)

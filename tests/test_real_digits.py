@@ -1,0 +1,55 @@
+"""REAL handwritten-digit accuracy (BASELINE honesty item): the env
+has no egress so MNIST cannot be fetched; the checked-in UCI digits
+(real human handwriting) carry the real-data accuracy claim instead.
+The synthetic-MNIST path must keep labeling itself synthetic."""
+import numpy as np
+
+from deeplearning4j_tpu.data import RealDigitsDataSetIterator
+from deeplearning4j_tpu.data.digits import load_real_digits
+from deeplearning4j_tpu.eval_ import Evaluation
+from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.config import InputType
+from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DenseLayer,
+                                          OutputLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn import updaters as upd
+
+
+def test_real_digits_are_real():
+    x, y = load_real_digits(train=True)
+    xt, yt = load_real_digits(train=False)
+    # 1797 genuine samples, disjoint deterministic split
+    assert len(x) + len(xt) == 1797
+    assert x.shape[1:] == (8, 8, 1) and y.shape[1] == 10
+    # real data: every class present in both splits
+    assert set(y.argmax(1)) == set(range(10))
+    assert set(yt.argmax(1)) == set(range(10))
+
+
+def test_small_cnn_reaches_95pct_on_real_digits():
+    """The reference's 'LeNet >= 99% on real MNIST' claim, scaled to
+    the real data actually available offline."""
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater(upd.Adam(learning_rate=2e-3))
+            .weight_init_fn("xavier").list()
+            .layer(ConvolutionLayer(n_out=16, kernel_size=(3, 3),
+                                    padding="SAME", activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=64, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.convolutional(8, 8, 1)).build())
+    net = MultiLayerNetwork(conf).init()
+    it = RealDigitsDataSetIterator(batch_size=64, train=True)
+    for _ in range(30):
+        net.fit(it)
+    xt, yt = load_real_digits(train=False)
+    ev = Evaluation()
+    ev.eval(yt, np.asarray(net.output(xt)))
+    assert ev.accuracy() >= 0.95, ev.accuracy()
+
+
+def test_synthetic_mnist_labels_itself():
+    from deeplearning4j_tpu.data.mnist import MnistDataSetIterator
+    it = MnistDataSetIterator(batch_size=32, train=True, n_examples=64)
+    assert it.synthetic is True     # no real MNIST files in this env

@@ -12,6 +12,8 @@ from deeplearning4j_tpu.data.datasets import (
 )
 from deeplearning4j_tpu.data.digits import (RealDigitsDataSetIterator,
                                             load_real_digits)
+from deeplearning4j_tpu.data.transform_executor import \
+    DistributedTransformExecutor
 from deeplearning4j_tpu.data.records import (
     RecordReader, CollectionRecordReader, CSVRecordReader,
     LineRecordReader, RegexLineRecordReader, CSVSequenceRecordReader,
@@ -34,6 +36,7 @@ __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ListDataSetIterator",
     "TfDataSetIterator", "BucketedSequenceIterator", "EmnistDataSetIterator", "Cifar10DataSetIterator", "SvhnDataSetIterator", "IrisDataSetIterator",
     "RealDigitsDataSetIterator", "load_real_digits",
+    "DistributedTransformExecutor",
     "AsyncDataSetIterator", "NormalizerStandardize",
     "NormalizerMinMaxScaler", "ImagePreProcessingScaler",
     "NativeImageLoader", "ImageRecordReader", "ParentPathLabelGenerator",

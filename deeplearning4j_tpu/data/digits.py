@@ -24,13 +24,17 @@ _NPZ = Path(__file__).resolve().parents[2] / "resources" / "datasets" / \
     "digits_real.npz"
 
 
-def load_real_digits(train: bool = True, test_fraction: float = 0.2,
-                     seed: int = 7):
+#: the train/test split is a FIXED property of the dataset — varying it
+#: with a user seed would leak test samples into training
+_SPLIT_SEED = 7
+
+
+def load_real_digits(train: bool = True, test_fraction: float = 0.2):
     """Returns ``(features [N,8,8,1] float32 in [0,1], one-hot labels
     [N,10])`` for the deterministic train or test split."""
     with np.load(_NPZ) as z:
         images, labels = z["images"], z["labels"]
-    rng = np.random.default_rng(seed)
+    rng = np.random.default_rng(_SPLIT_SEED)
     order = rng.permutation(len(images))
     n_test = int(len(images) * test_fraction)
     idx = order[n_test:] if train else order[:n_test]
@@ -46,6 +50,8 @@ class RealDigitsDataSetIterator(ListDataSetIterator):
 
     def __init__(self, batch_size: int = 64, train: bool = True,
                  seed: int = 7):
-        x, y = load_real_digits(train=train, seed=seed)
+        # seed varies only the epoch shuffle order; the split itself is
+        # fixed (see _SPLIT_SEED)
+        x, y = load_real_digits(train=train)
         super().__init__(DataSet(x, y), batch_size=batch_size,
                          shuffle=train, seed=seed)

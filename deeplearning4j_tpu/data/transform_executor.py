@@ -1,0 +1,109 @@
+"""Distributed TransformProcess execution.
+
+Reference: ``datavec-spark``'s ``SparkTransformExecutor`` — the same
+``TransformProcess`` that ``LocalTransformExecutor`` runs in-process is
+shipped to a cluster and applied partition-parallel.  The TPU-side
+rebuild keeps the exact contract with a *multiprocess local* executor:
+records are partitioned, each partition runs ``TransformProcess
+.execute`` in a forked worker, and results concatenate in order —
+row-independent transforms (every TransformProcess step is per-row;
+Reducer/Join are separate classes) make this semantically identical to
+the sequential path.
+
+Fork-based workers (the default) inherit the process image, so
+transform steps may close over lambdas (``transform_column``) without
+being picklable — the same problem the reference solves by requiring
+*serializable* transform descriptions, solved the unix way.  Caveat:
+``fork`` in a process with live JAX threads is formally unsafe
+(CPython warns); the children only run pure-python row transforms and
+never touch JAX, but callers who want full safety can pass
+``start_method="spawn"`` (requires a picklable TransformProcess, the
+reference's own contract).  Any pool failure falls back to sequential
+execution, which is always correct.
+"""
+from __future__ import annotations
+
+import multiprocessing
+import os
+import threading
+from typing import Any, List, Optional
+
+# fork-inherited state: set immediately before the pool is created so
+# children see it without pickling (lambdas in transform steps survive).
+# _FORK_LOCK serializes set-state→fork so concurrent execute() calls
+# from different threads can't snapshot each other's state.
+_FORK_STATE: dict = {}
+_FORK_LOCK = threading.Lock()
+
+
+def _run_chunk(bounds):
+    lo, hi = bounds
+    tp = _FORK_STATE["tp"]
+    return tp.execute(_FORK_STATE["records"][lo:hi])
+
+
+def _run_shipped(tp, chunk):
+    return tp.execute(chunk)
+
+
+class DistributedTransformExecutor:
+    """Partition-parallel ``TransformProcess`` execution (reference
+    ``SparkTransformExecutor.execute``).
+
+    >>> out = DistributedTransformExecutor(num_workers=4).execute(
+    ...     tp, records)            # == tp.execute(records), faster
+    """
+
+    def __init__(self, num_workers: Optional[int] = None,
+                 min_parallel_records: int = 2048,
+                 start_method: str = "fork"):
+        self.num_workers = num_workers or max(1, os.cpu_count() or 1)
+        self.min_parallel_records = min_parallel_records
+        self.start_method = start_method
+
+    def _usable(self) -> bool:
+        return (self.start_method
+                in multiprocessing.get_all_start_methods()
+                and self.num_workers > 1)
+
+    def execute(self, tp, records) -> List[List[Any]]:
+        records = list(records)
+        n = len(records)
+        if n < self.min_parallel_records or not self._usable():
+            return tp.execute(records)
+        workers = min(self.num_workers, n)
+        chunk = -(-n // workers)
+        bounds = [(lo, min(lo + chunk, n))
+                  for lo in range(0, n, chunk)]
+        if self.start_method != "fork":
+            # spawn/forkserver children don't inherit state; the
+            # TransformProcess must pickle (the reference's own
+            # serializable-transform contract).  Check BEFORE paying
+            # for a pool so closure-bearing transforms fall back fast.
+            import pickle
+            try:
+                pickle.dumps(tp)
+            except Exception:
+                return tp.execute(records)
+        try:
+            ctx = multiprocessing.get_context(self.start_method)
+            if self.start_method == "fork":
+                # children snapshot _FORK_STATE at Pool() fork time;
+                # hold the lock over exactly that window
+                with _FORK_LOCK:
+                    _FORK_STATE["tp"] = tp
+                    _FORK_STATE["records"] = records
+                    try:
+                        pool = ctx.Pool(processes=len(bounds))
+                    finally:
+                        _FORK_STATE.clear()
+                with pool:
+                    parts = pool.map(_run_chunk, bounds)
+            else:
+                with ctx.Pool(processes=len(bounds)) as pool:
+                    parts = pool.starmap(
+                        _run_shipped,
+                        [(tp, records[lo:hi]) for lo, hi in bounds])
+        except Exception:
+            return tp.execute(records)   # always-correct fallback
+        return [row for part in parts for row in part]

@@ -84,7 +84,7 @@ class GymEnvAdapter(MDP):
             obs, reward, done, info = out
             done = bool(done)
         self._done = done
-        return np.asarray(obs), float(reward), done, dict(info)
+        return np.asarray(obs), float(reward), done, dict(info or {})
 
     def is_done(self) -> bool:
         return self._done

@@ -10,6 +10,13 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+import jax  # noqa: E402
+
+# sitecustomize forces the axon TPU platform and overrides
+# JAX_PLATFORMS; force CPU before any device use so doc generation
+# never waits on (or hangs with) the TPU tunnel
+jax.config.update("jax_platforms", "cpu")
+
 
 def main():
     import warnings
@@ -55,10 +62,16 @@ def main():
     section("Constraints", list(_CONSTRAINTS))
     section("Weight noise", list(_NOISES))
     import inspect
-    zoo_models = [n for n in dir(zoo)
-                  if inspect.isclass(getattr(zoo, n))
-                  or (callable(getattr(zoo, n)) and n[:1].isupper())]
-    section("Zoo models", zoo_models)
+    zoo_models = [
+        n for n in dir(zoo)
+        if inspect.isclass(getattr(zoo, n))
+        and issubclass(getattr(zoo, n), zoo.ZooModel)
+        and getattr(zoo, n) is not zoo.ZooModel]
+    zoo_models += [n for n in dir(zoo)
+                   if not inspect.isclass(getattr(zoo, n))
+                   and callable(getattr(zoo, n)) and n[:1].isupper()
+                   and n not in ("DL4JResources",)]
+    section("Zoo models", sorted(set(zoo_models)))
 
     from deeplearning4j_tpu.nn.vertices import _VERTEX_REGISTRY
     section("Graph vertices", list(_VERTEX_REGISTRY))

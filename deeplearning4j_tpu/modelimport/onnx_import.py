@@ -499,17 +499,61 @@ def _m_conv(ctx, node, ins):
 def _m_deconv(ctx, node, ins):
     import jax.lax as lax
     w = ctx.consts.get(node.inputs[1])
-    spatial = w.ndim - 2
+    # weights may arrive as a graph input rather than an initializer;
+    # fall back to kernel_shape for the spatial rank (as Conv does)
+    spatial = (w.ndim - 2) if w is not None else \
+        len(node.attr_ints("kernel_shape", [0, 0]))
     strides = tuple(node.attr_ints("strides", [1] * spatial))
-    pads = node.attr_ints("pads", [0] * 2 * spatial)
-    padding = [(pads[i], pads[i + spatial]) for i in range(spatial)]
-    dn = ("NCHW", "IOHW", "NCHW") if spatial == 2 else \
-        ("NCH", "IOH", "NCH")
+    pads = tuple(node.attr_ints("pads", [0] * 2 * spatial))
+    dil = tuple(node.attr_ints("dilations", [1] * spatial))
+    out_pad = tuple(node.attr_ints("output_padding", [0] * spatial))
+    groups = node.attr_i("group", 1)
+    auto_pad = node.attr_s("auto_pad", "NOTSET")
+    if auto_pad not in ("NOTSET", ""):
+        raise ValueError(
+            f"ConvTranspose auto_pad={auto_pad!r} is not importable — "
+            "re-export with explicit pads")
+    # ONNX weight layout is [C_in, C_out/g, k...]; with
+    # transpose_kernel=True lax swaps the I/O letters internally, so
+    # the spec must read OI+spatial, and the ONNX pad p becomes a lax
+    # pad of (k_eff-1-p) with k_eff the dilated kernel extent;
+    # output_padding widens the high side — the adjoint-of-conv
+    # geometry (validated vs torch conv_transpose across
+    # stride/pad/dilation/output_padding/group combos)
+    dn = {1: ("NCH", "OIH", "NCH"),
+          2: ("NCHW", "OIHW", "NCHW"),
+          3: ("NCDHW", "OIDHW", "NCDHW")}.get(spatial)
+    if dn is None:
+        raise ValueError(
+            f"ConvTranspose with {spatial} spatial dims is not "
+            "importable (1-3 supported)")
 
-    def fn(x, w, *bs, strides=strides, padding=padding, dn=dn):
-        y = lax.conv_transpose(x, w, strides=strides, padding=padding,
-                               dimension_numbers=dn,
-                               transpose_kernel=True)
+    def one_group(x, w, strides, pads, dil, out_pad, dn, spatial):
+        k_eff = [(w.shape[2 + i] - 1) * dil[i] + 1
+                 for i in range(spatial)]
+        padding = [(k_eff[i] - 1 - pads[i],
+                    k_eff[i] - 1 - pads[i + spatial] + out_pad[i])
+                   for i in range(spatial)]
+        return lax.conv_transpose(x, w, strides=strides,
+                                  padding=padding, rhs_dilation=dil,
+                                  dimension_numbers=dn,
+                                  transpose_kernel=True)
+
+    def fn(x, w, *bs, strides=strides, pads=pads, dil=dil,
+           out_pad=out_pad, groups=groups, dn=dn, spatial=spatial):
+        import jax.numpy as jnp
+        if groups == 1:
+            y = one_group(x, w, strides, pads, dil, out_pad, dn,
+                          spatial)
+        else:
+            # lax.conv_transpose has no feature_group_count: run each
+            # group separately (x and w both split along C_in)
+            cin_g = x.shape[1] // groups
+            y = jnp.concatenate([
+                one_group(x[:, g * cin_g:(g + 1) * cin_g],
+                          w[g * cin_g:(g + 1) * cin_g], strides, pads,
+                          dil, out_pad, dn, spatial)
+                for g in range(groups)], axis=1)
         if bs:
             y = y + bs[0].reshape((1, -1) + (1,) * (y.ndim - 2))
         return y

@@ -1,0 +1,364 @@
+"""ONNX conformance sweep (reference: the onnx-import golden suite in
+``nd4j-onnxruntime`` / samediff-import — many tiny graphs executed and
+compared per-op).
+
+Like the TF sweep, cases are *generated*: every mapped ONNX op is swept
+across shapes/attrs, the graph bytes are produced by the in-package
+``OnnxBuilder`` (the image has no ``onnx`` package), and goldens come
+from torch (or exact numpy) running the same computation.  A coverage
+test fails if a mapped op family is never swept.
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from deeplearning4j_tpu.modelimport.onnx_import import (  # noqa: E402
+    OnnxBuilder, _MAPPERS, import_onnx)
+
+RNG = np.random.default_rng(77)
+SWEPT = set()
+
+
+def F32(*shape, lo=None, hi=None, scale=1.0):
+    a = (RNG.normal(size=shape) * scale).astype(np.float32)
+    if lo is not None:
+        a = np.clip(a, lo, hi).astype(np.float32)
+    return a
+
+
+CASES = []
+
+
+def ocase(cid, nodes, golden, inputs, rtol=1e-4, atol=1e-5, inits=()):
+    """nodes: list of (op, in_names, out_names, attrs) building from
+    graph inputs x0,x1,... to final output 'out'.  Input indices in
+    ``inits`` become graph initializers (how real exporters carry
+    shape/axes tensors) — still passed to the golden fn."""
+    CASES.append(pytest.param(nodes, golden, inputs, rtol, atol,
+                              frozenset(inits), id=cid))
+
+
+def _run_case(nodes, golden, inputs, rtol, atol, inits=frozenset()):
+    b = OnnxBuilder()
+    feed = {}
+    for i, a in enumerate(inputs):
+        if i in inits:
+            b.init(f"x{i}", a)
+            continue
+        b.input(f"x{i}", list(a.shape), a.dtype.type)
+        feed[f"x{i}"] = a
+    b.output("out")
+    for op, ins, outs, attrs in nodes:
+        b.node(op, ins, outs, **attrs)
+        SWEPT.add(op)
+    sd, vars_ = import_onnx(b.build())
+    res = sd.output(feed, [vars_["out"]])
+    got = res[vars_["out"].name]
+    want = np.asarray(golden(*inputs))
+    assert got.shape == want.shape, (got.shape, want.shape)
+    if want.dtype.kind in "fc":
+        np.testing.assert_allclose(got, want, rtol=rtol, atol=atol)
+    else:
+        np.testing.assert_array_equal(got, want)
+
+
+def T(fn):
+    """Torch golden from numpy inputs."""
+    def g(*arrs):
+        with torch.no_grad():
+            return fn(*[torch.from_numpy(a) for a in arrs]).numpy()
+    return g
+
+
+# --- unary ------------------------------------------------------------------
+_UNARY = {
+    "Abs": (torch.abs, None, None), "Ceil": (torch.ceil, None, None),
+    "Cos": (torch.cos, None, None), "Sin": (torch.sin, None, None),
+    "Tan": (torch.tan, -1.2, 1.2), "Exp": (torch.exp, None, None),
+    "Floor": (torch.floor, None, None),
+    "Round": (torch.round, None, None),
+    "Neg": (torch.neg, None, None), "Sign": (torch.sign, None, None),
+    "Relu": (torch.relu, None, None),
+    "Sigmoid": (torch.sigmoid, None, None),
+    "Tanh": (torch.tanh, None, None),
+    "Erf": (torch.erf, None, None),
+    "Softplus": (F.softplus, None, None),
+    "Elu": (F.elu, None, None),
+    "LeakyRelu": (F.leaky_relu, None, None),
+    "Gelu": (F.gelu, None, None),
+    "Log": (torch.log, 0.1, 9.0), "Sqrt": (torch.sqrt, 0.0, 9.0),
+    "Reciprocal": (torch.reciprocal, 0.3, 5.0),
+}
+for name, (tfn, lo, hi) in _UNARY.items():
+    for sid, shp in [("r2", (3, 4)), ("r3", (2, 3, 5))]:
+        ocase(f"unary-{name}-{sid}",
+              [(name, ["x0"], ["out"], {})], T(tfn),
+              [F32(*shp, lo=lo, hi=hi)])
+
+# --- binary / variadic ------------------------------------------------------
+_BINARY = {"Add": torch.add, "Sub": torch.sub, "Mul": torch.mul}
+for name, tfn in _BINARY.items():
+    ocase(f"binary-{name}", [(name, ["x0", "x1"], ["out"], {})],
+          T(tfn), [F32(3, 4), F32(3, 4)])
+    ocase(f"binary-{name}-bcast", [(name, ["x0", "x1"], ["out"], {})],
+          T(tfn), [F32(2, 3, 4), F32(4)])
+ocase("binary-Div", [("Div", ["x0", "x1"], ["out"], {})],
+      T(torch.div), [F32(3, 4), F32(3, 4, lo=0.5, hi=4.0)])
+ocase("binary-Pow", [("Pow", ["x0", "x1"], ["out"], {})],
+      T(torch.pow), [F32(3, 4, lo=0.2, hi=3.0), F32(3, 4, lo=-2.0,
+                                                    hi=2.0)])
+ocase("variadic-Max", [("Max", ["x0", "x1", "x2"], ["out"], {})],
+      lambda a, b, c: np.maximum(np.maximum(a, b), c),
+      [F32(3, 4), F32(3, 4), F32(3, 4)])
+ocase("variadic-Min", [("Min", ["x0", "x1", "x2"], ["out"], {})],
+      lambda a, b, c: np.minimum(np.minimum(a, b), c),
+      [F32(3, 4), F32(3, 4), F32(3, 4)])
+ocase("variadic-Sum", [("Sum", ["x0", "x1", "x2"], ["out"], {})],
+      lambda a, b, c: a + b + c, [F32(3, 4), F32(3, 4), F32(3, 4)])
+
+# --- reductions -------------------------------------------------------------
+_RED = {"ReduceSum": np.sum, "ReduceMean": np.mean,
+        "ReduceMax": np.max, "ReduceMin": np.min}
+for name, nfn in _RED.items():
+    ocase(f"reduce-{name}-ax1keep",
+          [(name, ["x0"], ["out"], {"axes": [1], "keepdims": 1})],
+          lambda x, nfn=nfn: nfn(x, axis=1, keepdims=True),
+          [F32(2, 3, 4)])
+    ocase(f"reduce-{name}-ax02",
+          [(name, ["x0"], ["out"], {"axes": [0, 2], "keepdims": 0})],
+          lambda x, nfn=nfn: nfn(x, axis=(0, 2)), [F32(2, 3, 4)])
+    ocase(f"reduce-{name}-all",
+          [(name, ["x0"], ["out"], {"keepdims": 1})],
+          lambda x, nfn=nfn: nfn(x, keepdims=True), [F32(3, 4)])
+
+# --- matmul / gemm ----------------------------------------------------------
+ocase("matmul-2d", [("MatMul", ["x0", "x1"], ["out"], {})],
+      T(torch.matmul), [F32(3, 4), F32(4, 5)], rtol=1e-3)
+ocase("matmul-batch", [("MatMul", ["x0", "x1"], ["out"], {})],
+      T(torch.matmul), [F32(2, 3, 4), F32(2, 4, 5)], rtol=1e-3)
+ocase("gemm-plain", [("Gemm", ["x0", "x1", "x2"], ["out"], {})],
+      lambda a, b, c: a @ b + c, [F32(3, 4), F32(4, 5), F32(5)],
+      rtol=1e-3)
+ocase("gemm-transB",
+      [("Gemm", ["x0", "x1", "x2"], ["out"], {"transB": 1})],
+      lambda a, b, c: a @ b.T + c, [F32(3, 4), F32(5, 4), F32(5)],
+      rtol=1e-3)
+ocase("gemm-alphabeta",
+      [("Gemm", ["x0", "x1", "x2"], ["out"],
+        {"alpha": 0.5, "beta": 2.0, "transA": 1})],
+      lambda a, b, c: 0.5 * (a.T @ b) + 2.0 * c,
+      [F32(4, 3), F32(4, 5), F32(5)], rtol=1e-3)
+
+# --- shape ops --------------------------------------------------------------
+ocase("reshape-zeros-minus1", [("Reshape", ["x0", "x1"], ["out"], {})],
+      lambda x, s: x.reshape(2, -1),
+      [F32(2, 3, 4), np.asarray([0, -1], np.int64)], inits=(1,))
+ocase("flatten-ax1", [("Flatten", ["x0"], ["out"], {"axis": 1})],
+      lambda x: x.reshape(2, -1), [F32(2, 3, 4)])
+ocase("flatten-ax2", [("Flatten", ["x0"], ["out"], {"axis": 2})],
+      lambda x: x.reshape(6, 4), [F32(2, 3, 4)])
+ocase("transpose-perm",
+      [("Transpose", ["x0"], ["out"], {"perm": [0, 2, 1]})],
+      lambda x: x.transpose(0, 2, 1), [F32(2, 3, 4)])
+ocase("transpose-default", [("Transpose", ["x0"], ["out"], {})],
+      lambda x: x.T, [F32(3, 5)])
+ocase("squeeze-attr", [("Squeeze", ["x0"], ["out"], {"axes": [1]})],
+      lambda x: x.squeeze(1), [F32(2, 1, 4)])
+ocase("unsqueeze-attr",
+      [("Unsqueeze", ["x0"], ["out"], {"axes": [0, 3]})],
+      lambda x: x[None, ..., None], [F32(3, 4)])
+ocase("concat-ax1", [("Concat", ["x0", "x1"], ["out"], {"axis": 1})],
+      lambda a, b: np.concatenate([a, b], 1), [F32(2, 3), F32(2, 5)])
+ocase("concat-neg", [("Concat", ["x0", "x1"], ["out"], {"axis": -1})],
+      lambda a, b: np.concatenate([a, b], -1),
+      [F32(2, 3, 2), F32(2, 3, 4)])
+ocase("gather-ax0", [("Gather", ["x0", "x1"], ["out"], {})],
+      lambda x, i: np.take(x, i, 0),
+      [F32(5, 3), RNG.integers(0, 5, 4).astype(np.int64)])
+ocase("gather-ax1", [("Gather", ["x0", "x1"], ["out"], {"axis": 1})],
+      lambda x, i: np.take(x, i, 1),
+      [F32(3, 6), RNG.integers(0, 6, 2).astype(np.int64)])
+ocase("slice-steps",
+      [("Slice", ["x0", "x1", "x2", "x3", "x4"], ["out"], {})],
+      lambda x, s, e, a, st: x[1:5:2],
+      [F32(6, 3), np.asarray([1], np.int64), np.asarray([5], np.int64),
+       np.asarray([0], np.int64), np.asarray([2], np.int64)],
+      inits=(1, 2, 3, 4))
+ocase("pad-constant",
+      [("Pad", ["x0"], ["out"], {"pads": [1, 0, 0, 2]})],
+      lambda x: np.pad(x, [(1, 0), (0, 2)]), [F32(2, 3)])
+ocase("pad-reflect",
+      [("Pad", ["x0"], ["out"],
+        {"pads": [0, 1, 0, 1], "mode": "reflect"})],
+      lambda x: np.pad(x, [(0, 0), (1, 1)], mode="reflect"),
+      [F32(2, 5)])
+ocase("pad-edge",
+      [("Pad", ["x0"], ["out"], {"pads": [1, 0, 1, 0], "mode": "edge"})],
+      lambda x: np.pad(x, [(1, 1), (0, 0)], mode="edge"), [F32(3, 4)])
+ocase("cast-roundtrip",
+      [("Cast", ["x0"], ["i"], {"to": 6}),      # 6 = int32
+       ("Cast", ["i"], ["out"], {"to": 1})],    # 1 = float32
+      lambda x: x.astype(np.int32).astype(np.float32),
+      [F32(3, 4, scale=3.0)])
+ocase("identity-dropout",
+      [("Dropout", ["x0"], ["out"], {})], lambda x: x, [F32(3, 4)])
+
+# --- activations with attrs -------------------------------------------------
+ocase("softmax-neg", [("Softmax", ["x0"], ["out"], {"axis": -1})],
+      T(lambda x: torch.softmax(x, -1)), [F32(4, 6)])
+ocase("softmax-ax1", [("Softmax", ["x0"], ["out"], {"axis": 1})],
+      T(lambda x: torch.softmax(x, 1)), [F32(2, 3, 5)])
+ocase("logsoftmax", [("LogSoftmax", ["x0"], ["out"], {"axis": -1})],
+      T(lambda x: torch.log_softmax(x, -1)), [F32(4, 6)])
+ocase("clip-attrs",
+      [("Clip", ["x0"], ["out"], {"min": -0.5, "max": 0.5})],
+      lambda x: np.clip(x, -0.5, 0.5), [F32(4, 6)])
+ocase("prelu", [("PRelu", ["x0", "x1"], ["out"], {})],
+      lambda x, s: np.where(x >= 0, x, s * x),
+      [F32(3, 4), np.asarray([0.25], np.float32)])
+
+# --- nn ---------------------------------------------------------------------
+def _conv_case(cid, cin, cout, k, stride, pads, groups=1):
+    x = F32(2, cin, 8, 8, scale=0.5)
+    w = F32(cout, cin // groups, k, k, scale=0.3)
+    bias = F32(cout, scale=0.1)
+    ocase(cid,
+          [("Conv", ["x0", "x1", "x2"], ["out"],
+            {"kernel_shape": [k, k], "strides": [stride, stride],
+             "pads": pads * 2, "group": groups})],
+          T(lambda x, w, b: F.conv2d(
+              x, w, b, stride=stride, padding=pads[0],
+              groups=groups)),
+          [x, w, bias], rtol=2e-3, atol=1e-4)
+
+
+_conv_case("conv-3x3-same", 3, 4, 3, 1, [1, 1])
+_conv_case("conv-3x3-valid", 3, 4, 3, 1, [0, 0])
+_conv_case("conv-stride2", 2, 3, 3, 2, [1, 1])
+_conv_case("conv-1x1", 4, 6, 1, 1, [0, 0])
+_conv_case("conv-grouped", 4, 4, 3, 1, [1, 1], groups=2)
+
+ocase("convtranspose",
+      [("ConvTranspose", ["x0", "x1"], ["out"],
+        {"kernel_shape": [2, 2], "strides": [2, 2]})],
+      T(lambda x, w: F.conv_transpose2d(x, w, stride=2)),
+      [F32(1, 3, 4, 4, scale=0.5), F32(3, 2, 2, 2, scale=0.3)],
+      rtol=2e-3, atol=1e-4)
+ocase("maxpool",
+      [("MaxPool", ["x0"], ["out"],
+        {"kernel_shape": [2, 2], "strides": [2, 2]})],
+      T(lambda x: F.max_pool2d(x, 2)), [F32(2, 3, 8, 8)])
+ocase("maxpool-pads",
+      [("MaxPool", ["x0"], ["out"],
+        {"kernel_shape": [3, 3], "strides": [2, 2],
+         "pads": [1, 1, 1, 1]})],
+      T(lambda x: F.max_pool2d(x, 3, 2, padding=1)),
+      [F32(1, 2, 7, 7)])
+ocase("avgpool",
+      [("AveragePool", ["x0"], ["out"],
+        {"kernel_shape": [2, 2], "strides": [2, 2]})],
+      T(lambda x: F.avg_pool2d(x, 2)), [F32(2, 3, 8, 8)])
+ocase("globalavgpool", [("GlobalAveragePool", ["x0"], ["out"], {})],
+      lambda x: x.mean((2, 3), keepdims=True), [F32(2, 3, 5, 7)])
+ocase("globalmaxpool", [("GlobalMaxPool", ["x0"], ["out"], {})],
+      lambda x: x.max((2, 3), keepdims=True), [F32(2, 3, 5, 7)])
+ocase("batchnorm-inference",
+      [("BatchNormalization", ["x0", "x1", "x2", "x3", "x4"], ["out"],
+        {"epsilon": 1e-5})],
+      lambda x, s, b, m, v: s[None, :, None, None]
+      * (x - m[None, :, None, None])
+      / np.sqrt(v[None, :, None, None] + 1e-5)
+      + b[None, :, None, None],
+      [F32(2, 3, 4, 4), F32(3, lo=0.5, hi=1.5), F32(3),
+       F32(3, scale=0.1), F32(3, lo=0.5, hi=1.5)], rtol=1e-3)
+ocase("lrn",
+      [("LRN", ["x0"], ["out"],
+        {"alpha": 1e-3, "beta": 0.75, "bias": 1.0, "size": 3})],
+      T(lambda x: F.local_response_norm(x, 3, alpha=1e-3, beta=0.75,
+                                        k=1.0)),
+      [F32(2, 6, 4, 4)], rtol=1e-3)
+
+# --- composites -------------------------------------------------------------
+ocase("composite-mlp",
+      [("Gemm", ["x0", "x1", "x2"], ["h"], {"transB": 1}),
+       ("Relu", ["h"], ["hr"], {}),
+       ("Gemm", ["hr", "x3", "x4"], ["lg"], {"transB": 1}),
+       ("Softmax", ["lg"], ["out"], {"axis": -1})],
+      T(lambda x, w1, b1, w2, b2: torch.softmax(
+          F.linear(torch.relu(F.linear(x, w1, b1)), w2, b2), -1)),
+      [F32(4, 6), F32(8, 6, scale=0.3), F32(8), F32(3, 8, scale=0.3),
+       F32(3)], rtol=1e-3)
+ocase("composite-residual",
+      [("MatMul", ["x0", "x1"], ["h"], {}),
+       ("Relu", ["h"], ["hr"], {}),
+       ("Add", ["x0", "hr"], ["out"], {})],
+      lambda x, w: x + np.maximum(x @ w, 0),
+      [F32(3, 6), F32(6, 6, scale=0.3)], rtol=1e-3)
+
+
+# regression for the dormant ConvTranspose bug the sweep caught:
+# asymmetric channel counts + nonzero ONNX pads
+ocase("convtranspose-padded",
+       [("ConvTranspose", ["x0", "x1", "x2"], ["out"],
+         {"kernel_shape": [3, 3], "strides": [2, 2],
+          "pads": [1, 1, 1, 1]})],
+       T(lambda x, w, b: F.conv_transpose2d(x, w, b, stride=2,
+                                            padding=1)),
+       [F32(1, 4, 5, 5, scale=0.5), F32(4, 3, 3, 3, scale=0.3),
+        F32(3, scale=0.1)], rtol=2e-3, atol=1e-4)
+
+
+ocase("convtranspose-outputpadding",
+      [("ConvTranspose", ["x0", "x1"], ["out"],
+        {"kernel_shape": [3, 3], "strides": [2, 2],
+         "pads": [1, 1, 1, 1], "output_padding": [1, 1]})],
+      T(lambda x, w: F.conv_transpose2d(x, w, stride=2, padding=1,
+                                        output_padding=1)),
+      [F32(1, 3, 4, 4, scale=0.5), F32(3, 2, 3, 3, scale=0.3)],
+      rtol=2e-3, atol=1e-4)
+ocase("convtranspose-dilated",
+      [("ConvTranspose", ["x0", "x1"], ["out"],
+        {"kernel_shape": [3, 3], "strides": [1, 1],
+         "dilations": [2, 2]})],
+      T(lambda x, w: F.conv_transpose2d(x, w, dilation=2)),
+      [F32(1, 2, 5, 5, scale=0.5), F32(2, 3, 3, 3, scale=0.3)],
+      rtol=2e-3, atol=1e-4)
+ocase("convtranspose-grouped",
+      [("ConvTranspose", ["x0", "x1"], ["out"],
+        {"kernel_shape": [3, 3], "strides": [2, 2], "group": 2})],
+      T(lambda x, w: F.conv_transpose2d(x, w, stride=2, groups=2)),
+      [F32(1, 4, 4, 4, scale=0.5), F32(4, 2, 3, 3, scale=0.3)],
+      rtol=2e-3, atol=1e-4)
+ocase("convtranspose-1d",
+      [("ConvTranspose", ["x0", "x1"], ["out"],
+        {"kernel_shape": [4], "strides": [2], "pads": [1, 1]})],
+      T(lambda x, w: F.conv_transpose1d(x, w, stride=2, padding=1)),
+      [F32(2, 3, 6, scale=0.5), F32(3, 2, 4, scale=0.3)],
+      rtol=2e-3, atol=1e-4)
+
+
+def test_convtranspose_autopad_rejected():
+    b = OnnxBuilder()
+    b.input("x", [1, 2, 4, 4]).output("out")
+    b.init("w", F32(2, 2, 3, 3))
+    b.node("ConvTranspose", ["x", "w"], ["out"],
+           kernel_shape=[3, 3], auto_pad="SAME_UPPER")
+    with pytest.raises(ValueError, match="auto_pad"):
+        import_onnx(b.build())
+
+
+@pytest.mark.parametrize("nodes,golden,inputs,rtol,atol,inits", CASES)
+def test_onnx_conformance(nodes, golden, inputs, rtol, atol, inits):
+    _run_case(nodes, golden, inputs, rtol, atol, inits)
+
+
+def test_onnx_sweep_coverage():
+    """Every mapped ONNX op must be exercised by the sweep (structural
+    ops the builder emits implicitly are exempt)."""
+    assert len(CASES) >= 100, len(CASES)
+    if not SWEPT:
+        pytest.skip("conformance cases did not run")
+    exempt = {"Constant", "Identity"}
+    unswept = sorted(set(_MAPPERS) - SWEPT - exempt)
+    assert not unswept, f"mapped ONNX ops never swept: {unswept}"

@@ -26,10 +26,13 @@ from deeplearning4j_tpu.parallel.moe import MixtureOfExperts
 from deeplearning4j_tpu.parallel.pipeline import (
     pipeline_apply, pipeline_train_step, make_mlp_stage,
 )
+from deeplearning4j_tpu.parallel.ring_attention import \
+    ring_self_attention
+from deeplearning4j_tpu.parallel.ulysses import ulysses_self_attention
 
 __all__ = [
     "MixtureOfExperts", "pipeline_apply", "pipeline_train_step",
-    "make_mlp_stage",
+    "make_mlp_stage", "ring_self_attention", "ulysses_self_attention",
     "make_mesh", "data_parallel_mesh", "initialize_distributed",
     "ParallelWrapper", "ParallelInference", "shard_model_params",
     "EncodedGradientsAccumulator", "encode_threshold", "decode_threshold",

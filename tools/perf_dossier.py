@@ -1,0 +1,244 @@
+"""Perf dossier: MFU / roofline table for BASELINE.md (VERDICT r1 #5).
+
+For each measured config reports achieved TFLOP/s and % of the v5e
+chip's 197 bf16 TFLOP/s peak (MFU), from wall-clock step times synced
+via scalar device→host transfers (the only reliable sync through the
+axon tunnel — BASELINE.md measurement caveat).  Achieved HBM bandwidth
+is NOT derivable from wall-clock alone: pass ``--trace DIR`` to wrap
+the timed runs in ``jax.profiler.trace`` and read the memory-bandwidth
+counters from the XProf capture (VERDICT r1 #5 asks for exactly that).
+
+Run on the real chip:  python tools/perf_dossier.py [--trace DIR] [config ...]
+Configs: resnet50 bert lstm flashbwd (default: all).
+``--smoke``: tiny CPU shapes to validate wiring — table rows are
+labeled ``(smoke)`` and carry no MFU claim.
+Writes a markdown table to stdout; paste into BASELINE.md.
+"""
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+PEAK_TFLOPS_BF16 = 197.0        # v5e MXU peak
+
+
+def _sync(x):
+    import jax.numpy as jnp
+    return float(jnp.asarray(x).astype(jnp.float32).ravel()[0])
+
+
+def _timeit(fn, sync_out, n=20, warmup=5):
+    for _ in range(warmup):
+        out = fn()
+    _sync(sync_out(out))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn()
+    _sync(sync_out(out))
+    return (time.perf_counter() - t0) / n
+
+
+SMOKE = False        # --smoke: tiny shapes on CPU to validate wiring
+
+
+def resnet50():
+    """ResNet-50 train step, batch 256 @ 224² bf16 (BASELINE cfg #2)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn import updaters as upd
+    from deeplearning4j_tpu.zoo import ResNet50
+
+    batch, size = (4, 64) if SMOKE else (256, 224)
+    net = ResNet50(num_classes=1000, seed=1, input_shape=(size, size, 3),
+                   updater=upd.Nesterovs(learning_rate=0.1, momentum=0.9),
+                   compute_dtype="bfloat16").init()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, size, size, 3)),
+                    jnp.float32)
+    y = jnp.asarray(np.eye(1000, dtype=np.float32)[
+        rng.integers(0, 1000, batch)])
+    step = net._make_train_step()
+    params, opt, state = net.params, net.opt_state, net.state
+    key = jax.random.PRNGKey(0)
+    # graph-style nets take ({name: x}, [y], masks, lmasks, rng)
+    graph = hasattr(net.conf, "inputs")
+
+    def one():
+        nonlocal params, opt, state
+        if graph:
+            params, opt, state, loss = step(
+                params, opt, state, {net.conf.inputs[0]: x}, [y],
+                {}, {}, key)
+        else:
+            params, opt, state, loss = step(params, opt, state, x, y,
+                                            None, None, key)
+        return loss
+
+    dt = _timeit(one, lambda l: l)
+    # ResNet-50 fwd ≈ 4.1 GFLOP @224²/img; train ≈ 3x fwd
+    flops = 3 * 4.1e9 * batch
+    return ("ResNet-50 train b256@224 bf16", batch / dt, "img/s", dt,
+            flops)
+
+
+def bert():
+    """BERT-base fine-tune step, B=64 T=128 bf16 (BASELINE cfg #4)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.zoo import BertBase
+
+    b, t = (2, 32) if SMOKE else (64, 128)
+    if SMOKE:
+        from deeplearning4j_tpu.zoo import BertTiny as BertBase  # noqa
+    net = BertBase(seed=2,
+                   compute_dtype=None if SMOKE else "bfloat16") \
+        .init_classifier(num_classes=2, seq_len=t)
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, 30000, (b, t)), jnp.int32)
+    segs = jnp.zeros((b, t), jnp.int32)
+    y = jnp.asarray(np.eye(2, dtype=np.float32)[
+        rng.integers(0, 2, b)])
+    step = net._make_train_step()
+    params, opt, state = net.params, net.opt_state, net.state
+    key = jax.random.PRNGKey(0)
+    feed = {"tokens": ids, "segments": segs}
+
+    def one():
+        nonlocal params, opt, state
+        params, opt, state, loss = step(params, opt, state, feed, [y],
+                                        {}, {}, key)
+        return loss
+
+    dt = _timeit(one, lambda l: l)
+    flops = 6 * 109e6 * b * t             # 6·N·tokens (dense transformer)
+    return ("BERT-base finetune b64 t128 bf16", b / dt, "samples/s", dt,
+            flops)
+
+
+def lstm():
+    """GravesLSTM char-RNN config (BASELINE cfg #3)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.zoo import TextGenerationLSTM
+
+    vocab, b, t = (12, 4, 20) if SMOKE else (77, 64, 200)
+    net = TextGenerationLSTM(vocab_size=vocab,
+                             hidden=16 if SMOKE else 512,
+                             layers=1 if SMOKE else 2,
+                             seed=3, tbptt=10 if SMOKE else 50).init()
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, vocab, (b, t + 1))
+    x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids[:, :-1]])
+    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids[:, 1:]])
+    step = net._make_train_step()
+    params, opt, state = net.params, net.opt_state, net.state
+    key = jax.random.PRNGKey(0)
+
+    def one():
+        nonlocal params, opt, state
+        params, opt, state, loss = step(params, opt, state, x, y,
+                                        None, None, key)
+        return loss
+
+    dt = _timeit(one, lambda l: l, n=10)
+    # 2-layer 512 peephole LSTM: ~2·(4·(d_in·d_h + d_h²))·T·B·3(train)
+    d = 512
+    flops = 3 * 2 * (4 * (vocab * d + d * d) + 4 * 2 * d * d) * t * b
+    return ("charRNN 2x512 b64 t200", b * t / dt, "chars/s", dt, flops)
+
+
+def flashbwd():
+    """Flash-attention fwd+bwd: Pallas backward vs scan recompute."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops import pallas_kernels as pk
+
+    B, T, H, D = (1, 128, 2, 16) if SMOKE else (8, 2048, 8, 64)
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((B, T, H, D)),
+                           jnp.bfloat16) for _ in range(3))
+    fold = (lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, D))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(pk.flash_attention(
+            q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    def loss_scan(q, k, v):
+        return jnp.sum(pk._reference_scan(
+            fold(q), fold(k), fold(v), True).astype(jnp.float32) ** 2)
+
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+    gs = jax.jit(jax.grad(loss_scan, argnums=(0, 1, 2)))
+    dtf = _timeit(lambda: gf(q, k, v), lambda g: g[0])
+    dts = _timeit(lambda: gs(q, k, v), lambda g: g[0])
+    # attention train FLOPs ≈ 2(fwd QK+PV) + 5x matmul-equiv bwd
+    flops = 3.5 * 4 * B * H * T * T * D / 2   # causal halves the work
+    label = (f"flash-attn fwd+bwd b{B} t{T} h{H} d{D} "
+             f"[{dts / dtf:.2f}x vs scan-recompute "
+             f"{dts*1e3:.1f}→{dtf*1e3:.1f} ms]")
+    return (label, 1.0 / dtf, "steps/s", dtf, flops)
+
+
+def main(names):
+    global SMOKE
+    if "--smoke" in names:
+        SMOKE = True
+        names = [n for n in names if n != "--smoke"]
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    if not SMOKE:
+        assert jax.devices()[0].platform in ("tpu", "axon"), \
+            "perf dossier must run on the real chip (or pass --smoke)"
+    trace_dir = None
+    if "--trace" in names:
+        i = names.index("--trace")
+        trace_dir = names[i + 1]
+        names = names[:i] + names[i + 2:]
+    rows = []
+    table = {"resnet50": resnet50, "bert": bert, "lstm": lstm,
+             "flashbwd": flashbwd}
+
+    def run_all():
+        for name in names or list(table):
+            try:
+                rows.append(table[name]())
+            except Exception as e:
+                print(f"{name}: FAILED {type(e).__name__}: {e}")
+
+    if trace_dir:
+        with jax.profiler.trace(trace_dir):
+            run_all()
+        print(f"# XProf capture in {trace_dir} — read the HBM "
+              "bandwidth counters there")
+    else:
+        run_all()
+    if SMOKE:
+        print("\n# SMOKE RUN — wiring check only; labels describe the "
+              "real configs but shapes were tiny. NOT for BASELINE.md.")
+        print("| Config | Step |")
+        print("|---|---|")
+        for label, thr, unit, dt, flops in rows:
+            print(f"| {label} (smoke) | {dt*1e3:.1f} ms |")
+        return
+    print("\n| Config | Throughput | Step | TFLOP/s | MFU |")
+    print("|---|---|---|---|---|")
+    for label, thr, unit, dt, flops in rows:
+        tflops = flops / dt / 1e12
+        mfu = 100 * tflops / PEAK_TFLOPS_BF16
+        print(f"| {label} | {thr:,.0f} {unit} | {dt*1e3:.1f} ms | "
+              f"{tflops:.1f} | {mfu:.1f}% |")
+    print(json.dumps([{ "config": r[0], "throughput": r[1],
+                        "unit": r[2], "step_s": r[3]} for r in rows]))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -91,54 +91,7 @@ def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
     return fn(q, k, v, mask)
 
 
-def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
-                      mask: Optional[jax.Array] = None):
-    """Ulysses-style (DeepSpeed) sequence parallelism: all-to-all swaps
-    the sharded axis from sequence to heads, each device computes FULL
-    attention for its head subset, then swaps back. One all-to-all pair
-    instead of N ring hops — better when heads ≥ devices and ICI
-    all-to-all bandwidth is plentiful.
-
-    q,k,v: [B, T, H, D] sharded on T. H must be divisible by the axis
-    size.
-    """
-    def local(q, k, v, kmask):
-        n = lax.psum(1, axis_name)
-
-        def seq_to_heads(x):
-            # [B, T/n, H, D] -> all_to_all -> [B, T, H/n, D]
-            b, tl, h, d = x.shape
-            x = x.reshape(b, tl, n, h // n, d)
-            x = lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
-                               tiled=False)
-            return x.reshape(b, tl * n, h // n, d)
-
-        def heads_to_seq(x):
-            b, t, hl, d = x.shape
-            x = x.reshape(b, n, t // n, hl, d)
-            x = lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3,
-                               tiled=False)
-            return x.reshape(b, t // n, hl * n, d)
-
-        qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-        kmf = lax.all_gather(kmask, axis_name, axis=1, tiled=True) \
-            if kmask is not None else None
-        dd = qf.shape[-1]
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) / jnp.sqrt(
-            jnp.asarray(dd, qf.dtype))
-        if kmf is not None:
-            s = jnp.where(kmf[:, None, None, :] > 0, s, -1e9)
-        w = jax.nn.softmax(s, axis=-1)
-        of = jnp.einsum("bhqk,bkhd->bqhd", w, vf)
-        return heads_to_seq(of)
-
-    spec = P(None, axis_name, None, None)
-    mspec = P(None, axis_name)
-    if mask is None:
-        fn = shard_map(lambda q, k, v: local(q, k, v, None), mesh=mesh,
-                       in_specs=(spec, spec, spec), out_specs=spec,
-                       check_vma=False)
-        return fn(q, k, v)
-    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec, mspec),
-                   out_specs=spec, check_vma=False)
-    return fn(q, k, v, mask)
+# Ulysses all-to-all SP lives in parallel/ulysses.py; this alias
+# preserves the original import location.
+from deeplearning4j_tpu.parallel.ulysses import \
+    ulysses_self_attention as ulysses_attention  # noqa: E402

@@ -11,6 +11,14 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+import jax
+
+# force CPU BEFORE any device query — sitecustomize routes to the axon
+# TPU tunnel otherwise, which serializes tiny examples (and hangs when
+# the tunnel is down); opt into TPU with DL4J_TPU_EXAMPLE_TPU=1
+if os.environ.get("DL4J_TPU_EXAMPLE_TPU") != "1":
+    jax.config.update("jax_platforms", "cpu")
+
 FAST = os.environ.get("DL4J_TPU_EXAMPLE_FAST") == "1"
 
 

@@ -13,7 +13,8 @@ EXAMPLES = Path(__file__).parent.parent / "examples"
     "lenet_mnist", "char_rnn_textgen", "bert_finetune",
     "distributed_data_parallel", "samediff_autodiff",
     "parallelism_modes", "hyperparameter_search", "transfer_learning",
-    "model_serving",
+    "model_serving", "pretrained_zoo", "long_context_attention",
+    "sharded_serving",
 ])
 def test_example_runs(name, monkeypatch, capsys):
     monkeypatch.setenv("DL4J_TPU_EXAMPLE_FAST", "1")

@@ -201,6 +201,9 @@ def main(names):
     trace_dir = None
     if "--trace" in names:
         i = names.index("--trace")
+        if i + 1 >= len(names) or names[i + 1] in ("resnet50", "bert",
+                                                   "lstm", "flashbwd"):
+            sys.exit("usage: perf_dossier.py --trace DIR [config ...]")
         trace_dir = names[i + 1]
         names = names[:i] + names[i + 2:]
     rows = []

@@ -67,6 +67,25 @@ class Evaluation:
         else:
             self.top_n_correct += int(np.sum(li == pi))
 
+    def merge(self, other: "Evaluation") -> "Evaluation":
+        """Fold another Evaluation's sufficient statistics into this one
+        (reference ``org.nd4j.evaluation.IEvaluation#merge`` — the
+        cross-shard reduction used by distributed evaluation)."""
+        if other.confusion is None:
+            return self
+        if self.confusion is None:
+            self.n_classes = other.n_classes
+            self.confusion = other.confusion.copy()
+        else:
+            if self.n_classes != other.n_classes:
+                raise ValueError(
+                    f"merge: class-count mismatch {self.n_classes} vs "
+                    f"{other.n_classes}")
+            self.confusion += other.confusion
+        self.top_n_correct += other.top_n_correct
+        self.count += other.count
+        return self
+
     # -- metrics (reference method names) ------------------------------
     def accuracy(self) -> float:
         c = self.confusion
@@ -157,6 +176,19 @@ class EvaluationBinary:
         self.tn += np.sum(~labels & ~preds & w, axis=0)
         self.fn += np.sum(labels & ~preds & w, axis=0)
 
+    def merge(self, other: "EvaluationBinary") -> "EvaluationBinary":
+        if other.tp is None:
+            return self
+        if self.tp is None:
+            self.tp, self.fp = other.tp.copy(), other.fp.copy()
+            self.tn, self.fn = other.tn.copy(), other.fn.copy()
+        else:
+            self.tp += other.tp
+            self.fp += other.fp
+            self.tn += other.tn
+            self.fn += other.fn
+        return self
+
     def accuracy(self, i: int) -> float:
         tot = self.tp[i] + self.fp[i] + self.tn[i] + self.fn[i]
         return float((self.tp[i] + self.tn[i]) / max(tot, 1))
@@ -188,6 +220,11 @@ class ROC:
             preds = preds[..., 1]
         self.scores.append(preds.ravel())
         self.labels.append(labels.ravel())
+
+    def merge(self, other: "ROC") -> "ROC":
+        self.scores.extend(other.scores)
+        self.labels.extend(other.labels)
+        return self
 
     def _collect(self):
         s = np.concatenate(self.scores)
@@ -231,6 +268,11 @@ class ROCMultiClass:
             self.rocs.setdefault(c, ROC()).eval(labels[..., c],
                                                 preds[..., c])
 
+    def merge(self, other: "ROCMultiClass") -> "ROCMultiClass":
+        for c, r in other.rocs.items():
+            self.rocs.setdefault(c, ROC()).merge(r)
+        return self
+
     def calculate_auc(self, cls: int) -> float:
         return self.rocs[cls].calculate_auc()
 
@@ -261,6 +303,11 @@ class ROCBinary:
                 keep = mc.ravel() > 0
                 lc, pc = lc.ravel()[keep], pc.ravel()[keep]
             self.rocs.setdefault(c, ROC()).eval(lc, pc)
+
+    def merge(self, other: "ROCBinary") -> "ROCBinary":
+        for c, r in other.rocs.items():
+            self.rocs.setdefault(c, ROC()).merge(r)
+        return self
 
     def num_labels(self) -> int:
         return len(self.rocs)
@@ -305,6 +352,15 @@ class EvaluationCalibration:
         np.add.at(self.bin_correct, idx, (pi == li).astype(np.int64))
         np.add.at(self.bin_prob_sum, idx, conf)
 
+    def merge(self,
+              other: "EvaluationCalibration") -> "EvaluationCalibration":
+        if other.bins != self.bins:
+            raise ValueError("merge: bin-count mismatch")
+        self.bin_counts += other.bin_counts
+        self.bin_correct += other.bin_correct
+        self.bin_prob_sum += other.bin_prob_sum
+        return self
+
     def reliability(self):
         with np.errstate(invalid="ignore"):
             acc = self.bin_correct / np.maximum(self.bin_counts, 1)
@@ -348,6 +404,18 @@ class RegressionEvaluation:
         s["p2"] += (p ** 2).sum(axis=0)
         s["yp"] += (y * p).sum(axis=0)
         self.n += y.shape[0]
+
+    def merge(self,
+              other: "RegressionEvaluation") -> "RegressionEvaluation":
+        if other._sums is None:
+            return self
+        if self._sums is None:
+            self._sums = {k: v.copy() for k, v in other._sums.items()}
+        else:
+            for k in self._sums:
+                self._sums[k] += other._sums[k]
+        self.n += other.n
+        return self
 
     def mean_squared_error(self, col: int = 0) -> float:
         return float(self._sums["se"][col] / max(self.n, 1))

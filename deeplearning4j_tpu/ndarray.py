@@ -832,11 +832,16 @@ def _shape(shape) -> tuple:
     return tuple(shape)
 
 
-_GLOBAL_KEY = [jax.random.PRNGKey(0)]
+# lazily seeded: creating a PRNGKey materialises a device array, and
+# importing the library must NEVER initialise a backend (with the axon
+# tunnel down, a device touch at import time hangs every consumer)
+_GLOBAL_KEY = [None]
 
 
 def _next_key(seed: Optional[int] = None):
     if seed is not None:
         return jax.random.PRNGKey(seed)
+    if _GLOBAL_KEY[0] is None:
+        _GLOBAL_KEY[0] = jax.random.PRNGKey(0)
     _GLOBAL_KEY[0], sub = jax.random.split(_GLOBAL_KEY[0])
     return sub

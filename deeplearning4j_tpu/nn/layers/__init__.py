@@ -29,7 +29,7 @@ from deeplearning4j_tpu.nn.layers.conv import (
 from deeplearning4j_tpu.nn.layers.recurrent import (
     LSTM, GravesLSTM, SimpleRnn, GRU, Bidirectional, LastTimeStep,
     RnnOutputLayer, RnnLossLayer, MaskZeroLayer, TimeDistributed,
-    GravesBidirectionalLSTM,
+    GravesBidirectionalLSTM, ConvLSTM2D,
 )
 from deeplearning4j_tpu.nn.layers.attention import (
     SelfAttentionLayer, LearnedSelfAttentionLayer, MultiHeadAttention,

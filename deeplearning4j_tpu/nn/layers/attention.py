@@ -46,11 +46,14 @@ def scaled_dot_attention(q, k, v, mask=None, causal=False):
     O(T) memory, 1.2-1.7x faster than the einsum at T>=4k.
     """
     d = q.shape[-1]
-    if (mask is None and q.shape[1] >= 1024 and q.shape[1] == k.shape[1]
+    if (q.shape[1] >= 1024 and q.shape[1] == k.shape[1]
             and q.dtype != jnp.float64
             and jax.default_backend() == "tpu"):
+        # masked sequences take the flash path too (per-example key
+        # mask operand in the kernel) — every padded-batch NLP workload
+        # stays O(T) memory instead of falling back to the [T,T] einsum
         from deeplearning4j_tpu.ops.pallas_kernels import flash_attention
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal, mask=mask)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
         jnp.asarray(d, q.dtype))
     neg = jnp.asarray(-1e30 if q.dtype == jnp.float64 else -1e9, q.dtype)

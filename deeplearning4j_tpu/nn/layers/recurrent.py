@@ -415,3 +415,85 @@ class GravesBidirectionalLSTM(Bidirectional):
                 activation=self.activation,
                 weight_init=self.weight_init, dropout=self.dropout,
                 l1=self.l1, l2=self.l2, bias_init=self.bias_init)
+
+
+@register_layer
+@dataclass
+class ConvLSTM2D(Layer):
+    """Convolutional LSTM over [B, T, H, W, C] (Keras ConvLSTM2D /
+    reference keras-import ``KerasConvLSTM2D``): every gate is a conv —
+    the input path convolves each frame, the recurrent path convolves
+    the hidden state (stride 1, SAME so spatial dims persist).
+
+    TPU design: the input convolution for ALL timesteps is ONE batched
+    conv ([B*T, H, W, C] — lands on the MXU); only the recurrent conv
+    runs inside ``lax.scan``. Gate packing follows Keras ([i, f, c, o]
+    along the last kernel axis) so imported weights map 1:1.
+    """
+    n_in: Optional[int] = None
+    n_out: int = 0
+    kernel_size: tuple = (3, 3)
+    stride: tuple = (1, 1)
+    padding: str = "VALID"
+    gate_activation: str = "hardsigmoid_keras"
+    return_sequences: bool = True
+    forget_gate_bias_init: float = 1.0
+
+    def _out_hw(self, h, w):
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        if self.padding.upper() == "SAME":
+            return -(-h // sh), -(-w // sw)
+        return (h - kh) // sh + 1, (w - kw) // sw + 1
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        t, h, w, c = input_shape
+        c = self.n_in or c
+        f = self.n_out
+        kh, kw = self.kernel_size
+        kx, kh_ = jax.random.split(key)
+        wi = winit.get(self.weight_init or "xavier")
+        bias = jnp.concatenate([
+            jnp.zeros((f,), dtype),
+            jnp.full((f,), self.forget_gate_bias_init, dtype),
+            jnp.zeros((2 * f,), dtype)])
+        params = {"Wx": wi(kx, (kh, kw, c, 4 * f), dtype),
+                  "Wh": wi(kh_, (kh, kw, f, 4 * f), dtype),
+                  "b": bias}
+        oh, ow = self._out_hw(h, w)
+        out = (t, oh, ow, f) if self.return_sequences else (oh, ow, f)
+        return params, {}, out
+
+    def apply(self, params, state, x, *, train=False, rng=None,
+              mask=None):
+        b, t, h, w, c = x.shape
+        f = self.n_out
+        dn = ("NHWC", "HWIO", "NHWC")
+        gate = activations.get(self.gate_activation)
+        act = self._act("tanh")
+        # one batched conv for every frame's input projection
+        xg = lax.conv_general_dilated(
+            x.reshape(b * t, h, w, c), params["Wx"],
+            window_strides=self.stride, padding=self.padding.upper(),
+            dimension_numbers=dn) + params["b"]
+        oh, ow = xg.shape[1:3]
+        xg = xg.reshape(b, t, oh, ow, 4 * f).swapaxes(0, 1)
+        Wh = params["Wh"]
+
+        def step(carry, g):
+            hp, cp = carry
+            z = g + lax.conv_general_dilated(
+                hp, Wh, window_strides=(1, 1), padding="SAME",
+                dimension_numbers=dn)
+            zi, zf, zc, zo = jnp.split(z, 4, axis=-1)  # Keras order
+            i, fg, o = gate(zi), gate(zf), gate(zo)
+            cn = fg * cp + i * act(zc)
+            hn = o * act(cn)
+            return (hn, cn), hn
+
+        zeros = jnp.zeros((b, oh, ow, f), x.dtype)
+        (hT, _), ys = lax.scan(step, (zeros, zeros), xg,
+                               unroll=_SCAN_UNROLL)
+        if not self.return_sequences:
+            return hT, state
+        return jnp.swapaxes(ys, 0, 1), state

@@ -113,6 +113,13 @@ def thresholdedrelu(x, theta: float = 1.0):
     return jnp.where(x > theta, x, 0.0)
 
 
+def clippedrelu(x, max_value: float = 6.0):
+    """ReLU capped at ``max_value`` (Keras ReLU(max_value=m); the
+    reference's ActivationReLU with a cap). ``relu6`` is the m=6
+    special case."""
+    return jnp.clip(x, 0.0, max_value)
+
+
 _REGISTRY: Dict[str, Callable] = {
     "identity": identity,
     "linear": identity,
@@ -140,6 +147,7 @@ _REGISTRY: Dict[str, Callable] = {
     "silu": swish,
     "mish": mish,
     "thresholdedrelu": thresholdedrelu,
+    "clippedrelu": clippedrelu,
 }
 
 
@@ -156,7 +164,8 @@ def get(name_or_fn) -> Callable:
     if ":" in key:
         base, _, arg = key.partition(":")
         alpha = float(arg)
-        if base in ("leakyrelu", "elu", "celu", "thresholdedrelu"):
+        if base in ("leakyrelu", "elu", "celu", "thresholdedrelu",
+                    "clippedrelu"):
             fn = _REGISTRY[base]
             return lambda x: fn(x, alpha)
         raise ValueError(f"activation {base!r} takes no parameter")

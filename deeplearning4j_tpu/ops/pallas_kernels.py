@@ -11,9 +11,14 @@ generic jnp fallback otherwise.
 Kernels:
 - ``flash_attention`` — blockwise online-softmax attention
   (never materialises the [T,T] score matrix; VMEM-resident
-  accumulators; MXU matmuls per block). Used by
-  ``scaled_dot_attention`` for long sequences on TPU and as the
-  building block the ring-attention layer composes over ICI.
+  accumulators; MXU matmuls per block). Supports per-example key
+  masks and dynamic global position offsets (for ring composition).
+  Used by ``scaled_dot_attention`` for long sequences on TPU —
+  including padded/masked batches — and composed per-KV-block by
+  ``parallel.ring_attention`` over ICI (``flash_block_fwd`` /
+  ``flash_block_bwd`` below are the composition surface: the ring
+  carries (out, lse) accumulators between Pallas calls and merges
+  them with exact log-sum-exp combination).
 - ``threshold_encode`` / ``threshold_decode`` — fused gradient
   threshold compression (reference libnd4j ops ``encode_threshold`` /
   ``decode_threshold``): one VMEM pass computes the ternary
@@ -45,7 +50,8 @@ def _vma(*xs) -> frozenset:
     (check_vma) — outputs vary over every axis an input varies over."""
     out: frozenset = frozenset()
     for x in xs:
-        out = out | getattr(jax.typeof(x), "vma", frozenset())
+        if x is not None:
+            out = out | getattr(jax.typeof(x), "vma", frozenset())
     return out
 
 
@@ -54,7 +60,7 @@ def _align_vma(x, vma: frozenset):
     kernel operand carries the same vma (mixed vmas trip check_vma
     inside pallas interpret mode)."""
     missing = vma - getattr(jax.typeof(x), "vma", frozenset())
-    return lax.pvary(x, tuple(missing)) if missing else x
+    return lax.pcast(x, tuple(missing), to="varying") if missing else x
 
 
 def _jnp_fallback(*xs) -> bool:
@@ -67,7 +73,20 @@ def _jnp_fallback(*xs) -> bool:
 # ---------------------------------------------------------------------------
 # flash attention
 # ---------------------------------------------------------------------------
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+#
+# All kernels take, in addition to q/k/v:
+#  - km_ref: [1, block_k] per-(batch·head) key validity mask block
+#    (1 = attend, 0 = padded key) — the reference cuDNN fused-attention
+#    helper's mask operand analog; blocks whose mask is all-zero are
+#    skipped entirely.
+#  - off_ref: SMEM int32 [2] = (q_offset, k_offset) GLOBAL position
+#    offsets used for causal masking. (0, 0) for single-device
+#    attention; ring attention passes (my_idx·Tq, src_idx·Tk) so the
+#    causal diagonal lands correctly on every ring step and blocks
+#    fully above the diagonal are skipped without any work.
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, km_ref, off_ref, o_ref, *rest,
                   scale: float, causal: bool, t_real: int,
                   block_q: int, block_k: int):
     # rest = (lse_ref?, acc, m, l): the lse output only exists on the
@@ -84,13 +103,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         acc[:] = jnp.zeros_like(acc[:])
 
     # skip dead blocks entirely (the einsum path can't): kv blocks
-    # fully past the real sequence, and — causal — blocks fully above
-    # the diagonal
+    # fully past the real sequence, blocks whose key mask is all-zero,
+    # and — causal — blocks fully above the (offset) diagonal
     i = pl.program_id(1)
-    live = j * block_k < t_real
+    km = km_ref[0]
+    live = jnp.logical_and(j * block_k < t_real, jnp.any(km > 0))
     if causal:
+        q_off, k_off = off_ref[0], off_ref[1]
         live = jnp.logical_and(
-            live, j * block_k <= i * block_q + block_q - 1)
+            live,
+            k_off + j * block_k <= q_off + i * block_q + block_q - 1)
 
     @pl.when(live)
     def _():
@@ -98,14 +120,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         k = k_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
 
-        # mask padded kv positions (t_real is the unpadded length)
+        # mask padded kv positions (t_real is the unpadded length) and
+        # key-masked positions
         kv_idx = j * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        mask = kv_idx < t_real
+        mask = jnp.logical_and(kv_idx < t_real,
+                               jnp.broadcast_to(km[None, :] > 0,
+                                                (block_q, block_k)))
         if causal:
             q_idx = i * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            mask = jnp.logical_and(mask, kv_idx <= q_idx)
+            mask = jnp.logical_and(
+                mask, off_ref[1] + kv_idx <= off_ref[0] + q_idx)
         s = jnp.where(mask, s, -jnp.inf)
 
         m_prev = m[:, :1]
@@ -136,34 +162,57 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                                           lse_ref.shape[1:])
 
 
-def _flash_blocks(t: int, d: int, block_q: int, block_k: int):
-    t128 = -(-t // 128) * 128
-    block_q = min(block_q, t128)              # don't block past the data
-    block_k = min(block_k, t128)
-    tq = -(-t // block_q) * block_q           # q and kv padded separately
-    tk = -(-t // block_k) * block_k           # (≤ one partial block each)
+def _flash_blocks(tq_real: int, tk_real: int, d: int, block_q: int,
+                  block_k: int):
+    q128 = -(-tq_real // 128) * 128
+    k128 = -(-tk_real // 128) * 128
+    block_q = min(block_q, q128)              # don't block past the data
+    block_k = min(block_k, k128)
+    tq = -(-tq_real // block_q) * block_q     # q and kv padded separately
+    tk = -(-tk_real // block_k) * block_k     # (≤ one partial block each)
     dp = max(-(-d // 128) * 128, 128)         # lane-align head dim
     return block_q, block_k, tq, tk, dp
 
 
-def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
-               return_lse: bool = False):
-    """q,k,v: [BH, T, D] (heads folded). Returns [BH, T, D] (and, for
-    the vjp, the padded per-row [BH, Tq, 1] logsumexp)."""
+def _ones_km(x):
+    return jnp.ones(x.shape[:2], jnp.float32)
+
+
+def _zero_offs():
+    return jnp.zeros((2,), jnp.int32)
+
+
+def _flash_fwd(q, k, v, km, offs, causal: bool, block_q: int,
+               block_k: int, return_lse: bool = False):
+    """q,k,v: [BH, T, D] (heads folded); km: [BH, Tk] key mask;
+    offs: int32 [2] global (q, k) position offsets. Returns [BH, T, D]
+    (and, for the vjp / ring composition, the per-row [BH, Tq, 1]
+    logsumexp)."""
+    if km is None:
+        km = _ones_km(k)
+    if offs is None:
+        offs = _zero_offs()
     if _jnp_fallback(q, k, v):
-        out = _reference_scan(q, k, v, causal)
-        return (out, None) if return_lse else out
+        return _reference_scan(q, k, v, km, offs, causal,
+                               return_lse=return_lse)
     bh, t, d = q.shape
+    tk_real = k.shape[1]
     scale = 1.0 / (d ** 0.5)
-    block_q, block_k, tq, tk, dp = _flash_blocks(t, d, block_q, block_k)
+    block_q, block_k, tq, tk, dp = _flash_blocks(t, tk_real, d,
+                                                 block_q, block_k)
 
     def pad(x, tpad):
-        return jnp.pad(x, ((0, 0), (0, tpad - t), (0, dp - d)))
+        return jnp.pad(x, ((0, 0), (0, tpad - x.shape[1]),
+                           (0, dp - d)))
 
-    vma = _vma(q, k, v)
+    vma = _vma(q, k, v, km, offs)
     qp = _align_vma(pad(q, tq), vma)
     kp = _align_vma(pad(k, tk), vma)
     vp = _align_vma(pad(v, tk), vma)
+    kmp = _align_vma(
+        jnp.pad(km.astype(jnp.float32), ((0, 0), (0, tk - tk_real))),
+        vma)
+    offs = _align_vma(offs.astype(jnp.int32), vma)
     nq, nk = tq // block_q, tk // block_k
     oshape = jax.ShapeDtypeStruct((bh, tq, dp), q.dtype, vma=vma)
     ospec = pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, i, 0))
@@ -171,13 +220,16 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
     lspec = pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
     res = pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale, causal=causal,
-                          t_real=t, block_q=block_q, block_k=block_k),
+                          t_real=tk_real, block_q=block_q,
+                          block_k=block_k),
         out_shape=(oshape, lshape) if return_lse else oshape,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, dp), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, dp), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (b, j)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=(ospec, lspec) if return_lse else ospec,
         scratch_shapes=[
@@ -186,38 +238,46 @@ def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
         interpret=_interpret(),
-    )(qp, kp, vp)
+    )(qp, kp, vp, kmp, offs)
     if return_lse:
         out, lse = res
         # keep one lane per row as the residual (128x smaller);
-        # _flash_bwd re-broadcasts before its kernels
-        return out[:, :t, :d], lse[:, :, :1]
+        # _flash_bwd re-pads and re-broadcasts before its kernels
+        return out[:, :t, :d], lse[:, :t, :1]
     return res[:, :t, :d]
 
 
-def _reference_scan(q, k, v, causal: bool, block: int = 512):
-    """Differentiable O(T) -memory blockwise attention in plain jnp
-    (lax.scan over kv blocks) — the backward path and CPU fallback."""
+def _reference_scan(q, k, v, km=None, offs=None, causal: bool = False,
+                    block: int = 512, return_lse: bool = False):
+    """Differentiable O(T)-memory blockwise attention in plain jnp
+    (lax.scan over kv blocks) — the backward path and CPU fallback.
+    Same mask/offset semantics as the Pallas kernel."""
     bh, t, d = q.shape
-    tp = -(-t // block) * block
-    kp = jnp.pad(k, ((0, 0), (0, tp - t), (0, 0)))
-    vp = jnp.pad(v, ((0, 0), (0, tp - t), (0, 0)))
+    tk_real = k.shape[1]
+    tp = -(-tk_real // block) * block
+    kp = jnp.pad(k, ((0, 0), (0, tp - tk_real), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, tp - tk_real), (0, 0)))
+    kmp = (jnp.ones((bh, tp), jnp.float32) if km is None else
+           jnp.pad(km.astype(jnp.float32),
+                   ((0, 0), (0, tp - tk_real))))
+    q_off = 0 if offs is None else offs[0]
+    k_off = 0 if offs is None else offs[1]
     scale = 1.0 / (d ** 0.5)
-    q_idx = jnp.arange(t)[:, None]
+    q_idx = q_off + jnp.arange(t)[:, None]
 
     def step(carry, blk):
         m_prev, l_prev, acc = carry
-        kb, vb, j0 = blk
+        kb, vb, kmb, j0 = blk
         s = jnp.einsum("bqd,bkd->bqk", q, kb) * scale
         kv_idx = j0 + jnp.arange(block)[None, :]
-        mask = kv_idx < t
+        mask = jnp.logical_and(kv_idx < tk_real, kmb[:, None, :] > 0)
         if causal:
-            mask = jnp.logical_and(mask, kv_idx <= q_idx)
-        s = jnp.where(mask[None], s, -jnp.inf)
+            mask = jnp.logical_and(mask, k_off + kv_idx <= q_idx)
+        s = jnp.where(mask, s, -jnp.inf)
         m_blk = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_blk)
         safe = jnp.where(jnp.isinf(m_new), 0.0, m_new)
-        p = jnp.where(mask[None], jnp.exp(s - safe), 0.0)
+        p = jnp.where(mask, jnp.exp(s - safe), 0.0)
         alpha = jnp.where(jnp.isinf(m_prev), 0.0,
                           jnp.exp(m_prev - safe))
         l_new = l_prev * alpha + jnp.sum(p, -1, keepdims=True)
@@ -227,22 +287,33 @@ def _reference_scan(q, k, v, causal: bool, block: int = 512):
     nb = tp // block
     kb = kp.reshape(bh, nb, block, d).swapaxes(0, 1)
     vb = vp.reshape(bh, nb, block, d).swapaxes(0, 1)
+    kmb = kmp.reshape(bh, nb, block).swapaxes(0, 1)
     j0s = jnp.arange(nb) * block
-    init = (jnp.full((bh, t, 1), -jnp.inf),
-            jnp.zeros((bh, t, 1)), jnp.zeros((bh, t, d)))
-    (m, l, acc), _ = lax.scan(step, init, (kb, vb, j0s))
-    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    # under shard_map the carry must share the operands' varying axes
+    vma = _vma(q, k, v, km, offs)
+    init = tuple(_align_vma(x, vma) for x in (
+        jnp.full((bh, t, 1), -jnp.inf),
+        jnp.zeros((bh, t, 1)), jnp.zeros((bh, t, d))))
+    (m, l, acc), _ = lax.scan(step, init, (kb, vb, kmb, j0s))
+    den = jnp.maximum(l, 1e-30)
+    out = (acc / den).astype(q.dtype)
+    if return_lse:
+        return out, (m + jnp.log(den)).astype(jnp.float32)
+    return out
 
 
-def _flash_bwd_masks(i, j, t_real, block_q, block_k, causal):
+def _flash_bwd_masks(i, j, q_off, k_off, km, tq_real, tk_real, block_q,
+                     block_k, causal):
     """(q,kv) validity mask for one [block_q, block_k] tile."""
     q_idx = i * block_q + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     kv_idx = j * block_k + lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    mask = jnp.logical_and(q_idx < t_real, kv_idx < t_real)
+    mask = jnp.logical_and(q_idx < tq_real, kv_idx < tk_real)
+    mask = jnp.logical_and(mask, jnp.broadcast_to(
+        km[None, :] > 0, (block_q, block_k)))
     if causal:
-        mask = jnp.logical_and(mask, kv_idx <= q_idx)
+        mask = jnp.logical_and(mask, k_off + kv_idx <= q_off + q_idx)
     return mask
 
 
@@ -266,8 +337,8 @@ def _flash_bwd_p_ds(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, mask,
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-                         dq_ref, acc, *, scale, causal, t_real,
-                         block_q, block_k):
+                         km_ref, off_ref, dq_ref, acc, *, scale, causal,
+                         tq_real, tk_real, block_q, block_k):
     i, j = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -275,14 +346,18 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
     def _():
         acc[:] = jnp.zeros_like(acc[:])
 
-    live = j * block_k < t_real
+    km = km_ref[0]
+    q_off, k_off = off_ref[0], off_ref[1]
+    live = jnp.logical_and(j * block_k < tk_real, jnp.any(km > 0))
     if causal:
         live = jnp.logical_and(
-            live, j * block_k <= i * block_q + block_q - 1)
+            live,
+            k_off + j * block_k <= q_off + i * block_q + block_q - 1)
 
     @pl.when(live)
     def _():
-        mask = _flash_bwd_masks(i, j, t_real, block_q, block_k, causal)
+        mask = _flash_bwd_masks(i, j, q_off, k_off, km, tq_real,
+                                tk_real, block_q, block_k, causal)
         _, k, _, _, ds = _flash_bwd_p_ds(
             q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, mask, scale)
         acc[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
@@ -293,8 +368,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-                          dk_ref, dv_ref, acck, accv, *, scale, causal,
-                          t_real, block_q, block_k):
+                          km_ref, off_ref, dk_ref, dv_ref, acck, accv,
+                          *, scale, causal, tq_real, tk_real, block_q,
+                          block_k):
     j, i = pl.program_id(1), pl.program_id(2)   # kv outer, q inner
     nq = pl.num_programs(2)
 
@@ -303,14 +379,18 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         acck[:] = jnp.zeros_like(acck[:])
         accv[:] = jnp.zeros_like(accv[:])
 
-    live = i * block_q < t_real
+    km = km_ref[0]
+    q_off, k_off = off_ref[0], off_ref[1]
+    live = jnp.logical_and(i * block_q < tq_real, jnp.any(km > 0))
     if causal:
         live = jnp.logical_and(
-            live, i * block_q + block_q - 1 >= j * block_k)
+            live,
+            q_off + i * block_q + block_q - 1 >= k_off + j * block_k)
 
     @pl.when(live)
     def _():
-        mask = _flash_bwd_masks(i, j, t_real, block_q, block_k, causal)
+        mask = _flash_bwd_masks(i, j, q_off, k_off, km, tq_real,
+                                tk_real, block_q, block_k, causal)
         q, _, do, p, ds = _flash_bwd_p_ds(
             q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, mask, scale)
         accv[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
@@ -322,91 +402,172 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         dv_ref[0] = accv[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k):
+def _flash_bwd(q, k, v, out, lse, g, km, offs, causal, block_q,
+               block_k):
+    if _jnp_fallback(q, k, v, g):
+        # shard_map manual axes on CPU: interpret-mode pallas can't run
+        # there — exact jnp backward from the global lse instead
+        return _reference_bwd_block(q, k, v, out, lse, g, km, offs,
+                                    causal)
+    if km is None:
+        km = _ones_km(k)
+    if offs is None:
+        offs = _zero_offs()
     bh, t, d = q.shape
+    tk_real = k.shape[1]
     scale = 1.0 / (d ** 0.5)
-    block_q, block_k, tq, tk, dp = _flash_blocks(t, d, block_q, block_k)
+    block_q, block_k, tq, tk, dp = _flash_blocks(t, tk_real, d,
+                                                 block_q, block_k)
 
     def pad(x, tpad):
-        return jnp.pad(x, ((0, 0), (0, tpad - t), (0, dp - d)))
+        return jnp.pad(x, ((0, 0), (0, tpad - x.shape[1]),
+                           (0, dp - d)))
 
-    vma = _vma(q, k, v, g)
+    vma = _vma(q, k, v, g, km, offs)
     qp = _align_vma(pad(q, tq), vma)
     kp = _align_vma(pad(k, tk), vma)
     vp = _align_vma(pad(v, tk), vma)
     dop = _align_vma(pad(g, tq), vma)
     op = _align_vma(pad(out, tq), vma)
+    kmp = _align_vma(
+        jnp.pad(km.astype(jnp.float32), ((0, 0), (0, tk - tk_real))),
+        vma)
+    offs = _align_vma(offs.astype(jnp.int32), vma)
     # residual is [BH, Tq, 1]; kernels read a full 128-lane block
-    lsep = _align_vma(jnp.broadcast_to(lse, (bh, tq, 128)), vma)
+    lsep = _align_vma(jnp.broadcast_to(
+        jnp.pad(lse, ((0, 0), (0, tq - t), (0, 0))), (bh, tq, 128)),
+        vma)
     nq, nk = tq // block_q, tk // block_k
-    kw = dict(scale=scale, causal=causal, t_real=t,
+    kw = dict(scale=scale, causal=causal, tq_real=t, tk_real=tk_real,
               block_q=block_q, block_k=block_k)
     qspec = pl.BlockSpec((1, block_q, dp), lambda b, x, y: (b, x, 0))
     lspec = pl.BlockSpec((1, block_q, 128), lambda b, x, y: (b, x, 0))
     kspec = pl.BlockSpec((1, block_k, dp), lambda b, x, y: (b, y, 0))
+    kmspec = pl.BlockSpec((1, block_k), lambda b, x, y: (b, y))
+    sspec = pl.BlockSpec(memory_space=pltpu.SMEM)
     # grid (bh, i, j): q-side blocks follow grid axis 1, kv axis 2
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, **kw),
         out_shape=jax.ShapeDtypeStruct((bh, tq, dp), q.dtype, vma=vma),
         grid=(bh, nq, nk),
-        in_specs=[qspec, kspec, kspec, qspec, qspec, lspec],
+        in_specs=[qspec, kspec, kspec, qspec, qspec, lspec, kmspec,
+                  sspec],
         out_specs=qspec,
         scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
         interpret=_interpret(),
-    )(qp, kp, vp, dop, op, lsep)
+    )(qp, kp, vp, dop, op, lsep, kmp, offs)
     # grid (bh, j, i): kv-side blocks follow grid axis 1, q axis 2
     qspec2 = pl.BlockSpec((1, block_q, dp), lambda b, y, x: (b, x, 0))
     lspec2 = pl.BlockSpec((1, block_q, 128), lambda b, y, x: (b, x, 0))
     kspec2 = pl.BlockSpec((1, block_k, dp), lambda b, y, x: (b, y, 0))
+    kmspec2 = pl.BlockSpec((1, block_k), lambda b, y, x: (b, y))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, **kw),
         out_shape=(jax.ShapeDtypeStruct((bh, tk, dp), k.dtype, vma=vma),
                    jax.ShapeDtypeStruct((bh, tk, dp), v.dtype,
                                         vma=vma)),
         grid=(bh, nk, nq),
-        in_specs=[qspec2, kspec2, kspec2, qspec2, qspec2, lspec2],
+        in_specs=[qspec2, kspec2, kspec2, qspec2, qspec2, lspec2,
+                  kmspec2, sspec],
         out_specs=(kspec2, kspec2),
         scratch_shapes=[pltpu.VMEM((block_k, dp), jnp.float32),
                         pltpu.VMEM((block_k, dp), jnp.float32)],
         interpret=_interpret(),
-    )(qp, kp, vp, dop, op, lsep)
-    return dq[:, :t, :d], dk[:, :t, :d], dv[:, :t, :d]
+    )(qp, kp, vp, dop, op, lsep, kmp, offs)
+    return (dq[:, :t, :d], dk[:, :tk_real, :d], dv[:, :tk_real, :d])
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, block_q, block_k):
-    return _flash_fwd(q, k, v, causal, block_q, block_k)
+def _reference_bwd_block(q, k, v, out, lse, g, km, offs, causal):
+    """jnp backward for one (q-block, kv-block) pair given the global
+    logsumexp — the interpret-mode/shard_map fallback of
+    ``flash_block_bwd``. O(Tq·Tk) memory but only used on CPU tests."""
+    bh, t, d = q.shape
+    scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    q_idx = (0 if offs is None else offs[0]) + jnp.arange(t)[:, None]
+    kv_idx = ((0 if offs is None else offs[1])
+              + jnp.arange(k.shape[1])[None, :])
+    mask = (jnp.ones(s.shape, bool) if km is None
+            else jnp.broadcast_to(km[:, None, :] > 0, s.shape))
+    if causal:
+        mask = jnp.logical_and(mask, (kv_idx <= q_idx)[None])
+    lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+    p = jnp.where(mask, jnp.exp(s - lse_safe), 0.0)
+    gf = g.astype(jnp.float32)
+    delta = jnp.sum(gf * out.astype(jnp.float32), -1, keepdims=True)
+    dp = jnp.einsum("bqd,bkd->bqk", gf, v.astype(jnp.float32))
+    ds = p * (dp - delta) * scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, k.astype(jnp.float32))
+    dk = jnp.einsum("bqk,bqd->bkd", ds, q.astype(jnp.float32))
+    dv = jnp.einsum("bqk,bqd->bkd", p, gf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-def _flash_vjp_fwd(q, k, v, causal, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, causal, block_q, block_k,
+# --- ring composition surface ------------------------------------------------
+def flash_block_fwd(q, k, v, km=None, offs=None, causal: bool = False,
+                    block_q: int = 256, block_k: int = 1024):
+    """One (local-Q × one-KV-block) flash forward returning
+    ``(out, lse)`` — out is the softmax-normalised attention of q
+    against ONLY this kv block, lse its per-row logsumexp. Two such
+    partial results merge exactly via log-sum-exp combination
+    (``ring_attention._merge_blocks``); the ring carries (out, lse)
+    between Pallas calls. q,k,v: [BH, T, D]; km: [BH, Tk];
+    offs: int32 [2] dynamic global (q, k) offsets for causal."""
+    return _flash_fwd(q, k, v, km, offs, causal, block_q, block_k,
+                      return_lse=True)
+
+
+def flash_block_bwd(q, k, v, out, lse, g, km=None, offs=None,
+                    causal: bool = False, block_q: int = 256,
+                    block_k: int = 1024):
+    """Backward of one (q-block, kv-block) pair given the GLOBAL
+    (all-blocks) out/lse — FlashAttention-2 style recompute. Returns
+    (dq_contrib, dk, dv): dq_contrib sums over kv blocks; dk/dv are
+    this block's totals once every q block has contributed.
+    (_flash_bwd itself falls back to the jnp backward under
+    shard_map-on-CPU.)"""
+    return _flash_bwd(q, k, v, out, lse, g, km, offs, causal,
+                      block_q, block_k)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, km, causal, block_q, block_k):
+    return _flash_fwd(q, k, v, km, None, causal, block_q, block_k)
+
+
+def _flash_vjp_fwd(q, k, v, km, causal, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, km, None, causal, block_q, block_k,
                           return_lse=True)
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, km, out, lse)
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, res, g):
-    q, k, v, out, lse = res
-    if lse is None:
-        # shard_map-on-CPU fallback: recompute through the scan path
-        _, vjp = jax.vjp(
-            lambda a, b, c: _reference_scan(a, b, c, causal), q, k, v)
-        return vjp(g)
-    return _flash_bwd(q, k, v, out, lse, g, causal, block_q, block_k)
+    q, k, v, km, out, lse = res
+    dkm = None if km is None else jnp.zeros_like(km)
+    return _flash_bwd(q, k, v, out, lse, g, km, None, causal,
+                      block_q, block_k) + (dkm,)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = False,
+                    mask: Optional[jax.Array] = None,
                     block_q: int = 256, block_k: int = 1024):
     """Blockwise attention, [B, T, H, D] layout (head axis 2) like
-    ``scaled_dot_attention``. Differentiable: the backward is a pair of
-    Pallas kernels (dQ; dK/dV) that recompute the probability tile per
-    block from the saved logsumexp — FlashAttention-2 style, no [T,T]
-    materialisation in either direction."""
+    ``scaled_dot_attention``; ``mask``: optional [B, Tk] key mask.
+    Differentiable: the backward is a pair of Pallas kernels (dQ;
+    dK/dV) that recompute the probability tile per block from the
+    saved logsumexp — FlashAttention-2 style, no [T,T] materialisation
+    in either direction."""
     b, t, h, d = q.shape
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, -1)
-    o = _flash(fold(q), fold(k), fold(v), causal, block_q, block_k)
+    km = None
+    if mask is not None:
+        # per-example key mask → per-(batch·head) rows
+        km = jnp.repeat(mask.astype(jnp.float32), h, axis=0)
+    o = _flash(fold(q), fold(k), fold(v), km, causal, block_q, block_k)
     return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 

@@ -190,14 +190,55 @@ class ShardedDataSetIterator:
         return getattr(self.base, name)
 
 
+def merge_across_processes(evals):
+    """Cross-process reduction of evaluation objects (reference
+    ``SparkDl4jMultiLayer#doEvaluation``: per-partition local eval
+    followed by a reduce of ``IEvaluation#merge``).
+
+    Every process calls this with its local shard's evaluation(s); the
+    serialized sufficient statistics are allgathered over the
+    ``jax.distributed`` cluster (byte payloads padded to the global max
+    so the collective is rectangular) and merged in process order, so
+    every process returns the identical full-data evaluation. Works for
+    any evaluation class with a ``merge`` method.
+    """
+    import pickle
+
+    single = not isinstance(evals, (list, tuple))
+    evs = [evals] if single else list(evals)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils as mh
+        payload = np.frombuffer(pickle.dumps(evs), np.uint8)
+        lens = np.asarray(mh.process_allgather(
+            jnp.asarray([payload.size], jnp.int32))).reshape(-1)
+        padded = np.zeros(int(lens.max()), np.uint8)
+        padded[:payload.size] = payload
+        gathered = np.asarray(mh.process_allgather(jnp.asarray(padded)))
+        merged = None
+        for p in range(jax.process_count()):
+            shard = pickle.loads(gathered[p, :lens[p]].tobytes())
+            if merged is None:
+                merged = shard
+            else:
+                if len(shard) != len(merged):
+                    raise ValueError(
+                        f"process {p} contributed {len(shard)} "
+                        f"evaluation objects, expected {len(merged)} — "
+                        "every process must pass the same evaluations")
+                for a, b in zip(merged, shard):
+                    a.merge(b)
+        evs = merged
+    return evs[0] if single else evs
+
+
 class SparkDl4jMultiLayer:
     """Reference ``SparkDl4jMultiLayer`` facade: distributed fit of a
     MultiLayerNetwork under a TrainingMaster strategy. Call
     ``initialize_distributed()`` first on every process (the
     spark-submit replacement); single-process it trains over all local
-    devices. ``evaluate``/``score`` run locally on this process's
-    shard (the reference evaluates on RDDs the same way: local eval +
-    reduce)."""
+    devices. ``evaluate`` runs locally on this process's shard, then
+    reduces across the cluster via ``merge_across_processes`` (the
+    reference's RDD local-eval + ``Evaluation#merge`` reduce)."""
 
     def __init__(self, net, training_master: TrainingMaster,
                  mesh=None):
@@ -227,8 +268,39 @@ class SparkDl4jMultiLayer:
         return self.fit(ListDataSetIterator(list(datasets)), epochs=epochs)
 
     def evaluate(self, iterator, num_classes: Optional[int] = None):
-        return self.net.evaluate(iterator) if num_classes is None else \
-            self.net.evaluate(iterator, num_classes=num_classes)
+        """Evaluate this process's shard, then merge confusion
+        statistics across all processes — every process returns the
+        full-data Evaluation. ``num_classes`` pins the class count for
+        shards that don't observe every class."""
+        if num_classes is None:
+            return merge_across_processes(self.net.evaluate(iterator))
+        from deeplearning4j_tpu.eval_.evaluation import Evaluation
+        return self.do_evaluation(iterator,
+                                  Evaluation(n_classes=num_classes))[0]
+
+    def evaluate_regression(self, iterator):
+        return merge_across_processes(
+            self.net.evaluate_regression(iterator))
+
+    def do_evaluation(self, iterator, *evals):
+        """Reference ``doEvaluation``: run arbitrary evaluation
+        objects over the local shard, reduce across processes.
+        Multi-io graphs evaluate on the FIRST output/label pair
+        (reference ``SparkComputationGraph#doEvaluation`` default)."""
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            x, y = (ds.features, ds.labels) if hasattr(ds, "features") \
+                else ds
+            out = (self.net.output(*x) if isinstance(x, (list, tuple))
+                   else self.net.output(x))
+            if isinstance(out, (list, tuple)):
+                out = out[0]
+            if isinstance(y, (list, tuple)):
+                y = y[0]
+            for e in evals:
+                e.eval(np.asarray(y), np.asarray(out))
+        return merge_across_processes(list(evals))
 
     def score(self) -> float:
         return self.net.score()
@@ -243,14 +315,18 @@ class SparkComputationGraph(SparkDl4jMultiLayer):
 
 
 def make_global_batch(mesh, local_x, local_y):
-    """Assemble a global device array from per-process local shards
+    """Assemble global device arrays from per-process local shards
     (reference: executors feeding their RDD partitions). On one process
     this is a plain device put; multi-process it uses
     ``jax.make_array_from_process_local_data`` so the jitted SPMD step
-    sees one logical batch spanning hosts."""
+    sees one logical batch spanning hosts. ``local_x``/``local_y`` may
+    be arrays or arbitrary pytrees of arrays (multi-input/multi-output
+    graphs): every leaf is sharded over the data axis."""
     from jax.sharding import NamedSharding, PartitionSpec as P
     sh = NamedSharding(mesh, P("data"))
     if jax.process_count() == 1:
-        return jax.device_put(local_x, sh), jax.device_put(local_y, sh)
-    return (jax.make_array_from_process_local_data(sh, np.asarray(local_x)),
-            jax.make_array_from_process_local_data(sh, np.asarray(local_y)))
+        put = lambda a: jax.device_put(a, sh)
+    else:
+        put = lambda a: jax.make_array_from_process_local_data(
+            sh, np.asarray(a))
+    return jax.tree.map(put, local_x), jax.tree.map(put, local_y)

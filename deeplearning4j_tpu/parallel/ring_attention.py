@@ -7,17 +7,33 @@ scaling.
 
 Design (blockwise/ring attention à la Liu et al.): the sequence axis is
 sharded over the mesh's 'seq' axis. Each device holds a Q block and a
-KV block. Over ``n_seq`` ring steps, every device computes attention of
-its Q block against the KV block it currently holds, accumulating a
-numerically-stable online softmax (running max + weighted sums), then
+KV block. Over ``n_seq`` ring steps, every device computes flash
+attention of its Q block against the KV block it currently holds — one
+``ops.pallas_kernels.flash_block_fwd`` call per step, returning the
+block's normalised output and per-row logsumexp — then merges the pair
+into its running (out, lse) with exact log-sum-exp combination and
 rotates the KV block to its ring neighbor with ``jax.lax.ppermute``
-(pure ICI traffic, overlapped by XLA with the block matmuls). Memory is
+(pure ICI traffic, overlapped by XLA with the block kernels). Memory is
 O(T/N) per device; no device ever materialises the full [T,T] score
-matrix.
+matrix — not even per ring step (the Pallas kernel tiles each block).
+
+Causal masking (``causal=True``): at ring step ``i`` a device with ring
+index ``m`` holds the KV block that ORIGINATED on device ``(m - i) mod
+n`` — so its global key offset is ``src·T_loc`` while the local query
+offset is ``m·T_loc``. Both offsets are passed to the flash kernel,
+which masks above the (offset) diagonal and skips blocks entirely above
+it without doing any work (the einsum formulation can't skip).
+
+Backward is a second ring (FlashAttention-2 style): each device keeps
+its q/out/lse/dO resident and re-rotates KV; per step one
+``flash_block_bwd`` call yields the (dq contribution, dk, dv) of that
+(q-block, kv-block) pair — dq accumulates locally, while dk/dv
+accumulators TRAVEL WITH their kv block around the ring, arriving home
+(fully summed over every q block) after n steps.
 """
 from __future__ import annotations
 
-from functools import partial
+import functools
 from typing import Optional
 
 import jax
@@ -26,68 +42,132 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
+from deeplearning4j_tpu.ops.pallas_kernels import (
+    flash_block_fwd, flash_block_bwd)
 
-def _block_attn_accum(q, k, v, m_prev, num_prev, den_prev, kmask=None):
-    """One KV-block contribution with online-softmax accumulation.
 
-    q: [B,Tq,H,D]; k,v: [B,Tk,H,D]; running (m, num, den).
-    """
-    d = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
-        jnp.asarray(d, q.dtype))
-    if kmask is not None:
-        s = jnp.where(kmask[:, None, None, :] > 0, s, -1e9)
-    m_blk = jnp.max(s, axis=-1)                      # [B,H,Tq]
-    m_new = jnp.maximum(m_prev, m_blk)
-    p = jnp.exp(s - m_new[..., None])                # [B,H,Tq,Tk]
-    scale = jnp.exp(m_prev - m_new)                  # rescale old accum
-    num = num_prev * scale[..., None] + jnp.einsum(
-        "bhqk,bkhd->bhqd", p, v)
-    den = den_prev * scale + jnp.sum(p, axis=-1)
-    return m_new, num, den
+def _merge_blocks(out, lse, o_b, lse_b):
+    """Merge a new block's normalised (out, lse) into the running pair.
+
+    Exact: out_b·exp(lse_b) is the block's unnormalised numerator and
+    exp(lse_b) its denominator, so the combination reweights by
+    exp(lse − lse_new) with lse_new = logaddexp(lse, lse_b)."""
+    lse_new = jnp.logaddexp(lse, lse_b)
+    safe = jnp.where(jnp.isinf(lse_new), 0.0, lse_new)
+    w_old = jnp.where(jnp.isinf(lse), 0.0, jnp.exp(lse - safe))
+    w_new = jnp.where(jnp.isinf(lse_b), 0.0, jnp.exp(lse_b - safe))
+    return out * w_old + o_b.astype(jnp.float32) * w_new, lse_new
+
+
+def _ring_perm(n):
+    return [(j, (j + 1) % n) for j in range(n)]
+
+
+def _ring_fwd_impl(q, k, v, km, axis_name, causal):
+    """q,k,v: [BH, T_loc, D] (heads folded), km: [BH, T_loc].
+    Returns (out [BH, T_loc, D] in q.dtype, lse [BH, T_loc, 1] f32)."""
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    t = q.shape[1]
+    vary = lambda x: lax.pcast(x, (axis_name,), to="varying")
+    out0 = vary(jnp.zeros(q.shape, jnp.float32))
+    lse0 = vary(jnp.full(q.shape[:2] + (1,), -jnp.inf, jnp.float32))
+
+    def body(i, carry):
+        out, lse, k_cur, v_cur, km_cur = carry
+        src = jnp.mod(my - i, n)
+        offs = jnp.stack([my * t, src * t]).astype(jnp.int32)
+        o_b, lse_b = flash_block_fwd(q, k_cur, v_cur, km_cur, offs,
+                                     causal)
+        out, lse = _merge_blocks(out, lse, o_b, lse_b)
+        perm = _ring_perm(n)
+        return (out, lse,
+                lax.ppermute(k_cur, axis_name, perm),
+                lax.ppermute(v_cur, axis_name, perm),
+                lax.ppermute(km_cur, axis_name, perm))
+
+    out, lse, _, _, _ = lax.fori_loop(0, n, body,
+                                      (out0, lse0, k, v, km))
+    return out.astype(q.dtype), lse
+
+
+def _ring_bwd_impl(q, k, v, km, out, lse, g, axis_name, causal):
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    t = q.shape[1]
+    zero = lambda x: lax.pcast(jnp.zeros(x.shape, jnp.float32),
+                               (axis_name,), to="varying")
+
+    def body(i, carry):
+        dq, dk_acc, dv_acc, k_cur, v_cur, km_cur = carry
+        src = jnp.mod(my - i, n)
+        offs = jnp.stack([my * t, src * t]).astype(jnp.int32)
+        dq_b, dk_b, dv_b = flash_block_bwd(q, k_cur, v_cur, out, lse, g,
+                                           km_cur, offs, causal)
+        dq = dq + dq_b.astype(jnp.float32)
+        dk_acc = dk_acc + dk_b.astype(jnp.float32)
+        dv_acc = dv_acc + dv_b.astype(jnp.float32)
+        # dk/dv accumulators travel with their kv block; after n
+        # rotations each block (and its now-complete gradient) is home
+        perm = _ring_perm(n)
+        pp = lambda x: lax.ppermute(x, axis_name, perm)
+        return (dq, pp(dk_acc), pp(dv_acc), pp(k_cur), pp(v_cur),
+                pp(km_cur))
+
+    dq, dk, dv, _, _, _ = lax.fori_loop(
+        0, n, body, (zero(q), zero(k), zero(v), k, v, km))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _ring_attn(q, k, v, km, axis_name, causal):
+    out, _ = _ring_fwd_impl(q, k, v, km, axis_name, causal)
+    return out
+
+
+def _ring_attn_fwd(q, k, v, km, axis_name, causal):
+    out, lse = _ring_fwd_impl(q, k, v, km, axis_name, causal)
+    return out, (q, k, v, km, out, lse)
+
+
+def _ring_attn_bwd(axis_name, causal, res, g):
+    q, k, v, km, out, lse = res
+    dq, dk, dv = _ring_bwd_impl(q, k, v, km, out, lse, g, axis_name,
+                                causal)
+    return dq, dk, dv, jnp.zeros_like(km)
+
+
+_ring_attn.defvjp(_ring_attn_fwd, _ring_attn_bwd)
 
 
 def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
-                        mask: Optional[jax.Array] = None):
+                        mask: Optional[jax.Array] = None,
+                        causal: bool = False):
     """Distributed attention: inputs [B, T, H, D] sharded on T over
     ``axis_name``; returns [B, T, H, D] with identical sharding.
 
-    ``mask``: [B, T] key mask, sharded the same way.
+    ``mask``: [B, T] key mask, sharded the same way. ``causal``: mask
+    above the global diagonal (works across ring steps via per-block
+    position offsets — the long-context causal-LM training path).
     """
     def local(q, k, v, kmask):
-        n = lax.psum(1, axis_name)
-        b, tq, h, d = q.shape
-        m0 = jnp.full((b, h, tq), -jnp.inf, q.dtype)
-        num0 = jnp.zeros((b, h, tq, d), q.dtype)
-        den0 = jnp.zeros((b, h, tq), q.dtype)
-
-        def body(i, carry):
-            m, num, den, k_cur, v_cur, km_cur = carry
-            m, num, den = _block_attn_accum(q, k_cur, v_cur, m, num, den,
-                                            km_cur)
-            # rotate KV (+mask) around the ring
-            perm = [(j, (j + 1) % n) for j in range(n)]
-            k_nxt = lax.ppermute(k_cur, axis_name, perm)
-            v_nxt = lax.ppermute(v_cur, axis_name, perm)
-            km_nxt = lax.ppermute(km_cur, axis_name, perm)
-            return m, num, den, k_nxt, v_nxt, km_nxt
-
-        km = (jnp.ones(k.shape[:2], q.dtype) if kmask is None else kmask)
-        m, num, den, _, _, _ = lax.fori_loop(
-            0, n, body, (m0, num0, den0, k, v, km))
-        out = num / jnp.maximum(den[..., None], 1e-30)  # [B,H,Tq,D]
-        return jnp.transpose(out, (0, 2, 1, 3))         # [B,Tq,H,D]
+        b, t, h, d = q.shape
+        fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        km = (lax.pcast(jnp.ones((b, t), jnp.float32), (axis_name,),
+                        to="varying")
+              if kmask is None else kmask.astype(jnp.float32))
+        km = jnp.repeat(km, h, axis=0)
+        o = _ring_attn(fold(q), fold(k), fold(v), km, axis_name, causal)
+        return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
     spec = P(None, axis_name, None, None)
     mspec = P(None, axis_name)
     if mask is None:
         fn = shard_map(lambda q, k, v: local(q, k, v, None), mesh=mesh,
-                       in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
+                       in_specs=(spec, spec, spec), out_specs=spec)
         return fn(q, k, v)
     fn = shard_map(local, mesh=mesh,
-                   in_specs=(spec, spec, spec, mspec),
-                   out_specs=spec, check_vma=False)
+                   in_specs=(spec, spec, spec, mspec), out_specs=spec)
     return fn(q, k, v, mask)
 
 

@@ -71,17 +71,21 @@ class ParallelWrapper:
         self._dp_state = None  # mode-specific device state
         # MultiLayerNetwork takes (x, y); ComputationGraph takes
         # ({name: x}, [y]) — adapt here so every mode's step body can
-        # stay network-agnostic (single-input/single-output graphs)
+        # stay network-agnostic. Multi-input/multi-output graphs pass
+        # through as pytrees (list of features / list of labels — every
+        # leaf is sharded over the data axis), matching the reference
+        # ParallelWrapper's support for arbitrary ComputationGraphs.
         if hasattr(net.conf, "inputs"):
-            ins, outs = net.conf.inputs, net.conf.outputs
-            if len(ins) != 1 or len(outs) != 1:
-                raise ValueError(
-                    "ParallelWrapper supports single-input/single-output"
-                    f" graphs; got {len(ins)} inputs / {len(outs)} "
-                    "outputs — shard multi-io batches manually with "
-                    "shard_map over the net's _loss_fn")
-            self._loss = lambda p, s, x, y, rng: net._loss_fn(
-                p, s, {ins[0]: x}, [y], {}, {}, rng)
+            ins = net.conf.inputs
+
+            def _graph_loss(p, s, x, y, rng):
+                xd = x if isinstance(x, dict) else (
+                    dict(zip(ins, x)) if isinstance(x, (list, tuple))
+                    else {ins[0]: x})
+                yl = list(y) if isinstance(y, (list, tuple)) else [y]
+                return net._loss_fn(p, s, xd, yl, {}, {}, rng)
+
+            self._loss = _graph_loss
         else:
             self._loss = lambda p, s, x, y, rng: net._loss_fn(
                 p, s, x, y, None, None, rng)
@@ -324,14 +328,14 @@ class ParallelWrapper:
                 jnp.asarray([n_local], jnp.int32)))
             n_steps = int(counts.min())
             first = next(iter(iterator))
-            b0 = first.features.shape[0] - (
-                first.features.shape[0] % local_n)
+            first_b = jax.tree.leaves(first.features)[0].shape[0]
+            b0 = first_b - (first_b % local_n)
             sizes = np.asarray(mhu.process_allgather(
                 jnp.asarray([b0], jnp.int32)))
             b_local = int(sizes.min())
             if b_local == 0:
                 raise ValueError(
-                    f"per-process batch ({first.features.shape[0]}) "
+                    f"per-process batch ({first_b}) "
                     f"smaller than local device count ({local_n})")
         it = AsyncDataSetIterator(iterator, self.prefetch_buffer) \
             if self.prefetch_buffer else iterator
@@ -343,11 +347,11 @@ class ParallelWrapper:
                 if n_steps is not None and step_i >= n_steps:
                     break               # stay in lockstep across hosts
                 x, y = ds.features, ds.labels
-                b = b_local if multi else \
-                    x.shape[0] - (x.shape[0] % self.n)
-                if multi and x.shape[0] < b:
+                bsz = jax.tree.leaves(x)[0].shape[0]
+                b = b_local if multi else bsz - (bsz % self.n)
+                if multi and bsz < b:
                     raise ValueError(
-                        f"batch of {x.shape[0]} smaller than the "
+                        f"batch of {bsz} smaller than the "
                         f"agreed per-process size {b}: multi-host "
                         "training needs uniform batches (drop or pad "
                         "the ragged remainder)")
@@ -356,15 +360,18 @@ class ParallelWrapper:
                     logging.getLogger("deeplearning4j_tpu").warning(
                         "ParallelWrapper: dropping batch of %d examples "
                         "(< %d workers); use batch sizes divisible by "
-                        "the worker count", x.shape[0], self.n)
+                        "the worker count", bsz, self.n)
                     continue
                 step_i += 1
+                trim = lambda a: a[:b]
+                x, y = jax.tree.map(trim, x), jax.tree.map(trim, y)
                 if multi:
                     # each process feeds its local shard; assemble ONE
                     # global device array spanning hosts
-                    x, y = make_global_batch(self.mesh, x[:b], y[:b])
+                    x, y = make_global_batch(self.mesh, x, y)
                 else:
-                    x, y = jnp.asarray(x[:b]), jnp.asarray(y[:b])
+                    x = jax.tree.map(jnp.asarray, x)
+                    y = jax.tree.map(jnp.asarray, y)
                 rng = jax.random.fold_in(
                     jax.random.PRNGKey(net.conf.seed), net.iteration)
                 if self.mode == self.SYNC:

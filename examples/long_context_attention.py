@@ -68,6 +68,34 @@ def main():
     assert np.isfinite(np.asarray(gu)).all()
     print("gradients finite through ppermute ring and all_to_all swap")
 
+    # the flagship workload: CAUSAL-LM training step with sequence-
+    # parallel ring attention — per ring step the flash kernel masks
+    # above the (globally-offset) diagonal and skips dead blocks
+    full_c = scaled_dot_attention(q, k, v, causal=True)
+    ring_c = ring_self_attention(q, k, v, mesh, causal=True)
+    err_c = float(jnp.max(jnp.abs(full_c - ring_c)))
+
+    import optax
+    wq = jax.random.normal(jax.random.PRNGKey(1), (d, d)) * 0.05
+
+    def lm_loss(wq, x):
+        qp = jnp.einsum("bthd,de->bthe", x, wq)
+        out = ring_self_attention(qp, x, x, mesh, causal=True)
+        # next-position prediction surrogate on the sharded axis
+        return jnp.mean((out[:, :-1] - x[:, 1:]) ** 2)
+
+    opt = optax.adam(1e-2)
+    state = opt.init(wq)
+    losses = []
+    for _ in range(3):
+        loss, grad = jax.value_and_grad(lm_loss)(wq, q)
+        upd, state = opt.update(grad, state, wq)
+        wq = optax.apply_updates(wq, upd)
+        losses.append(float(loss))
+    print(f"causal ring err {err_c:.2e}; causal-LM train losses "
+          f"{['%.4f' % l for l in losses]} (decreasing: "
+          f"{losses[-1] < losses[0]})")
+
 
 if __name__ == "__main__":
     main()

@@ -1,0 +1,155 @@
+"""Evaluation merge semantics (reference
+``org.nd4j.evaluation.IEvaluation#merge``): evaluating shards
+separately and merging must equal evaluating all data at once — the
+reduction contract distributed evaluation
+(``SparkDl4jMultiLayer#doEvaluation``) relies on."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval_.evaluation import (
+    Evaluation, EvaluationBinary, EvaluationCalibration,
+    RegressionEvaluation, ROC, ROCBinary, ROCMultiClass)
+
+
+@pytest.fixture
+def cls_data(rng):
+    n, c = 120, 4
+    y = np.eye(c, dtype=np.float32)[rng.integers(0, c, n)]
+    p = rng.random((n, c)).astype(np.float32)
+    p /= p.sum(1, keepdims=True)
+    return y, p
+
+
+def _shards(y, p, k=3):
+    idx = np.array_split(np.arange(len(y)), k)
+    return [(y[i], p[i]) for i in idx]
+
+
+def test_evaluation_merge_equals_full(cls_data):
+    y, p = cls_data
+    full = Evaluation()
+    full.eval(y, p)
+    merged = Evaluation()
+    for ys, ps in _shards(y, p):
+        e = Evaluation()
+        e.eval(ys, ps)
+        merged.merge(e)
+    np.testing.assert_array_equal(merged.confusion, full.confusion)
+    assert merged.count == full.count
+    assert merged.accuracy() == full.accuracy()
+    assert merged.f1() == full.f1()
+
+
+def test_evaluation_merge_into_empty(cls_data):
+    y, p = cls_data
+    e = Evaluation()
+    e.eval(y, p)
+    empty = Evaluation()
+    empty.merge(e)
+    assert empty.accuracy() == e.accuracy()
+    # and merging an empty one changes nothing
+    e2 = Evaluation()
+    e2.eval(y, p)
+    e2.merge(Evaluation())
+    assert e2.count == e.count
+
+
+def test_evaluation_merge_class_mismatch_raises(cls_data):
+    y, p = cls_data
+    a = Evaluation()
+    a.eval(y, p)
+    b = Evaluation()
+    b.eval(np.eye(3, dtype=np.float32)[[0, 1, 2]],
+           np.eye(3, dtype=np.float32)[[0, 2, 1]])
+    with pytest.raises(ValueError):
+        a.merge(b)
+
+
+def test_evaluation_binary_merge(rng):
+    y = (rng.random((80, 3)) > 0.5).astype(np.float32)
+    p = rng.random((80, 3)).astype(np.float32)
+    full = EvaluationBinary()
+    full.eval(y, p)
+    merged = EvaluationBinary()
+    for ys, ps in _shards(y, p):
+        e = EvaluationBinary()
+        e.eval(ys, ps)
+        merged.merge(e)
+    for i in range(3):
+        assert merged.f1(i) == full.f1(i)
+        assert merged.accuracy(i) == full.accuracy(i)
+
+
+def test_roc_merge(rng):
+    y = (rng.random(200) > 0.5).astype(np.float32)
+    p = rng.random(200).astype(np.float32)
+    full = ROC()
+    full.eval(y, p)
+    merged = ROC()
+    for ys, ps in _shards(y, p):
+        r = ROC()
+        r.eval(ys, ps)
+        merged.merge(r)
+    assert merged.calculate_auc() == pytest.approx(
+        full.calculate_auc(), abs=1e-12)
+    assert merged.calculate_auprc() == pytest.approx(
+        full.calculate_auprc(), abs=1e-12)
+
+
+def test_roc_multiclass_and_binary_merge(cls_data):
+    y, p = cls_data
+    for cls in (ROCMultiClass, ROCBinary):
+        full = cls()
+        full.eval(y, p)
+        merged = cls()
+        for ys, ps in _shards(y, p):
+            r = cls()
+            r.eval(ys, ps)
+            merged.merge(r)
+        assert merged.average_auc() == pytest.approx(
+            full.average_auc(), abs=1e-12)
+
+
+def test_calibration_merge(cls_data):
+    y, p = cls_data
+    full = EvaluationCalibration()
+    full.eval(y, p)
+    merged = EvaluationCalibration()
+    for ys, ps in _shards(y, p):
+        e = EvaluationCalibration()
+        e.eval(ys, ps)
+        merged.merge(e)
+    assert merged.expected_calibration_error() == pytest.approx(
+        full.expected_calibration_error(), abs=1e-12)
+
+
+def test_regression_merge(rng):
+    y = rng.standard_normal((90, 2))
+    p = y + 0.1 * rng.standard_normal((90, 2))
+    full = RegressionEvaluation()
+    full.eval(y, p)
+    merged = RegressionEvaluation()
+    for ys, ps in _shards(y, p):
+        e = RegressionEvaluation()
+        e.eval(ys, ps)
+        merged.merge(e)
+    for col in range(2):
+        assert merged.mean_squared_error(col) == pytest.approx(
+            full.mean_squared_error(col), rel=1e-12)
+        assert merged.r_squared(col) == pytest.approx(
+            full.r_squared(col), rel=1e-12)
+        assert merged.pearson_correlation(col) == pytest.approx(
+            full.pearson_correlation(col), rel=1e-12)
+
+
+def test_merge_across_processes_single_process(cls_data):
+    """Single-process: merge_across_processes is the identity (the
+    2-process path is exercised by tests/test_multiprocess.py)."""
+    from deeplearning4j_tpu.parallel.master import merge_across_processes
+    y, p = cls_data
+    e = Evaluation()
+    e.eval(y, p)
+    out = merge_across_processes(e)
+    assert out is e
+    outs = merge_across_processes([e, e])
+    assert outs == [e, e]

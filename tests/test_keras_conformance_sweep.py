@@ -1,0 +1,291 @@
+"""Keras conformance sweep (reference: KerasModelEndToEndTest — ~60
+end-to-end .h5 models imported and compared against Keras-produced
+activations, SURVEY §4).
+
+Like the TF (306 graphs) and ONNX (113 graphs) sweeps, cases are
+*generated*: per-mapper Keras models are built in-process with the
+installed Keras, saved, imported, and the forward pass must match the
+Keras prediction within tolerance. A final coverage gate compares
+``keras_import.MAPPED_LAYER_CLASSES`` against the classes the sweep
+actually exercised and fails on any unswept mapper.
+"""
+import numpy as np
+import pytest
+
+keras = pytest.importorskip("keras")
+
+from deeplearning4j_tpu.modelimport import KerasModelImport  # noqa: E402
+from deeplearning4j_tpu.modelimport.keras_import import (  # noqa: E402
+    MAPPED_LAYER_CLASSES)
+
+L = keras.layers
+RNG = np.random.default_rng(2026)
+
+#: Keras classes observed across all swept model configs
+SWEPT = set()
+#: how many sweep models actually ran this session (the coverage gate
+#: only judges a COMPLETE sweep — pytest -k subsets skip it)
+RAN = []
+
+#: mapped classes that CANNOT be swept against installed Keras 3
+#: (removed upstream) — still importable from legacy h5 archives and
+#: covered by the hand-written crafted-archive tests
+EXEMPT = {
+    "ThresholdedReLU",       # removed in Keras 3
+    "LocallyConnected1D",    # removed in Keras 3
+    "LocallyConnected2D",    # removed in Keras 3
+}
+
+#: pure aliases that resolve through the same mapper branch as the
+#: canonical class name (legacy Keras-1 spellings)
+ALIASES = {"Convolution1D", "Convolution2D", "Convolution3D",
+           "Convolution2DTranspose"}
+
+
+def _record(model):
+    """Walk the serialized config and record every layer class seen."""
+    def walk(node):
+        if isinstance(node, dict):
+            cn = node.get("class_name")
+            if cn:
+                SWEPT.add(cn)
+            for v in node.values():
+                walk(v)
+        elif isinstance(node, (list, tuple)):
+            for v in node:
+                walk(v)
+    walk(model.get_config())
+    SWEPT.add("InputLayer")      # implicit in every built model
+
+
+def _run(model, x, tmp_path, rtol=1e-4, atol=1e-5):
+    _record(model)
+    RAN.append(1)
+    path = str(tmp_path / "m.h5")
+    model.save(path)
+    net = KerasModelImport.import_model(path)
+    want = np.asarray(model(x if not isinstance(x, list) else
+                            [np.asarray(v) for v in x], training=False))
+    got = net.output(*x) if isinstance(x, list) else net.output(x)
+    if isinstance(got, (list, tuple)):
+        got = got[0]
+    np.testing.assert_allclose(np.asarray(got), want, rtol=rtol,
+                               atol=atol)
+
+
+def _x(*shape):
+    return RNG.normal(size=shape).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# case table: (id, builder) — builder returns (keras model, input)
+# ---------------------------------------------------------------------------
+def _seq(input_shape, *layers):
+    return keras.Sequential([L.Input(input_shape), *layers])
+
+
+CASES = [
+    # dense family
+    ("dense_relu", lambda: (_seq((7,), L.Dense(5, activation="relu"),
+                                 L.Dense(3)), _x(4, 7))),
+    ("dense_nobias_softmax", lambda: (_seq(
+        (6,), L.Dense(4, use_bias=False, activation="softmax")),
+        _x(3, 6))),
+    ("conv_flatten_dense", lambda: (_seq(
+        (8, 8, 2), L.Conv2D(3, 3), L.Flatten(), L.Dense(4)),
+        _x(2, 8, 8, 2))),
+    # conv2d family
+    ("conv2d_same", lambda: (_seq(
+        (12, 12, 3), L.Conv2D(6, 3, padding="same", activation="relu")),
+        _x(2, 12, 12, 3))),
+    ("conv2d_valid_strides", lambda: (_seq(
+        (13, 13, 2), L.Conv2D(4, 3, strides=2, padding="valid")),
+        _x(2, 13, 13, 2))),
+    ("conv2d_dilated", lambda: (_seq(
+        (14, 14, 2), L.Conv2D(4, 3, dilation_rate=2)), _x(1, 14, 14, 2))),
+    ("conv1d", lambda: (_seq(
+        (11, 4), L.Conv1D(6, 3, padding="same", activation="tanh")),
+        _x(2, 11, 4))),
+    ("conv1d_strides", lambda: (_seq(
+        (12, 3), L.Conv1D(5, 3, strides=2, padding="valid")),
+        _x(2, 12, 3))),
+    ("conv2dtranspose_same", lambda: (_seq(
+        (8, 8, 3), L.Conv2DTranspose(5, 3, padding="same")),
+        _x(2, 8, 8, 3))),
+    ("conv2dtranspose_strides", lambda: (_seq(
+        (7, 7, 2), L.Conv2DTranspose(4, 3, strides=2, padding="valid")),
+        _x(1, 7, 7, 2))),
+    ("conv3d", lambda: (_seq(
+        (6, 6, 6, 2), L.Conv3D(3, 2, activation="relu")),
+        _x(1, 6, 6, 6, 2))),
+    ("depthwise_m1", lambda: (_seq(
+        (10, 10, 3), L.DepthwiseConv2D(3, padding="same")),
+        _x(2, 10, 10, 3))),
+    ("depthwise_m2_strides", lambda: (_seq(
+        (11, 11, 2), L.DepthwiseConv2D(3, strides=2,
+                                       depth_multiplier=2)),
+        _x(2, 11, 11, 2))),
+    # SeparableConv edge configs (VERDICT r2 #8)
+    ("separable_basic", lambda: (_seq(
+        (10, 10, 3), L.SeparableConv2D(5, 3)), _x(2, 10, 10, 3))),
+    ("separable_m2_same", lambda: (_seq(
+        (9, 9, 2), L.SeparableConv2D(4, 3, depth_multiplier=2,
+                                     padding="same",
+                                     activation="relu")),
+        _x(2, 9, 9, 2))),
+    ("separable_strides_nobias", lambda: (_seq(
+        (12, 12, 3), L.SeparableConv2D(6, 3, strides=2,
+                                       use_bias=False)),
+        _x(1, 12, 12, 3))),
+    # pooling
+    ("maxpool2d", lambda: (_seq(
+        (10, 10, 2), L.MaxPooling2D(2)), _x(2, 10, 10, 2))),
+    ("avgpool2d_pad", lambda: (_seq(
+        (9, 9, 2), L.AveragePooling2D(2, padding="same")),
+        _x(2, 9, 9, 2))),
+    ("maxpool1d", lambda: (_seq((12, 3), L.MaxPooling1D(2)),
+                           _x(2, 12, 3))),
+    ("avgpool1d_stride3", lambda: (_seq(
+        (12, 3), L.AveragePooling1D(2, strides=3)), _x(2, 12, 3))),
+    ("maxpool3d", lambda: (_seq(
+        (6, 6, 6, 2), L.MaxPooling3D(2)), _x(1, 6, 6, 6, 2))),
+    ("avgpool3d", lambda: (_seq(
+        (6, 6, 6, 2), L.AveragePooling3D(2)), _x(1, 6, 6, 6, 2))),
+    ("globalmax2d", lambda: (_seq(
+        (8, 8, 3), L.GlobalMaxPooling2D()), _x(2, 8, 8, 3))),
+    ("globalavg2d", lambda: (_seq(
+        (8, 8, 3), L.GlobalAveragePooling2D()), _x(2, 8, 8, 3))),
+    ("globalmax1d", lambda: (_seq((9, 4), L.GlobalMaxPooling1D()),
+                             _x(2, 9, 4))),
+    ("globalavg1d", lambda: (_seq((9, 4), L.GlobalAveragePooling1D()),
+                             _x(2, 9, 4))),
+    # norm
+    ("batchnorm_conv", lambda: (_seq(
+        (8, 8, 3), L.Conv2D(4, 3), L.BatchNormalization()),
+        _x(2, 8, 8, 3))),
+    ("batchnorm_dense_nocenter", lambda: (_seq(
+        (6,), L.Dense(5), L.BatchNormalization(center=False)),
+        _x(3, 6))),
+    ("layernorm", lambda: (_seq(
+        (7,), L.Dense(6), L.LayerNormalization()), _x(3, 7))),
+    # dropout family (identity at inference — import must still map)
+    ("dropouts", lambda: (_seq(
+        (6,), L.Dense(5), L.Dropout(0.3), L.GaussianNoise(0.1),
+        L.GaussianDropout(0.2), L.AlphaDropout(0.1)), _x(3, 6))),
+    ("spatial_dropouts", lambda: (_seq(
+        (8, 8, 2), L.SpatialDropout2D(0.2), L.Conv2D(3, 3)),
+        _x(2, 8, 8, 2))),
+    ("spatial_dropout1d", lambda: (_seq(
+        (9, 3), L.SpatialDropout1D(0.2), L.Conv1D(3, 3)), _x(2, 9, 3))),
+    ("spatial_dropout3d", lambda: (_seq(
+        (5, 5, 5, 2), L.SpatialDropout3D(0.2), L.Conv3D(2, 2)),
+        _x(1, 5, 5, 5, 2))),
+    # activations
+    ("activation_layer", lambda: (_seq(
+        (6,), L.Dense(4), L.Activation("tanh")), _x(2, 6))),
+    ("relu_layer_max", lambda: (_seq(
+        (6,), L.Dense(4), L.ReLU(max_value=1.0)), _x(2, 6))),
+    ("relu_layer_slope", lambda: (_seq(
+        (6,), L.Dense(4), L.ReLU(negative_slope=0.2)), _x(2, 6))),
+    ("leaky_relu", lambda: (_seq(
+        (6,), L.Dense(4), L.LeakyReLU(negative_slope=0.1)), _x(2, 6))),
+    ("prelu", lambda: (_seq((6,), L.Dense(4), L.PReLU()), _x(2, 6))),
+    ("elu_softmax", lambda: (_seq(
+        (6,), L.Dense(4), L.ELU(), L.Dense(3), L.Softmax()), _x(2, 6))),
+    # shape ops
+    ("zeropad2d_crop2d", lambda: (_seq(
+        (8, 8, 2), L.ZeroPadding2D(((1, 2), (0, 1))),
+        L.Cropping2D(((1, 0), (2, 1)))), _x(2, 8, 8, 2))),
+    ("zeropad1d_crop1d", lambda: (_seq(
+        (9, 3), L.ZeroPadding1D(2), L.Cropping1D((1, 2))), _x(2, 9, 3))),
+    ("zeropad3d_crop3d", lambda: (_seq(
+        (5, 5, 5, 2), L.ZeroPadding3D(1), L.Cropping3D(1)),
+        _x(1, 5, 5, 5, 2))),
+    ("upsampling2d", lambda: (_seq(
+        (5, 5, 2), L.UpSampling2D(2)), _x(2, 5, 5, 2))),
+    ("upsampling1d", lambda: (_seq((6, 3), L.UpSampling1D(2)),
+                              _x(2, 6, 3))),
+    ("upsampling3d", lambda: (_seq(
+        (4, 4, 4, 2), L.UpSampling3D(2)), _x(1, 4, 4, 4, 2))),
+    ("repeat_vector", lambda: (_seq(
+        (5,), L.Dense(4), L.RepeatVector(3)), _x(2, 5))),
+    # recurrent
+    ("lstm_seq", lambda: (_seq(
+        (8, 4), L.LSTM(5, return_sequences=True)), _x(2, 8, 4))),
+    ("lstm_last", lambda: (_seq((8, 4), L.LSTM(5)), _x(2, 8, 4))),
+    ("gru_reset_after", lambda: (_seq(
+        (8, 4), L.GRU(5, reset_after=True)), _x(2, 8, 4))),
+    ("gru_no_reset_after", lambda: (_seq(
+        (8, 4), L.GRU(5, reset_after=False, return_sequences=True)),
+        _x(2, 8, 4))),
+    ("simplernn", lambda: (_seq(
+        (7, 3), L.SimpleRNN(4, return_sequences=True)), _x(2, 7, 3))),
+    ("bidirectional_concat", lambda: (_seq(
+        (8, 4), L.Bidirectional(L.LSTM(3, return_sequences=True))),
+        _x(2, 8, 4))),
+    ("bidirectional_sum_last", lambda: (_seq(
+        (8, 4), L.Bidirectional(L.LSTM(3), merge_mode="sum")),
+        _x(2, 8, 4))),
+    ("timedistributed_dense", lambda: (_seq(
+        (6, 4), L.TimeDistributed(L.Dense(3))), _x(2, 6, 4))),
+    ("masking_lstm", lambda: (_seq(
+        (6, 3), L.Masking(), L.LSTM(4, return_sequences=True)),
+        _x(2, 6, 3))),
+    # ConvLSTM2D (VERDICT r2 #8 named mapper)
+    ("convlstm2d_last", lambda: (_seq(
+        (4, 8, 8, 2), L.ConvLSTM2D(3, 3, padding="same")),
+        _x(2, 4, 8, 8, 2))),
+    ("convlstm2d_seq_valid", lambda: (_seq(
+        (3, 9, 9, 2), L.ConvLSTM2D(4, 3, strides=2,
+                                   return_sequences=True)),
+        _x(1, 3, 9, 9, 2))),
+    # embedding
+    ("embedding", lambda: (
+        _seq((5,), L.Embedding(11, 6), L.LSTM(4)),
+        RNG.integers(0, 11, (3, 5)).astype(np.float32))),
+]
+
+
+@pytest.mark.parametrize("case_id,builder", CASES,
+                         ids=[c[0] for c in CASES])
+def test_keras_conformance(case_id, builder, tmp_path):
+    model, x = builder()
+    tol = {"convlstm2d_last": (5e-4, 5e-5),
+           "convlstm2d_seq_valid": (5e-4, 5e-5),
+           "lstm_seq": (2e-4, 2e-5), "lstm_last": (2e-4, 2e-5),
+           "bidirectional_concat": (2e-4, 2e-5),
+           "bidirectional_sum_last": (2e-4, 2e-5)}.get(
+        case_id, (1e-4, 1e-5))
+    _run(model, x, tmp_path, rtol=tol[0], atol=tol[1])
+
+
+def test_functional_merge_layers(tmp_path):
+    """Add/Subtract/Multiply/Average/Maximum/Concatenate through the
+    functional-model vertex map."""
+    a = L.Input((6,), name="a")
+    b = L.Input((6,), name="b")
+    da = L.Dense(5, activation="tanh")(a)
+    db = L.Dense(5, activation="tanh")(b)
+    merged = [L.Add()([da, db]), L.Subtract()([da, db]),
+              L.Multiply()([da, db]), L.Average()([da, db]),
+              L.Maximum()([da, db])]
+    out = L.Concatenate()(merged)
+    out = L.Dense(3)(out)
+    model = keras.Model([a, b], out)
+    xa, xb = _x(3, 6), _x(3, 6)
+    _run(model, [xa, xb], tmp_path)
+
+
+def test_keras_sweep_coverage_gate():
+    """Every mapped Keras class must be exercised by the sweep (or be
+    explicitly exempt with a reason) — mapped-vs-swept gate mirroring
+    the TF/ONNX sweeps."""
+    assert len(CASES) >= 40, "sweep shrank below the 40-model floor"
+    if len(RAN) < len(CASES) + 1:      # CASES + the functional model
+        pytest.skip("coverage gate judges only a complete sweep run")
+    unswept = MAPPED_LAYER_CLASSES - SWEPT - EXEMPT - ALIASES
+    assert not unswept, (
+        f"mapped Keras classes never swept: {sorted(unswept)} — add a "
+        "generated case or an explicit exemption with a reason")
+    stale = (EXEMPT | ALIASES) - MAPPED_LAYER_CLASSES
+    assert not stale, f"exempt/alias entries not in mapper: {stale}"

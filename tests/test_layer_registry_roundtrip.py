@@ -63,6 +63,7 @@ SPECS = {
     "SpaceToDepthLayer": (dict(block_size=2), (4, 4, 2)),
     "DepthToSpaceLayer": (dict(block_size=2), (2, 2, 8)),
     "LSTM": (dict(n_out=4), (5, 3)),
+    "ConvLSTM2D": (dict(n_out=3, kernel_size=(2, 2)), (4, 6, 6, 2)),
     "GravesLSTM": (dict(n_out=4), (5, 3)),
     "GravesBidirectionalLSTM": (dict(n_out=4), (5, 3)),
     "GRU": (dict(n_out=4), (5, 3)),

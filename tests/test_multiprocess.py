@@ -64,6 +64,15 @@ WORKER = textwrap.dedent("""
     leaf = jax.tree.leaves(net.params)[0]
     s = float(jnp.sum(jnp.asarray(leaf)))
     print(f"proc {pid} checksum {s:.6f}", flush=True)
+
+    # distributed evaluation: each process evaluates ONLY its shard,
+    # merge_across_processes must reconstruct the full-data Evaluation
+    # (reference SparkDl4jMultiLayer#doEvaluation reduce semantics)
+    ev = trainer.evaluate(ShardedDataSetIterator(data))
+    full = net.evaluate(ListDataSetIterator(data))   # all data, local
+    assert ev.count == full.count, (ev.count, full.count)
+    assert (ev.confusion == full.confusion).all()
+    print(f"proc {pid} evalacc {ev.accuracy():.6f}", flush=True)
     print(f"proc {pid} DONE", flush=True)
 """)
 
@@ -97,3 +106,6 @@ def test_two_process_distributed_training(tmp_path):
     import re
     sums = [re.search(r"checksum (-?[\d.]+)", o).group(1) for o in outs]
     assert sums[0] == sums[1], sums
+    # merged evaluation identical on both processes
+    accs = [re.search(r"evalacc (-?[\d.]+)", o).group(1) for o in outs]
+    assert accs[0] == accs[1], accs

@@ -100,7 +100,8 @@ def test_flash_backward_bf16(rng):
         return jnp.sum(fn(*a, causal=False).astype(jnp.float32) ** 2)
 
     g1 = jax.grad(lambda *a: loss(lambda q, k, v, causal: pk.
-                  flash_attention(q, k, v, causal, 32, 32), *a),
+                  flash_attention(q, k, v, causal, block_q=32,
+                                  block_k=32), *a),
                   argnums=(0, 1, 2))(q, k, v)
     g2 = jax.grad(lambda *a: loss(
         lambda q, k, v, causal: scaled_dot_attention(
@@ -108,6 +109,126 @@ def test_flash_backward_bf16(rng):
     for a, b in zip(g1, g2):
         err = float(jnp.max(jnp.abs(a.astype(jnp.float32) - b)))
         assert err < 0.15, err   # bf16 rounding, not accumulation error
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_masked_matches_einsum(rng, causal):
+    """Per-example key masks through the Pallas kernel (VERDICT r2 #3):
+    padded-batch sequences must match the masked einsum reference —
+    forward AND backward, causal and not."""
+    B, T, H, D = 3, 96, 2, 16
+    q, k, v = (jnp.asarray(rng.standard_normal((B, T, H, D)),
+                           jnp.float32) for _ in range(3))
+    # ragged lengths incl. one full-length row
+    lens = jnp.asarray([96, 40, 77])
+    mask = (jnp.arange(T)[None, :] < lens[:, None]).astype(jnp.float32)
+    co = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) * co)
+
+    flash = lambda q, k, v: pk.flash_attention(
+        q, k, v, causal=causal, mask=mask, block_q=32, block_k=32)
+    ref = lambda q, k, v: scaled_dot_attention(
+        q, k, v, mask=mask, causal=causal)
+    # only compare valid query rows (masked-out queries differ: flash
+    # emits zeros there, einsum emits a uniform average — both are
+    # discarded by downstream masking)
+    valid = mask[:, :, None, None]
+    outf, outr = flash(q, k, v) * valid, ref(q, k, v) * valid
+    assert float(jnp.max(jnp.abs(outf - outr))) < 2e-5
+    g1 = jax.grad(loss(lambda *a: flash(*a) * valid),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss(lambda *a: ref(*a) * valid),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        assert float(jnp.max(jnp.abs(a - b))) < 5e-5
+
+
+def test_flash_block_offsets_compose(rng):
+    """flash_block_fwd/_merge semantics (the ring-attention surface):
+    two half-sequence KV blocks with dynamic global offsets, merged by
+    log-sum-exp combination, must equal full causal attention."""
+    from deeplearning4j_tpu.parallel.ring_attention import _merge_blocks
+    bh, t, d = 2, 64, 16
+    q, k, v = (jnp.asarray(rng.standard_normal((bh, t, d)), jnp.float32)
+               for _ in range(3))
+    half = t // 2
+    out = jnp.zeros((bh, half, d), jnp.float32)
+    lse = jnp.full((bh, half, 1), -jnp.inf, jnp.float32)
+    # queries are the SECOND half (global offset `half`)
+    qh = q[:, half:]
+    for blk in range(2):
+        offs = jnp.asarray([half, blk * half], jnp.int32)
+        o_b, lse_b = pk.flash_block_fwd(
+            qh, k[:, blk * half:(blk + 1) * half],
+            v[:, blk * half:(blk + 1) * half], None, offs, True,
+            block_q=32, block_k=32)
+        out, lse = _merge_blocks(out, lse, o_b, lse_b)
+    want = pk._reference_scan(q, k, v, causal=True, block=32)[:, half:]
+    assert float(jnp.max(jnp.abs(out - want))) < 2e-5
+
+
+def test_flash_block_bwd_composes(rng):
+    """flash_block_bwd with global lse: summing per-block dq and
+    per-block dk/dv must equal autodiff through full attention."""
+    bh, t, d = 2, 64, 16
+    q, k, v = (jnp.asarray(rng.standard_normal((bh, t, d)), jnp.float32)
+               for _ in range(3))
+    co = jnp.asarray(rng.standard_normal((bh, t, d)), jnp.float32)
+    out, lse = pk._flash_fwd(q, k, v, None, None, True, 32, 32,
+                             return_lse=True)
+    half = t // 2
+    dq = jnp.zeros_like(q)
+    dks, dvs = [], []
+    for blk in range(2):
+        sl = slice(blk * half, (blk + 1) * half)
+        offs = jnp.asarray([0, blk * half], jnp.int32)
+        dq_b, dk_b, dv_b = pk.flash_block_bwd(
+            q, k[:, sl], v[:, sl], out, lse, co, None, offs, True,
+            block_q=32, block_k=32)
+        dq = dq + dq_b
+        dks.append(dk_b)
+        dvs.append(dv_b)
+    dk = jnp.concatenate(dks, axis=1)
+    dv = jnp.concatenate(dvs, axis=1)
+    want = jax.grad(
+        lambda q, k, v: jnp.sum(_dense_causal(q, k, v) * co),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip((dq, dk, dv), want):
+        assert float(jnp.max(jnp.abs(a - b))) < 5e-5
+
+
+def test_flash_block_bwd_kv_longer_than_q(rng):
+    """Rectangular kv>q: dk/dv must come back at the KV length, not
+    truncated to the q length (regression: dk[:, :t] slice bug)."""
+    bh, tq, tk, d = 2, 32, 64, 16
+    q = jnp.asarray(rng.standard_normal((bh, tq, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((bh, tk, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((bh, tk, d)), jnp.float32)
+    co = jnp.asarray(rng.standard_normal((bh, tq, d)), jnp.float32)
+    out, lse = pk._flash_fwd(q, k, v, None, None, False, 32, 32,
+                             return_lse=True)
+    dq, dk, dv = pk.flash_block_bwd(q, k, v, out, lse, co,
+                                    block_q=32, block_k=32)
+    assert dk.shape == k.shape and dv.shape == v.shape
+
+    def ref(q, k, v):
+        s = jnp.einsum("bqd,bkd->bqk", q, k) / np.sqrt(d)
+        return jnp.einsum("bqk,bkd->bqd", jax.nn.softmax(s, -1), v)
+
+    want = jax.grad(lambda q, k, v: jnp.sum(ref(q, k, v) * co),
+                    argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip((dq, dk, dv), want):
+        assert float(jnp.max(jnp.abs(a - b))) < 5e-5
+
+
+def _dense_causal(q, k, v):
+    d = q.shape[-1]
+    s = jnp.einsum("bqd,bkd->bqk", q, k) / np.sqrt(d)
+    t = q.shape[1]
+    s = jnp.where(jnp.tril(jnp.ones((t, t), bool))[None], s, -jnp.inf)
+    return jnp.einsum("bqk,bkd->bqd", jax.nn.softmax(s, -1), v)
 
 
 def test_reference_scan_matches_full_attention(rng):

@@ -1,0 +1,221 @@
+"""Collective-volume accounting for the multi-chip scaling claim
+(BASELINE #5 "linear to 32 chips"; VERDICT r2 #10).
+
+Compiles representative distributed train steps on the virtual
+8-device CPU mesh, extracts every collective op and its byte volume
+from the optimized HLO, and projects per-step ICI time at v5e link
+bandwidth against MXU compute time — the derisking evidence for the
+scaling claim until real multi-chip hardware is reachable.
+
+Wire-volume model (ring algorithms, per device):
+  all-reduce      2·N·(n−1)/n     (reduce-scatter + all-gather)
+  all-gather      S·(n−1)         (S = per-device shard bytes sent)
+  reduce-scatter  (N/n)·(n−1)
+  collective-permute  N           (one neighbor hop)
+  all-to-all      N·(n−1)/n
+
+    python tools/collective_volume.py [--markdown]
+"""
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+# public v5e figure (jax-ml.github.io/scaling-book): ICI 45 GB/s per
+# link per direction (2D torus; ring collectives ride one link
+# direction per neighbor hop)
+V5E_ICI_GBPS = 45e9
+
+# HLO line shape: `%name = <shape-or-tuple> <opcode>(...), ...` — the
+# result may be a TUPLE (XLA fuses many gradients into one all-reduce)
+_LINE_RE = re.compile(
+    r"=\s*(\(?[^(=]*?(?:\([^)]*\))?)\s*"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?:-start)?\(")
+_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s32": 4,
+                "u32": 4, "s64": 8, "u64": 8, "s16": 2, "u16": 2,
+                "s8": 1, "u8": 1, "pred": 1, "c64": 8, "c128": 16}
+
+
+def _bytes(dtype, dims):
+    n = 1
+    for d in dims.split(",") if dims else []:
+        n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def collectives_of(compiled, n_devices=8):
+    """Parse optimized HLO → [(kind, tensor_bytes, wire_bytes)].
+
+    Collectives inside a `while` body (the ring attention fori_loop)
+    execute once per trip; the ring's trip count is the mesh size, so
+    those are multiplied by ``n_devices``.
+    """
+    out = []
+    for line in compiled.as_text().splitlines():
+        head = line.split("metadata=")[0]
+        m = _LINE_RE.search(head)
+        if not m or "-done" in head:
+            continue
+        shapes, kind = m.groups()
+        nb = sum(_bytes(d, dims)
+                 for d, dims in _SHAPE_RE.findall(shapes))
+        n = n_devices
+        wire = {"all-reduce": 2 * nb * (n - 1) / n,
+                # HLO all-gather result is the FULL gathered tensor;
+                # each device sends its shard to n-1 peers
+                "all-gather": nb / n * (n - 1),
+                "reduce-scatter": nb * (n - 1),   # result is the shard
+                "collective-permute": nb,
+                "all-to-all": nb * (n - 1) / n}[kind]
+        trips = n_devices if "/while/" in line else 1
+        out.append((kind, nb, wire * trips))
+    return out
+
+
+def analyze(name, jitted, args, n_devices=8):
+    """HLO-derived collective counts + wire bytes + projected ICI time.
+
+    No compute-time column here: XLA-CPU cost analysis is meaningless
+    for TPU projection — BASELINE.md pairs these ICI times with the
+    round-1 MEASURED per-step times on the real chip instead.
+    """
+    compiled = jitted.lower(*args).compile()
+    colls = collectives_of(compiled, n_devices)
+    wire = sum(w for _, _, w in colls)
+    by_kind = {}
+    for kind, _, w in colls:
+        c, tot = by_kind.get(kind, (0, 0.0))
+        by_kind[kind] = (c + 1, tot + w)
+    t_ici = wire / V5E_ICI_GBPS
+    return {"name": name, "collectives": by_kind,
+            "wire_bytes": wire, "t_ici_ms": t_ici * 1e3}
+
+
+# ---------------------------------------------------------------------------
+# representative configs (mirror __graft_entry__.dryrun_multichip stages)
+# ---------------------------------------------------------------------------
+def dp_resnet(mesh_devices=8):
+    """DP ResNet-50 sync step: the BASELINE #5 workload. Collective
+    volume = one gradient all-reduce of every parameter."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    import optax
+    from deeplearning4j_tpu.zoo import ResNet50
+    from deeplearning4j_tpu.nn import updaters as upd
+
+    mesh = Mesh(np.array(jax.devices()[:mesh_devices]), ("data",))
+    net = ResNet50(num_classes=1000, seed=0, input_shape=(64, 64, 3),
+                   updater=upd.Nesterovs(learning_rate=0.1,
+                                         momentum=0.9)).init()
+    x = jnp.zeros((16, 64, 64, 3), jnp.float32)
+    y = jnp.zeros((16, 1000), jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    repl = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P("data"))
+
+    def step(params, opt_state, state, x, y):
+        (loss, new_state), g = jax.value_and_grad(
+            net._loss_fn, has_aux=True)(params, state,
+                                        {net.conf.inputs[0]: x}, [y],
+                                        {}, {}, rng)
+        updates, opt_state = net._optimizer.update(g, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, new_state, loss
+
+    jitted = jax.jit(step, in_shardings=(repl, repl, repl, shard, shard),
+                     out_shardings=(repl, repl, repl, repl))
+    return jitted, (net.params, net.opt_state, net.state, x, y)
+
+
+def tp_mlp(mesh_devices=8):
+    """Tensor-parallel 2-layer MLP (col→row sharded): all-reduce of
+    activations, not params."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()[:mesh_devices]), ("model",))
+    d, h = 1024, 4096
+    params = {"W1": jnp.zeros((d, h), jnp.bfloat16),
+              "W2": jnp.zeros((h, d), jnp.bfloat16)}
+    x = jnp.zeros((32, d), jnp.bfloat16)
+    shardings = {"W1": NamedSharding(mesh, P(None, "model")),
+                 "W2": NamedSharding(mesh, P("model", None))}
+
+    def fwd(p, x):
+        hdn = jax.nn.relu(x @ p["W1"])
+        return jnp.sum((hdn @ p["W2"]) ** 2)
+
+    def step(p, x):
+        return jax.value_and_grad(fwd)(p, x)
+
+    jitted = jax.jit(step,
+                     in_shardings=({"W1": shardings["W1"],
+                                    "W2": shardings["W2"]},
+                                   NamedSharding(mesh, P())))
+    return jitted, (jax.device_put(params, shardings), x)
+
+
+def sp_ring(mesh_devices=8, t_total=8192):
+    """Ring-attention fwd+bwd: collective-permute KV/mask blocks per
+    ring step (the long-context SP path)."""
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from deeplearning4j_tpu.parallel.ring_attention import \
+        ring_self_attention
+    mesh = make_mesh({"seq": mesh_devices})
+    b, h, d = 1, 8, 128
+    q = jnp.zeros((b, t_total, h, d), jnp.bfloat16)
+
+    def loss(q):
+        return jnp.sum(
+            ring_self_attention(q, q, q, mesh, causal=True)
+            .astype(jnp.float32) ** 2)
+
+    jitted = jax.jit(jax.value_and_grad(loss))
+    return jitted, (q,)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--markdown", action="store_true")
+    args = ap.parse_args()
+
+    rows = []
+    for name, build in [("DP ResNet-50 (grad allreduce)", dp_resnet),
+                        ("TP MLP col→row (activation allreduce)",
+                         tp_mlp),
+                        ("SP ring attention T=8k causal", sp_ring)]:
+        jitted, a = build()
+        rows.append(analyze(name, jitted, a))
+
+    if args.markdown:
+        print("| config | collectives (count × kind) | wire MB/step "
+              "| projected ICI ms (45 GB/s link) |")
+        print("|---|---|---|---|")
+        for r in rows:
+            kinds = ", ".join(f"{c}× {k}"
+                              for k, (c, _) in sorted(
+                                  r["collectives"].items()))
+            print(f"| {r['name']} | {kinds} "
+                  f"| {r['wire_bytes'] / 1e6:.1f} "
+                  f"| {r['t_ici_ms']:.2f} |")
+    else:
+        for r in rows:
+            print(r)
+
+
+if __name__ == "__main__":
+    main()

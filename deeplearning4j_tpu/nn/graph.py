@@ -397,9 +397,25 @@ class ComputationGraph:
 
         return jax.jit(loop, donate_argnums=(0, 1, 2))
 
+    def _refresh_ambient_trace(self):
+        """Drop jitted caches when the ambient distributed context has
+        changed since tracing (see MultiLayerNetwork's counterpart)."""
+        if not any(node.kind == "layer"
+                   and getattr(node.obj, "sequence_parallel", None)
+                   for node in self.order):
+            return
+        from deeplearning4j_tpu.parallel.mesh import context_epoch
+        e = context_epoch()
+        if getattr(self, "_ctx_epoch", None) != e:
+            self._ctx_epoch = e
+            self._train_step_fn = None
+            self._train_loop_fn = None
+            self._output_fn = None
+
     def _fit_group(self, group):
         """Run a group of uniformly-shaped mask-free batches in one
         scanned call (see ``_make_train_loop``)."""
+        self._refresh_ambient_trace()
         if self._train_loop_fn is None:
             self._train_loop_fn = self._make_train_loop()
         inputs = {n: jnp.stack([jnp.asarray(np.asarray(xs[i]))
@@ -499,6 +515,7 @@ class ComputationGraph:
         group.clear()
 
     def _fit_batch(self, xs, ys, fms=None, lms=None):
+        self._refresh_ambient_trace()
         if self._train_step_fn is None:
             self._train_step_fn = self._make_train_step()
         inputs = {n: jnp.asarray(np.asarray(x))
@@ -524,6 +541,7 @@ class ComputationGraph:
     def output(self, *features, train: bool = False):
         """Returns a list of output activations (reference
         ComputationGraph.output)."""
+        self._refresh_ambient_trace()
         if self._output_fn is None:
             cd = self.conf.compute_dtype
 

@@ -71,12 +71,66 @@ def scaled_dot_attention(q, k, v, mask=None, causal=False):
 @dataclass
 class MultiHeadAttention(Layer):
     """Self multi-head attention projection block (reference
-    multi_head_dot_product_attention op + AttentionVertex)."""
+    multi_head_dot_product_attention op + AttentionVertex).
+
+    ``sequence_parallel``: ``"ring"`` | ``"zigzag_ring"`` |
+    ``"ulysses"`` | ``None`` — when an ambient
+    ``parallel.distributed_context`` is active, the attention runs
+    sequence-parallel over its mesh (ring ppermute, load-balanced
+    zigzag ring, or all-to-all head swap); outside a context it falls
+    back to local attention, so the same model config runs single- and
+    multi-chip. Entering/exiting the context invalidates the owning
+    net's jitted traces, so the decision is never stale.
+    """
     n_in: Optional[int] = None
     n_out: int = 0
     n_heads: int = 1
     causal: bool = False
     project_out: bool = True
+    sequence_parallel: Optional[str] = None
+
+    _SP_MODES = (None, "ring", "ulysses", "zigzag_ring")
+
+    def _attend(self, q, k, v, mask):
+        if self.sequence_parallel not in self._SP_MODES:
+            # reject typos even single-chip, where no context is active
+            raise ValueError(
+                f"unknown sequence_parallel mode "
+                f"{self.sequence_parallel!r} (ring|ulysses|zigzag_ring)")
+        if self.sequence_parallel:
+            from deeplearning4j_tpu.parallel.mesh import active_context
+            ctx = active_context()
+            if ctx is not None:
+                if self.sequence_parallel == "ring":
+                    from deeplearning4j_tpu.parallel.ring_attention \
+                        import ring_self_attention
+                    return ring_self_attention(
+                        q, k, v, ctx.mesh, axis_name=ctx.axis_name,
+                        mask=mask, causal=self.causal)
+                if self.sequence_parallel == "ulysses":
+                    from deeplearning4j_tpu.parallel.ulysses import \
+                        ulysses_self_attention
+                    return ulysses_self_attention(
+                        q, k, v, ctx.mesh, axis_name=ctx.axis_name,
+                        mask=mask, causal=self.causal)
+                if self.sequence_parallel == "zigzag_ring":
+                    # load-balanced causal ring; tokens permuted into
+                    # zigzag layout around the call (pre-permute the
+                    # DATA once instead for production pipelines)
+                    from deeplearning4j_tpu.parallel.ring_attention \
+                        import (zigzag_permute,
+                                zigzag_ring_self_attention,
+                                zigzag_unpermute)
+                    if not self.causal or mask is not None:
+                        raise ValueError("zigzag_ring is causal-only "
+                                         "and takes no key mask")
+                    n = ctx.mesh.shape[ctx.axis_name]
+                    o = zigzag_ring_self_attention(
+                        zigzag_permute(q, n), zigzag_permute(k, n),
+                        zigzag_permute(v, n), ctx.mesh,
+                        axis_name=ctx.axis_name)
+                    return zigzag_unpermute(o, n)
+        return scaled_dot_attention(q, k, v, mask, self.causal)
 
     def init(self, key, input_shape, dtype=jnp.float32):
         n_in = self.n_in or input_shape[-1]
@@ -99,7 +153,7 @@ class MultiHeadAttention(Layer):
         q = _split_heads(x @ params["Wq"], self.n_heads)
         k = _split_heads(x @ params["Wk"], self.n_heads)
         v = _split_heads(x @ params["Wv"], self.n_heads)
-        o = _merge_heads(scaled_dot_attention(q, k, v, mask, self.causal))
+        o = _merge_heads(self._attend(q, k, v, mask))
         if self.project_out:
             o = o @ params["Wo"] + params["bo"]
         if mask is not None:
@@ -175,12 +229,16 @@ class TransformerEncoderBlock(Layer):
     n_in: Optional[int] = None
     n_heads: int = 8
     ffn_mult: int = 4
+    causal: bool = False
+    sequence_parallel: Optional[str] = None
 
     def init(self, key, input_shape, dtype=jnp.float32):
         f = self.n_in = self.n_in or input_shape[-1]
         wi = winit.get(self.weight_init or "xavier")
         ks = jax.random.split(key, 6)
-        self._mha = MultiHeadAttention(n_in=f, n_out=f, n_heads=self.n_heads)
+        self._mha = MultiHeadAttention(
+            n_in=f, n_out=f, n_heads=self.n_heads, causal=self.causal,
+            sequence_parallel=self.sequence_parallel)
         self._ln1 = LayerNormalization()
         self._ln2 = LayerNormalization()
         pa, _, _ = self._mha.init(ks[0], input_shape, dtype)
@@ -197,8 +255,10 @@ class TransformerEncoderBlock(Layer):
     def _subs(self, input_shape=None):
         f = self.n_in
         if not hasattr(self, "_mha"):
-            self._mha = MultiHeadAttention(n_in=f, n_out=f,
-                                           n_heads=self.n_heads)
+            self._mha = MultiHeadAttention(
+                n_in=f, n_out=f, n_heads=self.n_heads,
+                causal=self.causal,
+                sequence_parallel=self.sequence_parallel)
             self._ln1 = LayerNormalization()
             self._ln2 = LayerNormalization()
 

@@ -294,7 +294,25 @@ class MultiLayerNetwork:
 
         return jax.jit(loop, donate_argnums=(0, 1, 2))
 
+    def _refresh_ambient_trace(self):
+        """Nets whose layers consult the ambient distributed context
+        (``sequence_parallel`` attention) bake that decision into their
+        jitted traces — drop the caches whenever the context has
+        changed since tracing, so entering/exiting
+        ``parallel.distributed_context`` never runs a stale plan."""
+        if not any(getattr(l, "sequence_parallel", None)
+                   for l in self.layers):
+            return
+        from deeplearning4j_tpu.parallel.mesh import context_epoch
+        e = context_epoch()
+        if getattr(self, "_ctx_epoch", None) != e:
+            self._ctx_epoch = e
+            self._train_step_fn = None
+            self._train_loop_fn = None
+            self._output_fn = None
+
     def _fit_group(self, group):
+        self._refresh_ambient_trace()
         if self._train_loop_fn is None:
             self._train_loop_fn = self._make_train_loop()
         xs = jnp.stack([jnp.asarray(np.asarray(x)) for x, _ in group])
@@ -392,6 +410,7 @@ class MultiLayerNetwork:
         y = jnp.asarray(np.asarray(y))
         if (self.conf.backprop_type == "TruncatedBPTT" and x.ndim == 3):
             return self._fit_tbptt(x, y, fmask, lmask)
+        self._refresh_ambient_trace()
         if self._train_step_fn is None:
             self._train_step_fn = self._make_train_step()
         rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed),
@@ -530,6 +549,7 @@ class MultiLayerNetwork:
     def output(self, x, train: bool = False, mask=None):
         """Reference: MultiLayerNetwork.output (SURVEY §3.3)."""
         x = jnp.asarray(np.asarray(x))
+        self._refresh_ambient_trace()
         if self._output_fn is None:
             cd = self.conf.compute_dtype
 

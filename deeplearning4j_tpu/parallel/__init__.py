@@ -10,7 +10,9 @@ sharding annotations. ``jax.distributed`` replaces Spark/Aeron mesh
 formation for multi-host.
 """
 from deeplearning4j_tpu.parallel.mesh import (make_mesh, data_parallel_mesh,
-                                              initialize_distributed)
+                                              initialize_distributed,
+                                              distributed_context,
+                                              active_context)
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
 from deeplearning4j_tpu.parallel.inference import (ParallelInference,
                                                    shard_model_params)
@@ -26,13 +28,16 @@ from deeplearning4j_tpu.parallel.moe import MixtureOfExperts
 from deeplearning4j_tpu.parallel.pipeline import (
     pipeline_apply, pipeline_train_step, make_mlp_stage,
 )
-from deeplearning4j_tpu.parallel.ring_attention import \
-    ring_self_attention
+from deeplearning4j_tpu.parallel.ring_attention import (
+    ring_self_attention, zigzag_ring_self_attention, zigzag_permute,
+    zigzag_unpermute)
 from deeplearning4j_tpu.parallel.ulysses import ulysses_self_attention
 
 __all__ = [
     "MixtureOfExperts", "pipeline_apply", "pipeline_train_step",
     "make_mlp_stage", "ring_self_attention", "ulysses_self_attention",
+    "zigzag_ring_self_attention", "zigzag_permute", "zigzag_unpermute",
+    "distributed_context", "active_context",
     "make_mesh", "data_parallel_mesh", "initialize_distributed",
     "ParallelWrapper", "ParallelInference", "shard_model_params",
     "EncodedGradientsAccumulator", "encode_threshold", "decode_threshold",

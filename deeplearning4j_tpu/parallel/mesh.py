@@ -72,3 +72,66 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         coordinator_address=coordinator_address,
         num_processes=num_processes or int(os.environ["DL4J_TPU_NPROC"]),
         process_id=process_id or int(os.environ["DL4J_TPU_PROC_ID"]))
+
+
+# ---------------------------------------------------------------------------
+# ambient distributed context — lets high-level layers (nn.layers.*)
+# pick up the active mesh without threading it through every apply()
+# signature (the reference threads context via static singletons the
+# same way, e.g. Nd4j.getAffinityManager). Thread-local so e.g.
+# ParallelInference worker threads never see the training thread's
+# mesh; the epoch counter lets jit caches detect that the ambient
+# state they traced under has changed.
+import threading as _threading
+
+_TLS = _threading.local()
+_CTX_EPOCH = [0]
+
+
+def _stack() -> list:
+    if not hasattr(_TLS, "stack"):
+        _TLS.stack = []
+    return _TLS.stack
+
+
+class distributed_context:
+    """Context manager installing a mesh as the ambient distributed
+    context: layers with a ``sequence_parallel`` setting (e.g.
+    MultiHeadAttention) route their attention over ``axis_name`` of
+    this mesh while the context is active.
+
+        with distributed_context(make_mesh({"seq": 8})):
+            net.fit(...)      # attention runs sequence-parallel
+
+    The context is per-thread. Networks whose layers consult it
+    re-trace their jitted steps when the ambient state changes (see
+    ``context_epoch``), so the same net object can fit inside and
+    outside a context without stale traces.
+    """
+
+    def __init__(self, mesh: Mesh, axis_name: str = "seq"):
+        self.mesh = mesh
+        self.axis_name = axis_name
+
+    def __enter__(self):
+        _stack().append(self)
+        _CTX_EPOCH[0] += 1
+        return self
+
+    def __exit__(self, *exc):
+        stack = _stack()
+        if self in stack:          # tolerate out-of-order exits
+            stack.remove(self)
+        _CTX_EPOCH[0] += 1
+        return False
+
+
+def active_context() -> Optional["distributed_context"]:
+    stack = _stack()
+    return stack[-1] if stack else None
+
+
+def context_epoch() -> int:
+    """Monotone counter bumped on every context enter/exit — jit-cache
+    invalidation key for nets with ambient-context-dependent layers."""
+    return _CTX_EPOCH[0]

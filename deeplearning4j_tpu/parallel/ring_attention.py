@@ -175,3 +175,164 @@ def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
 # preserves the original import location.
 from deeplearning4j_tpu.parallel.ulysses import \
     ulysses_self_attention as ulysses_attention  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# zigzag (load-balanced) causal ring attention
+# ---------------------------------------------------------------------------
+#
+# Plain causal ring attention is imbalanced: ring index m has m+1 live
+# KV blocks of n, so the last device does n× the work of the first and
+# the ring's wall-clock is set by the worst device. The zigzag layout
+# (Megatron-style context parallelism) gives every device TWO
+# half-chunks — global chunk m and chunk 2n−1−m — so each device owns
+# one early (cheap) and one late (expensive) piece of the causal
+# triangle and every device computes exactly 2n+1 live half-chunk pairs
+# per full ring: perfectly balanced, same O(T/N) memory, same ppermute
+# volume.
+
+def zigzag_order(n: int):
+    """Global chunk order of the zigzag layout: device m holds chunks
+    (m, 2n−1−m) of 2n equal chunks."""
+    order = []
+    for m in range(n):
+        order += [m, 2 * n - 1 - m]
+    return order
+
+
+def zigzag_permute(x, n: int, axis: int = 1):
+    """Reorder a gathered [..., T, ...] array into zigzag layout (call
+    before sharding the sequence axis over the mesh)."""
+    t = x.shape[axis]
+    c = t // (2 * n)
+    if t % (2 * n):
+        raise ValueError(f"T={t} not divisible by 2·n_devices={2 * n}")
+    idx = jnp.concatenate([jnp.arange(j * c, (j + 1) * c)
+                           for j in zigzag_order(n)])
+    return jnp.take(x, idx, axis=axis)
+
+
+def zigzag_unpermute(x, n: int, axis: int = 1):
+    """Inverse of :func:`zigzag_permute`."""
+    t = x.shape[axis]
+    c = t // (2 * n)
+    idx = jnp.concatenate([jnp.arange(j * c, (j + 1) * c)
+                           for j in zigzag_order(n)])
+    inv = jnp.zeros_like(idx).at[idx].set(jnp.arange(t))
+    return jnp.take(x, inv, axis=axis)
+
+
+def _zz_merge_half(out, lse, o_b, lse_b, qi, c):
+    sl = slice(qi * c, (qi + 1) * c)
+    o_new, l_new = _merge_blocks(out[:, sl], lse[:, sl], o_b, lse_b)
+    return out.at[:, sl].set(o_new), lse.at[:, sl].set(l_new)
+
+
+def _zz_fwd_impl(q, k, v, axis_name):
+    """q,k,v: [BH, 2c, D] in zigzag layout. Causal only."""
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    c = q.shape[1] // 2
+    vary = lambda x: lax.pcast(x, (axis_name,), to="varying")
+    out0 = vary(jnp.zeros(q.shape, jnp.float32))
+    lse0 = vary(jnp.full(q.shape[:2] + (1,), -jnp.inf, jnp.float32))
+    q_ids = (my, 2 * n - 1 - my)
+    qh = (q[:, :c], q[:, c:])
+
+    def body(i, carry):
+        out, lse, k_cur, v_cur = carry
+        src = jnp.mod(my - i, n)
+        k_ids = (src, 2 * n - 1 - src)
+        for qi in (0, 1):
+            for ki in (0, 1):
+                offs = jnp.stack([q_ids[qi] * c,
+                                  k_ids[ki] * c]).astype(jnp.int32)
+                o_b, lse_b = flash_block_fwd(
+                    qh[qi], k_cur[:, ki * c:(ki + 1) * c],
+                    v_cur[:, ki * c:(ki + 1) * c], None, offs, True)
+                out, lse = _zz_merge_half(out, lse, o_b, lse_b, qi, c)
+        perm = _ring_perm(n)
+        return (out, lse, lax.ppermute(k_cur, axis_name, perm),
+                lax.ppermute(v_cur, axis_name, perm))
+
+    out, lse, _, _ = lax.fori_loop(0, n, body, (out0, lse0, k, v))
+    return out.astype(q.dtype), lse
+
+
+def _zz_bwd_impl(q, k, v, out, lse, g, axis_name):
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    c = q.shape[1] // 2
+    zero = lambda x: lax.pcast(jnp.zeros(x.shape, jnp.float32),
+                               (axis_name,), to="varying")
+    q_ids = (my, 2 * n - 1 - my)
+    qh = (q[:, :c], q[:, c:])
+    outh = (out[:, :c], out[:, c:])
+    lseh = (lse[:, :c], lse[:, c:])
+    gh = (g[:, :c], g[:, c:])
+
+    def body(i, carry):
+        dq, dk_acc, dv_acc, k_cur, v_cur = carry
+        src = jnp.mod(my - i, n)
+        k_ids = (src, 2 * n - 1 - src)
+        for qi in (0, 1):
+            for ki in (0, 1):
+                ks = slice(ki * c, (ki + 1) * c)
+                offs = jnp.stack([q_ids[qi] * c,
+                                  k_ids[ki] * c]).astype(jnp.int32)
+                dq_b, dk_b, dv_b = flash_block_bwd(
+                    qh[qi], k_cur[:, ks], v_cur[:, ks], outh[qi],
+                    lseh[qi], gh[qi], None, offs, True)
+                qs = slice(qi * c, (qi + 1) * c)
+                dq = dq.at[:, qs].add(dq_b.astype(jnp.float32))
+                dk_acc = dk_acc.at[:, ks].add(dk_b.astype(jnp.float32))
+                dv_acc = dv_acc.at[:, ks].add(dv_b.astype(jnp.float32))
+        perm = _ring_perm(n)
+        pp = lambda x: lax.ppermute(x, axis_name, perm)
+        return dq, pp(dk_acc), pp(dv_acc), pp(k_cur), pp(v_cur)
+
+    dq, dk, dv, _, _ = lax.fori_loop(
+        0, n, body, (zero(q), zero(k), zero(v), k, v))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _zz_ring_attn(q, k, v, axis_name):
+    out, _ = _zz_fwd_impl(q, k, v, axis_name)
+    return out
+
+
+def _zz_ring_attn_fwd(q, k, v, axis_name):
+    out, lse = _zz_fwd_impl(q, k, v, axis_name)
+    return out, (q, k, v, out, lse)
+
+
+def _zz_ring_attn_bwd(axis_name, res, g):
+    return _zz_bwd_impl(*res, g, axis_name)
+
+
+_zz_ring_attn.defvjp(_zz_ring_attn_fwd, _zz_ring_attn_bwd)
+
+
+def zigzag_ring_self_attention(q, k, v, mesh: Mesh,
+                               axis_name: str = "seq"):
+    """Load-balanced CAUSAL ring attention. Inputs [B, T, H, D] in
+    ZIGZAG layout on the T axis (see :func:`zigzag_permute`), sharded
+    over ``axis_name``; returns the same layout/sharding.
+
+    Every device computes the same number of live half-chunk pairs per
+    ring, so the causal triangle no longer serialises on the
+    last-ranked device (plain ``ring_self_attention`` with
+    ``causal=True`` is correct but its critical path is the device
+    holding the final blocks).
+    """
+    def local(q, k, v):
+        b, t, h, d = q.shape
+        fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        o = _zz_ring_attn(fold(q), fold(k), fold(v), axis_name)
+        return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec)
+    return fn(q, k, v)

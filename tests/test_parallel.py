@@ -393,6 +393,156 @@ def test_ring_attention_causal_masked():
                                rtol=2e-4, atol=2e-5)
 
 
+def test_zigzag_ring_matches_dense_causal():
+    """Load-balanced zigzag layout: permute → distributed causal
+    attention → unpermute must equal dense causal attention in the
+    original order (fwd)."""
+    from deeplearning4j_tpu.parallel import (
+        zigzag_permute, zigzag_ring_self_attention, zigzag_unpermute)
+    mesh = make_mesh({"seq": 8})
+    n, (b, t, h, d) = 8, (2, 64, 2, 8)
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(9), 3)
+    q = jax.random.normal(kq, (b, t, h, d))
+    k = jax.random.normal(kk, (b, t, h, d))
+    v = jax.random.normal(kv, (b, t, h, d))
+    from deeplearning4j_tpu.nn.layers.attention import \
+        scaled_dot_attention
+    want = scaled_dot_attention(q, k, v, causal=True)
+    zz = zigzag_ring_self_attention(
+        zigzag_permute(q, n), zigzag_permute(k, n),
+        zigzag_permute(v, n), mesh)
+    got = zigzag_unpermute(zz, n)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_zigzag_ring_gradients_match():
+    from deeplearning4j_tpu.parallel import (
+        zigzag_permute, zigzag_ring_self_attention, zigzag_unpermute)
+    mesh = make_mesh({"seq": 8})
+    n, (b, t, h, d) = 8, (1, 32, 2, 8)
+    q = jax.random.normal(jax.random.PRNGKey(10), (b, t, h, d))
+    co = jax.random.normal(jax.random.PRNGKey(11), (b, t, h, d))
+    from deeplearning4j_tpu.nn.layers.attention import \
+        scaled_dot_attention
+
+    def loss_zz(x):
+        xz = zigzag_permute(x, n)
+        o = zigzag_ring_self_attention(xz, xz, xz, mesh)
+        return jnp.sum(zigzag_unpermute(o, n) * co)
+
+    def loss_dense(x):
+        return jnp.sum(scaled_dot_attention(x, x, x, causal=True) * co)
+
+    g_zz = jax.grad(loss_zz)(q)
+    g_d = jax.grad(loss_dense)(q)
+    np.testing.assert_allclose(np.asarray(g_zz), np.asarray(g_d),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_zigzag_permute_roundtrip():
+    from deeplearning4j_tpu.parallel import (zigzag_permute,
+                                             zigzag_unpermute)
+    x = jnp.arange(2 * 48.0).reshape(2, 48)
+    rt = zigzag_unpermute(zigzag_permute(x, 8, axis=1), 8, axis=1)
+    np.testing.assert_array_equal(np.asarray(rt), np.asarray(x))
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses", "zigzag_ring"])
+def test_sequence_parallel_layer_api(mode):
+    """MultiHeadAttention(sequence_parallel=...) under an ambient
+    distributed_context must equal the same layer outside the context
+    (the high-level long-context path; users never touch shard_map)."""
+    from deeplearning4j_tpu.parallel import (distributed_context,
+                                             make_mesh)
+    from deeplearning4j_tpu.nn.layers import MultiHeadAttention
+    mesh = make_mesh({"seq": 8})
+    t = 32
+    layer = MultiHeadAttention(n_in=16, n_out=16, n_heads=8,
+                               causal=True, sequence_parallel=mode)
+    params, _, _ = layer.init(jax.random.PRNGKey(0), (t, 16))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, t, 16))
+    local, _ = layer.apply(params, {}, x)          # no ambient context
+    with distributed_context(mesh):
+        dist, _ = layer.apply(params, {}, x)
+    np.testing.assert_allclose(np.asarray(local), np.asarray(dist),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_sequence_parallel_context_invalidates_traces():
+    """A net fit OUTSIDE the context first must re-trace when entering
+    it (and vice versa) — the ambient decision is never baked into a
+    stale jit cache. Also: a typo'd mode raises even single-chip."""
+    from deeplearning4j_tpu.parallel import (distributed_context,
+                                             make_mesh)
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import (GlobalPoolingLayer,
+                                              MultiHeadAttention,
+                                              OutputLayer,
+                                              TransformerEncoderBlock)
+    from deeplearning4j_tpu.nn import updaters as upd
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater(upd.Adam(learning_rate=0.01)).list()
+            .layer(TransformerEncoderBlock(n_heads=8, causal=True,
+                                           sequence_parallel="ring"))
+            .layer(GlobalPoolingLayer(pooling_type="avg"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType("rnn", (16, 16))).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 16, 16)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+    net.fit(x, y)                      # traces LOCAL attention
+    local_fn = net._train_step_fn
+    with distributed_context(make_mesh({"seq": 8})):
+        net.fit(x, y)                  # must re-trace distributed
+        assert net._train_step_fn is not local_fn
+        dist_fn = net._train_step_fn
+    net.fit(x, y)                      # back outside: re-trace again
+    assert net._train_step_fn is not dist_fn
+    assert np.isfinite(net.score())
+
+    bad = MultiHeadAttention(n_in=16, n_out=16, n_heads=2,
+                             sequence_parallel="ulyses")
+    params, _, _ = bad.init(jax.random.PRNGKey(0), (8, 16))
+    with pytest.raises(ValueError, match="sequence_parallel"):
+        bad.apply(params, {}, jnp.zeros((1, 8, 16)))
+
+
+def test_sequence_parallel_transformer_trains():
+    """A full MultiLayerNetwork with a sequence-parallel transformer
+    block trains under the ambient context (grads flow through the
+    ring inside the jitted train step)."""
+    from deeplearning4j_tpu.parallel import (distributed_context,
+                                             make_mesh)
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import (GlobalPoolingLayer,
+                                              OutputLayer,
+                                              TransformerEncoderBlock)
+    from deeplearning4j_tpu.nn import updaters as upd
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater(upd.Adam(learning_rate=0.01)).list()
+            .layer(TransformerEncoderBlock(n_heads=8, causal=True,
+                                           sequence_parallel="ring"))
+            .layer(GlobalPoolingLayer(pooling_type="avg"))
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType("rnn", (16, 16))).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 16, 16)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+    with distributed_context(make_mesh({"seq": 8})):
+        for _ in range(3):
+            net.fit(x, y)
+    assert np.isfinite(net.score())
+
+
 def test_ulysses_attention_legacy_alias():
     """The original ring_attention.ulysses_attention import location
     must keep working (now delegating to parallel/ulysses.py)."""

@@ -16,7 +16,7 @@ from deeplearning4j_tpu.nn.layers.core import (
     DenseLayer, OutputLayer, LossLayer, ActivationLayer, DropoutLayer,
     EmbeddingLayer, EmbeddingSequenceLayer, ElementWiseMultiplicationLayer,
     BatchNormalization, LayerNormalization, LocalResponseNormalization,
-    CnnLossLayer, Cnn3DLossLayer,
+    CnnLossLayer, Cnn3DLossLayer, RMSNorm,
 )
 from deeplearning4j_tpu.nn.layers.conv import (
     ConvolutionLayer, Convolution1DLayer, Convolution3DLayer,
@@ -34,7 +34,7 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
 from deeplearning4j_tpu.nn.layers.attention import (
     SelfAttentionLayer, LearnedSelfAttentionLayer, MultiHeadAttention,
     TransformerEncoderBlock, PositionalEmbeddingLayer, ClsTokenPoolLayer,
-    RecurrentAttentionLayer,
+    RecurrentAttentionLayer, TransformerDecoderBlock,
 )
 from deeplearning4j_tpu.nn.layers.special import (
     AutoEncoder, VariationalAutoencoder, CenterLossOutputLayer,

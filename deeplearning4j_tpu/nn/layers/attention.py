@@ -36,6 +36,38 @@ def _merge_heads(x):
     return x.reshape(b, t, h * d)
 
 
+def rotary_embedding(x, theta: float = 10000.0, offset=0):
+    """Rotary position embedding (RoPE) on [B, T, H, D] (D even):
+    HALF-SPLIT pairing (GPT-NeoX convention — feature i rotates with
+    feature i + D/2, NOT the interleaved (i, i+1) GPT-J convention;
+    permute Wq/Wk columns when importing interleaved-RoPE weights).
+    Scores depend only on RELATIVE position — the modern long-context
+    positional scheme. ``offset`` shifts the position index (KV-cache
+    decoding)."""
+    b, t, h, d = x.shape
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    pos = offset + jnp.arange(t, dtype=jnp.float32)
+    ang = pos[:, None] * freqs[None, :]            # [T, D/2]
+    cos = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1)
+
+
+def repeat_kv_heads(k, n_heads: int):
+    """Grouped-query attention: broadcast ``n_kv`` key/value heads to
+    ``n_heads`` query heads ([B, T, n_kv, D] → [B, T, n_heads, D])."""
+    n_kv = k.shape[2]
+    if n_kv == n_heads:
+        return k
+    if n_heads % n_kv:
+        raise ValueError(f"n_heads={n_heads} not divisible by "
+                         f"n_kv_heads={n_kv}")
+    return jnp.repeat(k, n_heads // n_kv, axis=2)
+
+
 def scaled_dot_attention(q, k, v, mask=None, causal=False):
     """q,k,v: [B, T, H, D] (head axis 2). mask: [B, Tk] key mask.
 
@@ -88,15 +120,22 @@ class MultiHeadAttention(Layer):
     causal: bool = False
     project_out: bool = True
     sequence_parallel: Optional[str] = None
+    n_kv_heads: Optional[int] = None   # grouped-query attention
+    rope: bool = False                 # rotary position embeddings
+    rope_theta: float = 10000.0
 
     _SP_MODES = (None, "ring", "ulysses", "zigzag_ring")
 
     def _attend(self, q, k, v, mask):
+        """``k``/``v`` may carry fewer heads than ``q`` (GQA): the ring
+        paths keep the SMALL kv on the wire and expand per flash call;
+        local and Ulysses paths broadcast here."""
         if self.sequence_parallel not in self._SP_MODES:
             # reject typos even single-chip, where no context is active
             raise ValueError(
                 f"unknown sequence_parallel mode "
                 f"{self.sequence_parallel!r} (ring|ulysses|zigzag_ring)")
+        n_heads = q.shape[2]
         if self.sequence_parallel:
             from deeplearning4j_tpu.parallel.mesh import active_context
             ctx = active_context()
@@ -111,7 +150,9 @@ class MultiHeadAttention(Layer):
                     from deeplearning4j_tpu.parallel.ulysses import \
                         ulysses_self_attention
                     return ulysses_self_attention(
-                        q, k, v, ctx.mesh, axis_name=ctx.axis_name,
+                        q, repeat_kv_heads(k, n_heads),
+                        repeat_kv_heads(v, n_heads), ctx.mesh,
+                        axis_name=ctx.axis_name,
                         mask=mask, causal=self.causal)
                 if self.sequence_parallel == "zigzag_ring":
                     # load-balanced causal ring; tokens permuted into
@@ -130,7 +171,9 @@ class MultiHeadAttention(Layer):
                         zigzag_permute(v, n), ctx.mesh,
                         axis_name=ctx.axis_name)
                     return zigzag_unpermute(o, n)
-        return scaled_dot_attention(q, k, v, mask, self.causal)
+        return scaled_dot_attention(q, repeat_kv_heads(k, n_heads),
+                                    repeat_kv_heads(v, n_heads), mask,
+                                    self.causal)
 
     def init(self, key, input_shape, dtype=jnp.float32):
         n_in = self.n_in or input_shape[-1]
@@ -138,11 +181,16 @@ class MultiHeadAttention(Layer):
         if n_out % self.n_heads:
             raise ValueError(f"n_out={n_out} not divisible by "
                              f"n_heads={self.n_heads}")
+        n_kv = self.n_kv_heads or self.n_heads
+        if self.n_heads % n_kv:
+            raise ValueError(f"n_heads={self.n_heads} not divisible "
+                             f"by n_kv_heads={n_kv}")
+        kv_out = (n_out // self.n_heads) * n_kv
         wi = winit.get(self.weight_init or "xavier")
-        kq, kk, kv, ko = jax.random.split(key, 4)
+        kq, kk, kv_, ko = jax.random.split(key, 4)
         params = {"Wq": wi(kq, (n_in, n_out), dtype),
-                  "Wk": wi(kk, (n_in, n_out), dtype),
-                  "Wv": wi(kv, (n_in, n_out), dtype)}
+                  "Wk": wi(kk, (n_in, kv_out), dtype),
+                  "Wv": wi(kv_, (n_in, kv_out), dtype)}
         if self.project_out:
             params["Wo"] = wi(ko, (n_out, n_out), dtype)
             params["bo"] = jnp.zeros((n_out,), dtype)
@@ -150,9 +198,13 @@ class MultiHeadAttention(Layer):
         return params, {}, (t, n_out)
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        n_kv = self.n_kv_heads or self.n_heads
         q = _split_heads(x @ params["Wq"], self.n_heads)
-        k = _split_heads(x @ params["Wk"], self.n_heads)
-        v = _split_heads(x @ params["Wv"], self.n_heads)
+        k = _split_heads(x @ params["Wk"], n_kv)
+        v = _split_heads(x @ params["Wv"], n_kv)
+        if self.rope:
+            q = rotary_embedding(q, self.rope_theta)
+            k = rotary_embedding(k, self.rope_theta)
         o = _merge_heads(self._attend(q, k, v, mask))
         if self.project_out:
             o = o @ params["Wo"] + params["bo"]
@@ -273,6 +325,65 @@ class TransformerEncoderBlock(Layer):
         h = jax.nn.gelu(h @ params["W1"] + params["b1"])
         h = h @ params["W2"] + params["b2"]
         x = x + self._maybe_dropout(h, train, r2)
+        return x, state
+
+
+@register_layer
+@dataclass
+class TransformerDecoderBlock(Layer):
+    """Pre-RMSNorm causal decoder block (modern-LM style): grouped-
+    query attention with rotary embeddings + SwiGLU MLP, residuals
+    around both. The reference has no decoder-only transformer (its
+    LM story is char-RNN + imported BERT); this is the native causal-LM
+    building block, sequence-parallel-ready via ``sequence_parallel``.
+    """
+    n_in: Optional[int] = None
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None
+    ffn_mult: int = 4
+    rope_theta: float = 10000.0
+    sequence_parallel: Optional[str] = None
+
+    def _subs(self):
+        if not hasattr(self, "_mha"):
+            from deeplearning4j_tpu.nn.layers.core import RMSNorm
+            f = self.n_in
+            self._mha = MultiHeadAttention(
+                n_in=f, n_out=f, n_heads=self.n_heads,
+                n_kv_heads=self.n_kv_heads, causal=True, rope=True,
+                rope_theta=self.rope_theta,
+                sequence_parallel=self.sequence_parallel)
+            self._ln1 = RMSNorm()
+            self._ln2 = RMSNorm()
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        f = self.n_in = self.n_in or input_shape[-1]
+        self._subs()
+        wi = winit.get(self.weight_init or "xavier")
+        ks = jax.random.split(key, 6)
+        pa, _, _ = self._mha.init(ks[0], input_shape, dtype)
+        p1, _, _ = self._ln1.init(ks[1], input_shape, dtype)
+        p2, _, _ = self._ln2.init(ks[2], input_shape, dtype)
+        hid = f * self.ffn_mult
+        params = {"mha": pa, "ln1": p1, "ln2": p2,
+                  # SwiGLU: (silu(x W_gate) ⊙ x W_up) W_down
+                  "Wg": wi(ks[3], (f, hid), dtype),
+                  "Wu": wi(ks[4], (f, hid), dtype),
+                  "Wd": wi(ks[5], (hid, f), dtype)}
+        return params, {}, tuple(input_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None,
+              mask=None):
+        self._subs()
+        r1, r2 = (jax.random.split(rng) if rng is not None
+                  else (None, None))
+        h, _ = self._ln1.apply(params["ln1"], {}, x)
+        a, _ = self._mha.apply(params["mha"], {}, h, train=train,
+                               rng=r1, mask=mask)
+        x = x + a
+        h, _ = self._ln2.apply(params["ln2"], {}, x)
+        h = jax.nn.silu(h @ params["Wg"]) * (h @ params["Wu"])
+        x = x + self._maybe_dropout(h @ params["Wd"], train, r2)
         return x, state
 
 

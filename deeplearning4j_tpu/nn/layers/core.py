@@ -258,6 +258,29 @@ class LayerNormalization(Layer):
         return y * params["gamma"] + params["beta"], state
 
 
+#: default RMSNorm epsilon — zoo/gpt.py's KV-cache decode re-derives
+#: the norm inline and MUST use the same value (kept in one place)
+RMSNORM_EPS = 1e-6
+
+
+@register_layer
+@dataclass
+class RMSNorm(Layer):
+    """Root-mean-square norm over the trailing axis (no mean
+    subtraction, no bias) — the modern-LM normalisation the causal
+    transformer stack uses. No reference counterpart (its transformer
+    support predates RMSNorm); provided for the native LM family."""
+    eps: float = RMSNORM_EPS
+
+    def init(self, key, input_shape, dtype=jnp.float32):
+        c = input_shape[-1]
+        return {"gamma": jnp.ones((c,), dtype)}, {}, tuple(input_shape)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+        return x * jax.lax.rsqrt(ms + self.eps) * params["gamma"], state
+
+
 @register_layer
 @dataclass
 class LocalResponseNormalization(Layer):

@@ -63,9 +63,28 @@ def _ring_perm(n):
     return [(j, (j + 1) % n) for j in range(n)]
 
 
-def _ring_fwd_impl(q, k, v, km, axis_name, causal):
-    """q,k,v: [BH, T_loc, D] (heads folded), km: [BH, T_loc].
-    Returns (out [BH, T_loc, D] in q.dtype, lse [BH, T_loc, 1] f32)."""
+def _expand_groups(x, groups):
+    """[B·Hkv, ...] → [B·H, ...]: broadcast each kv-head row to its
+    ``groups`` query heads (rows are (batch, head)-major, and query
+    head h uses kv head h // groups, so adjacent repetition aligns)."""
+    return x if groups == 1 else jnp.repeat(x, groups, axis=0)
+
+
+def _reduce_groups(dx, groups):
+    """Transpose of :func:`_expand_groups`: sum query-head gradients
+    back onto their shared kv head."""
+    if groups == 1:
+        return dx
+    bh = dx.shape[0]
+    return jnp.sum(dx.reshape(bh // groups, groups, *dx.shape[1:]),
+                   axis=1)
+
+
+def _ring_fwd_impl(q, k, v, km, axis_name, causal, groups):
+    """q: [B·H, T_loc, D]; k,v: [B·Hkv, T_loc, D] (GQA: H = Hkv·groups
+    — only the SMALL kv travels the ring, expanded per flash call);
+    km: [B·Hkv, T_loc]. Returns (out [B·H, T_loc, D] in q.dtype,
+    lse [B·H, T_loc, 1] f32)."""
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     t = q.shape[1]
@@ -77,8 +96,10 @@ def _ring_fwd_impl(q, k, v, km, axis_name, causal):
         out, lse, k_cur, v_cur, km_cur = carry
         src = jnp.mod(my - i, n)
         offs = jnp.stack([my * t, src * t]).astype(jnp.int32)
-        o_b, lse_b = flash_block_fwd(q, k_cur, v_cur, km_cur, offs,
-                                     causal)
+        o_b, lse_b = flash_block_fwd(
+            q, _expand_groups(k_cur, groups),
+            _expand_groups(v_cur, groups),
+            _expand_groups(km_cur, groups), offs, causal)
         out, lse = _merge_blocks(out, lse, o_b, lse_b)
         perm = _ring_perm(n)
         return (out, lse,
@@ -91,7 +112,8 @@ def _ring_fwd_impl(q, k, v, km, axis_name, causal):
     return out.astype(q.dtype), lse
 
 
-def _ring_bwd_impl(q, k, v, km, out, lse, g, axis_name, causal):
+def _ring_bwd_impl(q, k, v, km, out, lse, g, axis_name, causal,
+                   groups):
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     t = q.shape[1]
@@ -102,11 +124,15 @@ def _ring_bwd_impl(q, k, v, km, out, lse, g, axis_name, causal):
         dq, dk_acc, dv_acc, k_cur, v_cur, km_cur = carry
         src = jnp.mod(my - i, n)
         offs = jnp.stack([my * t, src * t]).astype(jnp.int32)
-        dq_b, dk_b, dv_b = flash_block_bwd(q, k_cur, v_cur, out, lse, g,
-                                           km_cur, offs, causal)
+        dq_b, dk_b, dv_b = flash_block_bwd(
+            q, _expand_groups(k_cur, groups),
+            _expand_groups(v_cur, groups), out, lse, g,
+            _expand_groups(km_cur, groups), offs, causal)
         dq = dq + dq_b.astype(jnp.float32)
-        dk_acc = dk_acc + dk_b.astype(jnp.float32)
-        dv_acc = dv_acc + dv_b.astype(jnp.float32)
+        dk_acc = dk_acc + _reduce_groups(dk_b.astype(jnp.float32),
+                                         groups)
+        dv_acc = dv_acc + _reduce_groups(dv_b.astype(jnp.float32),
+                                         groups)
         # dk/dv accumulators travel with their kv block; after n
         # rotations each block (and its now-complete gradient) is home
         perm = _ring_perm(n)
@@ -119,21 +145,21 @@ def _ring_bwd_impl(q, k, v, km, out, lse, g, axis_name, causal):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _ring_attn(q, k, v, km, axis_name, causal):
-    out, _ = _ring_fwd_impl(q, k, v, km, axis_name, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _ring_attn(q, k, v, km, axis_name, causal, groups=1):
+    out, _ = _ring_fwd_impl(q, k, v, km, axis_name, causal, groups)
     return out
 
 
-def _ring_attn_fwd(q, k, v, km, axis_name, causal):
-    out, lse = _ring_fwd_impl(q, k, v, km, axis_name, causal)
+def _ring_attn_fwd(q, k, v, km, axis_name, causal, groups):
+    out, lse = _ring_fwd_impl(q, k, v, km, axis_name, causal, groups)
     return out, (q, k, v, km, out, lse)
 
 
-def _ring_attn_bwd(axis_name, causal, res, g):
+def _ring_attn_bwd(axis_name, causal, groups, res, g):
     q, k, v, km, out, lse = res
     dq, dk, dv = _ring_bwd_impl(q, k, v, km, out, lse, g, axis_name,
-                                causal)
+                                causal, groups)
     return dq, dk, dv, jnp.zeros_like(km)
 
 
@@ -149,15 +175,24 @@ def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
     ``mask``: [B, T] key mask, sharded the same way. ``causal``: mask
     above the global diagonal (works across ring steps via per-block
     position offsets — the long-context causal-LM training path).
+    Grouped-query attention: ``k``/``v`` may carry FEWER heads than
+    ``q`` (H divisible by Hkv) — only the small kv rotates over ICI,
+    expanded to the query heads at each flash call.
     """
     def local(q, k, v, kmask):
         b, t, h, d = q.shape
-        fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        h_kv = k.shape[2]
+        if h % h_kv:
+            raise ValueError(f"q heads ({h}) not divisible by kv "
+                             f"heads ({h_kv})")
+        fold = lambda x: x.transpose(0, 2, 1, 3).reshape(
+            b * x.shape[2], t, d)
         km = (lax.pcast(jnp.ones((b, t), jnp.float32), (axis_name,),
                         to="varying")
               if kmask is None else kmask.astype(jnp.float32))
-        km = jnp.repeat(km, h, axis=0)
-        o = _ring_attn(fold(q), fold(k), fold(v), km, axis_name, causal)
+        km = jnp.repeat(km, h_kv, axis=0)
+        o = _ring_attn(fold(q), fold(k), fold(v), km, axis_name,
+                       causal, h // h_kv)
         return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
     spec = P(None, axis_name, None, None)
@@ -228,8 +263,9 @@ def _zz_merge_half(out, lse, o_b, lse_b, qi, c):
     return out.at[:, sl].set(o_new), lse.at[:, sl].set(l_new)
 
 
-def _zz_fwd_impl(q, k, v, axis_name):
-    """q,k,v: [BH, 2c, D] in zigzag layout. Causal only."""
+def _zz_fwd_impl(q, k, v, axis_name, groups):
+    """q: [B·H, 2c, D]; k,v: [B·Hkv, 2c, D] in zigzag layout (GQA:
+    only the small kv rotates). Causal only."""
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     c = q.shape[1] // 2
@@ -248,8 +284,11 @@ def _zz_fwd_impl(q, k, v, axis_name):
                 offs = jnp.stack([q_ids[qi] * c,
                                   k_ids[ki] * c]).astype(jnp.int32)
                 o_b, lse_b = flash_block_fwd(
-                    qh[qi], k_cur[:, ki * c:(ki + 1) * c],
-                    v_cur[:, ki * c:(ki + 1) * c], None, offs, True)
+                    qh[qi],
+                    _expand_groups(k_cur[:, ki * c:(ki + 1) * c],
+                                   groups),
+                    _expand_groups(v_cur[:, ki * c:(ki + 1) * c],
+                                   groups), None, offs, True)
                 out, lse = _zz_merge_half(out, lse, o_b, lse_b, qi, c)
         perm = _ring_perm(n)
         return (out, lse, lax.ppermute(k_cur, axis_name, perm),
@@ -259,7 +298,7 @@ def _zz_fwd_impl(q, k, v, axis_name):
     return out.astype(q.dtype), lse
 
 
-def _zz_bwd_impl(q, k, v, out, lse, g, axis_name):
+def _zz_bwd_impl(q, k, v, out, lse, g, axis_name, groups):
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     c = q.shape[1] // 2
@@ -281,12 +320,15 @@ def _zz_bwd_impl(q, k, v, out, lse, g, axis_name):
                 offs = jnp.stack([q_ids[qi] * c,
                                   k_ids[ki] * c]).astype(jnp.int32)
                 dq_b, dk_b, dv_b = flash_block_bwd(
-                    qh[qi], k_cur[:, ks], v_cur[:, ks], outh[qi],
+                    qh[qi], _expand_groups(k_cur[:, ks], groups),
+                    _expand_groups(v_cur[:, ks], groups), outh[qi],
                     lseh[qi], gh[qi], None, offs, True)
                 qs = slice(qi * c, (qi + 1) * c)
                 dq = dq.at[:, qs].add(dq_b.astype(jnp.float32))
-                dk_acc = dk_acc.at[:, ks].add(dk_b.astype(jnp.float32))
-                dv_acc = dv_acc.at[:, ks].add(dv_b.astype(jnp.float32))
+                dk_acc = dk_acc.at[:, ks].add(
+                    _reduce_groups(dk_b.astype(jnp.float32), groups))
+                dv_acc = dv_acc.at[:, ks].add(
+                    _reduce_groups(dv_b.astype(jnp.float32), groups))
         perm = _ring_perm(n)
         pp = lambda x: lax.ppermute(x, axis_name, perm)
         return dq, pp(dk_acc), pp(dv_acc), pp(k_cur), pp(v_cur)
@@ -296,19 +338,19 @@ def _zz_bwd_impl(q, k, v, out, lse, g, axis_name):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _zz_ring_attn(q, k, v, axis_name):
-    out, _ = _zz_fwd_impl(q, k, v, axis_name)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _zz_ring_attn(q, k, v, axis_name, groups=1):
+    out, _ = _zz_fwd_impl(q, k, v, axis_name, groups)
     return out
 
 
-def _zz_ring_attn_fwd(q, k, v, axis_name):
-    out, lse = _zz_fwd_impl(q, k, v, axis_name)
+def _zz_ring_attn_fwd(q, k, v, axis_name, groups):
+    out, lse = _zz_fwd_impl(q, k, v, axis_name, groups)
     return out, (q, k, v, out, lse)
 
 
-def _zz_ring_attn_bwd(axis_name, res, g):
-    return _zz_bwd_impl(*res, g, axis_name)
+def _zz_ring_attn_bwd(axis_name, groups, res, g):
+    return _zz_bwd_impl(*res, g, axis_name, groups)
 
 
 _zz_ring_attn.defvjp(_zz_ring_attn_fwd, _zz_ring_attn_bwd)
@@ -324,12 +366,18 @@ def zigzag_ring_self_attention(q, k, v, mesh: Mesh,
     ring, so the causal triangle no longer serialises on the
     last-ranked device (plain ``ring_self_attention`` with
     ``causal=True`` is correct but its critical path is the device
-    holding the final blocks).
+    holding the final blocks). GQA: k/v may carry fewer heads than q.
     """
     def local(q, k, v):
         b, t, h, d = q.shape
-        fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-        o = _zz_ring_attn(fold(q), fold(k), fold(v), axis_name)
+        h_kv = k.shape[2]
+        if h % h_kv:
+            raise ValueError(f"q heads ({h}) not divisible by kv "
+                             f"heads ({h_kv})")
+        fold = lambda x: x.transpose(0, 2, 1, 3).reshape(
+            b * x.shape[2], t, d)
+        o = _zz_ring_attn(fold(q), fold(k), fold(v), axis_name,
+                          h // h_kv)
         return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
     spec = P(None, axis_name, None, None)

@@ -25,6 +25,8 @@ from deeplearning4j_tpu.zoo.nasnet import NASNet
 from deeplearning4j_tpu.zoo.simple_cnn import SimpleCNN
 from deeplearning4j_tpu.zoo.textgen_lstm import TextGenerationLSTM
 from deeplearning4j_tpu.zoo.bert import Bert, BertBase, BertTiny
+from deeplearning4j_tpu.zoo.gpt import (CausalTransformerLM, GPTMini,
+                                        GPTNano)
 from deeplearning4j_tpu.zoo.facenet import FaceNetNN4Small2
 from deeplearning4j_tpu.zoo.pretrained import (DL4JResources, ZooModel,
                                                export_pretrained,
@@ -35,5 +37,6 @@ __all__ = ["LeNet", "AlexNet", "VGG16", "VGG19", "ResNet50",
            "Xception", "InceptionResNetV1", "NASNet", "SimpleCNN",
            "TextGenerationLSTM", "TINY_YOLO_ANCHORS", "YOLO2_ANCHORS",
            "Bert", "BertBase", "BertTiny", "FaceNetNN4Small2",
+           "CausalTransformerLM", "GPTNano", "GPTMini",
            "ZooModel", "DL4JResources", "export_pretrained",
            "fetch_pretrained"]

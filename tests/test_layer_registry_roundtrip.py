@@ -64,6 +64,8 @@ SPECS = {
     "DepthToSpaceLayer": (dict(block_size=2), (2, 2, 8)),
     "LSTM": (dict(n_out=4), (5, 3)),
     "ConvLSTM2D": (dict(n_out=3, kernel_size=(2, 2)), (4, 6, 6, 2)),
+    "RMSNorm": ({}, (6,)),
+    "TransformerDecoderBlock": (dict(n_heads=2, n_kv_heads=1), (5, 8)),
     "GravesLSTM": (dict(n_out=4), (5, 3)),
     "GravesBidirectionalLSTM": (dict(n_out=4), (5, 3)),
     "GRU": (dict(n_out=4), (5, 3)),

@@ -69,13 +69,15 @@ def repeat_kv_heads(k, n_heads: int):
 
 
 def scaled_dot_attention(q, k, v, mask=None, causal=False):
-    """q,k,v: [B, T, H, D] (head axis 2). mask: [B, Tk] key mask.
+    """q,k,v: [B, T, H, D] (head axis 2); ``k``/``v`` may carry fewer
+    heads (GQA). mask: [B, Tk] key mask.
 
     Explicit einsum+softmax (not jax.nn.dot_product_attention, which is
     not exact in float64 — breaks gradient checking). Platform-helper
     dispatch (the reference's cuDNN-helper pattern, SURVEY §2.3): on
     TPU with long sequences the Pallas flash kernel is used instead —
-    O(T) memory, 1.2-1.7x faster than the einsum at T>=4k.
+    O(T) memory, 1.2-1.7x faster than the einsum at T>=4k, and
+    GQA-native (one kv block read per head group).
     """
     d = q.shape[-1]
     if (q.shape[1] >= 1024 and q.shape[1] == k.shape[1]
@@ -86,6 +88,8 @@ def scaled_dot_attention(q, k, v, mask=None, causal=False):
         # stays O(T) memory instead of falling back to the [T,T] einsum
         from deeplearning4j_tpu.ops.pallas_kernels import flash_attention
         return flash_attention(q, k, v, causal=causal, mask=mask)
+    k = repeat_kv_heads(k, q.shape[2])
+    v = repeat_kv_heads(v, q.shape[2])
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
         jnp.asarray(d, q.dtype))
     neg = jnp.asarray(-1e30 if q.dtype == jnp.float64 else -1e9, q.dtype)
@@ -127,9 +131,10 @@ class MultiHeadAttention(Layer):
     _SP_MODES = (None, "ring", "ulysses", "zigzag_ring")
 
     def _attend(self, q, k, v, mask):
-        """``k``/``v`` may carry fewer heads than ``q`` (GQA): the ring
-        paths keep the SMALL kv on the wire and expand per flash call;
-        local and Ulysses paths broadcast here."""
+        """``k``/``v`` may carry fewer heads than ``q`` (GQA): the
+        ring paths keep the SMALL kv on the wire and the flash kernels
+        read one kv block per head group; only Ulysses (head-axis
+        all-to-all) needs the broadcast."""
         if self.sequence_parallel not in self._SP_MODES:
             # reject typos even single-chip, where no context is active
             raise ValueError(
@@ -171,9 +176,7 @@ class MultiHeadAttention(Layer):
                         zigzag_permute(v, n), ctx.mesh,
                         axis_name=ctx.axis_name)
                     return zigzag_unpermute(o, n)
-        return scaled_dot_attention(q, repeat_kv_heads(k, n_heads),
-                                    repeat_kv_heads(v, n_heads), mask,
-                                    self.causal)
+        return scaled_dot_attention(q, k, v, mask, self.causal)
 
     def init(self, key, input_shape, dtype=jnp.float32):
         n_in = self.n_in or input_shape[-1]
@@ -336,6 +339,12 @@ class TransformerDecoderBlock(Layer):
     around both. The reference has no decoder-only transformer (its
     LM story is char-RNN + imported BERT); this is the native causal-LM
     building block, sequence-parallel-ready via ``sequence_parallel``.
+
+    ``remat=True`` wraps the block in ``jax.checkpoint``: activations
+    inside the block are recomputed during backward instead of stored —
+    the standard FLOPs-for-HBM trade that makes deep long-context
+    stacks fit (peak activation memory drops from O(layers·T·F) to
+    O(T·F) + per-block recompute).
     """
     n_in: Optional[int] = None
     n_heads: int = 8
@@ -343,6 +352,7 @@ class TransformerDecoderBlock(Layer):
     ffn_mult: int = 4
     rope_theta: float = 10000.0
     sequence_parallel: Optional[str] = None
+    remat: bool = False
 
     def _subs(self):
         if not hasattr(self, "_mha"):
@@ -372,9 +382,7 @@ class TransformerDecoderBlock(Layer):
                   "Wd": wi(ks[5], (hid, f), dtype)}
         return params, {}, tuple(input_shape)
 
-    def apply(self, params, state, x, *, train=False, rng=None,
-              mask=None):
-        self._subs()
+    def _body(self, params, x, mask, train, rng):
         r1, r2 = (jax.random.split(rng) if rng is not None
                   else (None, None))
         h, _ = self._ln1.apply(params["ln1"], {}, x)
@@ -383,8 +391,16 @@ class TransformerDecoderBlock(Layer):
         x = x + a
         h, _ = self._ln2.apply(params["ln2"], {}, x)
         h = jax.nn.silu(h @ params["Wg"]) * (h @ params["Wu"])
-        x = x + self._maybe_dropout(h @ params["Wd"], train, r2)
-        return x, state
+        return x + self._maybe_dropout(h @ params["Wd"], train, r2)
+
+    def apply(self, params, state, x, *, train=False, rng=None,
+              mask=None):
+        self._subs()
+        if self.remat:
+            fn = jax.checkpoint(
+                lambda p, x: self._body(p, x, mask, train, rng))
+            return fn(params, x), state
+        return self._body(params, x, mask, train, rng), state
 
 
 @register_layer

@@ -182,9 +182,30 @@ def _zero_offs():
     return jnp.zeros((2,), jnp.int32)
 
 
+def _expand_kv_rows(x, groups):
+    """[B·Hkv, ...] → [B·H, ...] for the jnp fallback paths (rows are
+    (batch, head)-major; query head h reads kv head h // groups)."""
+    return x if (x is None or groups == 1) else \
+        jnp.repeat(x, groups, axis=0)
+
+
+def _reduce_kv_rows(dx, groups):
+    """Transpose of :func:`_expand_kv_rows`: sum the per-query-head
+    kv gradients onto their shared kv head."""
+    if groups == 1:
+        return dx
+    bh = dx.shape[0]
+    return jnp.sum(dx.reshape(bh // groups, groups, *dx.shape[1:]),
+                   axis=1)
+
+
 def _flash_fwd(q, k, v, km, offs, causal: bool, block_q: int,
-               block_k: int, return_lse: bool = False):
-    """q,k,v: [BH, T, D] (heads folded); km: [BH, Tk] key mask;
+               block_k: int, return_lse: bool = False,
+               groups: int = 1):
+    """q: [B·H, T, D] (heads folded); k,v: [B·H/groups, Tk, D] —
+    grouped-query attention reads ONE kv block per head group straight
+    from HBM via the BlockSpec index map (``b // groups``), never
+    materialising the broadcast; km: [B·H/groups, Tk] key mask;
     offs: int32 [2] global (q, k) position offsets. Returns [BH, T, D]
     (and, for the vjp / ring composition, the per-row [BH, Tq, 1]
     logsumexp)."""
@@ -193,9 +214,14 @@ def _flash_fwd(q, k, v, km, offs, causal: bool, block_q: int,
     if offs is None:
         offs = _zero_offs()
     if _jnp_fallback(q, k, v):
-        return _reference_scan(q, k, v, km, offs, causal,
-                               return_lse=return_lse)
+        return _reference_scan(q, _expand_kv_rows(k, groups),
+                               _expand_kv_rows(v, groups),
+                               _expand_kv_rows(km, groups), offs,
+                               causal, return_lse=return_lse)
     bh, t, d = q.shape
+    if k.shape[0] * groups != bh:
+        raise ValueError(f"kv rows ({k.shape[0]}) × groups ({groups}) "
+                         f"!= q rows ({bh})")
     tk_real = k.shape[1]
     scale = 1.0 / (d ** 0.5)
     block_q, block_k, tq, tk, dp = _flash_blocks(t, tk_real, d,
@@ -214,6 +240,7 @@ def _flash_fwd(q, k, v, km, offs, causal: bool, block_q: int,
         vma)
     offs = _align_vma(offs.astype(jnp.int32), vma)
     nq, nk = tq // block_q, tk // block_k
+    g = groups
     oshape = jax.ShapeDtypeStruct((bh, tq, dp), q.dtype, vma=vma)
     ospec = pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, i, 0))
     lshape = jax.ShapeDtypeStruct((bh, tq, 128), jnp.float32, vma=vma)
@@ -226,9 +253,11 @@ def _flash_fwd(q, k, v, km, offs, causal: bool, block_q: int,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, dp), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, dp), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k), lambda b, i, j: (b, j)),
+            pl.BlockSpec((1, block_k, dp),
+                         lambda b, i, j: (b // g, j, 0)),
+            pl.BlockSpec((1, block_k, dp),
+                         lambda b, i, j: (b // g, j, 0)),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (b // g, j)),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=(ospec, lspec) if return_lse else ospec,
@@ -403,17 +432,27 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, g, km, offs, causal, block_q,
-               block_k):
+               block_k, groups: int = 1):
+    """Backward kernels. GQA (``groups`` > 1): kv operands stay at
+    [B·Hkv] rows and are shared across each head group via the index
+    map; dk/dv are produced per QUERY head (the accumulation grid runs
+    per q head) and reduced onto the kv heads afterwards."""
     if _jnp_fallback(q, k, v, g):
         # shard_map manual axes on CPU: interpret-mode pallas can't run
         # there — exact jnp backward from the global lse instead
-        return _reference_bwd_block(q, k, v, out, lse, g, km, offs,
-                                    causal)
+        dq, dk, dv = _reference_bwd_block(
+            q, _expand_kv_rows(k, groups), _expand_kv_rows(v, groups),
+            out, lse, g, _expand_kv_rows(km, groups), offs, causal)
+        return (dq, _reduce_kv_rows(dk, groups),
+                _reduce_kv_rows(dv, groups))
     if km is None:
         km = _ones_km(k)
     if offs is None:
         offs = _zero_offs()
     bh, t, d = q.shape
+    if k.shape[0] * groups != bh:
+        raise ValueError(f"kv rows ({k.shape[0]}) × groups ({groups}) "
+                         f"!= q rows ({bh})")
     tk_real = k.shape[1]
     scale = 1.0 / (d ** 0.5)
     block_q, block_k, tq, tk, dp = _flash_blocks(t, tk_real, d,
@@ -438,12 +477,14 @@ def _flash_bwd(q, k, v, out, lse, g, km, offs, causal, block_q,
         jnp.pad(lse, ((0, 0), (0, tq - t), (0, 0))), (bh, tq, 128)),
         vma)
     nq, nk = tq // block_q, tk // block_k
+    gg = groups
     kw = dict(scale=scale, causal=causal, tq_real=t, tk_real=tk_real,
               block_q=block_q, block_k=block_k)
     qspec = pl.BlockSpec((1, block_q, dp), lambda b, x, y: (b, x, 0))
     lspec = pl.BlockSpec((1, block_q, 128), lambda b, x, y: (b, x, 0))
-    kspec = pl.BlockSpec((1, block_k, dp), lambda b, x, y: (b, y, 0))
-    kmspec = pl.BlockSpec((1, block_k), lambda b, x, y: (b, y))
+    kspec = pl.BlockSpec((1, block_k, dp),
+                         lambda b, x, y: (b // gg, y, 0))
+    kmspec = pl.BlockSpec((1, block_k), lambda b, x, y: (b // gg, y))
     sspec = pl.BlockSpec(memory_space=pltpu.SMEM)
     # grid (bh, i, j): q-side blocks follow grid axis 1, kv axis 2
     dq = pl.pallas_call(
@@ -456,11 +497,14 @@ def _flash_bwd(q, k, v, out, lse, g, km, offs, causal, block_q,
         scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
         interpret=_interpret(),
     )(qp, kp, vp, dop, op, lsep, kmp, offs)
-    # grid (bh, j, i): kv-side blocks follow grid axis 1, q axis 2
+    # grid (bh, j, i): kv-side blocks follow grid axis 1, q axis 2;
+    # dk/dv land per QUERY head and are group-reduced below
     qspec2 = pl.BlockSpec((1, block_q, dp), lambda b, y, x: (b, x, 0))
     lspec2 = pl.BlockSpec((1, block_q, 128), lambda b, y, x: (b, x, 0))
-    kspec2 = pl.BlockSpec((1, block_k, dp), lambda b, y, x: (b, y, 0))
-    kmspec2 = pl.BlockSpec((1, block_k), lambda b, y, x: (b, y))
+    kspec2 = pl.BlockSpec((1, block_k, dp),
+                          lambda b, y, x: (b // gg, y, 0))
+    kmspec2 = pl.BlockSpec((1, block_k), lambda b, y, x: (b // gg, y))
+    ospec2 = pl.BlockSpec((1, block_k, dp), lambda b, y, x: (b, y, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, **kw),
         out_shape=(jax.ShapeDtypeStruct((bh, tk, dp), k.dtype, vma=vma),
@@ -469,12 +513,14 @@ def _flash_bwd(q, k, v, out, lse, g, km, offs, causal, block_q,
         grid=(bh, nk, nq),
         in_specs=[qspec2, kspec2, kspec2, qspec2, qspec2, lspec2,
                   kmspec2, sspec],
-        out_specs=(kspec2, kspec2),
+        out_specs=(ospec2, ospec2),
         scratch_shapes=[pltpu.VMEM((block_k, dp), jnp.float32),
                         pltpu.VMEM((block_k, dp), jnp.float32)],
         interpret=_interpret(),
     )(qp, kp, vp, dop, op, lsep, kmp, offs)
-    return (dq[:, :t, :d], dk[:, :tk_real, :d], dv[:, :tk_real, :d])
+    return (dq[:, :t, :d],
+            _reduce_kv_rows(dk[:, :tk_real, :d], groups),
+            _reduce_kv_rows(dv[:, :tk_real, :d], groups))
 
 
 def _reference_bwd_block(q, k, v, out, lse, g, km, offs, causal):
@@ -506,47 +552,51 @@ def _reference_bwd_block(q, k, v, out, lse, g, km, offs, causal):
 
 # --- ring composition surface ------------------------------------------------
 def flash_block_fwd(q, k, v, km=None, offs=None, causal: bool = False,
-                    block_q: int = 256, block_k: int = 1024):
+                    block_q: int = 256, block_k: int = 1024,
+                    groups: int = 1):
     """One (local-Q × one-KV-block) flash forward returning
     ``(out, lse)`` — out is the softmax-normalised attention of q
     against ONLY this kv block, lse its per-row logsumexp. Two such
     partial results merge exactly via log-sum-exp combination
     (``ring_attention._merge_blocks``); the ring carries (out, lse)
-    between Pallas calls. q,k,v: [BH, T, D]; km: [BH, Tk];
-    offs: int32 [2] dynamic global (q, k) offsets for causal."""
+    between Pallas calls. q: [B·H, T, D]; k,v: [B·H/groups, Tk, D]
+    (GQA: the kernel shares one kv block per head group — no
+    materialised broadcast); km: [B·H/groups, Tk]; offs: int32 [2]
+    dynamic global (q, k) offsets for causal."""
     return _flash_fwd(q, k, v, km, offs, causal, block_q, block_k,
-                      return_lse=True)
+                      return_lse=True, groups=groups)
 
 
 def flash_block_bwd(q, k, v, out, lse, g, km=None, offs=None,
                     causal: bool = False, block_q: int = 256,
-                    block_k: int = 1024):
+                    block_k: int = 1024, groups: int = 1):
     """Backward of one (q-block, kv-block) pair given the GLOBAL
     (all-blocks) out/lse — FlashAttention-2 style recompute. Returns
     (dq_contrib, dk, dv): dq_contrib sums over kv blocks; dk/dv are
-    this block's totals once every q block has contributed.
-    (_flash_bwd itself falls back to the jnp backward under
-    shard_map-on-CPU.)"""
+    this block's totals (at the KV head count when ``groups`` > 1)
+    once every q block has contributed. (_flash_bwd itself falls back
+    to the jnp backward under shard_map-on-CPU.)"""
     return _flash_bwd(q, k, v, out, lse, g, km, offs, causal,
-                      block_q, block_k)
+                      block_q, block_k, groups=groups)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q, k, v, km, causal, block_q, block_k):
-    return _flash_fwd(q, k, v, km, None, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, km, causal, block_q, block_k, groups=1):
+    return _flash_fwd(q, k, v, km, None, causal, block_q, block_k,
+                      groups=groups)
 
 
-def _flash_vjp_fwd(q, k, v, km, causal, block_q, block_k):
+def _flash_vjp_fwd(q, k, v, km, causal, block_q, block_k, groups):
     out, lse = _flash_fwd(q, k, v, km, None, causal, block_q, block_k,
-                          return_lse=True)
+                          return_lse=True, groups=groups)
     return out, (q, k, v, km, out, lse)
 
 
-def _flash_vjp_bwd(causal, block_q, block_k, res, g):
+def _flash_vjp_bwd(causal, block_q, block_k, groups, res, g):
     q, k, v, km, out, lse = res
     dkm = None if km is None else jnp.zeros_like(km)
     return _flash_bwd(q, k, v, out, lse, g, km, None, causal,
-                      block_q, block_k) + (dkm,)
+                      block_q, block_k, groups=groups) + (dkm,)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -557,17 +607,26 @@ def flash_attention(q, k, v, causal: bool = False,
                     block_q: int = 256, block_k: int = 1024):
     """Blockwise attention, [B, T, H, D] layout (head axis 2) like
     ``scaled_dot_attention``; ``mask``: optional [B, Tk] key mask.
+    ``k``/``v`` may carry FEWER heads than ``q`` (grouped-query
+    attention, H divisible by Hkv) — the kernels read the shared kv
+    block per head group directly, no broadcast in HBM.
     Differentiable: the backward is a pair of Pallas kernels (dQ;
     dK/dV) that recompute the probability tile per block from the
     saved logsumexp — FlashAttention-2 style, no [T,T] materialisation
     in either direction."""
     b, t, h, d = q.shape
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, -1)
+    h_kv = k.shape[2]
+    if h % h_kv:
+        raise ValueError(f"q heads ({h}) not divisible by kv heads "
+                         f"({h_kv})")
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(
+        b * x.shape[2], x.shape[1], -1)
     km = None
     if mask is not None:
-        # per-example key mask → per-(batch·head) rows
-        km = jnp.repeat(mask.astype(jnp.float32), h, axis=0)
-    o = _flash(fold(q), fold(k), fold(v), km, causal, block_q, block_k)
+        # per-example key mask → per-(batch·kv-head) rows
+        km = jnp.repeat(mask.astype(jnp.float32), h_kv, axis=0)
+    o = _flash(fold(q), fold(k), fold(v), km, causal, block_q, block_k,
+               h // h_kv)
     return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 

@@ -63,26 +63,10 @@ def _ring_perm(n):
     return [(j, (j + 1) % n) for j in range(n)]
 
 
-def _expand_groups(x, groups):
-    """[B·Hkv, ...] → [B·H, ...]: broadcast each kv-head row to its
-    ``groups`` query heads (rows are (batch, head)-major, and query
-    head h uses kv head h // groups, so adjacent repetition aligns)."""
-    return x if groups == 1 else jnp.repeat(x, groups, axis=0)
-
-
-def _reduce_groups(dx, groups):
-    """Transpose of :func:`_expand_groups`: sum query-head gradients
-    back onto their shared kv head."""
-    if groups == 1:
-        return dx
-    bh = dx.shape[0]
-    return jnp.sum(dx.reshape(bh // groups, groups, *dx.shape[1:]),
-                   axis=1)
-
-
 def _ring_fwd_impl(q, k, v, km, axis_name, causal, groups):
     """q: [B·H, T_loc, D]; k,v: [B·Hkv, T_loc, D] (GQA: H = Hkv·groups
-    — only the SMALL kv travels the ring, expanded per flash call);
+    — only the SMALL kv travels the ring; the flash kernel shares one
+    kv block per head group via its index map, no broadcast);
     km: [B·Hkv, T_loc]. Returns (out [B·H, T_loc, D] in q.dtype,
     lse [B·H, T_loc, 1] f32)."""
     n = lax.psum(1, axis_name)
@@ -96,10 +80,8 @@ def _ring_fwd_impl(q, k, v, km, axis_name, causal, groups):
         out, lse, k_cur, v_cur, km_cur = carry
         src = jnp.mod(my - i, n)
         offs = jnp.stack([my * t, src * t]).astype(jnp.int32)
-        o_b, lse_b = flash_block_fwd(
-            q, _expand_groups(k_cur, groups),
-            _expand_groups(v_cur, groups),
-            _expand_groups(km_cur, groups), offs, causal)
+        o_b, lse_b = flash_block_fwd(q, k_cur, v_cur, km_cur, offs,
+                                     causal, groups=groups)
         out, lse = _merge_blocks(out, lse, o_b, lse_b)
         perm = _ring_perm(n)
         return (out, lse,
@@ -124,15 +106,13 @@ def _ring_bwd_impl(q, k, v, km, out, lse, g, axis_name, causal,
         dq, dk_acc, dv_acc, k_cur, v_cur, km_cur = carry
         src = jnp.mod(my - i, n)
         offs = jnp.stack([my * t, src * t]).astype(jnp.int32)
+        # dk_b/dv_b come back already reduced to the kv head count
         dq_b, dk_b, dv_b = flash_block_bwd(
-            q, _expand_groups(k_cur, groups),
-            _expand_groups(v_cur, groups), out, lse, g,
-            _expand_groups(km_cur, groups), offs, causal)
+            q, k_cur, v_cur, out, lse, g, km_cur, offs, causal,
+            groups=groups)
         dq = dq + dq_b.astype(jnp.float32)
-        dk_acc = dk_acc + _reduce_groups(dk_b.astype(jnp.float32),
-                                         groups)
-        dv_acc = dv_acc + _reduce_groups(dv_b.astype(jnp.float32),
-                                         groups)
+        dk_acc = dk_acc + dk_b.astype(jnp.float32)
+        dv_acc = dv_acc + dv_b.astype(jnp.float32)
         # dk/dv accumulators travel with their kv block; after n
         # rotations each block (and its now-complete gradient) is home
         perm = _ring_perm(n)
@@ -284,11 +264,9 @@ def _zz_fwd_impl(q, k, v, axis_name, groups):
                 offs = jnp.stack([q_ids[qi] * c,
                                   k_ids[ki] * c]).astype(jnp.int32)
                 o_b, lse_b = flash_block_fwd(
-                    qh[qi],
-                    _expand_groups(k_cur[:, ki * c:(ki + 1) * c],
-                                   groups),
-                    _expand_groups(v_cur[:, ki * c:(ki + 1) * c],
-                                   groups), None, offs, True)
+                    qh[qi], k_cur[:, ki * c:(ki + 1) * c],
+                    v_cur[:, ki * c:(ki + 1) * c], None, offs, True,
+                    groups=groups)
                 out, lse = _zz_merge_half(out, lse, o_b, lse_b, qi, c)
         perm = _ring_perm(n)
         return (out, lse, lax.ppermute(k_cur, axis_name, perm),
@@ -320,15 +298,12 @@ def _zz_bwd_impl(q, k, v, out, lse, g, axis_name, groups):
                 offs = jnp.stack([q_ids[qi] * c,
                                   k_ids[ki] * c]).astype(jnp.int32)
                 dq_b, dk_b, dv_b = flash_block_bwd(
-                    qh[qi], _expand_groups(k_cur[:, ks], groups),
-                    _expand_groups(v_cur[:, ks], groups), outh[qi],
-                    lseh[qi], gh[qi], None, offs, True)
+                    qh[qi], k_cur[:, ks], v_cur[:, ks], outh[qi],
+                    lseh[qi], gh[qi], None, offs, True, groups=groups)
                 qs = slice(qi * c, (qi + 1) * c)
                 dq = dq.at[:, qs].add(dq_b.astype(jnp.float32))
-                dk_acc = dk_acc.at[:, ks].add(
-                    _reduce_groups(dk_b.astype(jnp.float32), groups))
-                dv_acc = dv_acc.at[:, ks].add(
-                    _reduce_groups(dv_b.astype(jnp.float32), groups))
+                dk_acc = dk_acc.at[:, ks].add(dk_b.astype(jnp.float32))
+                dv_acc = dv_acc.at[:, ks].add(dv_b.astype(jnp.float32))
         perm = _ring_perm(n)
         pp = lambda x: lax.ppermute(x, axis_name, perm)
         return dq, pp(dk_acc), pp(dv_acc), pp(k_cur), pp(v_cur)

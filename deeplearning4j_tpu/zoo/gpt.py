@@ -26,8 +26,7 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn.layers import (EmbeddingSequenceLayer,
                                           RMSNorm, RnnOutputLayer,
                                           TransformerDecoderBlock)
-from deeplearning4j_tpu.nn.layers.attention import (repeat_kv_heads,
-                                                    rotary_embedding)
+from deeplearning4j_tpu.nn.layers.attention import rotary_embedding
 from deeplearning4j_tpu.nn.layers.core import RMSNORM_EPS
 from deeplearning4j_tpu.nn import updaters as upd
 
@@ -44,8 +43,10 @@ class CausalTransformerLM(ZooModel):
                  ffn_mult: int = 4, rope_theta: float = 10000.0,
                  dropout: float = 0.0,
                  sequence_parallel: Optional[str] = None,
+                 remat: bool = False,
                  seed: int = 123, updater=None,
                  compute_dtype: Optional[str] = None):
+        self.remat = remat
         self.vocab_size = vocab_size
         self.hidden = hidden
         self.n_layers = n_layers
@@ -75,7 +76,7 @@ class CausalTransformerLM(ZooModel):
             b.layer(TransformerDecoderBlock(
                 n_heads=self.n_heads, n_kv_heads=self.n_kv_heads,
                 ffn_mult=self.ffn_mult, rope_theta=self.rope_theta,
-                dropout=self.dropout or None,
+                dropout=self.dropout or None, remat=self.remat,
                 sequence_parallel=self.sequence_parallel))
         b.layer(RMSNorm())
         # fused-from-logits sparse softmax CE over the vocabulary —
@@ -115,21 +116,22 @@ class CausalTransformerLM(ZooModel):
         pad = jnp.zeros((b, n_new), jnp.int32)
         token_seq = jnp.concatenate([prompt, pad], axis=1)
         # params are a jit ARGUMENT (not closure-captured), so further
-        # training never runs against a stale compiled decode; the
-        # compiled scan is cached per decode geometry
-        key_ = (b, t0, n_new, temperature > 0)
+        # training never runs against a stale compiled decode; t0 is a
+        # TRACED scalar (only `pos < t0` consumes it), so one compiled
+        # scan serves every prompt/new split of the same total length
+        key_ = (b, total, temperature > 0)
         cache = getattr(self, "_gen_cache", None)
         if cache is None:
             cache = self._gen_cache = {}
         if key_ not in cache:
             cache[key_] = jax.jit(functools.partial(
-                self._decode_scan, b=b, t0=t0, total=total,
+                self._decode_scan, b=b, total=total,
                 sample=temperature > 0))
         return np.asarray(cache[key_](
-            net.params, token_seq,
+            net.params, token_seq, jnp.asarray(t0, jnp.int32),
             jnp.asarray(temperature or 1.0, jnp.float32), rng))
 
-    def _decode_scan(self, params, tokens, temperature, rng, *, b, t0,
+    def _decode_scan(self, params, tokens, t0, temperature, rng, *, b,
                      total, sample):
         hd = self.hidden // self.n_heads
         n_kv = self.n_kv_heads
@@ -145,7 +147,13 @@ class CausalTransformerLM(ZooModel):
 
         def block_step(pblk, x, ck, cv, pos):
             """One token through one decoder block with cache update.
-            x: [B, F]; ck/cv: [B, total, n_kv, hd]."""
+            x: [B, F]; ck/cv: [B, total, n_kv, hd].
+
+            Deliberately re-derives the block math from the params
+            (the transformer analog of the reference's rnnTimeStep):
+            any drift from TransformerDecoderBlock's training forward
+            is caught by test_generate_matches_training_forward; the
+            RMSNorm eps is shared via RMSNORM_EPS."""
             h = rms(x, pblk["ln1"]["gamma"])
             mha = pblk["mha"]
             q = (h @ mha["Wq"]).reshape(b, 1, self.n_heads, hd)
@@ -155,14 +163,17 @@ class CausalTransformerLM(ZooModel):
             k = rotary_embedding(k, self.rope_theta, offset=pos)[:, 0]
             ck = jax.lax.dynamic_update_index_in_dim(ck, k, pos, 1)
             cv = jax.lax.dynamic_update_index_in_dim(cv, v[:, 0], pos, 1)
-            kf = repeat_kv_heads(ck, self.n_heads)   # [B, total, H, hd]
-            vf = repeat_kv_heads(cv, self.n_heads)
-            s = jnp.einsum("bhd,bthd->bht", q, kf) / jnp.sqrt(
+            # grouped einsums attend straight against the SMALL cache
+            # (GQA's cache-bandwidth saving survives decode: no
+            # [B,total,H,hd] broadcast is ever materialised)
+            groups = self.n_heads // n_kv
+            qg = q.reshape(b, n_kv, groups, hd)
+            s = jnp.einsum("bkgd,btkd->bkgt", qg, ck) / jnp.sqrt(
                 jnp.asarray(hd, x.dtype))
-            live = jnp.arange(ck.shape[1])[None, None, :] <= pos
+            live = jnp.arange(ck.shape[1])[None, None, None, :] <= pos
             s = jnp.where(live, s, -1e9)
             w = jax.nn.softmax(s, axis=-1)
-            a = jnp.einsum("bht,bthd->bhd", w, vf).reshape(b, -1)
+            a = jnp.einsum("bkgt,btkd->bkgd", w, cv).reshape(b, -1)
             x = x + a @ mha["Wo"] + mha["bo"]
             h = rms(x, pblk["ln2"]["gamma"])
             h = jax.nn.silu(h @ pblk["Wg"]) * (h @ pblk["Wu"])

@@ -106,6 +106,25 @@ def test_generate_continues_pattern(toy_lm):
     np.testing.assert_array_equal(out[0, 10:], want)
 
 
+def test_remat_same_loss_and_gradients():
+    """remat=True must be numerically identical to remat=False (only
+    memory behavior differs): same loss, same post-step params."""
+    def build(remat):
+        m = GPTNano(vocab_size=16, max_len=32, seed=5, remat=remat)
+        return m.init(seq_len=12)
+
+    tokens = np.arange(13) % 5 + 1
+    x = np.tile(tokens[:12], (4, 1)).astype(np.int32)
+    y = np.tile(tokens[1:13], (4, 1)).astype(np.int32)
+    nets = [build(False), build(True)]
+    for net in nets:
+        net.fit(x, y)
+    assert nets[0].score() == pytest.approx(nets[1].score(), rel=1e-6)
+    a = jax.tree.leaves(nets[0].params)[0]
+    b = jax.tree.leaves(nets[1].params)[0]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
 def test_generate_n_new_zero_returns_prompt(toy_lm):
     """n_new=0 must hand the prompt back untouched (regression: the
     final-slot write used to clobber the last prompt token)."""

@@ -145,6 +145,36 @@ def test_flash_masked_matches_einsum(rng, causal):
         assert float(jnp.max(jnp.abs(a - b))) < 5e-5
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gqa_matches_repeat(rng, causal):
+    """Native GQA (kv BlockSpec index map b // groups) must equal
+    attention with kv heads explicitly broadcast — fwd AND bwd,
+    with a key mask."""
+    B, T, H, HKV, D = 2, 96, 4, 2, 16
+    q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, T, HKV, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, T, HKV, D)), jnp.float32)
+    mask = (jnp.arange(T)[None, :]
+            < jnp.asarray([[96], [70]])).astype(jnp.float32)
+    co = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+    rep = lambda x: jnp.repeat(x, H // HKV, axis=2)
+
+    gqa = lambda q, k, v: pk.flash_attention(
+        q, k, v, causal=causal, mask=mask, block_q=32, block_k=32)
+    full = lambda q, k, v: pk.flash_attention(
+        q, rep(k), rep(v), causal=causal, mask=mask,
+        block_q=32, block_k=32)
+    np.testing.assert_allclose(np.asarray(gqa(q, k, v)),
+                               np.asarray(full(q, k, v)),
+                               rtol=1e-5, atol=1e-6)
+    g1 = jax.grad(lambda q, k, v: jnp.sum(gqa(q, k, v) * co),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: jnp.sum(full(q, k, v) * co),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        assert float(jnp.max(jnp.abs(a - b))) < 5e-5
+
+
 def test_flash_block_offsets_compose(rng):
     """flash_block_fwd/_merge semantics (the ring-attention surface):
     two half-sequence KV blocks with dynamic global offsets, merged by

@@ -1,0 +1,43 @@
+"""Shared scaffolding for TRUE multi-process (jax.distributed) tests:
+launch N worker processes with a coordinator address, collect their
+output, and guarantee cleanup — a crashed or hung worker never leaks
+past the test (its peer would otherwise block in a collective forever
+and keep the coordinator port bound)."""
+import os
+import subprocess
+import sys
+
+
+def run_two_process_workers(script_path, port, extra_env=None,
+                            timeout=300):
+    """Launch 2 workers of ``script_path`` (each sees COORD/PROC_ID and
+    2 CPU devices), wait for both, and return their outputs. Kills
+    both processes on any failure path."""
+    procs = []
+    try:
+        for pid in range(2):
+            env = dict(os.environ,
+                       COORD=f"127.0.0.1:{port}", NPROC="2",
+                       PROC_ID=str(pid),
+                       XLA_FLAGS="--xla_force_host_platform_device_count=2",
+                       JAX_PLATFORMS="cpu", **(extra_env or {}))
+            procs.append(subprocess.Popen(
+                [sys.executable, str(script_path)], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+        return procs, outs
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate(timeout=30)
+
+
+def assert_all_done(procs, outs):
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
+        assert f"proc {pid} DONE" in out

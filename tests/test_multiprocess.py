@@ -6,8 +6,6 @@ process feeding its shard. Reference analog: GradientSharingTrainingTest
 (SURVEY §4); this exercises the real process boundary instead.
 """
 import os
-import subprocess
-import sys
 import textwrap
 
 import pytest
@@ -80,28 +78,13 @@ WORKER = textwrap.dedent("""
 @pytest.mark.skipif(os.environ.get("DL4J_TPU_SKIP_MP") == "1",
                     reason="multi-process test disabled")
 def test_two_process_distributed_training(tmp_path):
+    from mp_harness import assert_all_done, run_two_process_workers
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = tmp_path / "worker.py"
     script.write_text(WORKER % {"repo": repo})
-    port = 29500 + (os.getpid() % 500)
-    procs = []
-    for pid in range(2):
-        env = dict(os.environ,
-                   COORD=f"127.0.0.1:{port}", NPROC="2",
-                   PROC_ID=str(pid),
-                   XLA_FLAGS="--xla_force_host_platform_device_count=2",
-                   JAX_PLATFORMS="cpu")
-        procs.append(subprocess.Popen(
-            [sys.executable, str(script)], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True))
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=300)
-        outs.append(out)
-    for pid, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
-        assert f"proc {pid} DONE" in out
+    procs, outs = run_two_process_workers(
+        script, port=29500 + (os.getpid() % 500))
+    assert_all_done(procs, outs)
     # identical replicated params on both processes
     import re
     sums = [re.search(r"checksum (-?[\d.]+)", o).group(1) for o in outs]

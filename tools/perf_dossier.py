@@ -9,7 +9,7 @@ the timed runs in ``jax.profiler.trace`` and read the memory-bandwidth
 counters from the XProf capture (VERDICT r1 #5 asks for exactly that).
 
 Run on the real chip:  python tools/perf_dossier.py [--trace DIR] [config ...]
-Configs: resnet50 bert lstm flashbwd (default: all).
+Configs: resnet50 bert lstm flashbwd gpt (default: all).
 ``--smoke``: tiny CPU shapes to validate wiring — table rows are
 labeled ``(smoke)`` and carry no MFU claim.
 Writes a markdown table to stdout; paste into BASELINE.md.
@@ -121,6 +121,66 @@ def bert():
             flops)
 
 
+def gpt():
+    """Causal-LM train step + KV-cached decode (the native decoder-only
+    family; no BASELINE row — new-capability measurement)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.zoo import CausalTransformerLM, GPTNano
+
+    if SMOKE:
+        model = GPTNano(vocab_size=256, max_len=128)
+        b, t = 2, 32
+    else:
+        # GPT-2-small geometry (12L/768/12H) but with an UNTIED output
+        # head: ~190M params total (n_params below is the truth the
+        # 6·N FLOPs row uses), bf16, B=8 T=1024
+        model = CausalTransformerLM(vocab_size=50257, hidden=768,
+                                    n_layers=12, n_heads=12,
+                                    max_len=2048,
+                                    compute_dtype="bfloat16")
+        b, t = 8, 1024
+    net = model.init(seq_len=t)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.integers(0, 200, (b, t)), jnp.int32)
+    y = jnp.asarray(rng.integers(0, 200, (b, t)), jnp.int32)
+    step = net._make_train_step()
+    params, opt, state = net.params, net.opt_state, net.state
+    key = jax.random.PRNGKey(0)
+
+    def one():
+        nonlocal params, opt, state
+        params, opt, state, loss = step(params, opt, state, x, y,
+                                        None, None, key)
+        return loss
+
+    dt = _timeit(one, lambda l: l)
+    # the jitted step donates its inputs — net's original buffers are
+    # deleted; point the net at the live copies before decoding
+    net.params, net.opt_state, net.state = params, opt, state
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(net.params))
+    flops = 6 * n_params * b * t          # 6·N·tokens
+
+    # decode throughput: KV-cached scan, greedy. Every scan step costs
+    # the same (prefill positions included), so the denominator is the
+    # FULL total-1 step count; median-of-3 timed runs after compile.
+    prompt = np.asarray(rng.integers(0, 200, (b, 16)), np.int32)
+    n_new = 16 if SMOKE else 128
+    model.generate(net, prompt, n_new=n_new)          # compile
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        model.generate(net, prompt, n_new=n_new)      # blocks (host out)
+        times.append(time.perf_counter() - t0)
+    steps = prompt.shape[1] + n_new - 1
+    toks = b * steps / sorted(times)[1]
+    label = (f"causal-LM train b{b} t{t} "
+             f"[decode {toks:,.0f} tok-steps/s kv-cached]")
+    return (label, b * t / dt, "tok/s", dt, flops)
+
+
 def lstm():
     """GravesLSTM char-RNN config (BASELINE cfg #3)."""
     import jax
@@ -211,13 +271,14 @@ def main(names):
     if "--trace" in names:
         i = names.index("--trace")
         if i + 1 >= len(names) or names[i + 1] in ("resnet50", "bert",
-                                                   "lstm", "flashbwd"):
+                                                   "lstm", "flashbwd",
+                                                   "gpt"):
             sys.exit("usage: perf_dossier.py --trace DIR [config ...]")
         trace_dir = names[i + 1]
         names = names[:i] + names[i + 2:]
     rows = []
     table = {"resnet50": resnet50, "bert": bert, "lstm": lstm,
-             "flashbwd": flashbwd}
+             "flashbwd": flashbwd, "gpt": gpt}
 
     def run_all():
         for name in names or list(table):

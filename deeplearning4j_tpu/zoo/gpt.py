@@ -93,15 +93,20 @@ class CausalTransformerLM(ZooModel):
 
     # -- KV-cached autoregressive decoding ------------------------------
     def generate(self, net: MultiLayerNetwork, prompt, n_new: int,
-                 temperature: float = 0.0, rng=None):
-        """Greedy (or temperature-sampled) decoding with per-layer KV
-        caches, compiled as one ``lax.scan`` over positions: prefill
-        and generation share the step (prompt positions force-feed the
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None, rng=None):
+        """Greedy (or sampled) decoding with per-layer KV caches,
+        compiled as one ``lax.scan`` over positions: prefill and
+        generation share the step (prompt positions force-feed the
         prompt token; later positions feed the previous prediction).
 
-        ``prompt``: [B, T0] int32. Returns [B, T0 + n_new] int32.
-        The per-step attention reads the cache up to the current
-        position only — O(T) total memory, no [T,T] score matrix.
+        Sampling (``temperature > 0``) supports ``top_k`` (keep the k
+        most likely tokens) and nucleus ``top_p`` (keep the smallest
+        set of tokens whose probability mass ≥ p); both filters
+        compose. ``prompt``: [B, T0] int32. Returns [B, T0 + n_new]
+        int32. The per-step attention reads the cache up to the
+        current position only — O(T) total memory, no [T,T] score
+        matrix.
         """
         prompt = jnp.asarray(np.asarray(prompt), jnp.int32)
         b, t0 = prompt.shape
@@ -116,23 +121,58 @@ class CausalTransformerLM(ZooModel):
         pad = jnp.zeros((b, n_new), jnp.int32)
         token_seq = jnp.concatenate([prompt, pad], axis=1)
         # params are a jit ARGUMENT (not closure-captured), so further
-        # training never runs against a stale compiled decode; t0 is a
-        # TRACED scalar (only `pos < t0` consumes it), so one compiled
-        # scan serves every prompt/new split of the same total length
-        key_ = (b, total, temperature > 0)
+        # training never runs against a stale compiled decode; t0 and
+        # top_p are TRACED scalars, so one compiled scan serves every
+        # prompt/new split of the same total length
+        key_ = (b, total, temperature > 0, top_k, top_p is not None)
         cache = getattr(self, "_gen_cache", None)
         if cache is None:
             cache = self._gen_cache = {}
         if key_ not in cache:
             cache[key_] = jax.jit(functools.partial(
                 self._decode_scan, b=b, total=total,
-                sample=temperature > 0))
+                sample=temperature > 0, top_k=top_k,
+                nucleus=top_p is not None))
         return np.asarray(cache[key_](
             net.params, token_seq, jnp.asarray(t0, jnp.int32),
-            jnp.asarray(temperature or 1.0, jnp.float32), rng))
+            jnp.asarray(temperature or 1.0, jnp.float32),
+            jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
+            rng))
 
-    def _decode_scan(self, params, tokens, t0, temperature, rng, *, b,
-                     total, sample):
+    @staticmethod
+    def _filter_logits(logits, top_k, top_p, nucleus):
+        """Top-k then nucleus filtering on [B, V] f32 logits (filtered
+        entries → -inf). ``top_k``/``nucleus`` are static — unused
+        filters cost nothing (plain temperature sampling never sorts);
+        ``top_p`` is a traced scalar. One descending sort serves both
+        filters."""
+        if not (top_k is not None or nucleus):
+            return logits
+        sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+        if top_k is not None:
+            logits = jnp.where(
+                logits < sorted_l[:, top_k - 1][:, None], -jnp.inf,
+                logits)
+            sorted_l = jnp.where(
+                jnp.arange(sorted_l.shape[-1])[None, :] < top_k,
+                sorted_l, -jnp.inf)
+        if nucleus:
+            # keep the smallest prefix of the sorted distribution whose
+            # cumulative mass reaches top_p (always keep the argmax)
+            probs = jax.nn.softmax(sorted_l, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep_sorted = jnp.concatenate(
+                [jnp.ones_like(cum[:, :1], bool),
+                 cum[:, :-1] < top_p], axis=-1)
+            # threshold logit = smallest kept sorted logit per row
+            thresh = jnp.min(
+                jnp.where(keep_sorted, sorted_l, jnp.inf),
+                axis=-1, keepdims=True)
+            logits = jnp.where(logits < thresh, -jnp.inf, logits)
+        return logits
+
+    def _decode_scan(self, params, tokens, t0, temperature, top_p, rng,
+                     *, b, total, sample, top_k, nucleus):
         hd = self.hidden // self.n_heads
         n_kv = self.n_kv_heads
         emb_W = params["layer_0"]["W"]
@@ -201,9 +241,10 @@ class CausalTransformerLM(ZooModel):
             logits = x @ out_head["W"] + out_head["b"]
             key, sub = jax.random.split(key)
             if sample:
-                nxt = jax.random.categorical(
-                    sub, logits.astype(jnp.float32) / temperature,
-                    axis=-1)
+                lf = self._filter_logits(
+                    logits.astype(jnp.float32) / temperature, top_k,
+                    top_p, nucleus)
+                nxt = jax.random.categorical(sub, lf, axis=-1)
             else:
                 nxt = jnp.argmax(logits, axis=-1)
             return ((tokens, tuple(new_caches), nxt.astype(jnp.int32),

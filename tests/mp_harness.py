@@ -20,7 +20,8 @@ def run_two_process_workers(script_path, port, extra_env=None,
                        COORD=f"127.0.0.1:{port}", NPROC="2",
                        PROC_ID=str(pid),
                        XLA_FLAGS="--xla_force_host_platform_device_count=2",
-                       JAX_PLATFORMS="cpu", **(extra_env or {}))
+                       JAX_PLATFORMS="cpu")
+            env.update(extra_env or {})      # overrides win
             procs.append(subprocess.Popen(
                 [sys.executable, str(script_path)], env=env,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,

@@ -209,3 +209,23 @@ def test_generate_batched_and_sampled(toy_lm):
                         rng=jax.random.PRNGKey(7))
     np.testing.assert_array_equal(s1, s2)
     assert s1.min() >= 0 and s1.max() < 16
+
+
+def test_generate_top_k_top_p(toy_lm):
+    """top_k=1 sampling collapses to greedy regardless of temperature
+    or seed; top_p in-vocab and reproducible; filters compose."""
+    model, net, _, period = toy_lm
+    prompt = (np.arange(8) % period + 1)[None, :].astype(np.int32)
+    greedy = model.generate(net, prompt, n_new=5)
+    k1 = model.generate(net, prompt, n_new=5, temperature=2.0,
+                        top_k=1, rng=jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(greedy, k1)
+    # a sharply-trained model puts ~all mass on one token: tiny top_p
+    # also reproduces greedy
+    p_small = model.generate(net, prompt, n_new=5, temperature=1.0,
+                             top_p=0.5, rng=jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(greedy, p_small)
+    both = model.generate(net, prompt, n_new=5, temperature=0.9,
+                          top_k=3, top_p=0.9,
+                          rng=jax.random.PRNGKey(2))
+    assert both.min() >= 0 and both.max() < 16

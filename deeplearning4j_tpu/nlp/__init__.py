@@ -10,7 +10,12 @@ from deeplearning4j_tpu.nlp.word2vec import (Word2Vec, ParagraphVectors,
                                              WordVectorSerializer)
 from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.fasttext import FastText
+from deeplearning4j_tpu.nlp.bert_iterator import (BertIterator,
+                                                  BertWordPieceTokenizer,
+                                                  LMSequenceIterator)
 
 __all__ = ["DefaultTokenizer", "DefaultTokenizerFactory",
            "CommonPreprocessor", "VocabCache", "VocabWord", "Word2Vec",
-           "ParagraphVectors", "WordVectorSerializer", "Glove", "FastText"]
+           "ParagraphVectors", "WordVectorSerializer", "Glove",
+           "FastText", "BertIterator", "BertWordPieceTokenizer",
+           "LMSequenceIterator"]

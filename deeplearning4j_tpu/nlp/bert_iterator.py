@@ -1,0 +1,254 @@
+"""BERT-style data pipelines.
+
+Reference: ``org.deeplearning4j.iterator.BertIterator`` (tasks
+UNSUPERVISED/masked-LM and SEQ_CLASSIFICATION, fixed-length truncate/
+pad, masked-token 80/10/10 corruption) and
+``o.d.text.tokenization.tokenizer.BertWordPieceTokenizer`` (greedy
+longest-match wordpiece over a fixed vocab with ``##`` continuations).
+Plus ``LMSequenceIterator`` — the causal-LM analog of the reference's
+char-RNN ``CharacterIterator``: pack a token stream into [B, T]
+next-token batches for ``zoo.CausalTransformerLM``.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+
+PAD, UNK, CLS, SEP, MASK = "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"
+SPECIALS = (PAD, UNK, CLS, SEP, MASK)
+
+
+class BertWordPieceTokenizer:
+    """Greedy longest-match wordpiece (reference
+    BertWordPieceTokenizer): lowercases, splits on whitespace, then
+    decomposes each word into the longest vocab prefixes with ``##``
+    continuation pieces; words with no valid decomposition → [UNK]."""
+
+    def __init__(self, vocab: Dict[str, int], lower_case: bool = True,
+                 max_word_chars: int = 100):
+        self.vocab = vocab
+        self.lower_case = lower_case
+        self.max_word_chars = max_word_chars
+
+    @classmethod
+    def build_vocab(cls, sentences: Iterable[str],
+                    max_pieces: int = 30000) -> Dict[str, int]:
+        """Tiny wordpiece-vocab builder for tests/toy corpora: all
+        specials, then whole words, then all character pieces (with
+        ``##`` variants) so every word is decomposable."""
+        from collections import Counter
+        words = Counter()
+        chars = set()
+        for s in sentences:
+            for w in s.lower().split():
+                words[w] += 1
+                chars.update(w)
+        vocab: Dict[str, int] = {t: i for i, t in enumerate(SPECIALS)}
+        for ch in sorted(chars):
+            for piece in (ch, "##" + ch):
+                if piece not in vocab:
+                    vocab[piece] = len(vocab)
+        for w, _ in words.most_common():
+            if len(vocab) >= max_pieces:
+                break
+            if w not in vocab:
+                vocab[w] = len(vocab)
+        return vocab
+
+    def tokenize(self, text: str) -> List[str]:
+        out: List[str] = []
+        for word in (text.lower() if self.lower_case
+                     else text).split():
+            if len(word) > self.max_word_chars:
+                out.append(UNK)
+                continue
+            pieces, start = [], 0
+            while start < len(word):
+                end, cur = len(word), None
+                while start < end:
+                    sub = word[start:end]
+                    if start > 0:
+                        sub = "##" + sub
+                    if sub in self.vocab:
+                        cur = sub
+                        break
+                    end -= 1
+                if cur is None:
+                    pieces, start = [UNK], len(word)
+                    break
+                pieces.append(cur)
+                start = end
+            out.extend(pieces)
+        return out
+
+    def encode(self, text: str) -> List[int]:
+        return [self.vocab[t] for t in self.tokenize(text)]
+
+
+class BertIterator:
+    """Reference ``BertIterator``: sentence (or pair) provider →
+    fixed-length [B, T] token-id batches.
+
+    ``task="mask_lm"``: 15% of non-special positions are selected; of
+    those 80% → [MASK], 10% → random token, 10% kept — labels carry
+    the ORIGINAL ids at selected positions and ``labels_mask`` scores
+    only them (reference UNSUPERVISED task semantics).
+    ``task="seq_classification"``: labels from the provider.
+
+    Yields ``MultiDataSet([tokens, segments], ...)`` matching
+    ``zoo.Bert``'s (tokens, segments) inputs; the trailing batch may be
+    smaller than ``batch_size`` (nothing is dropped).
+    ``one_hot_labels=True`` (default, reference format) emits [B, T, V]
+    one-hot MLM labels for ``conf_mlm``'s softmax CE; ``False`` emits
+    sparse [B, T] int ids for sparse-CE heads.
+    """
+
+    def __init__(self, tokenizer: BertWordPieceTokenizer,
+                 sentences: Sequence, batch_size: int = 8,
+                 seq_len: int = 64, task: str = "mask_lm",
+                 mask_prob: float = 0.15, one_hot_labels: bool = True,
+                 num_classes: Optional[int] = None, seed: int = 0):
+        if task not in ("mask_lm", "seq_classification"):
+            raise ValueError(f"unknown BertIterator task {task!r}")
+        if task == "seq_classification" and num_classes is None:
+            raise ValueError("seq_classification needs num_classes")
+        self.tok = tokenizer
+        self.sentences = list(sentences)
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.task = task
+        self.mask_prob = mask_prob
+        self.one_hot = one_hot_labels
+        self.num_classes = num_classes
+        self.seed = seed
+        self._epoch = 0
+
+    def reset(self):
+        self._epoch += 1          # fresh masking every epoch
+
+    def _encode_fixed(self, text, text_b=None):
+        """[CLS] a [SEP] (b [SEP]) truncated/padded to seq_len; returns
+        (ids, segments, valid_len)."""
+        v = self.tok.vocab
+        ids = [v[CLS]] + self.tok.encode(text) + [v[SEP]]
+        segs = [0] * len(ids)
+        if text_b is not None:
+            bt = self.tok.encode(text_b) + [v[SEP]]
+            ids += bt
+            segs += [1] * len(bt)
+        ids, segs = ids[:self.seq_len], segs[:self.seq_len]
+        n = len(ids)
+        ids += [v[PAD]] * (self.seq_len - n)
+        segs += [0] * (self.seq_len - n)
+        return ids, segs, n
+
+    def __iter__(self):
+        rng = np.random.default_rng(self.seed + self._epoch)
+        v = self.tok.vocab
+        special_ids = {v[t] for t in SPECIALS}
+        n_vocab = len(v)
+        for i in range(0, len(self.sentences), self.batch_size):
+            batch = self.sentences[i:i + self.batch_size]
+            bs = len(batch)            # trailing batch may be short
+            ids = np.zeros((bs, self.seq_len), np.int32)
+            segs = np.zeros((bs, self.seq_len), np.int32)
+            labels_cls = np.zeros((bs,), np.int64)
+            for j, item in enumerate(batch):
+                if self.task == "seq_classification":
+                    if isinstance(item, (tuple, list)) and len(item) == 3:
+                        text, text_b, label = item
+                    else:
+                        (text, label), text_b = item, None
+                    labels_cls[j] = int(label)
+                else:
+                    if isinstance(item, (tuple, list)):
+                        text = item[0]
+                        text_b = item[1] if len(item) > 1 else None
+                    else:
+                        text, text_b = item, None
+                ids[j], segs[j], _ = self._encode_fixed(text, text_b)
+            if self.task == "seq_classification":
+                y = np.eye(self.num_classes,
+                           dtype=np.float32)[labels_cls]
+                yield MultiDataSet([ids, segs], [y])
+                continue
+            # masked LM: select, corrupt 80/10/10, score selected only
+            selectable = ~np.isin(ids, list(special_ids))
+            sel = selectable & (rng.random(ids.shape) < self.mask_prob)
+            # guarantee ≥1 selected position per example
+            for j in range(bs):
+                if selectable[j].any() and not sel[j].any():
+                    sel[j, rng.choice(np.flatnonzero(selectable[j]))] \
+                        = True
+            corrupted = ids.copy()
+            r = rng.random(ids.shape)
+            corrupted[sel & (r < 0.8)] = v[MASK]
+            rnd = sel & (r >= 0.8) & (r < 0.9)
+            # random replacements draw from NON-special ids only
+            corrupted[rnd] = rng.integers(len(SPECIALS), n_vocab,
+                                          int(rnd.sum()))
+            lmask = sel.astype(np.float32)
+            if self.one_hot:
+                # scatter, not np.eye-index: eye would allocate an
+                # O(V²) identity per batch (3.6 GB at V=30k)
+                y = np.zeros((bs, self.seq_len, n_vocab), np.float32)
+                bi, ti = np.indices(ids.shape)
+                y[bi, ti, ids] = 1.0
+            else:
+                y = ids.astype(np.int32)
+            yield MultiDataSet([corrupted, segs], [y],
+                               labels_masks=[lmask])
+
+
+class LMSequenceIterator:
+    """Causal-LM packing (the transformer-era ``CharacterIterator``):
+    concatenate the encoded corpus into one token stream and cut it
+    into [B, T] (inputs, next-token targets) DataSets for
+    ``zoo.CausalTransformerLM`` (sparse int targets)."""
+
+    def __init__(self, token_stream: Sequence[int], batch_size: int,
+                 seq_len: int):
+        self.tokens = np.asarray(token_stream, np.int32)
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        n_windows = (self.tokens.size - 1) // seq_len
+        if n_windows < 1:
+            raise ValueError(f"corpus of {self.tokens.size} tokens is "
+                             f"shorter than seq_len+1={seq_len + 1}")
+        self.n_batches = n_windows // batch_size
+        if self.n_batches < 1:
+            raise ValueError(
+                f"corpus packs into only {n_windows} windows of "
+                f"seq_len={seq_len} — fewer than batch_size="
+                f"{batch_size}; shrink the batch or the sequence")
+
+    @classmethod
+    def from_texts(cls, texts: Iterable[str],
+                   tokenizer: BertWordPieceTokenizer, batch_size: int,
+                   seq_len: int) -> "LMSequenceIterator":
+        stream: List[int] = []
+        sep = tokenizer.vocab[SEP]
+        for t in texts:
+            stream.extend(tokenizer.encode(t))
+            stream.append(sep)
+        return cls(stream, batch_size, seq_len)
+
+    def reset(self):
+        pass
+
+    def __len__(self):
+        return self.n_batches
+
+    def __iter__(self):
+        T, B = self.seq_len, self.batch_size
+        for b in range(self.n_batches):
+            xs = np.zeros((B, T), np.int32)
+            ys = np.zeros((B, T), np.int32)
+            for j in range(B):
+                o = (b * B + j) * T
+                xs[j] = self.tokens[o:o + T]
+                ys[j] = self.tokens[o + 1:o + T + 1]
+            yield DataSet(xs, ys)

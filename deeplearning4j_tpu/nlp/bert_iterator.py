@@ -131,7 +131,9 @@ class BertIterator:
 
     def _encode_fixed(self, text, text_b=None):
         """[CLS] a [SEP] (b [SEP]) truncated/padded to seq_len; returns
-        (ids, segments, valid_len)."""
+        (ids, segments, valid_len). Truncation preserves the trailing
+        [SEP] (and, for pairs, at least the pair's separator), so every
+        row keeps the sentence-structure markers the model keys on."""
         v = self.tok.vocab
         ids = [v[CLS]] + self.tok.encode(text) + [v[SEP]]
         segs = [0] * len(ids)
@@ -139,7 +141,9 @@ class BertIterator:
             bt = self.tok.encode(text_b) + [v[SEP]]
             ids += bt
             segs += [1] * len(bt)
-        ids, segs = ids[:self.seq_len], segs[:self.seq_len]
+        if len(ids) > self.seq_len:
+            ids = ids[:self.seq_len - 1] + [v[SEP]]
+            segs = segs[:self.seq_len - 1] + [segs[self.seq_len - 1]]
         n = len(ids)
         ids += [v[PAD]] * (self.seq_len - n)
         segs += [0] * (self.seq_len - n)
@@ -150,6 +154,10 @@ class BertIterator:
         v = self.tok.vocab
         special_ids = {v[t] for t in SPECIALS}
         n_vocab = len(v)
+        # non-special id pool by VALUE, not by position: external
+        # vocabs (e.g. real BERT vocab.txt) scatter specials anywhere
+        nonspecial = np.setdiff1d(np.arange(n_vocab),
+                                  np.asarray(sorted(special_ids)))
         for i in range(0, len(self.sentences), self.batch_size):
             batch = self.sentences[i:i + self.batch_size]
             bs = len(batch)            # trailing batch may be short
@@ -188,8 +196,7 @@ class BertIterator:
             corrupted[sel & (r < 0.8)] = v[MASK]
             rnd = sel & (r >= 0.8) & (r < 0.9)
             # random replacements draw from NON-special ids only
-            corrupted[rnd] = rng.integers(len(SPECIALS), n_vocab,
-                                          int(rnd.sum()))
+            corrupted[rnd] = rng.choice(nonspecial, int(rnd.sum()))
             lmask = sel.astype(np.float32)
             if self.one_hot:
                 # scatter, not np.eye-index: eye would allocate an
@@ -207,23 +214,20 @@ class LMSequenceIterator:
     """Causal-LM packing (the transformer-era ``CharacterIterator``):
     concatenate the encoded corpus into one token stream and cut it
     into [B, T] (inputs, next-token targets) DataSets for
-    ``zoo.CausalTransformerLM`` (sparse int targets)."""
+    ``zoo.CausalTransformerLM`` (sparse int targets). The trailing
+    batch may be short — every packable window is yielded."""
 
     def __init__(self, token_stream: Sequence[int], batch_size: int,
                  seq_len: int):
         self.tokens = np.asarray(token_stream, np.int32)
         self.batch_size = batch_size
         self.seq_len = seq_len
-        n_windows = (self.tokens.size - 1) // seq_len
-        if n_windows < 1:
+        self.n_windows = (self.tokens.size - 1) // seq_len
+        if self.n_windows < 1:
             raise ValueError(f"corpus of {self.tokens.size} tokens is "
                              f"shorter than seq_len+1={seq_len + 1}")
-        self.n_batches = n_windows // batch_size
-        if self.n_batches < 1:
-            raise ValueError(
-                f"corpus packs into only {n_windows} windows of "
-                f"seq_len={seq_len} — fewer than batch_size="
-                f"{batch_size}; shrink the batch or the sequence")
+        # trailing short batch included — no window is dropped
+        self.n_batches = -(-self.n_windows // batch_size)
 
     @classmethod
     def from_texts(cls, texts: Iterable[str],
@@ -245,9 +249,10 @@ class LMSequenceIterator:
     def __iter__(self):
         T, B = self.seq_len, self.batch_size
         for b in range(self.n_batches):
-            xs = np.zeros((B, T), np.int32)
-            ys = np.zeros((B, T), np.int32)
-            for j in range(B):
+            rows = min(B, self.n_windows - b * B)
+            xs = np.zeros((rows, T), np.int32)
+            ys = np.zeros((rows, T), np.int32)
+            for j in range(rows):
                 o = (b * B + j) * T
                 xs[j] = self.tokens[o:o + T]
                 ys[j] = self.tokens[o + 1:o + T + 1]

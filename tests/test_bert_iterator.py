@@ -174,7 +174,28 @@ def test_lm_sequence_iterator_packs_and_trains(tok):
 def test_lm_iterator_rejects_short_corpus(tok):
     with pytest.raises(ValueError, match="shorter"):
         LMSequenceIterator([1, 2, 3], batch_size=2, seq_len=8)
-    # enough tokens for windows but not for one full batch: loud, not
-    # a silent zero-batch iterator
-    with pytest.raises(ValueError, match="fewer than batch_size"):
-        LMSequenceIterator(list(range(50)), batch_size=8, seq_len=12)
+
+
+def test_lm_iterator_trailing_windows_not_dropped():
+    """50 tokens @ T=12 pack into 4 windows; batch_size=8 must yield
+    one SHORT batch of 4 rows, not silently nothing."""
+    it = LMSequenceIterator(list(range(50)), batch_size=8, seq_len=12)
+    batches = list(it)
+    assert len(batches) == 1 and len(it) == 1
+    assert batches[0].features.shape == (4, 12)
+    # and a 10-window corpus with batch_size=4 yields 4+4+2
+    it2 = LMSequenceIterator(list(range(121)), batch_size=4,
+                             seq_len=12)
+    assert [b.features.shape[0] for b in it2] == [4, 4, 2]
+
+
+def test_encode_fixed_truncation_keeps_sep(tok):
+    """Over-long sentences keep the trailing [SEP] after truncation;
+    pair encoding keeps a separator even when text_b is cut."""
+    it = BertIterator(tok, CORPUS, batch_size=2, seq_len=8)
+    long_text = " ".join(CORPUS)
+    ids, segs, n = it._encode_fixed(long_text)
+    v = tok.vocab
+    assert n == 8 and ids[-1] == v[SEP] and ids[0] == v[CLS]
+    ids2, segs2, _ = it._encode_fixed(long_text, "short tail")
+    assert ids2[-1] == v[SEP]

@@ -14,7 +14,7 @@ EXAMPLES = Path(__file__).parent.parent / "examples"
     "distributed_data_parallel", "samediff_autodiff",
     "parallelism_modes", "hyperparameter_search", "transfer_learning",
     "model_serving", "pretrained_zoo", "long_context_attention",
-    "sharded_serving", "causal_lm",
+    "sharded_serving", "causal_lm", "bert_pretrain_mlm",
 ])
 def test_example_runs(name, monkeypatch, capsys):
     monkeypatch.setenv("DL4J_TPU_EXAMPLE_FAST", "1")

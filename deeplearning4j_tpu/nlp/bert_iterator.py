@@ -34,6 +34,24 @@ class BertWordPieceTokenizer:
         self.max_word_chars = max_word_chars
 
     @classmethod
+    def from_vocab_file(cls, path, **kw) -> "BertWordPieceTokenizer":
+        """Load a standard BERT ``vocab.txt`` (one piece per line, id =
+        line number) — the reference's
+        ``BertWordPieceTokenizer(vocabFile)`` entry point."""
+        vocab: Dict[str, int] = {}
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                vocab[line.rstrip("\r\n")] = i   # CRLF-safe
+        return cls(vocab, **kw)
+
+    def save_vocab(self, path) -> None:
+        """Write ``vocab.txt`` (inverse of :meth:`from_vocab_file`)."""
+        inv = sorted(self.vocab.items(), key=lambda kv: kv[1])
+        with open(path, "w", encoding="utf-8") as f:
+            for piece, _ in inv:
+                f.write(piece + "\n")
+
+    @classmethod
     def build_vocab(cls, sentences: Iterable[str],
                     max_pieces: int = 30000) -> Dict[str, int]:
         """Tiny wordpiece-vocab builder for tests/toy corpora: all
@@ -131,19 +149,23 @@ class BertIterator:
 
     def _encode_fixed(self, text, text_b=None):
         """[CLS] a [SEP] (b [SEP]) truncated/padded to seq_len; returns
-        (ids, segments, valid_len). Truncation preserves the trailing
-        [SEP] (and, for pairs, at least the pair's separator), so every
-        row keeps the sentence-structure markers the model keys on."""
+        (ids, segments, valid_len). Truncation is PAIR-AWARE
+        (reference ``truncateSeqPair``): tokens pop off the longer
+        sentence first, so both segments — and both [SEP] markers —
+        always survive."""
         v = self.tok.vocab
-        ids = [v[CLS]] + self.tok.encode(text) + [v[SEP]]
-        segs = [0] * len(ids)
-        if text_b is not None:
-            bt = self.tok.encode(text_b) + [v[SEP]]
-            ids += bt
-            segs += [1] * len(bt)
-        if len(ids) > self.seq_len:
-            ids = ids[:self.seq_len - 1] + [v[SEP]]
-            segs = segs[:self.seq_len - 1] + [segs[self.seq_len - 1]]
+        a = self.tok.encode(text)
+        if text_b is None:
+            a = a[:self.seq_len - 2]
+            ids = [v[CLS]] + a + [v[SEP]]
+            segs = [0] * len(ids)
+        else:
+            b = self.tok.encode(text_b)
+            budget = self.seq_len - 3          # [CLS] + 2×[SEP]
+            while len(a) + len(b) > budget:
+                (a if len(a) >= len(b) else b).pop()
+            ids = [v[CLS]] + a + [v[SEP]] + b + [v[SEP]]
+            segs = [0] * (len(a) + 2) + [1] * (len(b) + 1)
         n = len(ids)
         ids += [v[PAD]] * (self.seq_len - n)
         segs += [0] * (self.seq_len - n)

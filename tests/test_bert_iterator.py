@@ -42,6 +42,31 @@ def test_wordpiece_unknown_char_is_unk():
     assert t.tokenize("abc xyz") == ["abc", UNK]
 
 
+def test_vocab_file_roundtrip(tok, tmp_path):
+    """vocab.txt save/load (reference BertWordPieceTokenizer(vocabFile))
+    — and the masking invariant holds for a vocab whose special ids
+    are NOT the first rows (real-BERT layout)."""
+    p = tmp_path / "vocab.txt"
+    tok.save_vocab(p)
+    tok2 = BertWordPieceTokenizer.from_vocab_file(p)
+    assert tok2.vocab == tok.vocab
+    assert tok2.tokenize("the quick fox") == tok.tokenize("the quick fox")
+    # scrambled layout: specials at high ids (like Google's vocab.txt
+    # where [CLS]=101 etc.)
+    pieces = sorted(tok.vocab, key=tok.vocab.get)
+    scrambled = [w for w in pieces if w not in SPECIALS] + \
+        [w for w in pieces if w in SPECIALS]
+    (tmp_path / "v2.txt").write_text("\n".join(scrambled) + "\n")
+    tok3 = BertWordPieceTokenizer.from_vocab_file(tmp_path / "v2.txt")
+    it = BertIterator(tok3, CORPUS, batch_size=4, seq_len=16, seed=21)
+    v = tok3.vocab
+    for mds in it:
+        ids = mds.features[0]
+        lmask = mds.labels_masks[0]
+        special = np.isin(ids, [v[s] for s in SPECIALS])
+        assert not (special & (lmask > 0) & (ids != v[MASK])).any()
+
+
 def test_mask_lm_batch_shapes_and_semantics(tok):
     it = BertIterator(tok, CORPUS, batch_size=4, seq_len=16,
                       task="mask_lm", seed=1)
@@ -190,12 +215,19 @@ def test_lm_iterator_trailing_windows_not_dropped():
 
 
 def test_encode_fixed_truncation_keeps_sep(tok):
-    """Over-long sentences keep the trailing [SEP] after truncation;
-    pair encoding keeps a separator even when text_b is cut."""
+    """Over-long sentences keep the trailing [SEP]; PAIR truncation
+    pops from the longer sentence so BOTH segments (and both [SEP]s)
+    survive (reference truncateSeqPair semantics)."""
     it = BertIterator(tok, CORPUS, batch_size=2, seq_len=8)
     long_text = " ".join(CORPUS)
     ids, segs, n = it._encode_fixed(long_text)
     v = tok.vocab
     assert n == 8 and ids[-1] == v[SEP] and ids[0] == v[CLS]
-    ids2, segs2, _ = it._encode_fixed(long_text, "short tail")
-    assert ids2[-1] == v[SEP]
+    assert ids.count(v[SEP]) == 1 and set(segs) == {0}
+    # pair: a huge text_a must NOT evict text_b — segment 1 survives
+    ids2, segs2, n2 = it._encode_fixed(long_text, "lazy dog")
+    assert n2 == 8 and ids2[-1] == v[SEP]
+    assert ids2.count(v[SEP]) == 2
+    assert 1 in segs2                    # second segment present
+    seps = [i for i, t in enumerate(ids2) if t == v[SEP]]
+    assert segs2[seps[0]] == 0 and segs2[seps[1]] == 1

@@ -41,11 +41,23 @@ class BertWordPieceTokenizer:
         vocab: Dict[str, int] = {}
         with open(path, encoding="utf-8") as f:
             for i, line in enumerate(f):
-                vocab[line.rstrip("\r\n")] = i   # CRLF-safe
+                piece = line.rstrip("\r\n")      # CRLF-safe
+                if piece in vocab:
+                    raise ValueError(
+                        f"duplicate piece {piece!r} at line {i} of "
+                        f"{path} — ids would shift silently")
+                vocab[piece] = i
         return cls(vocab, **kw)
 
     def save_vocab(self, path) -> None:
-        """Write ``vocab.txt`` (inverse of :meth:`from_vocab_file`)."""
+        """Write ``vocab.txt`` (inverse of :meth:`from_vocab_file`).
+        Requires contiguous ids 0..V-1 — the line-number format cannot
+        represent gaps, which would silently remap ids on reload."""
+        ids = sorted(self.vocab.values())
+        if ids != list(range(len(ids))):
+            raise ValueError(
+                "vocab ids are not contiguous 0..V-1; saving to the "
+                "line-number vocab.txt format would remap them")
         inv = sorted(self.vocab.items(), key=lambda kv: kv[1])
         with open(path, "w", encoding="utf-8") as f:
             for piece, _ in inv:

@@ -34,10 +34,19 @@ def _flatten_with_paths(tree):
     return out
 
 
+def _writestr_det(zf: zipfile.ZipFile, name: str, data) -> None:
+    """Deterministic zip entry: fixed DOS epoch timestamp so identical
+    content always produces an identical archive (checksum-stable
+    goldens; plain writestr stamps the current time)."""
+    info = zipfile.ZipInfo(name, date_time=(1980, 1, 1, 0, 0, 0))
+    info.compress_type = zipfile.ZIP_DEFLATED
+    zf.writestr(info, data)
+
+
 def _save_npz(zf: zipfile.ZipFile, name: str, tree) -> None:
     buf = io.BytesIO()
     np.savez(buf, **_flatten_with_paths(tree))
-    zf.writestr(name, buf.getvalue())
+    _writestr_det(zf, name, buf.getvalue())
 
 
 def _load_npz_into(zf: zipfile.ZipFile, name: str, tree):
@@ -64,14 +73,14 @@ class ModelSerializer:
         path = Path(path)
         path.parent.mkdir(parents=True, exist_ok=True)
         with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-            zf.writestr("configuration.json", net.conf.to_json())
+            _writestr_det(zf, "configuration.json", net.conf.to_json())
             _save_npz(zf, "params.npz", net.params)
             _save_npz(zf, "state.npz", net.state)
             if save_updater and net.opt_state is not None:
                 _save_npz(zf, "updater.npz", net.opt_state)
             if normalizer is not None:
-                zf.writestr("normalizer.json",
-                            json.dumps(normalizer.state_dict()))
+                _writestr_det(zf, "normalizer.json",
+                              json.dumps(normalizer.state_dict()))
             meta = {"iteration": net.iteration, "epoch": net.epoch,
                     "format_version": 1}
             ishape = getattr(net, "_input_shape", None)
@@ -83,7 +92,7 @@ class ModelSerializer:
             if shapes and hasattr(net.conf, "inputs"):
                 meta["input_shapes"] = {
                     n: list(shapes[n]) for n in net.conf.inputs}
-            zf.writestr("meta.json", json.dumps(meta))
+            _writestr_det(zf, "meta.json", json.dumps(meta))
 
     @staticmethod
     def _restore(zf: zipfile.ZipFile, net, meta: dict,

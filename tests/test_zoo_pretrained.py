@@ -8,8 +8,8 @@ from pathlib import Path
 import numpy as np
 import pytest
 
-from deeplearning4j_tpu.zoo import (LeNet, SimpleCNN,
-                                    TextGenerationLSTM)
+from deeplearning4j_tpu.zoo import (CausalTransformerLM, LeNet,
+                                    SimpleCNN, TextGenerationLSTM)
 from deeplearning4j_tpu.zoo.pretrained import (DL4JResources,
                                                export_pretrained,
                                                fetch_pretrained)
@@ -18,7 +18,8 @@ GOLDENS = Path(__file__).resolve().parents[1] / "resources" / \
     "pretrained"
 
 
-@pytest.mark.parametrize("cls", [LeNet, SimpleCNN, TextGenerationLSTM])
+@pytest.mark.parametrize("cls", [LeNet, SimpleCNN, TextGenerationLSTM,
+                                 CausalTransformerLM])
 def test_init_pretrained_matches_golden_forward(cls):
     """load-pretrained → forward == the outputs captured at minting.
     base_dir pinned to the checked-in goldens so an ambient

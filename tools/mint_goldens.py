@@ -22,7 +22,8 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 
 from deeplearning4j_tpu.data import DataSet, ListDataSetIterator  # noqa: E402
-from deeplearning4j_tpu.zoo import (LeNet, SimpleCNN,  # noqa: E402
+from deeplearning4j_tpu.zoo import (CausalTransformerLM,  # noqa: E402
+                                    LeNet, SimpleCNN,
                                     TextGenerationLSTM)
 from deeplearning4j_tpu.zoo.pretrained import export_pretrained  # noqa: E402
 
@@ -75,6 +76,17 @@ def main():
     lstm = TextGenerationLSTM(vocab_size=vocab, seed=7, hidden=16,
                               layers=1, tbptt=10).init()
     mint(TextGenerationLSTM, _train_briefly(lstm, xs, ys), xs)
+
+    # CausalTransformerLM nano variant (decoder-only LM family)
+    model = CausalTransformerLM(vocab_size=16, hidden=32, n_layers=2,
+                                n_heads=4, n_kv_heads=2, max_len=32,
+                                seed=7)
+    net = model.init(seq_len=12)
+    tokens = np.arange(13) % 5 + 1
+    lx = np.tile(tokens[:12], (8, 1)).astype(np.int32)
+    ly = np.tile(tokens[1:13], (8, 1)).astype(np.int32)
+    mint(CausalTransformerLM,
+         _train_briefly(net, lx, ly, epochs=20, batch=8), lx)
 
 
 if __name__ == "__main__":

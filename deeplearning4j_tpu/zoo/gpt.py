@@ -108,36 +108,50 @@ class CausalTransformerLM(ZooModel):
         current position only — O(T) total memory, no [T,T] score
         matrix.
         """
-        prompt = jnp.asarray(np.asarray(prompt), jnp.int32)
-        b, t0 = prompt.shape
-        if n_new <= 0:
-            return np.asarray(prompt)
-        total = t0 + n_new
-        if total > self.max_len:
-            raise ValueError(f"prompt+new ({total}) exceeds "
-                             f"max_len={self.max_len}")
+        prep = self._prep_decode(prompt, n_new)
+        if prep is None:
+            return np.asarray(np.asarray(prompt, np.int32))
+        token_seq, b, t0, total = prep
         if rng is None:
             rng = jax.random.PRNGKey(0)
-        pad = jnp.zeros((b, n_new), jnp.int32)
-        token_seq = jnp.concatenate([prompt, pad], axis=1)
         # params are a jit ARGUMENT (not closure-captured), so further
         # training never runs against a stale compiled decode; t0 and
         # top_p are TRACED scalars, so one compiled scan serves every
         # prompt/new split of the same total length
-        key_ = (b, total, temperature > 0, top_k, top_p is not None)
-        cache = getattr(self, "_gen_cache", None)
-        if cache is None:
-            cache = self._gen_cache = {}
-        if key_ not in cache:
-            cache[key_] = jax.jit(functools.partial(
+        fn = self._jit_cached(
+            (b, total, temperature > 0, top_k, top_p is not None),
+            lambda: functools.partial(
                 self._decode_scan, b=b, total=total,
                 sample=temperature > 0, top_k=top_k,
                 nucleus=top_p is not None))
-        return np.asarray(cache[key_](
+        return np.asarray(fn(
             net.params, token_seq, jnp.asarray(t0, jnp.int32),
             jnp.asarray(temperature or 1.0, jnp.float32),
             jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
             rng))
+
+    def _prep_decode(self, prompt, n_new: int):
+        """Shared generate/generate_beam prologue: coerce, guard, pad.
+        Returns None when there is nothing to generate."""
+        prompt = jnp.asarray(np.asarray(prompt), jnp.int32)
+        b, t0 = prompt.shape
+        if n_new <= 0:
+            return None
+        total = t0 + n_new
+        if total > self.max_len:
+            raise ValueError(f"prompt+new ({total}) exceeds "
+                             f"max_len={self.max_len}")
+        token_seq = jnp.concatenate(
+            [prompt, jnp.zeros((b, n_new), jnp.int32)], axis=1)
+        return token_seq, b, t0, total
+
+    def _jit_cached(self, key, make_fn):
+        cache = getattr(self, "_gen_cache", None)
+        if cache is None:
+            cache = self._gen_cache = {}
+        if key not in cache:
+            cache[key] = jax.jit(make_fn())
+        return cache[key]
 
     @staticmethod
     def _filter_logits(logits, top_k, top_p, nucleus):
@@ -171,59 +185,69 @@ class CausalTransformerLM(ZooModel):
             logits = jnp.where(logits < thresh, -jnp.inf, logits)
         return logits
 
-    def _decode_scan(self, params, tokens, t0, temperature, top_p, rng,
-                     *, b, total, sample, top_k, nucleus):
+    def _fresh_caches(self, params, rows, total):
+        hd = self.hidden // self.n_heads
+        dt = params["layer_0"]["W"].dtype   # caches match model dtype
+        return tuple(
+            (jnp.zeros((rows, total, self.n_kv_heads, hd), dt),
+             jnp.zeros((rows, total, self.n_kv_heads, hd), dt))
+            for _ in range(self.n_layers))
+
+    def _token_logits(self, params, tok, caches, pos, rows):
+        """One decode position through the whole stack: token ids
+        [rows] → (logits [rows, V], updated caches). Shared by the
+        greedy/sampled scan and the beam scan.
+
+        Deliberately re-derives the block math from the params (the
+        transformer analog of the reference's rnnTimeStep): any drift
+        from TransformerDecoderBlock's training forward is caught by
+        test_generate_matches_training_forward; the RMSNorm eps is
+        shared via RMSNORM_EPS."""
         hd = self.hidden // self.n_heads
         n_kv = self.n_kv_heads
-        emb_W = params["layer_0"]["W"]
-        dt = emb_W.dtype                 # caches match the model dtype
-        final_norm = params[f"layer_{self.n_layers + 1}"]
-        out_head = params[f"layer_{self.n_layers + 2}"]
 
         def rms(x, gamma):
             return x * jax.lax.rsqrt(
                 jnp.mean(jnp.square(x), -1, keepdims=True)
                 + RMSNORM_EPS) * gamma
 
-        def block_step(pblk, x, ck, cv, pos):
-            """One token through one decoder block with cache update.
-            x: [B, F]; ck/cv: [B, total, n_kv, hd].
-
-            Deliberately re-derives the block math from the params
-            (the transformer analog of the reference's rnnTimeStep):
-            any drift from TransformerDecoderBlock's training forward
-            is caught by test_generate_matches_training_forward; the
-            RMSNorm eps is shared via RMSNORM_EPS."""
+        def block_step(pblk, x, ck, cv):
             h = rms(x, pblk["ln1"]["gamma"])
             mha = pblk["mha"]
-            q = (h @ mha["Wq"]).reshape(b, 1, self.n_heads, hd)
-            k = (h @ mha["Wk"]).reshape(b, 1, n_kv, hd)
-            v = (h @ mha["Wv"]).reshape(b, 1, n_kv, hd)
+            q = (h @ mha["Wq"]).reshape(rows, 1, self.n_heads, hd)
+            k = (h @ mha["Wk"]).reshape(rows, 1, n_kv, hd)
+            v = (h @ mha["Wv"]).reshape(rows, 1, n_kv, hd)
             q = rotary_embedding(q, self.rope_theta, offset=pos)[:, 0]
             k = rotary_embedding(k, self.rope_theta, offset=pos)[:, 0]
             ck = jax.lax.dynamic_update_index_in_dim(ck, k, pos, 1)
             cv = jax.lax.dynamic_update_index_in_dim(cv, v[:, 0], pos, 1)
             # grouped einsums attend straight against the SMALL cache
             # (GQA's cache-bandwidth saving survives decode: no
-            # [B,total,H,hd] broadcast is ever materialised)
+            # [rows,total,H,hd] broadcast is ever materialised)
             groups = self.n_heads // n_kv
-            qg = q.reshape(b, n_kv, groups, hd)
+            qg = q.reshape(rows, n_kv, groups, hd)
             s = jnp.einsum("bkgd,btkd->bkgt", qg, ck) / jnp.sqrt(
                 jnp.asarray(hd, x.dtype))
             live = jnp.arange(ck.shape[1])[None, None, None, :] <= pos
             s = jnp.where(live, s, -1e9)
             w = jax.nn.softmax(s, axis=-1)
-            a = jnp.einsum("bkgt,btkd->bkgd", w, cv).reshape(b, -1)
+            a = jnp.einsum("bkgt,btkd->bkgd", w, cv).reshape(rows, -1)
             x = x + a @ mha["Wo"] + mha["bo"]
             h = rms(x, pblk["ln2"]["gamma"])
             h = jax.nn.silu(h @ pblk["Wg"]) * (h @ pblk["Wu"])
             return x + h @ pblk["Wd"], ck, cv
 
-        caches = tuple(
-            (jnp.zeros((b, total, n_kv, hd), dt),
-             jnp.zeros((b, total, n_kv, hd), dt))
-            for _ in range(self.n_layers))
+        x = params["layer_0"]["W"][tok]             # [rows, F]
+        new_caches = []
+        for i, (ck, cv) in enumerate(caches):
+            x, ck, cv = block_step(params[f"layer_{i + 1}"], x, ck, cv)
+            new_caches.append((ck, cv))
+        x = rms(x, params[f"layer_{self.n_layers + 1}"]["gamma"])
+        head = params[f"layer_{self.n_layers + 2}"]
+        return x @ head["W"] + head["b"], tuple(new_caches)
 
+    def _decode_scan(self, params, tokens, t0, temperature, top_p, rng,
+                     *, b, total, sample, top_k, nucleus):
         def step(carry, pos):
             tokens, caches, prev, key = carry
             # prompt region feeds the given token, beyond it the
@@ -231,14 +255,8 @@ class CausalTransformerLM(ZooModel):
             tok = jnp.where(pos < t0, tokens[:, pos], prev)
             tokens = jax.lax.dynamic_update_index_in_dim(
                 tokens, tok, pos, 1)
-            x = emb_W[tok]                          # [B, F]
-            new_caches = []
-            for i, (ck, cv) in enumerate(caches):
-                x, ck, cv = block_step(params[f"layer_{i + 1}"], x, ck,
-                                       cv, pos)
-                new_caches.append((ck, cv))
-            x = rms(x, final_norm["gamma"])
-            logits = x @ out_head["W"] + out_head["b"]
+            logits, caches = self._token_logits(params, tok, caches,
+                                                pos, b)
             key, sub = jax.random.split(key)
             if sample:
                 lf = self._filter_logits(
@@ -247,16 +265,98 @@ class CausalTransformerLM(ZooModel):
                 nxt = jax.random.categorical(sub, lf, axis=-1)
             else:
                 nxt = jnp.argmax(logits, axis=-1)
-            return ((tokens, tuple(new_caches), nxt.astype(jnp.int32),
-                     key), None)
+            return ((tokens, caches, nxt.astype(jnp.int32), key), None)
 
         (tokens, _, last, _), _ = jax.lax.scan(
-            step, (tokens, caches, jnp.zeros((b,), jnp.int32), rng),
+            step,
+            (tokens, self._fresh_caches(params, b, total),
+             jnp.zeros((b,), jnp.int32), rng),
             jnp.arange(total - 1))
         # write the final prediction into the last slot (total > t0
         # guaranteed by the n_new guard, so this never touches prompt)
         return jax.lax.dynamic_update_index_in_dim(
             tokens, last, total - 1, 1)
+
+    # -- beam search -----------------------------------------------------
+    def generate_beam(self, net: MultiLayerNetwork, prompt, n_new: int,
+                      beams: int = 4):
+        """Beam-search decoding (deterministic): keeps the ``beams``
+        highest-logprob hypotheses per example, KV caches reordered to
+        follow their parent beam at every step. The prompt is prefilled
+        with B rows and the caches repeated only for the expansion
+        phase, so prefill never pays the beams× redundancy (the
+        compiled scan is keyed per prompt length — a serving-style
+        trade of one compile per T0 for beams× less prefill compute).
+        Returns the best hypothesis per example, [B, T0+n_new] int32.
+        """
+        if beams < 1 or beams > self.vocab_size:
+            raise ValueError(f"beams={beams} outside [1, vocab_size]")
+        prep = self._prep_decode(prompt, n_new)
+        if prep is None:
+            return np.asarray(np.asarray(prompt, np.int32))
+        token_seq, b, t0, total = prep
+        fn = self._jit_cached(
+            ("beam", b, beams, total, t0),
+            lambda: functools.partial(self._beam_scan, b=b,
+                                      beams=beams, total=total, t0=t0))
+        return np.asarray(fn(net.params, token_seq))
+
+    def _beam_scan(self, params, tokens_b, *, b, beams, total, t0):
+        R = b * beams
+        V = self.vocab_size
+
+        # phase 1: prefill the caches with B rows (positions 0..t0-2;
+        # position t0-1 is consumed by the first expansion step)
+        def prefill(caches, pos):
+            _, caches = self._token_logits(params, tokens_b[:, pos],
+                                           caches, pos, b)
+            return caches, None
+
+        caches_b, _ = jax.lax.scan(
+            prefill, self._fresh_caches(params, b, total),
+            jnp.arange(t0 - 1))
+
+        # phase 2: every hypothesis gets a copy of the prefilled cache;
+        # only beam 0 is live at first, so identical prompt copies
+        # never produce duplicate hypotheses
+        rep = lambda c: jnp.repeat(c, beams, axis=0)
+        caches = jax.tree.map(rep, caches_b)
+        tokens = rep(tokens_b)                   # [B·beams, total]
+        scores0 = jnp.tile(jnp.concatenate(
+            [jnp.zeros((1,)), jnp.full((beams - 1,), -jnp.inf)])[None],
+            (b, 1))                              # [B, beams]
+
+        def step(carry, pos):
+            tokens, caches, scores, prev = carry
+            tok = jnp.where(pos < t0, tokens[:, pos], prev)
+            tokens = jax.lax.dynamic_update_index_in_dim(
+                tokens, tok, pos, 1)
+            logits, caches = self._token_logits(params, tok, caches,
+                                                pos, R)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+            tot = scores[:, :, None] + logp.reshape(b, beams, V)
+            scores, flat = jax.lax.top_k(
+                tot.reshape(b, beams * V), beams)
+            parent = flat // V                   # [B, beams]
+            nxt = (flat % V).astype(jnp.int32)
+            rowsel = (jnp.arange(b)[:, None] * beams
+                      + parent).reshape(-1)
+            # hypotheses and their KV caches follow the parent beam
+            tokens = jnp.take(tokens, rowsel, axis=0)
+            caches = jax.tree.map(
+                lambda c: jnp.take(c, rowsel, axis=0), caches)
+            return (tokens, caches, scores, nxt.reshape(-1)), None
+
+        (tokens, _, scores, last), _ = jax.lax.scan(
+            step, (tokens, caches, scores0,
+                   jnp.zeros((R,), jnp.int32)),
+            jnp.arange(t0 - 1, total - 1))
+        tokens = jax.lax.dynamic_update_index_in_dim(
+            tokens, last, total - 1, 1)
+        # best hypothesis per example
+        best = jnp.argmax(scores, axis=1)        # [B]
+        rows = jnp.arange(b) * beams + best
+        return jnp.take(tokens, rows, axis=0)
 
 
 def GPTNano(**kw) -> CausalTransformerLM:

@@ -211,6 +211,72 @@ def test_generate_batched_and_sampled(toy_lm):
     assert s1.min() >= 0 and s1.max() < 16
 
 
+def _sequence_logprob(net, seq, t0):
+    """Σ log p(token_i | tokens_<i) over the generated region under the
+    training-time forward — the objective beam search maximises."""
+    probs = np.asarray(net.output(seq[:, :-1]))     # [B, T-1, V]
+    lp = 0.0
+    for i in range(t0 - 1, seq.shape[1] - 1):
+        lp += float(np.log(probs[0, i, seq[0, i + 1]] + 1e-30))
+    return lp
+
+
+def test_beam_search_matches_greedy_at_one_beam(toy_lm):
+    model, net, _, period = toy_lm
+    prompt = (np.arange(9) % period + 1)[None, :].astype(np.int32)
+    greedy = model.generate(net, prompt, n_new=6)
+    beam1 = model.generate_beam(net, prompt, n_new=6, beams=1)
+    np.testing.assert_array_equal(greedy, beam1)
+
+
+def test_beam_search_exact_at_full_width():
+    """With beams == vocab_size and n_new == 2, beam search IS
+    exhaustive (step 1 keeps every first token, step 2 maximises over
+    all V² continuations) — so its result must equal the brute-force
+    argmax over every 2-token continuation, and its logprob must be
+    >= greedy's. Uses an UNDERTRAINED model so greedy is suboptimal-
+    prone."""
+    V = 16
+    model = GPTNano(vocab_size=V, max_len=64, seed=13)
+    net = model.init(seq_len=20)
+    rng = np.random.default_rng(3)
+    net.fit(rng.integers(1, V, (8, 20)).astype(np.int32),
+            rng.integers(1, V, (8, 20)).astype(np.int32))
+    prompt = np.asarray([[1, 2, 3, 4, 5, 6]], np.int32)
+    t0 = prompt.shape[1]
+    beam = model.generate_beam(net, prompt, n_new=2, beams=V)
+
+    # brute force: total logprob of every (t1, t2) continuation
+    cands = np.asarray([[a, c] for a in range(V) for c in range(V)],
+                       np.int32)
+    seqs = np.concatenate(
+        [np.tile(prompt, (V * V, 1)), cands], axis=1)
+    probs = np.asarray(net.output(seqs[:, :-1]))   # [V², t0+1, V]
+    lp = (np.log(probs[np.arange(V * V), t0 - 1, cands[:, 0]] + 1e-30)
+          + np.log(probs[np.arange(V * V), t0, cands[:, 1]] + 1e-30))
+    best = cands[int(np.argmax(lp))]
+    np.testing.assert_array_equal(beam[0, t0:], best)
+    greedy = model.generate(net, prompt, n_new=2)
+    assert _sequence_logprob(net, beam, t0) >= \
+        _sequence_logprob(net, greedy, t0) - 1e-5
+
+
+def test_beam_search_batched_and_guards(toy_lm):
+    model, net, _, period = toy_lm
+    prompts = np.stack([(np.arange(8) % period + 1),
+                        (np.arange(2, 10) % period + 1)]).astype(np.int32)
+    out = model.generate_beam(net, prompts, n_new=4, beams=3)
+    assert out.shape == (2, 12)
+    np.testing.assert_array_equal(out[:, :8], prompts)   # prompts kept
+    # the sharply-trained toy model: beam == greedy continuation
+    greedy = model.generate(net, prompts, n_new=4)
+    np.testing.assert_array_equal(out, greedy)
+    np.testing.assert_array_equal(
+        model.generate_beam(net, prompts, n_new=0, beams=3), prompts)
+    with pytest.raises(ValueError, match="beams"):
+        model.generate_beam(net, prompts, n_new=2, beams=99)
+
+
 def test_generate_top_k_top_p(toy_lm):
     """top_k=1 sampling collapses to greedy regardless of temperature
     or seed; top_p in-vocab and reproducible; filters compose."""

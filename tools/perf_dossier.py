@@ -8,8 +8,9 @@ is NOT derivable from wall-clock alone: pass ``--trace DIR`` to wrap
 the timed runs in ``jax.profiler.trace`` and read the memory-bandwidth
 counters from the XProf capture (VERDICT r1 #5 asks for exactly that).
 
-Run on the real chip:  python tools/perf_dossier.py [--trace DIR] [config ...]
-Configs: resnet50 bert lstm flashbwd gpt (default: all).
+Run on the real chip:
+  python tools/perf_dossier.py [--trace DIR] [--out FILE] [config ...]
+Configs: resnet50 bert lstm flashbwd gpt gpt8k (default: all).
 ``--smoke``: tiny CPU shapes to validate wiring — table rows are
 labeled ``(smoke)`` and carry no MFU claim.
 Writes a markdown table to stdout; paste into BASELINE.md.
@@ -122,8 +123,8 @@ def bert():
 
 
 def gpt():
-    """Causal-LM train step + KV-cached decode (the native decoder-only
-    family; no BASELINE row — new-capability measurement)."""
+    """Causal-LM train step + KV-cached decode (BASELINE cfg #6 short-
+    context rows: train B=8 T=1024, decode @1k-prompt B=1/B=32)."""
     import jax
     import jax.numpy as jnp
 
@@ -163,22 +164,69 @@ def gpt():
                    for p in jax.tree.leaves(net.params))
     flops = 6 * n_params * b * t          # 6·N·tokens
 
-    # decode throughput: KV-cached scan, greedy. Every scan step costs
-    # the same (prefill positions included), so the denominator is the
-    # FULL total-1 step count; median-of-3 timed runs after compile.
-    prompt = np.asarray(rng.integers(0, 200, (b, 16)), np.int32)
-    n_new = 16 if SMOKE else 128
-    model.generate(net, prompt, n_new=n_new)          # compile
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        model.generate(net, prompt, n_new=n_new)      # blocks (host out)
-        times.append(time.perf_counter() - t0)
-    steps = prompt.shape[1] + n_new - 1
-    toks = b * steps / sorted(times)[1]
+    # decode throughput (BASELINE cfg #6): GENERATED tokens/s with a
+    # long prompt — prefill is one batched forward (round 4), so the
+    # serving metric is B·n_new over wall-clock, at B=1 and B=32.
+    # Median-of-3 timed runs after compile.
+    t0_len, n_new = (8, 8) if SMOKE else (1024, 128)
+    decode_rows = []
+    for db in ((1, 2) if SMOKE else (1, 32)):
+        prompt = np.asarray(rng.integers(0, 200, (db, t0_len)), np.int32)
+        model.generate(net, prompt, n_new=n_new)      # compile
+        times = []
+        for _ in range(3):
+            tt = time.perf_counter()
+            model.generate(net, prompt, n_new=n_new)  # blocks (host out)
+            times.append(time.perf_counter() - tt)
+        decode_rows.append(
+            f"B={db}: {db * n_new / sorted(times)[1]:,.0f}")
     label = (f"causal-LM train b{b} t{t} "
-             f"[decode {toks:,.0f} tok-steps/s kv-cached]")
+             f"[decode tok/s @{t0_len}-prompt {'; '.join(decode_rows)}]")
     return (label, b * t / dt, "tok/s", dt, flops)
+
+
+def gpt8k():
+    """Causal-LM train step at T=8192 (BASELINE cfg #6 long-context
+    row): flash attention + rematerialisation, single chip. Multi-chip
+    zigzag-ring at this length is exercised on the virtual mesh
+    (tests + dryrun_multichip); this row is the one-chip number."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.zoo import CausalTransformerLM, GPTNano
+
+    if SMOKE:
+        model = GPTNano(vocab_size=256, max_len=512, remat=True)
+        b, t = 1, 256
+    else:
+        model = CausalTransformerLM(vocab_size=50257, hidden=768,
+                                    n_layers=12, n_heads=12,
+                                    max_len=8192, remat=True,
+                                    compute_dtype="bfloat16")
+        b, t = 1, 8192
+    net = model.init(seq_len=t)
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.integers(0, 200, (b, t)), jnp.int32)
+    y = jnp.asarray(rng.integers(0, 200, (b, t)), jnp.int32)
+    step = net._make_train_step()
+    params, opt, state = net.params, net.opt_state, net.state
+    key = jax.random.PRNGKey(0)
+
+    def one():
+        nonlocal params, opt, state
+        params, opt, state, loss = step(params, opt, state, x, y,
+                                        None, None, key)
+        return loss
+
+    dt = _timeit(one, lambda l: l, n=10)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(params))
+    # 6·N·tokens plus the quadratic attention term (≈7·B·T²·hidden per
+    # layer for causal fwd+bwd) — at T=8k attention is no longer noise
+    flops = (6 * n_params * b * t
+             + model.n_layers * 7 * b * t * t * model.hidden)
+    return (f"causal-LM train b{b} t{t} flash+remat",
+            b * t / dt, "tok/s", dt, flops)
 
 
 def lstm():
@@ -255,6 +303,25 @@ def main(names):
         names = [n for n in names if n != "--smoke"]
         import jax
         jax.config.update("jax_platforms", "cpu")
+    table = {"resnet50": resnet50, "bert": bert, "lstm": lstm,
+             "flashbwd": flashbwd, "gpt": gpt, "gpt8k": gpt8k}
+    trace_dir = out_path = None
+    for flag in ("--trace", "--out"):
+        if flag in names:
+            i = names.index(flag)
+            if (i + 1 >= len(names) or names[i + 1] in table
+                    or names[i + 1].startswith("-")):
+                sys.exit(f"usage: perf_dossier.py {flag} PATH "
+                         "[config ...]")
+            if flag == "--trace":
+                trace_dir = names[i + 1]
+            else:
+                out_path = names[i + 1]
+            names = names[:i] + names[i + 2:]
+    unknown = [n for n in names if n not in table]
+    if unknown:
+        sys.exit(f"unknown config(s): {', '.join(unknown)} "
+                 f"(valid: {', '.join(table)})")
     if not SMOKE:
         # probe the tunnel in a subprocess FIRST: a down axon backend
         # hangs jax.devices() indefinitely (bench.py's robustness
@@ -267,18 +334,8 @@ def main(names):
     if not SMOKE:
         assert jax.devices()[0].platform in ("tpu", "axon"), \
             "perf dossier must run on the real chip (or pass --smoke)"
-    trace_dir = None
-    if "--trace" in names:
-        i = names.index("--trace")
-        if i + 1 >= len(names) or names[i + 1] in ("resnet50", "bert",
-                                                   "lstm", "flashbwd",
-                                                   "gpt"):
-            sys.exit("usage: perf_dossier.py --trace DIR [config ...]")
-        trace_dir = names[i + 1]
-        names = names[:i] + names[i + 2:]
     rows = []
-    table = {"resnet50": resnet50, "bert": bert, "lstm": lstm,
-             "flashbwd": flashbwd, "gpt": gpt}
+    failed = []
 
     def run_all():
         for name in names or list(table):
@@ -286,6 +343,7 @@ def main(names):
                 rows.append(table[name]())
             except Exception as e:
                 print(f"{name}: FAILED {type(e).__name__}: {e}")
+                failed.append(name)
 
     if trace_dir:
         with jax.profiler.trace(trace_dir):
@@ -294,6 +352,13 @@ def main(names):
               "bandwidth counters there")
     else:
         run_all()
+    payload = [{"config": r[0], "throughput": r[1], "unit": r[2],
+                "step_s": r[3], "flops": r[4],
+                "tflops": r[4] / r[3] / 1e12,
+                "mfu_pct": 100 * r[4] / r[3] / 1e12 / PEAK_TFLOPS_BF16,
+                "smoke": SMOKE} for r in rows]
+    if out_path:
+        Path(out_path).write_text(json.dumps(payload, indent=1))
     if SMOKE:
         print("\n# SMOKE RUN — wiring check only; labels describe the "
               "real configs but shapes were tiny. NOT for BASELINE.md.")
@@ -301,16 +366,20 @@ def main(names):
         print("|---|---|")
         for label, thr, unit, dt, flops in rows:
             print(f"| {label} (smoke) | {dt*1e3:.1f} ms |")
-        return
-    print("\n| Config | Throughput | Step | TFLOP/s | MFU |")
-    print("|---|---|---|---|---|")
-    for label, thr, unit, dt, flops in rows:
-        tflops = flops / dt / 1e12
-        mfu = 100 * tflops / PEAK_TFLOPS_BF16
-        print(f"| {label} | {thr:,.0f} {unit} | {dt*1e3:.1f} ms | "
-              f"{tflops:.1f} | {mfu:.1f}% |")
-    print(json.dumps([{ "config": r[0], "throughput": r[1],
-                        "unit": r[2], "step_s": r[3]} for r in rows]))
+    else:
+        print("\n| Config | Throughput | Step | TFLOP/s | MFU |")
+        print("|---|---|---|---|---|")
+        for label, thr, unit, dt, flops in rows:
+            tflops = flops / dt / 1e12
+            mfu = 100 * tflops / PEAK_TFLOPS_BF16
+            print(f"| {label} | {thr:,.0f} {unit} | {dt*1e3:.1f} ms | "
+                  f"{tflops:.1f} | {mfu:.1f}% |")
+        print(json.dumps(payload))
+    if failed:
+        # a mid-run tunnel drop (or any config crash) must NOT read as
+        # a landed dossier: nonzero rc sends tpu_watch back to watching
+        print(f"# {len(failed)} config(s) FAILED: {', '.join(failed)}")
+        sys.exit(1)
 
 
 if __name__ == "__main__":

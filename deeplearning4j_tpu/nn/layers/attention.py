@@ -167,14 +167,15 @@ class MultiHeadAttention(Layer):
                         import (zigzag_permute,
                                 zigzag_ring_self_attention,
                                 zigzag_unpermute)
-                    if not self.causal or mask is not None:
-                        raise ValueError("zigzag_ring is causal-only "
-                                         "and takes no key mask")
+                    if not self.causal:
+                        raise ValueError("zigzag_ring is causal-only")
                     n = ctx.mesh.shape[ctx.axis_name]
+                    zmask = (None if mask is None
+                             else zigzag_permute(mask, n, axis=1))
                     o = zigzag_ring_self_attention(
                         zigzag_permute(q, n), zigzag_permute(k, n),
                         zigzag_permute(v, n), ctx.mesh,
-                        axis_name=ctx.axis_name)
+                        axis_name=ctx.axis_name, mask=zmask)
                     return zigzag_unpermute(o, n)
         return scaled_dot_attention(q, k, v, mask, self.causal)
 

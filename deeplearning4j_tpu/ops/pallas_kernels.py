@@ -106,7 +106,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, km_ref, off_ref, o_ref, *rest,
     # fully past the real sequence, blocks whose key mask is all-zero,
     # and — causal — blocks fully above the (offset) diagonal
     i = pl.program_id(1)
-    km = km_ref[0]
+    km = km_ref[0, 0]
     live = jnp.logical_and(j * block_k < t_real, jnp.any(km > 0))
     if causal:
         q_off, k_off = off_ref[0], off_ref[1]
@@ -235,8 +235,12 @@ def _flash_fwd(q, k, v, km, offs, causal: bool, block_q: int,
     qp = _align_vma(pad(q, tq), vma)
     kp = _align_vma(pad(k, tk), vma)
     vp = _align_vma(pad(v, tk), vma)
+    # km rides as [BHkv, 1, Tk]: Mosaic requires the block's last two
+    # dims divisible by (8, 128) OR equal to the array dims — a unit
+    # sublane axis satisfies that with zero memory overhead
     kmp = _align_vma(
-        jnp.pad(km.astype(jnp.float32), ((0, 0), (0, tk - tk_real))),
+        jnp.pad(km.astype(jnp.float32),
+                ((0, 0), (0, tk - tk_real)))[:, None, :],
         vma)
     offs = _align_vma(offs.astype(jnp.int32), vma)
     nq, nk = tq // block_q, tk // block_k
@@ -257,7 +261,8 @@ def _flash_fwd(q, k, v, km, offs, causal: bool, block_q: int,
                          lambda b, i, j: (b // g, j, 0)),
             pl.BlockSpec((1, block_k, dp),
                          lambda b, i, j: (b // g, j, 0)),
-            pl.BlockSpec((1, block_k), lambda b, i, j: (b // g, j)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda b, i, j: (b // g, 0, j)),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=(ospec, lspec) if return_lse else ospec,
@@ -375,7 +380,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
     def _():
         acc[:] = jnp.zeros_like(acc[:])
 
-    km = km_ref[0]
+    km = km_ref[0, 0]
     q_off, k_off = off_ref[0], off_ref[1]
     live = jnp.logical_and(j * block_k < tk_real, jnp.any(km > 0))
     if causal:
@@ -408,7 +413,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         acck[:] = jnp.zeros_like(acck[:])
         accv[:] = jnp.zeros_like(accv[:])
 
-    km = km_ref[0]
+    km = km_ref[0, 0]
     q_off, k_off = off_ref[0], off_ref[1]
     live = jnp.logical_and(i * block_q < tq_real, jnp.any(km > 0))
     if causal:
@@ -469,7 +474,8 @@ def _flash_bwd(q, k, v, out, lse, g, km, offs, causal, block_q,
     dop = _align_vma(pad(g, tq), vma)
     op = _align_vma(pad(out, tq), vma)
     kmp = _align_vma(
-        jnp.pad(km.astype(jnp.float32), ((0, 0), (0, tk - tk_real))),
+        jnp.pad(km.astype(jnp.float32),
+                ((0, 0), (0, tk - tk_real)))[:, None, :],
         vma)
     offs = _align_vma(offs.astype(jnp.int32), vma)
     # residual is [BH, Tq, 1]; kernels read a full 128-lane block
@@ -484,7 +490,8 @@ def _flash_bwd(q, k, v, out, lse, g, km, offs, causal, block_q,
     lspec = pl.BlockSpec((1, block_q, 128), lambda b, x, y: (b, x, 0))
     kspec = pl.BlockSpec((1, block_k, dp),
                          lambda b, x, y: (b // gg, y, 0))
-    kmspec = pl.BlockSpec((1, block_k), lambda b, x, y: (b // gg, y))
+    kmspec = pl.BlockSpec((1, 1, block_k),
+                          lambda b, x, y: (b // gg, 0, y))
     sspec = pl.BlockSpec(memory_space=pltpu.SMEM)
     # grid (bh, i, j): q-side blocks follow grid axis 1, kv axis 2
     dq = pl.pallas_call(
@@ -503,7 +510,8 @@ def _flash_bwd(q, k, v, out, lse, g, km, offs, causal, block_q,
     lspec2 = pl.BlockSpec((1, block_q, 128), lambda b, y, x: (b, x, 0))
     kspec2 = pl.BlockSpec((1, block_k, dp),
                           lambda b, y, x: (b // gg, y, 0))
-    kmspec2 = pl.BlockSpec((1, block_k), lambda b, y, x: (b // gg, y))
+    kmspec2 = pl.BlockSpec((1, 1, block_k),
+                           lambda b, y, x: (b // gg, 0, y))
     ospec2 = pl.BlockSpec((1, block_k, dp), lambda b, y, x: (b, y, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, **kw),

@@ -67,31 +67,32 @@ def _ring_fwd_impl(q, k, v, km, axis_name, causal, groups):
     """q: [B·H, T_loc, D]; k,v: [B·Hkv, T_loc, D] (GQA: H = Hkv·groups
     — only the SMALL kv travels the ring; the flash kernel shares one
     kv block per head group via its index map, no broadcast);
-    km: [B·Hkv, T_loc]. Returns (out [B·H, T_loc, D] in q.dtype,
-    lse [B·H, T_loc, 1] f32)."""
+    km: [B·Hkv, T_loc] or None (None saves the per-step mask ppermute —
+    the flash call itself still substitutes an all-ones mask operand).
+    Returns (out [B·H, T_loc, D] in q.dtype, lse [B·H, T_loc, 1] f32)."""
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     t = q.shape[1]
+    has_km = km is not None
     vary = lambda x: lax.pcast(x, (axis_name,), to="varying")
     out0 = vary(jnp.zeros(q.shape, jnp.float32))
     lse0 = vary(jnp.full(q.shape[:2] + (1,), -jnp.inf, jnp.float32))
 
     def body(i, carry):
-        out, lse, k_cur, v_cur, km_cur = carry
+        out, lse, k_cur, v_cur = carry[:4]
+        km_cur = carry[4] if has_km else None
         src = jnp.mod(my - i, n)
         offs = jnp.stack([my * t, src * t]).astype(jnp.int32)
         o_b, lse_b = flash_block_fwd(q, k_cur, v_cur, km_cur, offs,
                                      causal, groups=groups)
         out, lse = _merge_blocks(out, lse, o_b, lse_b)
-        perm = _ring_perm(n)
-        return (out, lse,
-                lax.ppermute(k_cur, axis_name, perm),
-                lax.ppermute(v_cur, axis_name, perm),
-                lax.ppermute(km_cur, axis_name, perm))
+        pp = lambda x: lax.ppermute(x, axis_name, _ring_perm(n))
+        return (out, lse, pp(k_cur), pp(v_cur)) + (
+            (pp(km_cur),) if has_km else ())
 
-    out, lse, _, _, _ = lax.fori_loop(0, n, body,
-                                      (out0, lse0, k, v, km))
-    return out.astype(q.dtype), lse
+    init = (out0, lse0, k, v) + ((km,) if has_km else ())
+    res = lax.fori_loop(0, n, body, init)
+    return res[0].astype(q.dtype), res[1]
 
 
 def _ring_bwd_impl(q, k, v, km, out, lse, g, axis_name, causal,
@@ -99,11 +100,13 @@ def _ring_bwd_impl(q, k, v, km, out, lse, g, axis_name, causal,
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     t = q.shape[1]
+    has_km = km is not None
     zero = lambda x: lax.pcast(jnp.zeros(x.shape, jnp.float32),
                                (axis_name,), to="varying")
 
     def body(i, carry):
-        dq, dk_acc, dv_acc, k_cur, v_cur, km_cur = carry
+        dq, dk_acc, dv_acc, k_cur, v_cur = carry[:5]
+        km_cur = carry[5] if has_km else None
         src = jnp.mod(my - i, n)
         offs = jnp.stack([my * t, src * t]).astype(jnp.int32)
         # dk_b/dv_b come back already reduced to the kv head count
@@ -115,13 +118,14 @@ def _ring_bwd_impl(q, k, v, km, out, lse, g, axis_name, causal,
         dv_acc = dv_acc + dv_b.astype(jnp.float32)
         # dk/dv accumulators travel with their kv block; after n
         # rotations each block (and its now-complete gradient) is home
-        perm = _ring_perm(n)
-        pp = lambda x: lax.ppermute(x, axis_name, perm)
-        return (dq, pp(dk_acc), pp(dv_acc), pp(k_cur), pp(v_cur),
-                pp(km_cur))
+        pp = lambda x: lax.ppermute(x, axis_name, _ring_perm(n))
+        return (dq, pp(dk_acc), pp(dv_acc), pp(k_cur), pp(v_cur)) + (
+            (pp(km_cur),) if has_km else ())
 
-    dq, dk, dv, _, _, _ = lax.fori_loop(
-        0, n, body, (zero(q), zero(k), zero(v), k, v, km))
+    init = (zero(q), zero(k), zero(v), k, v) + (
+        (km,) if has_km else ())
+    res = lax.fori_loop(0, n, body, init)
+    dq, dk, dv = res[0], res[1], res[2]
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -140,10 +144,40 @@ def _ring_attn_bwd(axis_name, causal, groups, res, g):
     q, k, v, km, out, lse = res
     dq, dk, dv = _ring_bwd_impl(q, k, v, km, out, lse, g, axis_name,
                                 causal, groups)
-    return dq, dk, dv, jnp.zeros_like(km)
+    return dq, dk, dv, None if km is None else jnp.zeros_like(km)
 
 
 _ring_attn.defvjp(_ring_attn_fwd, _ring_attn_bwd)
+
+
+def _fold_dispatch(attn_fn, q, k, v, mask, mesh, axis_name):
+    """Shared [B,T,H,D] → ring dispatch: GQA head-count check, head
+    folding to [B·H, T_loc, D], key-mask folding to [B·Hkv, T_loc]
+    (None stays None — no mask tensor enters the ring), shard_map over
+    ``axis_name``. ``attn_fn(qf, kf, vf, km, groups)`` runs on the
+    per-device folded blocks."""
+    def local(q, k, v, kmask):
+        b, t, h, d = q.shape
+        h_kv = k.shape[2]
+        if h % h_kv:
+            raise ValueError(f"q heads ({h}) not divisible by kv "
+                             f"heads ({h_kv})")
+        fold = lambda x: x.transpose(0, 2, 1, 3).reshape(
+            b * x.shape[2], t, d)
+        km = (None if kmask is None
+              else jnp.repeat(kmask.astype(jnp.float32), h_kv, axis=0))
+        o = attn_fn(fold(q), fold(k), fold(v), km, h // h_kv)
+        return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+    spec = P(None, axis_name, None, None)
+    if mask is None:
+        fn = shard_map(lambda q, k, v: local(q, k, v, None), mesh=mesh,
+                       in_specs=(spec, spec, spec), out_specs=spec)
+        return fn(q, k, v)
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(spec, spec, spec, P(None, axis_name)),
+                   out_specs=spec)
+    return fn(q, k, v, mask)
 
 
 def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
@@ -159,31 +193,10 @@ def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
     ``q`` (H divisible by Hkv) — only the small kv rotates over ICI,
     expanded to the query heads at each flash call.
     """
-    def local(q, k, v, kmask):
-        b, t, h, d = q.shape
-        h_kv = k.shape[2]
-        if h % h_kv:
-            raise ValueError(f"q heads ({h}) not divisible by kv "
-                             f"heads ({h_kv})")
-        fold = lambda x: x.transpose(0, 2, 1, 3).reshape(
-            b * x.shape[2], t, d)
-        km = (lax.pcast(jnp.ones((b, t), jnp.float32), (axis_name,),
-                        to="varying")
-              if kmask is None else kmask.astype(jnp.float32))
-        km = jnp.repeat(km, h_kv, axis=0)
-        o = _ring_attn(fold(q), fold(k), fold(v), km, axis_name,
-                       causal, h // h_kv)
-        return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
-
-    spec = P(None, axis_name, None, None)
-    mspec = P(None, axis_name)
-    if mask is None:
-        fn = shard_map(lambda q, k, v: local(q, k, v, None), mesh=mesh,
-                       in_specs=(spec, spec, spec), out_specs=spec)
-        return fn(q, k, v)
-    fn = shard_map(local, mesh=mesh,
-                   in_specs=(spec, spec, spec, mspec), out_specs=spec)
-    return fn(q, k, v, mask)
+    return _fold_dispatch(
+        lambda qf, kf, vf, km, groups: _ring_attn(
+            qf, kf, vf, km, axis_name, causal, groups),
+        q, k, v, mask, mesh, axis_name)
 
 
 # Ulysses all-to-all SP lives in parallel/ulysses.py; this alias
@@ -243,12 +256,14 @@ def _zz_merge_half(out, lse, o_b, lse_b, qi, c):
     return out.at[:, sl].set(o_new), lse.at[:, sl].set(l_new)
 
 
-def _zz_fwd_impl(q, k, v, axis_name, groups):
-    """q: [B·H, 2c, D]; k,v: [B·Hkv, 2c, D] in zigzag layout (GQA:
-    only the small kv rotates). Causal only."""
+def _zz_fwd_impl(q, k, v, km, axis_name, groups):
+    """q: [B·H, 2c, D]; k,v: [B·Hkv, 2c, D], km: [B·Hkv, 2c] or None,
+    all in zigzag layout (GQA: only the small kv — and its mask —
+    rotates; km=None rotates nothing extra). Causal only."""
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     c = q.shape[1] // 2
+    has_km = km is not None
     vary = lambda x: lax.pcast(x, (axis_name,), to="varying")
     out0 = vary(jnp.zeros(q.shape, jnp.float32))
     lse0 = vary(jnp.full(q.shape[:2] + (1,), -jnp.inf, jnp.float32))
@@ -256,30 +271,34 @@ def _zz_fwd_impl(q, k, v, axis_name, groups):
     qh = (q[:, :c], q[:, c:])
 
     def body(i, carry):
-        out, lse, k_cur, v_cur = carry
+        out, lse, k_cur, v_cur = carry[:4]
+        km_cur = carry[4] if has_km else None
         src = jnp.mod(my - i, n)
         k_ids = (src, 2 * n - 1 - src)
         for qi in (0, 1):
             for ki in (0, 1):
+                ks = slice(ki * c, (ki + 1) * c)
                 offs = jnp.stack([q_ids[qi] * c,
                                   k_ids[ki] * c]).astype(jnp.int32)
                 o_b, lse_b = flash_block_fwd(
-                    qh[qi], k_cur[:, ki * c:(ki + 1) * c],
-                    v_cur[:, ki * c:(ki + 1) * c], None, offs, True,
-                    groups=groups)
+                    qh[qi], k_cur[:, ks], v_cur[:, ks],
+                    None if km_cur is None else km_cur[:, ks],
+                    offs, True, groups=groups)
                 out, lse = _zz_merge_half(out, lse, o_b, lse_b, qi, c)
-        perm = _ring_perm(n)
-        return (out, lse, lax.ppermute(k_cur, axis_name, perm),
-                lax.ppermute(v_cur, axis_name, perm))
+        pp = lambda x: lax.ppermute(x, axis_name, _ring_perm(n))
+        return (out, lse, pp(k_cur), pp(v_cur)) + (
+            (pp(km_cur),) if has_km else ())
 
-    out, lse, _, _ = lax.fori_loop(0, n, body, (out0, lse0, k, v))
-    return out.astype(q.dtype), lse
+    init = (out0, lse0, k, v) + ((km,) if has_km else ())
+    res = lax.fori_loop(0, n, body, init)
+    return res[0].astype(q.dtype), res[1]
 
 
-def _zz_bwd_impl(q, k, v, out, lse, g, axis_name, groups):
+def _zz_bwd_impl(q, k, v, km, out, lse, g, axis_name, groups):
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     c = q.shape[1] // 2
+    has_km = km is not None
     zero = lambda x: lax.pcast(jnp.zeros(x.shape, jnp.float32),
                                (axis_name,), to="varying")
     q_ids = (my, 2 * n - 1 - my)
@@ -289,7 +308,8 @@ def _zz_bwd_impl(q, k, v, out, lse, g, axis_name, groups):
     gh = (g[:, :c], g[:, c:])
 
     def body(i, carry):
-        dq, dk_acc, dv_acc, k_cur, v_cur = carry
+        dq, dk_acc, dv_acc, k_cur, v_cur = carry[:5]
+        km_cur = carry[5] if has_km else None
         src = jnp.mod(my - i, n)
         k_ids = (src, 2 * n - 1 - src)
         for qi in (0, 1):
@@ -299,40 +319,48 @@ def _zz_bwd_impl(q, k, v, out, lse, g, axis_name, groups):
                                   k_ids[ki] * c]).astype(jnp.int32)
                 dq_b, dk_b, dv_b = flash_block_bwd(
                     qh[qi], k_cur[:, ks], v_cur[:, ks], outh[qi],
-                    lseh[qi], gh[qi], None, offs, True, groups=groups)
+                    lseh[qi], gh[qi],
+                    None if km_cur is None else km_cur[:, ks],
+                    offs, True, groups=groups)
                 qs = slice(qi * c, (qi + 1) * c)
                 dq = dq.at[:, qs].add(dq_b.astype(jnp.float32))
                 dk_acc = dk_acc.at[:, ks].add(dk_b.astype(jnp.float32))
                 dv_acc = dv_acc.at[:, ks].add(dv_b.astype(jnp.float32))
-        perm = _ring_perm(n)
-        pp = lambda x: lax.ppermute(x, axis_name, perm)
-        return dq, pp(dk_acc), pp(dv_acc), pp(k_cur), pp(v_cur)
+        pp = lambda x: lax.ppermute(x, axis_name, _ring_perm(n))
+        return (dq, pp(dk_acc), pp(dv_acc), pp(k_cur), pp(v_cur)) + (
+            (pp(km_cur),) if has_km else ())
 
-    dq, dk, dv, _, _ = lax.fori_loop(
-        0, n, body, (zero(q), zero(k), zero(v), k, v))
+    init = (zero(q), zero(k), zero(v), k, v) + (
+        (km,) if has_km else ())
+    res = lax.fori_loop(0, n, body, init)
+    dq, dk, dv = res[0], res[1], res[2]
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _zz_ring_attn(q, k, v, axis_name, groups=1):
-    out, _ = _zz_fwd_impl(q, k, v, axis_name, groups)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _zz_ring_attn(q, k, v, km, axis_name, groups=1):
+    out, _ = _zz_fwd_impl(q, k, v, km, axis_name, groups)
     return out
 
 
-def _zz_ring_attn_fwd(q, k, v, axis_name, groups):
-    out, lse = _zz_fwd_impl(q, k, v, axis_name, groups)
-    return out, (q, k, v, out, lse)
+def _zz_ring_attn_fwd(q, k, v, km, axis_name, groups):
+    out, lse = _zz_fwd_impl(q, k, v, km, axis_name, groups)
+    return out, (q, k, v, km, out, lse)
 
 
 def _zz_ring_attn_bwd(axis_name, groups, res, g):
-    return _zz_bwd_impl(*res, g, axis_name, groups)
+    q, k, v, km, out, lse = res
+    dq, dk, dv = _zz_bwd_impl(q, k, v, km, out, lse, g, axis_name,
+                              groups)
+    return dq, dk, dv, None if km is None else jnp.zeros_like(km)
 
 
 _zz_ring_attn.defvjp(_zz_ring_attn_fwd, _zz_ring_attn_bwd)
 
 
 def zigzag_ring_self_attention(q, k, v, mesh: Mesh,
-                               axis_name: str = "seq"):
+                               axis_name: str = "seq",
+                               mask: Optional[jax.Array] = None):
     """Load-balanced CAUSAL ring attention. Inputs [B, T, H, D] in
     ZIGZAG layout on the T axis (see :func:`zigzag_permute`), sharded
     over ``axis_name``; returns the same layout/sharding.
@@ -342,20 +370,15 @@ def zigzag_ring_self_attention(q, k, v, mesh: Mesh,
     last-ranked device (plain ``ring_self_attention`` with
     ``causal=True`` is correct but its critical path is the device
     holding the final blocks). GQA: k/v may carry fewer heads than q.
-    """
-    def local(q, k, v):
-        b, t, h, d = q.shape
-        h_kv = k.shape[2]
-        if h % h_kv:
-            raise ValueError(f"q heads ({h}) not divisible by kv "
-                             f"heads ({h_kv})")
-        fold = lambda x: x.transpose(0, 2, 1, 3).reshape(
-            b * x.shape[2], t, d)
-        o = _zz_ring_attn(fold(q), fold(k), fold(v), axis_name,
-                          h // h_kv)
-        return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
-    spec = P(None, axis_name, None, None)
-    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=spec)
-    return fn(q, k, v)
+    ``mask``: [B, T] key mask IN ZIGZAG LAYOUT (apply
+    :func:`zigzag_permute` to the sequence-order mask alongside
+    q/k/v), sharded the same way — packed-document / padded causal
+    batches keep the balanced schedule. Masked key positions
+    contribute nothing; rows whose query position is masked produce
+    unspecified output (mask them downstream, as the dense path does).
+    """
+    return _fold_dispatch(
+        lambda qf, kf, vf, km, groups: _zz_ring_attn(
+            qf, kf, vf, km, axis_name, groups),
+        q, k, v, mask, mesh, axis_name)

@@ -440,6 +440,60 @@ def test_zigzag_ring_gradients_match():
                                rtol=2e-4, atol=2e-5)
 
 
+def test_zigzag_ring_masked_matches_dense():
+    """Key-masked zigzag (padded / packed-document causal batch) must
+    equal dense causal+mask — the balanced schedule is not given up
+    when the batch carries padding (VERDICT r3 #5)."""
+    from deeplearning4j_tpu.parallel import (
+        zigzag_permute, zigzag_ring_self_attention, zigzag_unpermute)
+    mesh = make_mesh({"seq": 8})
+    n, (b, t, h, d) = 8, (2, 64, 2, 8)
+    q = jax.random.normal(jax.random.PRNGKey(12), (b, t, h, d))
+    mask = (jnp.arange(t)[None, :]
+            < jnp.asarray([[64], [41]])).astype(jnp.float32)
+    from deeplearning4j_tpu.nn.layers.attention import \
+        scaled_dot_attention
+    want = scaled_dot_attention(q, q, q, mask=mask, causal=True)
+    zz = zigzag_ring_self_attention(
+        zigzag_permute(q, n), zigzag_permute(q, n),
+        zigzag_permute(q, n), mesh,
+        mask=zigzag_permute(mask, n, axis=1))
+    got = zigzag_unpermute(zz, n)
+    valid = np.asarray(mask)[:, :, None, None]
+    np.testing.assert_allclose(np.asarray(want) * valid,
+                               np.asarray(got) * valid,
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_zigzag_ring_masked_gradients_match():
+    from deeplearning4j_tpu.parallel import (
+        zigzag_permute, zigzag_ring_self_attention, zigzag_unpermute)
+    mesh = make_mesh({"seq": 8})
+    n, (b, t, h, d) = 8, (1, 32, 2, 8)
+    q = jax.random.normal(jax.random.PRNGKey(13), (b, t, h, d))
+    co = jax.random.normal(jax.random.PRNGKey(14), (b, t, h, d))
+    mask = (jnp.arange(t)[None, :] < 23).astype(jnp.float32)
+    from deeplearning4j_tpu.nn.layers.attention import \
+        scaled_dot_attention
+    valid = mask[:, :, None, None]
+
+    def loss_zz(x):
+        xz = zigzag_permute(x, n)
+        o = zigzag_ring_self_attention(
+            xz, xz, xz, mesh, mask=zigzag_permute(mask, n, axis=1))
+        return jnp.sum(zigzag_unpermute(o, n) * co * valid)
+
+    def loss_dense(x):
+        return jnp.sum(
+            scaled_dot_attention(x, x, x, mask=mask, causal=True)
+            * co * valid)
+
+    g_zz = jax.grad(loss_zz)(q)
+    g_d = jax.grad(loss_dense)(q)
+    np.testing.assert_allclose(np.asarray(g_zz), np.asarray(g_d),
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_zigzag_permute_roundtrip():
     from deeplearning4j_tpu.parallel import (zigzag_permute,
                                              zigzag_unpermute)
@@ -466,6 +520,31 @@ def test_sequence_parallel_layer_api(mode):
     with distributed_context(mesh):
         dist, _ = layer.apply(params, {}, x)
     np.testing.assert_allclose(np.asarray(local), np.asarray(dist),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("mode", ["ring", "zigzag_ring"])
+def test_sequence_parallel_layer_api_masked(mode):
+    """Padded batches through the layer API: the key mask reaches the
+    distributed attention (zigzag included — VERDICT r3 #5) and the
+    result matches local masked attention on valid positions."""
+    from deeplearning4j_tpu.parallel import (distributed_context,
+                                             make_mesh)
+    from deeplearning4j_tpu.nn.layers import MultiHeadAttention
+    mesh = make_mesh({"seq": 8})
+    t = 32
+    layer = MultiHeadAttention(n_in=16, n_out=16, n_heads=8,
+                               causal=True, sequence_parallel=mode)
+    params, _, _ = layer.init(jax.random.PRNGKey(0), (t, 16))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, t, 16))
+    mask = (jnp.arange(t)[None, :]
+            < jnp.asarray([[t], [21]])).astype(jnp.float32)
+    local, _ = layer.apply(params, {}, x, mask=mask)
+    with distributed_context(mesh):
+        dist, _ = layer.apply(params, {}, x, mask=mask)
+    valid = np.asarray(mask)[:, :, None]
+    np.testing.assert_allclose(np.asarray(local) * valid,
+                               np.asarray(dist) * valid,
                                rtol=2e-4, atol=2e-5)
 
 

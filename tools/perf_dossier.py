@@ -169,7 +169,7 @@ def gpt():
     # serving metric is B·n_new over wall-clock, at B=1 and B=32.
     # Median-of-3 timed runs after compile.
     t0_len, n_new = (8, 8) if SMOKE else (1024, 128)
-    decode_rows = []
+    decode = {}
     for db in ((1, 2) if SMOKE else (1, 32)):
         prompt = np.asarray(rng.integers(0, 200, (db, t0_len)), np.int32)
         model.generate(net, prompt, n_new=n_new)      # compile
@@ -178,11 +178,16 @@ def gpt():
             tt = time.perf_counter()
             model.generate(net, prompt, n_new=n_new)  # blocks (host out)
             times.append(time.perf_counter() - tt)
-        decode_rows.append(
-            f"B={db}: {db * n_new / sorted(times)[1]:,.0f}")
+        decode[f"B{db}"] = db * n_new / sorted(times)[1]
+    # decode figures ride in the structured payload (BASELINE cfg #6
+    # sets hard bars on them), not just the label
+    extra = {"decode_tok_s": decode, "decode_prompt_len": t0_len,
+             "decode_n_new": n_new}
+    decode_txt = "; ".join(f"B={k[1:]}: {v:,.0f}"
+                           for k, v in decode.items())
     label = (f"causal-LM train b{b} t{t} "
-             f"[decode tok/s @{t0_len}-prompt {'; '.join(decode_rows)}]")
-    return (label, b * t / dt, "tok/s", dt, flops)
+             f"[decode tok/s @{t0_len}-prompt {decode_txt}]")
+    return (label, b * t / dt, "tok/s", dt, flops, extra)
 
 
 def gpt8k():
@@ -356,7 +361,8 @@ def main(names):
                 "step_s": r[3], "flops": r[4],
                 "tflops": r[4] / r[3] / 1e12,
                 "mfu_pct": 100 * r[4] / r[3] / 1e12 / PEAK_TFLOPS_BF16,
-                "smoke": SMOKE} for r in rows]
+                "smoke": SMOKE,
+                **(r[5] if len(r) > 5 else {})} for r in rows]
     if out_path:
         Path(out_path).write_text(json.dumps(payload, indent=1))
     if SMOKE:
@@ -364,12 +370,12 @@ def main(names):
               "real configs but shapes were tiny. NOT for BASELINE.md.")
         print("| Config | Step |")
         print("|---|---|")
-        for label, thr, unit, dt, flops in rows:
+        for label, thr, unit, dt, flops, *_ in rows:
             print(f"| {label} (smoke) | {dt*1e3:.1f} ms |")
     else:
         print("\n| Config | Throughput | Step | TFLOP/s | MFU |")
         print("|---|---|---|---|---|")
-        for label, thr, unit, dt, flops in rows:
+        for label, thr, unit, dt, flops, *_ in rows:
             tflops = flops / dt / 1e12
             mfu = 100 * tflops / PEAK_TFLOPS_BF16
             print(f"| {label} | {thr:,.0f} {unit} | {dt*1e3:.1f} ms | "

@@ -60,6 +60,12 @@ _register("DL4J_TPU_NPROC", None, int,
 _register("DL4J_TPU_PROC_ID", None, int,
           "this process's rank in the multi-host job")
 
+# -- kernels ---------------------------------------------------------------
+_register("DL4J_TPU_FLASH_MIN_T", 1024, int,
+          "key-sequence length at/above which scaled_dot_attention "
+          "dispatches to the Pallas flash kernel on TPU (crossover "
+          "measured on v5e, tools/flash_crossover.py)")
+
 # -- UI / examples ---------------------------------------------------------
 _register("DL4J_TPU_UI_PORT", 9000, int,
           "training dashboard HTTP port (DL4JSystemProperties UI port)")

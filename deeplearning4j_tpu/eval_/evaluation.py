@@ -71,16 +71,23 @@ class Evaluation:
         """Fold another Evaluation's sufficient statistics into this one
         (reference ``org.nd4j.evaluation.IEvaluation#merge`` — the
         cross-shard reduction used by distributed evaluation)."""
+        # an explicitly pinned n_classes must agree even when either
+        # side saw no data yet (confusion None but n_classes set) —
+        # the check must not depend on merge direction
+        if (self.n_classes is not None and other.n_classes is not None
+                and self.n_classes != other.n_classes):
+            raise ValueError(
+                f"merge: class-count mismatch {self.n_classes} vs "
+                f"{other.n_classes}")
         if other.confusion is None:
+            # adopt an explicit pin from an empty shard so it still
+            # gates later merges into this accumulator
+            self.n_classes = self.n_classes or other.n_classes
             return self
         if self.confusion is None:
             self.n_classes = other.n_classes
             self.confusion = other.confusion.copy()
         else:
-            if self.n_classes != other.n_classes:
-                raise ValueError(
-                    f"merge: class-count mismatch {self.n_classes} vs "
-                    f"{other.n_classes}")
             self.confusion += other.confusion
         self.top_n_correct += other.top_n_correct
         self.count += other.count

@@ -6,8 +6,10 @@
 ``tf_import``     — frozen TensorFlow GraphDef → SameDiff graph
                     (reference samediff-import-tensorflow ImportGraph).
 """
-from deeplearning4j_tpu.modelimport.keras_import import KerasModelImport
+from deeplearning4j_tpu.modelimport.keras_import import (
+    KerasModelImport, register_keras_layer, unregister_keras_layer)
 from deeplearning4j_tpu.modelimport.tf_import import (TFImporter,
                                                       import_frozen_graph)
 
-__all__ = ["KerasModelImport", "TFImporter", "import_frozen_graph"]
+__all__ = ["KerasModelImport", "TFImporter", "import_frozen_graph",
+           "register_keras_layer", "unregister_keras_layer"]

@@ -206,12 +206,54 @@ def _input_type_for(shape: Tuple[Optional[int], ...]) -> InputType:
 
 
 # ---------------------------------------------------------------------------
+# custom-layer SPI (reference: KerasLayer.registerCustomLayer /
+# KerasLayerUtils customLayers map, SURVEY §2.3 — the hook that lets a
+# model with user-defined Keras layers import at all)
+
+_CUSTOM_LAYER_HANDLERS: Dict[str, Tuple[Any, Any]] = {}
+
+
+def register_keras_layer(class_name: str, layer_fn,
+                         weights_fn=None) -> None:
+    """Register an import handler for a Keras layer class the built-in
+    mappers don't know.
+
+    ``layer_fn(cfg: dict) -> Layer`` receives the layer's Keras config
+    dict and returns any of this framework's layers (a built-in, or a
+    ``SameDiffLayer`` subclass for fully custom math).
+
+    ``weights_fn(layer, cfg, weights: List[np.ndarray]) ->
+    (params, state)`` optionally maps the saved Keras weight arrays
+    onto the returned layer's param structure; omit it for layers whose
+    weights follow a built-in layout (the standard ``_map_weights``
+    rules apply) or that carry no weights.
+    """
+    _CUSTOM_LAYER_HANDLERS[class_name] = (layer_fn, weights_fn)
+
+
+def unregister_keras_layer(class_name: str) -> None:
+    _CUSTOM_LAYER_HANDLERS.pop(class_name, None)
+
+
+# ---------------------------------------------------------------------------
 # per-layer config mappers: keras config dict -> our Layer (or None = skip)
 
 
 def _map_layer(class_name: str, cfg: dict):
     """Returns (layer_or_None, follow_up_layer_or_None)."""
     cn = class_name
+    # Keras 3 saves registered custom classes as "package>ClassName";
+    # handlers may be registered under either form
+    handler = (_CUSTOM_LAYER_HANDLERS.get(cn)
+               or _CUSTOM_LAYER_HANDLERS.get(cn.rsplit(">", 1)[-1]))
+    if handler is not None:
+        layer_fn, weights_fn = handler
+        layer = layer_fn(cfg)
+        if weights_fn is not None:
+            # dataclass layers accept ad-hoc attributes; _map_weights
+            # checks this marker before its isinstance chain
+            layer._keras_custom_weights_fn = weights_fn
+        return layer, None
     if cn in ("InputLayer", "Flatten", "Reshape"):
         # Flatten is absorbed by our Dense auto-flattening; InputLayer
         # contributes only the InputType.
@@ -514,7 +556,11 @@ def _map_layer(class_name: str, cfg: dict):
         # identity at inference, like every dropout flavor
         return DropoutLayer(name=cfg.get("name"),
                             dropout=cfg.get("rate", 0.5)), None
-    raise ValueError(f"unsupported Keras layer class {class_name!r}")
+    raise ValueError(
+        f"unsupported Keras layer class {class_name!r} — for custom "
+        f"layers, register an import handler first: "
+        f"modelimport.register_keras_layer({class_name!r}, "
+        f"layer_fn, weights_fn)")
 
 
 #: every Keras layer class ``_map_layer`` (plus the functional-model
@@ -572,6 +618,9 @@ def _perm_gates(w: np.ndarray, order: List[int], h: int) -> np.ndarray:
 
 def _map_weights(layer, kcfg: dict, w: List[np.ndarray]):
     """Returns (params, state) matching our layer's init() structure."""
+    custom_wf = getattr(layer, "_keras_custom_weights_fn", None)
+    if custom_wf is not None:
+        return custom_wf(layer, kcfg, w)
     if isinstance(layer, (LastTimeStep, TimeDistributed)):
         return _map_weights(layer.underlying, kcfg, w)
     if isinstance(layer, Bidirectional):

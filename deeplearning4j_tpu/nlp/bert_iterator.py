@@ -212,10 +212,15 @@ class BertIterator:
                     else:
                         text, text_b = item, None
                 ids[j], segs[j], _ = self._encode_fixed(text, text_b)
+            # [PAD] keys must not be attended (upstream BertIterator
+            # emits an input mask alongside tokens/segments); one mask
+            # per graph input, threaded to attention as the key mask
+            pad_mask = (ids != v[PAD]).astype(np.float32)
             if self.task == "seq_classification":
                 y = np.eye(self.num_classes,
                            dtype=np.float32)[labels_cls]
-                yield MultiDataSet([ids, segs], [y])
+                yield MultiDataSet([ids, segs], [y],
+                                   features_masks=[pad_mask, pad_mask])
                 continue
             # masked LM: select, corrupt 80/10/10, score selected only
             selectable = ~np.isin(ids, list(special_ids))
@@ -241,6 +246,7 @@ class BertIterator:
             else:
                 y = ids.astype(np.int32)
             yield MultiDataSet([corrupted, segs], [y],
+                               features_masks=[pad_mask, pad_mask],
                                labels_masks=[lmask])
 
 

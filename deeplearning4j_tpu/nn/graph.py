@@ -153,6 +153,17 @@ class GraphBuilder:
                 g.grad_norm_threshold_ if g else 1.0))
 
 
+def _group_sig(xs, ys, fms, lms):
+    """Grouping key for the scanned device loop: batches scan together
+    only when every array shape and the mask structure match."""
+    arrs = (list(xs) + list(ys)
+            + [m for m in (fms or []) if m is not None]
+            + [m for m in (lms or []) if m is not None])
+    return (tuple(m is not None for m in (fms or [])),
+            tuple(m is not None for m in (lms or [])),
+            [np.shape(a) for a in arrs])
+
+
 def _toposort(nodes: List[_Node], inputs: List[str]) -> List[_Node]:
     done = set(inputs)
     ordered: List[_Node] = []
@@ -380,19 +391,23 @@ class ComputationGraph:
         through the runtime costs ~10ms of host/dispatch latency that a
         per-batch ``fit`` pays per step; the scanned loop pays it once
         per K steps. Numerically identical to K sequential steps: the
-        per-iteration rng keys are precomputed and scanned over."""
+        per-iteration rng keys are precomputed and scanned over.
+        Masked batches scan too — the mask stacks are (possibly empty)
+        dicts, so each mask structure gets its own trace."""
         def one(carry, batch):
             params, opt_state, state = carry
-            inputs, labels, rng = batch
+            inputs, labels, masks, lmasks, rng = batch
             params, opt_state, new_state, loss = self._update(
-                params, opt_state, state, inputs, labels, {}, {}, rng)
+                params, opt_state, state, inputs, labels, masks,
+                lmasks, rng)
             return (params, opt_state, new_state), loss
 
         def loop(params, opt_state, state, inputs_stack, labels_stack,
-                 rng_stack):
+                 masks_stack, lmasks_stack, rng_stack):
             (p, o, s), losses = jax.lax.scan(
                 one, (params, opt_state, state),
-                (inputs_stack, labels_stack, rng_stack))
+                (inputs_stack, labels_stack, masks_stack, lmasks_stack,
+                 rng_stack))
             return p, o, s, losses
 
         return jax.jit(loop, donate_argnums=(0, 1, 2))
@@ -413,24 +428,34 @@ class ComputationGraph:
             self._output_fn = None
 
     def _fit_group(self, group):
-        """Run a group of uniformly-shaped mask-free batches in one
-        scanned call (see ``_make_train_loop``)."""
+        """Run a group of uniformly-shaped batches (same mask
+        structure) in one scanned call (see ``_make_train_loop``)."""
         self._refresh_ambient_trace()
         if self._train_loop_fn is None:
             self._train_loop_fn = self._make_train_loop()
-        inputs = {n: jnp.stack([jnp.asarray(np.asarray(xs[i]))
-                                for xs, _ in group])
+        inputs = {n: jnp.stack([jnp.asarray(np.asarray(item[0][i]))
+                                for item in group])
                   for i, n in enumerate(self.conf.inputs)}
-        labels = [jnp.stack([jnp.asarray(np.asarray(ys[j]))
-                             for _, ys in group])
+        labels = [jnp.stack([jnp.asarray(np.asarray(item[1][j]))
+                             for item in group])
                   for j in range(len(group[0][1]))]
+        fms0, lms0 = group[0][2], group[0][3]
+        masks = {n: jnp.stack([jnp.asarray(np.asarray(item[2][i]))
+                               for item in group])
+                 for i, n in enumerate(self.conf.inputs)
+                 if fms0 and i < len(fms0) and fms0[i] is not None}
+        lmasks = {n: jnp.stack([jnp.asarray(np.asarray(item[3][j]))
+                                for item in group])
+                  for j, n in enumerate(self.conf.outputs)
+                  if lms0 and j < len(lms0) and lms0[j] is not None}
         base = jax.random.PRNGKey(self.conf.seed)
         rngs = jnp.stack([jax.random.fold_in(base, self.iteration + i)
                           for i in range(len(group))])
         try:
             self.params, self.opt_state, self.state, losses = \
                 self._train_loop_fn(self.params, self.opt_state,
-                                    self.state, inputs, labels, rngs)
+                                    self.state, inputs, labels, masks,
+                                    lmasks, rngs)
         except Exception as e:       # HBM OOM → diagnostic dump
             from deeplearning4j_tpu.utils import crashreport
             if crashreport.is_oom(e):
@@ -471,6 +496,7 @@ class ComputationGraph:
             if hasattr(it, "reset"):
                 it.reset()
             group: list = []
+            prev_sig = None
             for mds in it:
                 if hasattr(mds, "features"):
                     xs = (mds.features
@@ -485,15 +511,16 @@ class ComputationGraph:
                     xs = xs if isinstance(xs, list) else [xs]
                     ys = ys if isinstance(ys, list) else [ys]
                     fms = lms = None
-                if steps_per_loop > 1 and not fms and not lms:
-                    # group uniformly-shaped batches into one scanned
-                    # device loop; shape change flushes the group
-                    if group and any(
-                            np.shape(a) != np.shape(b)
-                            for a, b in zip(group[-1][0] + group[-1][1],
-                                            xs + ys)):
+                if steps_per_loop > 1:
+                    # group uniformly-shaped batches (masks included —
+                    # masked BERT batches keep the device loop) into
+                    # one scanned call; a shape or mask-structure
+                    # change flushes the group
+                    sig = _group_sig(xs, ys, fms, lms)
+                    if group and sig != prev_sig:
                         self._flush_group(group)
-                    group.append((xs, ys))
+                    group.append((xs, ys, fms, lms))
+                    prev_sig = sig
                     if len(group) == steps_per_loop:
                         self._flush_group(group)
                 else:

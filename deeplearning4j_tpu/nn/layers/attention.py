@@ -68,21 +68,40 @@ def repeat_kv_heads(k, n_heads: int):
     return jnp.repeat(k, n_heads // n_kv, axis=2)
 
 
+def _use_flash(q, k, causal: bool = False) -> bool:
+    """Platform-helper gate: route to the Pallas flash kernel when the
+    KEY sequence is long enough for the blockwise kernel to win (O(Tk)
+    memory, skipped dead blocks), including cross-attention — Tq may
+    differ. Tiny-Tq shapes (a scan step's single query, learned-query
+    pooling) stay on the einsum: their score tile is already O(Tk) and
+    the kernel would pad Tq to a full 128-row MXU block per launch.
+    Causal with Tq > Tk stays on the einsum too: its leading Tq−Tk
+    rows have NO live keys, and the two paths define that degenerate
+    row differently (kernel: zeros; einsum: uniform average).
+    Threshold via DL4J_TPU_FLASH_MIN_T (crossover measured on v5e,
+    tools/flash_crossover.py)."""
+    from deeplearning4j_tpu.environment import get_flag
+    return (k.shape[1] >= get_flag("DL4J_TPU_FLASH_MIN_T")
+            and q.shape[1] >= 128
+            and not (causal and q.shape[1] > k.shape[1])
+            and q.dtype != jnp.float64
+            and jax.default_backend() == "tpu")
+
+
 def scaled_dot_attention(q, k, v, mask=None, causal=False):
     """q,k,v: [B, T, H, D] (head axis 2); ``k``/``v`` may carry fewer
-    heads (GQA). mask: [B, Tk] key mask.
+    heads (GQA); Tq and Tk may differ (causal is then END-ALIGNED:
+    query i attends keys ≤ i + Tk − Tq). mask: [B, Tk] key mask.
 
     Explicit einsum+softmax (not jax.nn.dot_product_attention, which is
     not exact in float64 — breaks gradient checking). Platform-helper
     dispatch (the reference's cuDNN-helper pattern, SURVEY §2.3): on
-    TPU with long sequences the Pallas flash kernel is used instead —
-    O(T) memory, 1.2-1.7x faster than the einsum at T>=4k, and
+    TPU with long key sequences the Pallas flash kernel is used instead
+    — O(Tk) memory, 1.2-1.7x faster than the einsum at T>=4k, and
     GQA-native (one kv block read per head group).
     """
     d = q.shape[-1]
-    if (q.shape[1] >= 1024 and q.shape[1] == k.shape[1]
-            and q.dtype != jnp.float64
-            and jax.default_backend() == "tpu"):
+    if _use_flash(q, k, causal):
         # masked sequences take the flash path too (per-example key
         # mask operand in the kernel) — every padded-batch NLP workload
         # stays O(T) memory instead of falling back to the [T,T] einsum

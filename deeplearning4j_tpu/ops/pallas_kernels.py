@@ -75,10 +75,14 @@ def _jnp_fallback(*xs) -> bool:
 # ---------------------------------------------------------------------------
 #
 # All kernels take, in addition to q/k/v:
-#  - km_ref: [1, block_k] per-(batch·head) key validity mask block
-#    (1 = attend, 0 = padded key) — the reference cuDNN fused-attention
-#    helper's mask operand analog; blocks whose mask is all-zero are
-#    skipped entirely.
+#  - km_ref: [1, 1, block_k] per-(batch·head) key validity mask block
+#    (1 = attend, 0 = padded key; kernels read km_ref[0, 0]) — the
+#    reference cuDNN fused-attention helper's mask operand analog;
+#    blocks whose mask is all-zero are skipped entirely. The mask
+#    rides as [BHkv, 1, Tk]: Mosaic requires a block's last two dims
+#    be (8, 128)-divisible OR equal to the array dims, and the unit
+#    sublane axis satisfies that at zero memory cost (a 2-D
+#    [BHkv, Tk] operand with (1, block_k) blocks does NOT lower).
 #  - off_ref: SMEM int32 [2] = (q_offset, k_offset) GLOBAL position
 #    offsets used for causal masking. (0, 0) for single-device
 #    attention; ring attention passes (my_idx·Tq, src_idx·Tk) so the
@@ -168,6 +172,13 @@ def _flash_blocks(tq_real: int, tk_real: int, d: int, block_q: int,
     k128 = -(-tk_real // 128) * 128
     block_q = min(block_q, q128)              # don't block past the data
     block_k = min(block_k, k128)
+    if not _interpret():
+        # Mosaic: the km operand's LANE dim is block_k, which must be
+        # a multiple of 128 (or span the whole padded array) — clamp
+        # caller-tuned sub-128 block_k up on real hardware (interpret
+        # mode keeps small blocks so CPU tests exercise multi-block
+        # grids at small T)
+        block_k = min(-(-block_k // 128) * 128, k128)
     tq = -(-tq_real // block_q) * block_q     # q and kv padded separately
     tk = -(-tk_real // block_k) * block_k     # (≤ one partial block each)
     dp = max(-(-d // 128) * 128, 128)         # lane-align head dim
@@ -588,23 +599,29 @@ def flash_block_bwd(q, k, v, out, lse, g, km=None, offs=None,
                       block_q, block_k, groups=groups)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, km, causal, block_q, block_k, groups=1):
-    return _flash_fwd(q, k, v, km, None, causal, block_q, block_k,
-                      groups=groups)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, km, causal, block_q, block_k, groups=1, q_off=0):
+    return _flash_fwd(q, k, v, km, _static_offs(q_off), causal,
+                      block_q, block_k, groups=groups)
 
 
-def _flash_vjp_fwd(q, k, v, km, causal, block_q, block_k, groups):
-    out, lse = _flash_fwd(q, k, v, km, None, causal, block_q, block_k,
-                          return_lse=True, groups=groups)
+def _static_offs(q_off: int):
+    return None if q_off == 0 else jnp.asarray([q_off, 0], jnp.int32)
+
+
+def _flash_vjp_fwd(q, k, v, km, causal, block_q, block_k, groups,
+                   q_off):
+    out, lse = _flash_fwd(q, k, v, km, _static_offs(q_off), causal,
+                          block_q, block_k, return_lse=True,
+                          groups=groups)
     return out, (q, k, v, km, out, lse)
 
 
-def _flash_vjp_bwd(causal, block_q, block_k, groups, res, g):
+def _flash_vjp_bwd(causal, block_q, block_k, groups, q_off, res, g):
     q, k, v, km, out, lse = res
     dkm = None if km is None else jnp.zeros_like(km)
-    return _flash_bwd(q, k, v, out, lse, g, km, None, causal,
-                      block_q, block_k, groups=groups) + (dkm,)
+    return _flash_bwd(q, k, v, out, lse, g, km, _static_offs(q_off),
+                      causal, block_q, block_k, groups=groups) + (dkm,)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -617,7 +634,14 @@ def flash_attention(q, k, v, causal: bool = False,
     ``scaled_dot_attention``; ``mask``: optional [B, Tk] key mask.
     ``k``/``v`` may carry FEWER heads than ``q`` (grouped-query
     attention, H divisible by Hkv) — the kernels read the shared kv
-    block per head group directly, no broadcast in HBM.
+    block per head group directly, no broadcast in HBM. Tq and Tk may
+    differ (cross-attention / short-query-long-key); causal then masks
+    against the END-ALIGNED diagonal (query row i attends keys
+    ≤ i + Tk − Tq, matching the dense path's ``tril(..., Tk − Tq)``)
+    — for valid rows: with Tq > Tk the leading Tq − Tk rows have NO
+    live keys and the paths diverge there (kernel: zeros; einsum:
+    uniform average), which is why ``_use_flash`` refuses causal
+    Tq > Tk; mask such rows downstream if you call this directly.
     Differentiable: the backward is a pair of Pallas kernels (dQ;
     dK/dV) that recompute the probability tile per block from the
     saved logsumexp — FlashAttention-2 style, no [T,T] materialisation
@@ -634,7 +658,7 @@ def flash_attention(q, k, v, causal: bool = False,
         # per-example key mask → per-(batch·kv-head) rows
         km = jnp.repeat(mask.astype(jnp.float32), h_kv, axis=0)
     o = _flash(fold(q), fold(k), fold(v), km, causal, block_q, block_k,
-               h // h_kv)
+               h // h_kv, k.shape[1] - t if causal else 0)
     return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 

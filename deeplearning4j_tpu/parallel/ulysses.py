@@ -15,11 +15,11 @@ ICI) versus ring attention's N ppermute rounds; Ulysses wins when the
 head count ≥ mesh size and sequences are long enough that ring-step
 latency dominates.  Memory: activations stay O(T/N) per device outside
 the attention call; *inside* it each device attends over the full
-sequence with H/N heads through ``scaled_dot_attention`` — on TPU with
-long unmasked sequences that takes the Pallas flash path (no [T,T]
-materialisation), but the masked/short/einsum path allocates the
-[B, H/N, T, T] score tile per device.  For extreme sequence lengths
-with masks, prefer ``ring_attention`` (always O(T/N·block) scores).
+sequence with H/N heads through ``scaled_dot_attention`` — on TPU at
+T ≥ DL4J_TPU_FLASH_MIN_T that takes the Pallas flash path, masked or
+not (the kernel carries a per-example key-mask operand), so no [T,T]
+scores are materialised; only sub-threshold sequences use the einsum
+path's [B, H/N, T, T] tile.
 """
 from __future__ import annotations
 

@@ -55,6 +55,7 @@ class ParallelWrapper:
     def __init__(self, net, workers: Optional[int] = None,
                  mode: str = SYNC,
                  averaging_frequency: int = 5,
+                 average_updaters: bool = True,
                  accumulator: Optional[EncodedGradientsAccumulator] = None,
                  mesh: Optional[Mesh] = None,
                  prefetch_buffer: int = 4):
@@ -63,6 +64,10 @@ class ParallelWrapper:
         self.n = int(np.prod(self.mesh.devices.shape))
         self.mode = mode
         self.averaging_frequency = averaging_frequency
+        # reference ParallelWrapper.Builder#averageUpdaters (default
+        # true): AVERAGING mode averages the optimizer moments along
+        # with the params at every averaging round
+        self.average_updaters = average_updaters
         self.accumulator = accumulator or (
             EncodedGradientsAccumulator()
             if mode in (self.ENCODED, self.ASYNC) else None)
@@ -105,6 +110,10 @@ class ParallelWrapper:
 
         def averaging_frequency(self, k):
             self._kw["averaging_frequency"] = k
+            return self
+
+        def average_updaters(self, flag: bool):
+            self._kw["average_updaters"] = flag
             return self
 
         def gradients_accumulator(self, acc):
@@ -219,6 +228,14 @@ class ParallelWrapper:
         mesh = self.mesh
         optimizer = net._optimizer
         k = self.averaging_frequency
+        avg_upd = self.average_updaters
+
+        def pmean_floats(tree):
+            # optimizer state holds non-float leaves too (step counts);
+            # those are replica-identical — average only the moments
+            return jax.tree.map(
+                lambda a: jax.lax.pmean(a, "data")
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
 
         def local_step(params, opt_state, state, x, y, rng, it):
             # strip the leading per-device axis added by the stacking
@@ -230,13 +247,14 @@ class ParallelWrapper:
             params = optax.apply_updates(params, updates)
             params = net._apply_constraints(params)
             # every k-th iteration: replica averaging (reference
-            # ParameterAveraging semantics)
+            # ParameterAveraging semantics; averageUpdaters=true also
+            # averages the optimizer moments)
             do_avg = (it % k) == (k - 1)
-            params = jax.lax.cond(
+            params, opt_state = jax.lax.cond(
                 do_avg,
-                lambda p: jax.tree.map(
-                    lambda a: jax.lax.pmean(a, "data"), p),
-                lambda p: p, params)
+                lambda po: (pmean_floats(po[0]),
+                            pmean_floats(po[1]) if avg_upd else po[1]),
+                lambda po: po, (params, opt_state))
             loss = jax.lax.pmean(loss, "data")
             params = jax.tree.map(lambda a: a[None], params)
             opt_state = jax.tree.map(lambda a: a[None], opt_state)
@@ -406,7 +424,13 @@ class ParallelWrapper:
     def _sync_back(self):
         """After averaging/async-mode training, fold replicas back into
         the wrapped net (reference: ParallelWrapper final params
-        copy)."""
+        copy; averageUpdaters also folds the optimizer moments as the
+        replica mean rather than replica 0's)."""
         p, o = self._dp_state[0], self._dp_state[1]
         self.net.params = jax.tree.map(lambda a: jnp.mean(a, axis=0), p)
-        self.net.opt_state = jax.tree.map(lambda a: a[0], o)
+        if self.mode == self.AVERAGING and self.average_updaters:
+            self.net.opt_state = jax.tree.map(
+                lambda a: jnp.mean(a, axis=0)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a[0], o)
+        else:
+            self.net.opt_state = jax.tree.map(lambda a: a[0], o)

@@ -74,6 +74,12 @@ def test_mask_lm_batch_shapes_and_semantics(tok):
     ids, segs = mds.features
     (y,), (lmask,) = mds.labels, mds.labels_masks
     assert ids.shape == (4, 16) and segs.shape == (4, 16)
+    # input (key) masks: [PAD] positions excluded for BOTH graph inputs
+    # (ADVICE r3 — upstream BertIterator supplies an input mask)
+    fm_tok, fm_seg = mds.features_masks
+    v_pad = tok.vocab["[PAD]"]
+    assert (fm_tok == (ids != v_pad)).all()
+    assert (fm_seg == fm_tok).all()
     assert y.shape == (4, 16, len(tok.vocab))
     v = tok.vocab
     # every row starts with [CLS], has a [SEP], pads with [PAD]
@@ -145,6 +151,17 @@ def test_reset_changes_masking(tok):
     it.reset()
     b = next(iter(it)).features[0]
     assert (a != b).any()          # fresh corruption per epoch
+
+
+def test_seq_classification_emits_pad_mask(tok):
+    data = [(t, i % 2) for i, t in enumerate(CORPUS)]
+    it = BertIterator(tok, data, batch_size=4, seq_len=16,
+                      task="seq_classification", num_classes=2, seed=2)
+    mds = next(iter(it))
+    ids = mds.features[0]
+    fm_tok, fm_seg = mds.features_masks
+    assert (fm_tok == (ids != tok.vocab["[PAD]"])).all()
+    assert (fm_seg == fm_tok).all()
 
 
 def test_seq_classification_batches(tok):

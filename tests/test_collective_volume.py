@@ -1,0 +1,145 @@
+"""Collective-volume CI gates (VERDICT r3 #7): the BASELINE.md wire
+table is enforced, not just documented. Each gate compiles a
+representative distributed step on the virtual 8-device mesh, parses
+the optimized HLO with ``tools.collective_volume``, and asserts the
+collective kinds + byte volumes against the ring-algorithm formulas —
+a sharding regression (lost allreduce, extra all-gather, mask tensor
+rejoining the ring) fails the suite instead of silently drifting a doc.
+
+Reference analog: there is none — the reference never gates wire
+volume; this enforces BASELINE #5's "linear to 32 chips" derisking.
+"""
+import importlib.util
+import pathlib
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices")
+
+_TOOLS = pathlib.Path(__file__).resolve().parent.parent / "tools"
+
+
+def _load_cv():
+    spec = importlib.util.spec_from_file_location(
+        "collective_volume", _TOOLS / "collective_volume.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("collective_volume", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def cv():
+    return _load_cv()
+
+
+def _volumes(cv, jitted, args, kind):
+    colls = cv.collectives_of(jitted.lower(*args).compile())
+    return [w for k, _, w in colls if k == kind], colls
+
+
+def test_dp_resnet_allreduce_matches_ring_formula(cv):
+    """DP ResNet-50: ONE gradient-sync all-reduce family whose total
+    wire volume equals 2·P·(n−1)/n — the minimal ring volume for the
+    fp32 gradient bytes P (BASELINE #5 reading)."""
+    jitted, args = cv.dp_resnet()
+    ar, colls = _volumes(cv, jitted, args, "all-reduce")
+    assert ar, "gradient all-reduce disappeared from the DP step"
+    params = args[0]
+    p_bytes = sum(np.prod(p.shape) * p.dtype.itemsize
+                  for p in jax.tree.leaves(params))
+    want = 2 * p_bytes * 7 / 8
+    got = sum(ar)
+    # small non-gradient allreduces (loss mean, BN stats) ride along;
+    # the gradient sync must dominate and not exceed the formula by
+    # more than a few percent
+    assert want * 0.98 < got < want * 1.05, (got, want)
+    # and nothing else moves: a DP step has no business all-gathering
+    other = [k for k, _, _ in colls
+             if k in ("all-gather", "reduce-scatter", "all-to-all")]
+    assert not other, other
+
+
+def test_dp_broken_sharding_is_caught(cv):
+    """Canary: the same step with the batch REPLICATED (a classic
+    sharding regression — every device computes the full batch) emits
+    no gradient all-reduce, so the formula gate above would fail.
+    Proves the gate detects the regression class it exists for."""
+    broken, args = cv.dp_resnet(sharded=False)
+    ar, _ = _volumes(cv, broken, args, "all-reduce")
+    assert sum(ar) < 1e6   # ~0: the gradient sync is gone
+
+
+def test_tp_mlp_activation_allreduce_only(cv):
+    """TP col→row MLP: activations (not params) allreduce — volume is
+    activation-sized (≪ param bytes), and no collective-permute."""
+    jitted, args = cv.tp_mlp()
+    ar, colls = _volumes(cv, jitted, args, "all-reduce")
+    assert ar
+    params, x = args
+    p_bytes = sum(np.prod(p.shape) * p.dtype.itemsize
+                  for p in jax.tree.leaves(params))
+    act_bytes = np.prod(x.shape) * x.dtype.itemsize
+    got = sum(ar)
+    # well under even 10% of a param sync; within 8x of one activation
+    # allreduce (fwd+bwd, dtype promotion allowed)
+    assert got < 0.1 * p_bytes
+    assert got <= 8 * 2 * act_bytes * 7 / 8, (got, act_bytes)
+    assert not [k for k, _, _ in colls if k == "collective-permute"]
+
+
+def test_sp_ring_volume_and_no_mask_tensor(cv):
+    """SP causal ring fwd+bwd at T=8k: KV blocks + gradient
+    accumulators ride collective-permute for n trips; with no key mask
+    given, NO mask tensor rotates (round 4's km=None threading) — the
+    volume stays within the k/v/dk/dv formula."""
+    jitted, args = cv.sp_ring()
+    cp, colls = _volumes(cv, jitted, args, "collective-permute")
+    assert cp, "ring lost its collective-permutes"
+    (q,) = args
+    b, t, h, d = q.shape
+    n = 8
+    shard_bf16 = b * (t // n) * h * d * 2
+    shard_f32 = 2 * shard_bf16
+    # fwd: k+v; bwd: k+v + dk+dv accumulators (f32); each rotates once
+    # per ring trip × n trips. XLA:CPU promotes bf16 buffers to f32,
+    # so the band spans bf16-preserved (TPU) .. all-f32 (CPU).
+    want_lo = n * (4 * shard_bf16 + 2 * shard_f32)
+    want_hi = n * 6 * shard_f32
+    got = sum(cp)
+    assert want_lo * 0.85 < got < want_hi * 1.1, \
+        (got, want_lo, want_hi)
+
+
+def test_sp_ring_masked_adds_only_mask_bytes(cv):
+    """With a key mask the ring carries ONE extra small tensor: volume
+    grows by ≈ n·(mask shard bytes)·trips and nothing else."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from deeplearning4j_tpu.parallel.ring_attention import \
+        ring_self_attention
+    mesh = make_mesh({"seq": 8})
+    b, t, h, d = 1, 8192, 8, 128
+    q = jnp.zeros((b, t, h, d), jnp.bfloat16)
+    mask = jnp.ones((b, t), jnp.float32)
+
+    def loss(q):
+        return jnp.sum(ring_self_attention(
+            q, q, q, mesh, mask=mask, causal=True)
+            .astype(jnp.float32) ** 2)
+
+    jitted = jax.jit(jax.value_and_grad(loss))
+    cp_m, _ = _volumes(cv, jitted, (q,), "collective-permute")
+    jit_u, args_u = cv.sp_ring()
+    cp_u, _ = _volumes(cv, jit_u, args_u, "collective-permute")
+    n = 8
+    # folded mask is [B·H, T/n] f32 replicated per kv head row
+    mask_bytes = h * (t // n) * 4
+    extra = sum(cp_m) - sum(cp_u)
+    # fwd + bwd each rotate the mask once per trip
+    want_extra = 2 * n * mask_bytes
+    assert 0 < extra <= want_extra * 1.3, (extra, want_extra)

@@ -65,6 +65,33 @@ def test_evaluation_merge_class_mismatch_raises(cls_data):
         a.merge(b)
 
 
+def test_evaluation_merge_pinned_classes_empty_shard_raises(cls_data):
+    """A pinned n_classes must be honoured even before any data lands
+    on this shard (e.g. evaluate(num_classes=...) on a process whose
+    shard was empty) — silent adoption of the other's count hides a
+    config mismatch (ADVICE r3)."""
+    y, p = cls_data
+    other = Evaluation()
+    other.eval(y, p)                       # n_classes from data
+    pinned = Evaluation(n_classes=other.n_classes + 2)
+    with pytest.raises(ValueError):
+        pinned.merge(other)
+    # same pin, matching count: merge proceeds
+    ok = Evaluation(n_classes=other.n_classes)
+    ok.merge(other)
+    assert ok.accuracy() == other.accuracy()
+    # direction-independent: data.merge(pinned-but-empty) raises too
+    with pytest.raises(ValueError):
+        other.merge(Evaluation(n_classes=other.n_classes + 2))
+    # an empty accumulator ADOPTS a pin from an empty shard, so the
+    # pin still gates later merges (tree-reduce order independence)
+    acc = Evaluation()
+    acc.merge(Evaluation(n_classes=other.n_classes + 2))
+    assert acc.n_classes == other.n_classes + 2
+    with pytest.raises(ValueError):
+        acc.merge(other)
+
+
 def test_evaluation_binary_merge(rng):
     y = (rng.random((80, 3)) > 0.5).astype(np.float32)
     p = rng.random((80, 3)).astype(np.float32)

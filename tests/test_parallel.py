@@ -97,6 +97,58 @@ def test_parallel_wrapper_averaging():
     assert (preds == ds.labels.argmax(1)).mean() > 0.85
 
 
+def test_averaging_mode_averages_updater_state():
+    """averageUpdaters=true (reference Builder default): at each
+    averaging round the optimizer MOMENTS are pmean'd with the params,
+    and _sync_back folds the replica mean — not replica 0's moments
+    (VERDICT r3 #9)."""
+    net = _net()
+    w = (ParallelWrapper.builder(net).workers(8)
+         .training_mode(ParallelWrapper.AVERAGING)
+         .averaging_frequency(1).build())
+    assert w.average_updaters        # reference default
+    it = ListDataSetIterator(_toy_data(), batch_size=64)
+    w.fit(it, epochs=1)
+    # frequency=1: every step averaged → replicas agree on moments
+    p_stack, o_stack = w._dp_state
+    for leaf in jax.tree.leaves(o_stack):
+        a = np.asarray(leaf)
+        if np.issubdtype(a.dtype, np.floating):
+            np.testing.assert_allclose(a, np.broadcast_to(a[:1], a.shape),
+                                       rtol=1e-6, atol=1e-7)
+    # and the net got the replica mean
+    for got, stack in zip(jax.tree.leaves(net.opt_state),
+                          jax.tree.leaves(o_stack)):
+        a = np.asarray(stack)
+        want = a.mean(0) if np.issubdtype(a.dtype, np.floating) else a[0]
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_averaging_mode_updaters_opt_out():
+    """average_updaters=False (reference averageUpdaters(false)):
+    moments stay replica-local and _sync_back keeps replica 0's."""
+    net = _net()
+    w = (ParallelWrapper.builder(net).workers(8)
+         .training_mode(ParallelWrapper.AVERAGING)
+         .averaging_frequency(2).average_updaters(False).build())
+    it = ListDataSetIterator(_toy_data(), batch_size=64)
+    w.fit(it, epochs=2)
+    p_stack, o_stack = w._dp_state
+    # shards differ → at least one float moment leaf diverges
+    diverged = any(
+        np.issubdtype(np.asarray(l).dtype, np.floating)
+        and not np.allclose(np.asarray(l),
+                            np.broadcast_to(np.asarray(l)[:1],
+                                            np.asarray(l).shape))
+        for l in jax.tree.leaves(o_stack))
+    assert diverged
+    for got, stack in zip(jax.tree.leaves(net.opt_state),
+                          jax.tree.leaves(o_stack)):
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(stack)[0])
+
+
 def test_parallel_wrapper_encoded():
     net = _net()
     acc = EncodedGradientsAccumulator(

@@ -81,3 +81,66 @@ def test_steps_per_loop_shape_change_flushes():
     net.fit(ListDataSetIterator(data), steps_per_loop=4)
     assert net.iteration == len(data)
     assert np.isfinite(net.score())
+
+
+def _masked_rnn_graph():
+    from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater(upd.Adam(learning_rate=0.02))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("rnn", LSTM(n_out=8), "in")
+            .add_layer("out", RnnOutputLayer(n_out=2,
+                                             activation="softmax",
+                                             loss="mcxent"), "rnn")
+            .set_outputs("out")
+            .set_input_types(**{"in": InputType.recurrent(4, 6)})
+            .build())
+    return ComputationGraph(conf).init()
+
+
+def _masked_mds_batches(n=6, b=8, t=6, seed=3):
+    from deeplearning4j_tpu.data import MultiDataSet
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        x = rng.standard_normal((b, t, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[
+            rng.integers(0, 2, (b, t))]
+        m = (np.arange(t)[None, :]
+             < rng.integers(3, t + 1, (b, 1))).astype(np.float32)
+        out.append(MultiDataSet([x], [y], features_masks=[m],
+                                labels_masks=[m]))
+    return out
+
+
+class _ListIt:
+    def __init__(self, items):
+        self.items = items
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        return iter(self.items)
+
+
+def test_graph_steps_per_loop_groups_masked_batches():
+    """Masked batches must keep the scanned device loop (a BERT
+    fine-tune with pad masks ran per-batch before round 4) — grouped
+    fit equals sequential fit, and no per-batch dispatch happens for
+    full groups."""
+    data = _masked_mds_batches()
+    a, b = _masked_rnn_graph(), _masked_rnn_graph()
+    a.fit(_ListIt(data))
+    per_batch_calls = []
+    orig = b._fit_batch
+    b._fit_batch = lambda *args, **kw: (per_batch_calls.append(1),
+                                        orig(*args, **kw))[1]
+    b.fit(_ListIt(data), steps_per_loop=3)   # 6 batches = 2 groups
+    assert not per_batch_calls, "masked batches fell out of the loop"
+    assert a.iteration == b.iteration == len(data)
+    for la, lb in zip(jax.tree.leaves(a.params),
+                      jax.tree.leaves(b.params)):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=2e-5, atol=2e-6)

@@ -111,9 +111,13 @@ def analyze(name, jitted, args, n_devices=8):
 # ---------------------------------------------------------------------------
 # representative configs (mirror __graft_entry__.dryrun_multichip stages)
 # ---------------------------------------------------------------------------
-def dp_resnet(mesh_devices=8):
+def dp_resnet(mesh_devices=8, sharded=True):
     """DP ResNet-50 sync step: the BASELINE #5 workload. Collective
-    volume = one gradient all-reduce of every parameter."""
+    volume = one gradient all-reduce of every parameter.
+
+    ``sharded=False`` compiles the SAME step with the batch replicated
+    — the classic lost-sharding regression; the CI gate uses it as the
+    detection canary (no gradient all-reduce is emitted)."""
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     import optax
     from deeplearning4j_tpu.zoo import ResNet50
@@ -138,7 +142,9 @@ def dp_resnet(mesh_devices=8):
         params = optax.apply_updates(params, updates)
         return params, opt_state, new_state, loss
 
-    jitted = jax.jit(step, in_shardings=(repl, repl, repl, shard, shard),
+    dshard = shard if sharded else repl
+    jitted = jax.jit(step,
+                     in_shardings=(repl, repl, repl, dshard, dshard),
                      out_shardings=(repl, repl, repl, repl))
     return jitted, (net.params, net.opt_state, net.state, x, y)
 

@@ -77,6 +77,13 @@ class MultiLayerConfiguration:
     # per-layer-index input preprocessors (reference
     # ListBuilder.inputPreProcessor(idx, proc))
     input_preprocessors: dict = field(default_factory=dict)
+    # weight tying: [dst_layer, dst_param, src_layer, src_param,
+    # transpose] entries — the dst param is NOT a master parameter; it
+    # is materialised from src inside every forward (so gradients
+    # accumulate onto src from both uses). The classic use is a causal
+    # LM's tied embedding/output head (GPT-2/LLaMA convention; no
+    # reference analog — its DL4J-era models never tie).
+    tied_weights: List[list] = field(default_factory=list)
 
     def __post_init__(self):
         if self.updater is None:
@@ -101,6 +108,7 @@ class MultiLayerConfiguration:
             "input_preprocessors": {
                 str(i): p.to_dict()
                 for i, p in self.input_preprocessors.items()},
+            "tied_weights": [list(t) for t in self.tied_weights],
         }, indent=2)
 
     @staticmethod
@@ -129,6 +137,7 @@ class MultiLayerConfiguration:
             conf.input_preprocessors = {
                 int(i): preprocessor_from_dict(pd)
                 for i, pd in pp.items()}
+        conf.tied_weights = [list(t) for t in d.get("tied_weights", [])]
         return conf
 
 
@@ -140,6 +149,7 @@ class ListBuilder:
         self._layers: List[Layer] = []
         self._input_type: Optional[InputType] = None
         self._preprocessors: dict = {}
+        self._tied: List[list] = []
 
     def layer(self, *args) -> "ListBuilder":
         """layer(l) or layer(index, l) like the reference."""
@@ -167,6 +177,19 @@ class ListBuilder:
         """Attach an InputPreProcessor before layer ``idx`` (reference
         ListBuilder.inputPreProcessor)."""
         self._preprocessors[idx] = proc
+        return self
+
+    def tie_weights(self, dst_layer: int, dst_param: str,
+                    src_layer: int, src_param: str,
+                    transpose: bool = False) -> "ListBuilder":
+        """Tie layer ``dst_layer``'s ``dst_param`` to ``src_layer``'s
+        ``src_param`` (optionally transposed): the dst param stops
+        being a trainable master parameter and is rebuilt from src in
+        every forward — gradients flow to src from both uses. The
+        embedding/LM-head tie (GPT-2 convention) is the canonical
+        case."""
+        self._tied.append([dst_layer, dst_param, src_layer, src_param,
+                           bool(transpose)])
         return self
 
     def backprop_type(self, t: str) -> "ListBuilder":
@@ -197,6 +220,7 @@ class ListBuilder:
             tbptt_fwd_length=self._g.tbptt_fwd_,
             tbptt_back_length=self._g.tbptt_back_,
             input_preprocessors=dict(self._preprocessors),
+            tied_weights=[list(t) for t in self._tied],
         )
 
 

@@ -303,7 +303,7 @@ class TransformerEncoderBlock(Layer):
     """
     n_in: Optional[int] = None
     n_heads: int = 8
-    ffn_mult: int = 4
+    ffn_mult: float = 4
     causal: bool = False
     sequence_parallel: Optional[str] = None
 
@@ -319,7 +319,7 @@ class TransformerEncoderBlock(Layer):
         pa, _, _ = self._mha.init(ks[0], input_shape, dtype)
         p1, _, _ = self._ln1.init(ks[1], input_shape, dtype)
         p2, _, _ = self._ln2.init(ks[2], input_shape, dtype)
-        hid = f * self.ffn_mult
+        hid = int(round(f * self.ffn_mult))
         params = {"mha": pa, "ln1": p1, "ln2": p2,
                   "W1": wi(ks[3], (f, hid), dtype),
                   "b1": jnp.zeros((hid,), dtype),
@@ -369,7 +369,9 @@ class TransformerDecoderBlock(Layer):
     n_in: Optional[int] = None
     n_heads: int = 8
     n_kv_heads: Optional[int] = None
-    ffn_mult: int = 4
+    # float allowed: 8/3 is the LLaMA convention that makes a SwiGLU
+    # block parameter-match a classic 4x two-matrix MLP
+    ffn_mult: float = 4
     rope_theta: float = 10000.0
     sequence_parallel: Optional[str] = None
     remat: bool = False
@@ -394,7 +396,7 @@ class TransformerDecoderBlock(Layer):
         pa, _, _ = self._mha.init(ks[0], input_shape, dtype)
         p1, _, _ = self._ln1.init(ks[1], input_shape, dtype)
         p2, _, _ = self._ln2.init(ks[2], input_shape, dtype)
-        hid = f * self.ffn_mult
+        hid = int(round(f * self.ffn_mult))
         params = {"mha": pa, "ln1": p1, "ln2": p2,
                   # SwiGLU: (silu(x W_gate) ⊙ x W_up) W_down
                   "Wg": wi(ks[3], (f, hid), dtype),

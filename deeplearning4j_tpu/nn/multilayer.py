@@ -92,8 +92,34 @@ class MultiLayerNetwork:
             self.state[_lname(i)] = s
             self._layer_shapes.append(shape)
         self._output_shape = shape
+        # tied params are NOT master parameters: drop them after init
+        # (shape-checked against their source); _forward rebuilds them
+        for di, dn, si, sn, tr in self.conf.tied_weights:
+            src = self.params[_lname(si)][sn]
+            dst = self.params[_lname(di)].pop(dn)
+            want = src.shape[::-1] if tr else src.shape
+            if tuple(dst.shape) != tuple(want):
+                raise ValueError(
+                    f"tie_weights: layer_{di}.{dn} {dst.shape} != "
+                    f"layer_{si}.{sn}{'(transposed)' if tr else ''} "
+                    f"{want}")
         self._build_optimizer()
         return self
+
+    def _materialize_ties(self, params):
+        """Rebuild tied params from their source inside the traced
+        forward — gradients accumulate onto the source from both
+        uses."""
+        ties = getattr(self.conf, "tied_weights", None)
+        if not ties:
+            return params
+        out = dict(params)
+        for di, dn, si, sn, tr in ties:
+            src = out[_lname(si)][sn]
+            blk = dict(out.get(_lname(di), {}))
+            blk[dn] = src.T if tr else src
+            out[_lname(di)] = blk
+        return out
 
     def _layer_updater(self, layer: Layer):
         u = layer.updater
@@ -136,6 +162,7 @@ class MultiLayerNetwork:
             raise RuntimeError(
                 "Network has no parameters — call init() before "
                 "fit()/output() (reference: MultiLayerNetwork.init()).")
+        params = self._materialize_ties(params)
         new_state = {}
         rnn_states = {}
         n = len(self.layers) if stop_at is None else stop_at
@@ -568,13 +595,14 @@ class MultiLayerNetwork:
         """All layer activations (reference feedForward): list, input
         first."""
         x = jnp.asarray(np.asarray(x))
+        params = self._materialize_ties(self.params)
         acts = [x]
         cur = x
         for i, layer in enumerate(self.layers):
             proc = self.conf.input_preprocessors.get(i)
             if proc is not None:
                 cur = proc.pre_process(cur)
-            cur, _ = layer.apply(self.params[_lname(i)],
+            cur, _ = layer.apply(params[_lname(i)],
                                  self.state[_lname(i)], cur,
                                  train=train, rng=None)
             acts.append(cur)
@@ -582,12 +610,13 @@ class MultiLayerNetwork:
 
     def activate_selected_layers(self, from_: int, to: int, x):
         cur = jnp.asarray(np.asarray(x))
+        params = self._materialize_ties(self.params)
         for i in range(from_, to + 1):
             proc = self.conf.input_preprocessors.get(i)
             if proc is not None:
                 cur = proc.pre_process(cur)
             cur, _ = self.layers[i].apply(
-                self.params[_lname(i)], self.state[_lname(i)], cur,
+                params[_lname(i)], self.state[_lname(i)], cur,
                 train=False, rng=None)
         return cur
 

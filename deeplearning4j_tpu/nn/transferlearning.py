@@ -148,6 +148,15 @@ class TransferLearning:
 
             conf = copy.deepcopy(src.conf)
             conf.layers = layers
+            # tie entries survive ONLY when both endpoints are kept,
+            # un-replaced, un-reinitialized layers; a tie touching a
+            # removed or fresh layer is dropped (the canonical
+            # head-swap on a tied LM gets an ordinary fresh head —
+            # silently re-tying it would shadow its new params)
+            conf.tied_weights = [
+                t for t in getattr(conf, "tied_weights", [])
+                if (t[0] < n_keep and t[2] < n_keep
+                    and t[0] not in reinit and t[2] not in reinit)]
             if self._ftc is not None:
                 self._ftc._apply(conf, layers)
 
@@ -159,6 +168,7 @@ class TransferLearning:
             shape = src._input_shape
             new._input_shape = shape
             new._layer_shapes = []
+            tied_dst = {(t[0], t[1]) for t in conf.tied_weights}
             for i, layer in enumerate(layers):
                 key, sub = jax.random.split(key)
                 p, s, shape = layer.init(sub, shape, dtype)
@@ -166,7 +176,14 @@ class TransferLearning:
                     new.params[_lname(i)] = p
                     new.state[_lname(i)] = s
                 else:
-                    new.params[_lname(i)] = params[_lname(i)]
+                    # trained copies win; fresh leaves fill params the
+                    # source never had as masters (a DROPPED tie's dst
+                    # needs its W back) — but never resurrect a leaf a
+                    # SURVIVING tie still materializes
+                    merged = {k: v for k, v in p.items()
+                              if (i, k) not in tied_dst}
+                    merged.update(params[_lname(i)])
+                    new.params[_lname(i)] = merged
                     new.state[_lname(i)] = state[_lname(i)]
                 new._layer_shapes.append(shape)
             new._output_shape = shape
@@ -201,6 +218,22 @@ class TransferLearningHelper:
         import jax.numpy as jnp
         tail_conf = copy.deepcopy(net.conf)
         tail_conf.layers = net.layers[self._split:]
+        # tie entries are layer-index based: reindex onto the tail; a
+        # tie crossing the frozen/tail boundary has no tail-local
+        # source and cannot be represented
+        retied = []
+        for di, dn, si, sn, tr in getattr(tail_conf, "tied_weights",
+                                          []):
+            if di >= self._split and si >= self._split:
+                retied.append([di - self._split, dn,
+                               si - self._split, sn, tr])
+            elif di >= self._split or si >= self._split:
+                raise ValueError(
+                    f"tie_weights layer_{di}.{dn} <- layer_{si}.{sn} "
+                    f"crosses the frozen/unfrozen split at "
+                    f"{self._split}; freeze through both ends or "
+                    f"neither")
+        tail_conf.tied_weights = retied
         self._tail = MultiLayerNetwork(tail_conf)
         for i in range(self._split, len(net.layers)):
             self._tail.params[_lname(i - self._split)] = \

@@ -629,7 +629,8 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def flash_attention(q, k, v, causal: bool = False,
                     mask: Optional[jax.Array] = None,
-                    block_q: int = 256, block_k: int = 1024):
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None):
     """Blockwise attention, [B, T, H, D] layout (head axis 2) like
     ``scaled_dot_attention``; ``mask``: optional [B, Tk] key mask.
     ``k``/``v`` may carry FEWER heads than ``q`` (grouped-query
@@ -651,6 +652,15 @@ def flash_attention(q, k, v, causal: bool = False,
     if h % h_kv:
         raise ValueError(f"q heads ({h}) not divisible by kv heads "
                          f"({h_kv})")
+    # defaults from the v5e block sweep (tools/flash_crossover.py era,
+    # causal fwd+bwd): big q blocks amortise the backward's kv-side
+    # recompute — (1024, 512) wins ≤4k keys (−28% vs the old 256/1024
+    # at T=2048), (1024, 1024) wins at 8k keys (−16%); larger q blocks
+    # exceed VMEM at T=8k
+    if block_q is None:
+        block_q = 1024
+    if block_k is None:
+        block_k = 512 if k.shape[1] <= 4096 else 1024
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(
         b * x.shape[2], x.shape[1], -1)
     km = None

@@ -356,3 +356,184 @@ def test_generate_top_p_validation(toy_lm):
         with pytest.raises(ValueError, match="top_p"):
             model.generate(net, prompt, n_new=2, temperature=1.0,
                            top_p=bad)
+
+
+def test_tied_embeddings_lm():
+    """tie_embeddings=True: the head W is GONE from the master params
+    (the tie rebuilds it from the embedding in every forward), the
+    model trains (gradients reach the embedding from both uses), KV-
+    cached decode matches the training forward, and the zip round-trip
+    preserves the tie."""
+    model = GPTNano(vocab_size=16, max_len=64, seed=5,
+                    tie_embeddings=True)
+    net = model.init(seq_len=24)
+    head = f"layer_{model.n_layers + 2}"
+    assert "W" not in net.params[head]          # not a master param
+    assert "b" in net.params[head]
+    period = 5
+    tokens = np.arange(24 + 1) % period + 1
+    x = np.tile(tokens[:24], (8, 1)).astype(np.int32)
+    y = np.tile(tokens[1:25], (8, 1)).astype(np.int32)
+    emb0 = np.asarray(net.params["layer_0"]["W"]).copy()
+    s0 = None
+    for _ in range(60):
+        net.fit(x, y)
+        s0 = s0 if s0 is not None else net.score()
+    assert net.score() < s0 * 0.25, (net.score(), s0)
+    assert not np.allclose(np.asarray(net.params["layer_0"]["W"]),
+                           emb0)                # embedding trained
+    prompt = (np.arange(9) % period + 1)[None, :].astype(np.int32)
+    out = model.generate(net, prompt, n_new=6)
+    probs = np.asarray(net.output(prompt))
+    assert out[0, 9] == int(np.argmax(probs[0, -1]))
+    # serialization round-trip keeps the tie (no head W reappears)
+    import tempfile, os
+    from deeplearning4j_tpu.serialization import ModelSerializer
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "tied.zip")
+        ModelSerializer.write_model(net, p)
+        net2 = ModelSerializer.restore_multi_layer_network(p)
+        assert "W" not in net2.params[head]
+        np.testing.assert_allclose(np.asarray(net2.output(prompt)),
+                                   probs, rtol=1e-5, atol=1e-6)
+
+
+def test_tie_weights_mln_generic():
+    """Network-level tie_weights on a plain autoencoder-style MLP:
+    decoder W = encoder W^T, gradients flow to the single master."""
+    import jax
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn import updaters as upd
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater(upd.Adam(learning_rate=0.01)).list()
+            .layer(DenseLayer(n_out=6, activation="tanh"))
+            .layer(OutputLayer(n_out=10, activation="identity",
+                               loss="mse"))
+            .tie_weights(1, "W", 0, "W", transpose=True)
+            .set_input_type(InputType.feed_forward(10)).build())
+    net = MultiLayerNetwork(conf).init()
+    assert "W" not in net.params["layer_1"]
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((32, 10)).astype(np.float32)
+    net.fit(x, x)
+    s0 = net.score()
+    for _ in range(40):
+        net.fit(x, x)
+    assert net.score() < s0 * 0.7
+    # conf JSON round-trip carries the tie
+    from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+    rt = MultiLayerConfiguration.from_json(conf.to_json())
+    assert rt.tied_weights == [[1, "W", 0, "W", True]]
+
+
+def test_tie_weights_shape_mismatch_raises():
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    conf = (NeuralNetConfiguration.builder().list()
+            .layer(DenseLayer(n_out=6))
+            .layer(OutputLayer(n_out=9, loss="mse"))   # 9 != 10
+            .tie_weights(1, "W", 0, "W", transpose=True)
+            .set_input_type(InputType.feed_forward(10)).build())
+    with pytest.raises(ValueError, match="tie_weights"):
+        MultiLayerNetwork(conf).init()
+
+
+def test_tied_weights_direct_param_apis():
+    """feed_forward / activate_selected_layers read self.params
+    directly — they must see materialised tied weights, not KeyError
+    (round-4 review finding)."""
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    conf = (NeuralNetConfiguration.builder().seed(3).list()
+            .layer(DenseLayer(n_out=6, activation="tanh"))
+            .layer(OutputLayer(n_out=10, activation="identity",
+                               loss="mse"))
+            .tie_weights(1, "W", 0, "W", transpose=True)
+            .set_input_type(InputType.feed_forward(10)).build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).standard_normal((4, 10)) \
+        .astype(np.float32)
+    acts = net.feed_forward(x)
+    assert len(acts) == 3
+    np.testing.assert_allclose(np.asarray(acts[-1]),
+                               np.asarray(net.output(x)),
+                               rtol=1e-5, atol=1e-6)
+    mid = net.activate_selected_layers(0, 0, x)
+    np.testing.assert_allclose(np.asarray(mid), np.asarray(acts[1]),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_tied_weights_transfer_learning():
+    """Ties reindex onto the transfer-learning tail; a tie crossing
+    the frozen/unfrozen split is rejected with a clear error."""
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration,
+                                       TransferLearningHelper)
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.data import DataSet
+
+    def build(tie):
+        b = (NeuralNetConfiguration.builder().seed(3).list()
+             .layer(DenseLayer(n_out=8, activation="tanh"))
+             .layer(DenseLayer(n_out=8, activation="tanh"))
+             .layer(OutputLayer(n_out=8, activation="identity",
+                                loss="mse")))
+        b.tie_weights(*tie)
+        return MultiLayerNetwork(
+            b.set_input_type(InputType.feed_forward(8)).build()).init()
+
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((16, 8)).astype(np.float32)
+    y = rng.standard_normal((16, 8)).astype(np.float32)
+
+    # tie fully inside the tail (layers 1,2 -> tail 0,1): works
+    net = build((2, "W", 1, "W", True))
+    h = TransferLearningHelper(net, frozen_until=0)
+    tail = h.unfrozen_mln()
+    assert tail.conf.tied_weights == [[1, "W", 0, "W", True]]
+    h.fit_featurized(DataSet(x, y))
+    assert np.isfinite(tail.score_)
+    feats = h.featurize(DataSet(x, y))       # frozen prefix runs
+    assert feats.features.shape == (16, 8)
+
+    # tie crossing the split: rejected
+    net2 = build((1, "W", 0, "W", True))
+    with pytest.raises(ValueError, match="crosses"):
+        TransferLearningHelper(net2, frozen_until=0)
+
+
+def test_tied_lm_head_swap_transfer():
+    """The canonical fine-tune: swap a tied LM's head via
+    TransferLearning.Builder — the stale tie must be DROPPED (fresh
+    untied head with its own W), not re-materialised over the new
+    head (round-4 review repro: broadcast error (2,24,16) vs (7,))."""
+    from deeplearning4j_tpu.nn import TransferLearning
+    from deeplearning4j_tpu.nn.layers import RnnOutputLayer
+    model = GPTNano(vocab_size=16, max_len=64, seed=5,
+                    tie_embeddings=True)
+    net = model.init(seq_len=24)
+    head = f"layer_{model.n_layers + 2}"
+    new = (TransferLearning.builder(net)
+           .remove_output_layer()
+           .add_layer(RnnOutputLayer(n_out=7, activation="softmax",
+                                     loss="mcxent"))
+           .build())
+    assert new.conf.tied_weights == []          # stale tie dropped
+    assert "W" in new.params[head]              # fresh untied head
+    x = np.random.default_rng(0).integers(0, 16, (2, 24)) \
+        .astype(np.int32)
+    out = np.asarray(new.output(x))
+    assert out.shape == (2, 24, 7)
+    # keeping the head keeps the tie (and the W-less param block)
+    kept = (TransferLearning.builder(net).build())
+    assert kept.conf.tied_weights == net.conf.tied_weights
+    assert "W" not in kept.params[head]
+    assert np.asarray(kept.output(x)).shape == (2, 24, 16)

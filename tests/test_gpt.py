@@ -537,3 +537,81 @@ def test_tied_lm_head_swap_transfer():
     assert kept.conf.tied_weights == net.conf.tied_weights
     assert "W" not in kept.params[head]
     assert np.asarray(kept.output(x)).shape == (2, 24, 16)
+
+
+def test_int8_serving_matches_f32_greedy():
+    """serve_quant="int8" (weight-only per-channel, dequant fused in
+    the consuming matmul): greedy decode on a trained toy LM must
+    produce the same continuation as full-precision serving, through
+    both the tied and untied heads and the beam path."""
+    for tied in (False, True):
+        model = GPTNano(vocab_size=16, max_len=64, seed=5,
+                        tie_embeddings=tied)
+        net = model.init(seq_len=24)
+        period = 5
+        toks = np.arange(25) % period + 1
+        x = np.tile(toks[:24], (8, 1)).astype(np.int32)
+        y = np.tile(toks[1:25], (8, 1)).astype(np.int32)
+        for _ in range(60):
+            net.fit(x, y)
+        prompt = (np.arange(9) % period + 1)[None, :].astype(np.int32)
+        ref = model.generate(net, prompt, n_new=8)
+        model_q = GPTNano(vocab_size=16, max_len=64, seed=5,
+                          tie_embeddings=tied, serve_quant="int8")
+        got = model_q.generate(net, prompt, n_new=8)
+        np.testing.assert_array_equal(got, ref)
+        beam = model_q.generate_beam(net, prompt, n_new=8, beams=2)
+        np.testing.assert_array_equal(beam, ref)   # peaked dist
+
+
+def test_int8_quantized_weight_roundtrip():
+    from deeplearning4j_tpu.zoo.gpt import QuantizedWeight
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((32, 48)), jnp.float32)
+    for axis in (0, 1):
+        qw = QuantizedWeight.quantize(w, axis)
+        assert qw.w8.dtype == jnp.int8
+        deq = qw._dequant(jnp.float32)
+        # per-channel max error bounded by scale/2
+        err = np.abs(np.asarray(deq - w))
+        smax = np.broadcast_to(np.asarray(qw.scale), w.shape)
+        assert (err <= smax * 0.5 + 1e-7).all()
+        # matmul protocol + transpose flips the channel axis
+        x = jnp.asarray(rng.standard_normal((4, 32)), jnp.float32)
+        np.testing.assert_allclose(np.asarray(x @ qw),
+                                   np.asarray(x @ deq), rtol=1e-6)
+        assert qw.T.axis == 1 - axis
+        # row gather (embedding use): exact in the default f32
+        # act_dtype — a wrong scale row would show immediately
+        rows = qw[jnp.asarray([1, 3])]
+        np.testing.assert_allclose(
+            np.asarray(rows), np.asarray(deq[jnp.asarray([1, 3])]),
+            rtol=1e-6, atol=1e-7)
+
+
+def test_serve_quant_validation():
+    with pytest.raises(ValueError, match="serve_quant"):
+        GPTNano(serve_quant="int4")
+
+
+def test_decode_params_cache_invalidation():
+    """The serving prepare-cache must see BOTH params-change styles:
+    fit() rebinding net.params AND in-place per-layer writes
+    (TransferLearningHelper, manual loading) — round-4 review
+    finding."""
+    model = GPTNano(vocab_size=16, max_len=64, seed=5,
+                    compute_dtype="bfloat16")
+    net = model.init(seq_len=24)
+    prompt = np.asarray([[1, 2, 3, 4, 5]], np.int32)
+    out0 = model.generate(net, prompt, n_new=4)
+    # in-place write: bias the head so token 9 always wins
+    head = f"layer_{model.n_layers + 2}"
+    import jax.numpy as jnp
+    b = np.zeros(16, np.float32); b[9] = 1e4
+    net.params[head] = dict(net.params[head], b=jnp.asarray(b))
+    out1 = model.generate(net, prompt, n_new=4)
+    assert (out1[0, 5:] == 9).all(), out1
+    # and repeated calls against unchanged params hit the cache
+    refs, prepared = model._decode_params_cache
+    model.generate(net, prompt, n_new=4)
+    assert model._decode_params_cache[1] is prepared

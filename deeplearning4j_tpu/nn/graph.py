@@ -363,7 +363,8 @@ class ComputationGraph:
             kw = {"from_logits": True} if fused else {}
             lm = lmasks.get(name) if lmasks else None
             logits = acts[name]
-            if cd is not None:
+            if cd is not None and losses_mod.wants_f32_logits(fn,
+                                                              fused):
                 logits = logits.astype(jnp.float32)
             total = total + fn(y, logits, mask=lm, **kw)
         return total, new_state

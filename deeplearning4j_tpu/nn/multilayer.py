@@ -276,9 +276,10 @@ class MultiLayerNetwork:
         out, new_state, _ = self._forward(
             params, state, x, train=True, rng=rng, mask=mask,
             pre_output_last=fused)
-        if cd is not None:
-            out = out.astype(jnp.float32)
         loss_fn = losses_mod.get(loss_name)
+        if cd is not None and losses_mod.wants_f32_logits(loss_fn,
+                                                          fused):
+            out = out.astype(jnp.float32)
         kw = {"from_logits": True} if fused else {}
         data_loss = loss_fn(y, out, mask=lmask, **kw)
         return data_loss + self._reg_score(master), new_state
@@ -550,7 +551,8 @@ class MultiLayerNetwork:
             out, new_state, rnn_states = self._forward(
                 params, state, x, train=True, rng=rng, mask=mask,
                 rnn_init=rnn_init, pre_output_last=fused)
-            if cd is not None:
+            if cd is not None and losses_mod.wants_f32_logits(loss_fn,
+                                                              fused):
                 out = out.astype(jnp.float32)
             kw = {"from_logits": True} if fused else {}
             loss = loss_fn(y, out, mask=lmask, **kw)

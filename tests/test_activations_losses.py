@@ -136,3 +136,35 @@ def test_ctc_loss_runs(rng):
     val = losses.ctc_loss(labels, logits,
                           jnp.array([4, 3]), jnp.array([10, 8]))
     assert jnp.isfinite(val)
+
+
+def test_wants_f32_logits_gate():
+    """Single source of truth for the half-precision loss cast: only
+    fused losses that declare handles_low_precision_logits skip the
+    f32 upcast (round-4 review: the gate was copy-pasted at 3 sites
+    and the tBPTT one missed)."""
+    from deeplearning4j_tpu.ops import losses as L
+    assert not L.wants_f32_logits(L.get("sparse_mcxent"), fused=True)
+    assert L.wants_f32_logits(L.get("sparse_mcxent"), fused=False)
+    assert L.wants_f32_logits(L.get("mcxent"), fused=True)
+    assert L.wants_f32_logits(lambda y, p, mask=None: 0.0, fused=True)
+
+
+def test_sparse_mcxent_bf16_logits_match_f32():
+    """The logsumexp-formulated from-logits path accepts bf16 logits
+    (f32 accumulation inside): loss within bf16 rounding of the f32
+    reference, gradients finite."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.ops import losses as L
+    rng = np.random.default_rng(0)
+    logits = rng.standard_normal((4, 16, 512)).astype(np.float32) * 3
+    labels = rng.integers(0, 512, (4, 16)).astype(np.int32)
+    fn = L.get("sparse_mcxent")
+    f32 = float(fn(labels, jnp.asarray(logits), from_logits=True))
+    bf16 = float(fn(labels, jnp.asarray(logits, jnp.bfloat16),
+                    from_logits=True))
+    assert abs(f32 - bf16) < 0.03 * abs(f32) + 1e-3
+    g = jax.grad(lambda x: fn(labels, x, from_logits=True))(
+        jnp.asarray(logits, jnp.bfloat16))
+    assert bool(jnp.isfinite(g.astype(jnp.float32)).all())

@@ -570,8 +570,19 @@ def _reference_bwd_block(q, k, v, out, lse, g, km, offs, causal):
 
 
 # --- ring composition surface ------------------------------------------------
+def _ring_block_defaults(block_q, block_k, tk):
+    """Measured v5e block policy shared with flash_attention: big q
+    blocks; block_k 512 up to 4k keys, 1024 beyond."""
+    if block_q is None:
+        block_q = 1024
+    if block_k is None:
+        block_k = 512 if tk <= 4096 else 1024
+    return block_q, block_k
+
+
 def flash_block_fwd(q, k, v, km=None, offs=None, causal: bool = False,
-                    block_q: int = 256, block_k: int = 1024,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     groups: int = 1):
     """One (local-Q × one-KV-block) flash forward returning
     ``(out, lse)`` — out is the softmax-normalised attention of q
@@ -581,20 +592,28 @@ def flash_block_fwd(q, k, v, km=None, offs=None, causal: bool = False,
     between Pallas calls. q: [B·H, T, D]; k,v: [B·H/groups, Tk, D]
     (GQA: the kernel shares one kv block per head group — no
     materialised broadcast); km: [B·H/groups, Tk]; offs: int32 [2]
-    dynamic global (q, k) offsets for causal."""
+    dynamic global (q, k) offsets for causal. Default blocks follow
+    the measured v5e sweep — (1024, 512) up to 4k-key blocks (the
+    usual ring regime; 1.44x vs the einsum pair at T/N=4096, see
+    BASELINE.md), block_k 1024 beyond."""
+    block_q, block_k = _ring_block_defaults(block_q, block_k,
+                                            k.shape[1])
     return _flash_fwd(q, k, v, km, offs, causal, block_q, block_k,
                       return_lse=True, groups=groups)
 
 
 def flash_block_bwd(q, k, v, out, lse, g, km=None, offs=None,
-                    causal: bool = False, block_q: int = 256,
-                    block_k: int = 1024, groups: int = 1):
+                    causal: bool = False,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None, groups: int = 1):
     """Backward of one (q-block, kv-block) pair given the GLOBAL
     (all-blocks) out/lse — FlashAttention-2 style recompute. Returns
     (dq_contrib, dk, dv): dq_contrib sums over kv blocks; dk/dv are
     this block's totals (at the KV head count when ``groups`` > 1)
     once every q block has contributed. (_flash_bwd itself falls back
     to the jnp backward under shard_map-on-CPU.)"""
+    block_q, block_k = _ring_block_defaults(block_q, block_k,
+                                            k.shape[1])
     return _flash_bwd(q, k, v, out, lse, g, km, offs, causal,
                       block_q, block_k, groups=groups)
 

@@ -51,6 +51,23 @@ def main():
     print("expected     :", want,
           "MATCH" if out[0, 10:].tolist() == want else "(still learning)")
 
+    # production serving recipe (round 4): tied embeddings train the
+    # GPT-2 way; bf16 + weight-only int8 serving halve-then-halve the
+    # per-token HBM traffic — greedy outputs stay identical
+    tied = GPTNano(vocab_size=32, max_len=64, seed=11,
+                   tie_embeddings=True, compute_dtype="bfloat16")
+    tnet = tied.init(seq_len=t)
+    for _ in range(steps):
+        tnet.fit(x, y)
+    full_out = tied.generate(tnet, prompt, n_new=10)
+    server = GPTNano(vocab_size=32, max_len=64, seed=11,
+                     tie_embeddings=True, compute_dtype="bfloat16",
+                     serve_quant="int8")
+    q_out = server.generate(tnet, prompt, n_new=10)
+    print("int8-served  :", q_out[0, 10:].tolist(),
+          "MATCH" if q_out.tolist() == full_out.tolist()
+          else "DIVERGED from full precision!")
+
     # the same config trains sequence-parallel — layer API only
     from deeplearning4j_tpu.parallel import (distributed_context,
                                              make_mesh)

@@ -133,7 +133,8 @@ def bench_body():
 
     for _ in range(20 // k_inner):
         params, opt_state, state, _ = loop(params, opt_state, state,
-                                           x_stack, y_stack, rngs)
+                                           x_stack, y_stack, {}, {},
+                                           rngs)
     sync(params)
 
     def timed_run(n_steps=20):
@@ -142,7 +143,8 @@ def bench_body():
         t0 = time.perf_counter()
         for _ in range(n_steps // k_inner):
             params, opt_state, state, _ = loop(
-                params, opt_state, state, x_stack, y_stack, rngs)
+                params, opt_state, state, x_stack, y_stack, {}, {},
+                rngs)
         sync(params)
         return n_steps * batch / (time.perf_counter() - t0)
 

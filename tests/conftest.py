@@ -32,3 +32,10 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(12345)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: round-end harness fences (subprocess bench/dossier "
+        "runs, ~8 min); deselect with -m 'not slow' for quick loops")

@@ -120,9 +120,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, km_ref, off_ref, o_ref, *rest,
 
     @pl.when(live)
     def _():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        # operands stay in their storage dtype (bf16 in-model): the MXU
+        # runs native bf16×bf16→f32; casting to f32 first would force
+        # the multi-pass f32 matmul path at a fraction of peak
+        s = jnp.dot(q_ref[0], k_ref[0].T,
+                    preferred_element_type=jnp.float32) * scale
 
         # mask padded kv positions (t_real is the unpadded length) and
         # key-masked positions
@@ -365,18 +367,20 @@ def _flash_bwd_masks(i, j, q_off, k_off, km, tq_real, tk_real, block_q,
 def _flash_bwd_p_ds(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, mask,
                     scale):
     """Recompute the probability tile and dS for the backward pass
-    (FlashAttention-2 eq. dS = P ∘ (dP − Δ), Δ = rowsum(dO ∘ O))."""
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    (FlashAttention-2 eq. dS = P ∘ (dP − Δ), Δ = rowsum(dO ∘ O)).
+    Matmul operands stay in storage dtype (native bf16 MXU mode);
+    softmax math and accumulation are f32. Returned q/k/do are the
+    storage-dtype tiles; p/ds are f32 (cast to the operand dtype at
+    their consuming matmuls, FA2-style)."""
+    q, k, do = q_ref[0], k_ref[0], do_ref[0]
     s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
     lse = lse_ref[0][:, :1]
     lse = jnp.where(jnp.isfinite(lse), lse, 0.0)
     p = jnp.where(mask, jnp.exp(s - lse), 0.0)
-    delta = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1,
+    delta = jnp.sum(do.astype(jnp.float32)
+                    * o_ref[0].astype(jnp.float32), axis=-1,
                     keepdims=True)
-    dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+    dp = jnp.dot(do, v_ref[0].T, preferred_element_type=jnp.float32)
     ds = p * (dp - delta) * scale
     return q, k, do, p, ds
 
@@ -405,7 +409,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                                 tk_real, block_q, block_k, causal)
         _, k, _, _, ds = _flash_bwd_p_ds(
             q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, mask, scale)
-        acc[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+        acc[:] += jnp.dot(ds.astype(k.dtype), k,
+                          preferred_element_type=jnp.float32)
 
     @pl.when(j == nk - 1)
     def _():
@@ -438,13 +443,80 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
                                 tk_real, block_q, block_k, causal)
         q, _, do, p, ds = _flash_bwd_p_ds(
             q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, mask, scale)
-        accv[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
-        acck[:] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        accv[:] += jnp.dot(p.astype(do.dtype).T, do,
+                          preferred_element_type=jnp.float32)
+        acck[:] += jnp.dot(ds.astype(q.dtype).T, q,
+                          preferred_element_type=jnp.float32)
 
     @pl.when(i == nq - 1)
     def _():
         dk_ref[0] = acck[:].astype(dk_ref.dtype)
         dv_ref[0] = accv[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                            km_ref, off_ref, dq_ref, dk_ref, dv_ref,
+                            dq_acc, acck, accv, *, scale, causal,
+                            tq_real, tk_real, block_q, block_k):
+    """Single-pass FA2 backward: grid (bh, kv, q). Each (kv, q) block
+    pair recomputes s/p/dS ONCE and feeds all three gradient matmuls
+    (the split kernels recompute the pair twice — ~7 matmul-class ops
+    per pair vs 5 here, and they stream q/k/v/do from HBM twice).
+    dk/dv accumulate in per-kv-block VMEM scratch, written when the
+    inner q sweep ends; dq accumulates in a full-length f32 VMEM
+    scratch (contributions to q block i arrive once per OUTER kv step,
+    so a per-block buffer can't persist) and streams the running
+    partial to the output each step — the final kv iteration's flush
+    is the converged value."""
+    j, i = pl.program_id(1), pl.program_id(2)   # kv outer, q inner
+    nq = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        # first visit of q block i this row: zero its dq scratch slice
+        dq_acc[pl.ds(i * block_q, block_q)] = jnp.zeros(
+            (block_q, dq_acc.shape[1]), jnp.float32)
+
+    @pl.when(i == 0)
+    def _():
+        acck[:] = jnp.zeros_like(acck[:])
+        accv[:] = jnp.zeros_like(accv[:])
+
+    km = km_ref[0, 0]
+    q_off, k_off = off_ref[0], off_ref[1]
+    live = jnp.logical_and(
+        jnp.logical_and(i * block_q < tq_real, j * block_k < tk_real),
+        jnp.any(km > 0))
+    if causal:
+        live = jnp.logical_and(
+            live,
+            q_off + i * block_q + block_q - 1 >= k_off + j * block_k)
+
+    @pl.when(live)
+    def _():
+        mask = _flash_bwd_masks(i, j, q_off, k_off, km, tq_real,
+                                tk_real, block_q, block_k, causal)
+        q, k, do, p, ds = _flash_bwd_p_ds(
+            q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, mask, scale)
+        accv[:] += jnp.dot(p.astype(do.dtype).T, do,
+                           preferred_element_type=jnp.float32)
+        acck[:] += jnp.dot(ds.astype(q.dtype).T, q,
+                           preferred_element_type=jnp.float32)
+        dq_acc[pl.ds(i * block_q, block_q)] += jnp.dot(
+            ds.astype(k.dtype), k, preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = acck[:].astype(dk_ref.dtype)
+        dv_ref[0] = accv[:].astype(dv_ref.dtype)
+
+    dq_ref[0] = dq_acc[pl.ds(i * block_q, block_q)].astype(dq_ref.dtype)
+
+
+# full-length dq scratch budget for the fused backward (f32 bytes);
+# past this (T ≳ 12k at d≤128) fall back to the split kernels rather
+# than risk VMEM exhaustion (~16 MB/core on v5e)
+_FUSED_BWD_DQ_VMEM = 6 * 1024 * 1024
 
 
 def _flash_bwd(q, k, v, out, lse, g, km, offs, causal, block_q,
@@ -497,13 +569,45 @@ def _flash_bwd(q, k, v, out, lse, g, km, offs, causal, block_q,
     gg = groups
     kw = dict(scale=scale, causal=causal, tq_real=t, tk_real=tk_real,
               block_q=block_q, block_k=block_k)
+    sspec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    # grid (bh, j, i): kv-side blocks follow grid axis 1, q axis 2;
+    # dk/dv land per QUERY head and are group-reduced below
+    qspec2 = pl.BlockSpec((1, block_q, dp), lambda b, y, x: (b, x, 0))
+    lspec2 = pl.BlockSpec((1, block_q, 128), lambda b, y, x: (b, x, 0))
+    kspec2 = pl.BlockSpec((1, block_k, dp),
+                          lambda b, y, x: (b // gg, y, 0))
+    kmspec2 = pl.BlockSpec((1, 1, block_k),
+                           lambda b, y, x: (b // gg, 0, y))
+    ospec2 = pl.BlockSpec((1, block_k, dp), lambda b, y, x: (b, y, 0))
+    if tq * dp * 4 <= _FUSED_BWD_DQ_VMEM:
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_flash_bwd_fused_kernel, **kw),
+            out_shape=(jax.ShapeDtypeStruct((bh, tq, dp), q.dtype,
+                                            vma=vma),
+                       jax.ShapeDtypeStruct((bh, tk, dp), k.dtype,
+                                            vma=vma),
+                       jax.ShapeDtypeStruct((bh, tk, dp), v.dtype,
+                                            vma=vma)),
+            grid=(bh, nk, nq),
+            in_specs=[qspec2, kspec2, kspec2, qspec2, qspec2, lspec2,
+                      kmspec2, sspec],
+            out_specs=(qspec2, ospec2, ospec2),
+            scratch_shapes=[pltpu.VMEM((tq, dp), jnp.float32),
+                            pltpu.VMEM((block_k, dp), jnp.float32),
+                            pltpu.VMEM((block_k, dp), jnp.float32)],
+            interpret=_interpret(),
+        )(qp, kp, vp, dop, op, lsep, kmp, offs)
+        return (dq[:, :t, :d],
+                _reduce_kv_rows(dk[:, :tk_real, :d], groups),
+                _reduce_kv_rows(dv[:, :tk_real, :d], groups))
+    # very long sequences: the full-length dq scratch would not fit in
+    # VMEM — split dq / dkv passes with per-block accumulators
     qspec = pl.BlockSpec((1, block_q, dp), lambda b, x, y: (b, x, 0))
     lspec = pl.BlockSpec((1, block_q, 128), lambda b, x, y: (b, x, 0))
     kspec = pl.BlockSpec((1, block_k, dp),
                          lambda b, x, y: (b // gg, y, 0))
     kmspec = pl.BlockSpec((1, 1, block_k),
                           lambda b, x, y: (b // gg, 0, y))
-    sspec = pl.BlockSpec(memory_space=pltpu.SMEM)
     # grid (bh, i, j): q-side blocks follow grid axis 1, kv axis 2
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, **kw),
@@ -515,15 +619,6 @@ def _flash_bwd(q, k, v, out, lse, g, km, offs, causal, block_q,
         scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
         interpret=_interpret(),
     )(qp, kp, vp, dop, op, lsep, kmp, offs)
-    # grid (bh, j, i): kv-side blocks follow grid axis 1, q axis 2;
-    # dk/dv land per QUERY head and are group-reduced below
-    qspec2 = pl.BlockSpec((1, block_q, dp), lambda b, y, x: (b, x, 0))
-    lspec2 = pl.BlockSpec((1, block_q, 128), lambda b, y, x: (b, x, 0))
-    kspec2 = pl.BlockSpec((1, block_k, dp),
-                          lambda b, y, x: (b // gg, y, 0))
-    kmspec2 = pl.BlockSpec((1, 1, block_k),
-                           lambda b, y, x: (b // gg, 0, y))
-    ospec2 = pl.BlockSpec((1, block_k, dp), lambda b, y, x: (b, y, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, **kw),
         out_shape=(jax.ShapeDtypeStruct((bh, tk, dp), k.dtype, vma=vma),

@@ -63,6 +63,29 @@ def test_flash_backward_matches_reference(rng, causal, t):
         assert float(jnp.max(jnp.abs(a - b))) < 5e-5
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward_split_fallback(monkeypatch, rng, causal):
+    """Very long sequences fall back from the fused single-pass
+    backward to the split dq / dkv kernels (full-length dq scratch
+    would exceed VMEM). Force the threshold to 0 so the split path
+    stays covered at test sizes."""
+    monkeypatch.setattr(pk, "_FUSED_BWD_DQ_VMEM", 0)
+    B, H, D, t = 2, 2, 16, 130
+    q, k, v = (jnp.asarray(rng.standard_normal((B, t, H, D)),
+                           jnp.float32) for _ in range(3))
+    co = jnp.asarray(rng.standard_normal((B, t, H, D)), jnp.float32)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v, causal=causal) * co)
+
+    g1 = jax.grad(loss(lambda *a, **kw: pk.flash_attention(
+        *a, block_q=64, block_k=64, **kw)), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss(scaled_dot_attention),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        assert float(jnp.max(jnp.abs(a - b))) < 5e-5
+
+
 def test_flash_backward_finite_difference(rng):
     """Directional finite-difference check straight through the Pallas
     custom_vjp (float64-free: central difference in f32 with a loose

@@ -17,8 +17,12 @@ tunnel is down).  An infra outage must never read as ``rc:1`` /
      ``{"metric": ..., "skipped": true, "reason": ...}`` and exits 0.
 
 Protocol (BASELINE.md): steady-state throughput — warmup (compile +
-20 steps) excluded, median of 3 timed runs, synthetic ImageNet-shaped
-data (224x224x3, 1000 classes) so storage never bounds the number.
+20 steps) excluded, median of 3 run-length-differenced estimates
+(T(60 steps) − T(20 steps): one device→host sync through the axon
+tunnel costs ~100–150 ms, so differencing cancels the constant
+sync/dispatch floor while keeping every real per-step cost), synthetic
+ImageNet-shaped data (224x224x3, 1000 classes) so storage never
+bounds the number.
 Whole-graph jitted train step, bf16 compute / fp32 master params on
 TPU (the reference's cuDNN path is fp32 with per-op JNI dispatch —
 SURVEY §3.2).
@@ -96,8 +100,11 @@ def bench_body():
     from deeplearning4j_tpu.nn import updaters as upd
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-    batch = 256 if on_tpu else 8
-    size = 224 if on_tpu else 64
+    # the CPU path only validates wiring (the number is labeled with
+    # its platform); this box has ONE core and XLA-CPU ResNet steps
+    # run ~seconds each, so keep the CPU shapes tiny
+    batch = 256 if on_tpu else 4
+    size = 224 if on_tpu else 32
 
     net = ResNet50(num_classes=1000, seed=123,
                    input_shape=(size, size, 3),
@@ -131,13 +138,13 @@ def bench_body():
         # final optimizer update, so the whole chain must be done
         float(jax.tree.leaves(tree)[0].ravel()[0])
 
-    for _ in range(20 // k_inner):
+    for _ in range((20 if on_tpu else 4) // k_inner):
         params, opt_state, state, _ = loop(params, opt_state, state,
                                            x_stack, y_stack, {}, {},
                                            rngs)
     sync(params)
 
-    def timed_run(n_steps=20):
+    def run_steps(n_steps):
         nonlocal params, opt_state, state
         assert n_steps % k_inner == 0
         t0 = time.perf_counter()
@@ -146,10 +153,23 @@ def bench_body():
                 params, opt_state, state, x_stack, y_stack, {}, {},
                 rngs)
         sync(params)
-        return n_steps * batch / (time.perf_counter() - t0)
+        return time.perf_counter() - t0
+
+    def timed_run(n_lo=None, n_hi=None):
+        # run-length differencing: one sync through the axon tunnel
+        # costs ~100-150 ms (round-5 measurement), so T(n)/n would
+        # overstate the step time by the amortised floor; timing n_lo
+        # and n_hi steps and differencing cancels the constant
+        # sync/dispatch floor while keeping every real per-step cost.
+        # The CPU path only validates wiring — keep it short there.
+        if n_lo is None:
+            n_lo, n_hi = (20, 60) if on_tpu else (4, 8)
+        dt = run_steps(n_hi) - run_steps(n_lo)
+        return ((n_hi - n_lo) * batch / dt if dt > 0
+                else n_hi * batch / run_steps(n_hi))
 
     runs = sorted(timed_run() for _ in range(3))
-    images_per_sec = runs[1]  # median of 3
+    images_per_sec = runs[1]  # median of 3 paired estimates
 
     print(json.dumps({
         "metric": METRIC,

@@ -122,12 +122,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, km_ref, off_ref, o_ref, *rest,
     def _():
         # operands stay in their storage dtype (bf16 in-model): the MXU
         # runs native bf16×bf16→f32; casting to f32 first would force
-        # the multi-pass f32 matmul path at a fraction of peak
-        s = jnp.dot(q_ref[0], k_ref[0].T,
-                    preferred_element_type=jnp.float32) * scale
+        # the multi-pass f32 matmul path at a fraction of peak. The
+        # softmax scale folds into the q TILE ([bq, d] mul) instead of
+        # the score tile ([bq, bk] mul — bk/d× more VPU work).
+        qs = q_ref[0] * q_ref.dtype.type(scale)
+        s = jnp.dot(qs, k_ref[0].T, preferred_element_type=jnp.float32)
 
-        # mask padded kv positions (t_real is the unpadded length) and
-        # key-masked positions
+        # mask padded kv positions (t_real is the unpadded length),
+        # key-masked positions and (causal) above-diagonal entries by
+        # folding -inf into s: exp(s - m) then yields exact zeros, so
+        # no separate p-masking is needed. (A lax.cond that skips the
+        # mask arithmetic on interior blocks was measured SLOWER on
+        # v5e — the Mosaic branch costs more than the VPU ops saved.)
         kv_idx = j * block_k + lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = jnp.logical_and(kv_idx < t_real,
@@ -145,7 +151,6 @@ def _flash_kernel(q_ref, k_ref, v_ref, km_ref, off_ref, o_ref, *rest,
         m_new = jnp.maximum(m_prev, m_blk)
         # exp(-inf - -inf) guard: rows with no live keys yet keep m=-inf
         p = jnp.exp(s - jnp.where(jnp.isinf(m_new), 0.0, m_new))
-        p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(jnp.where(jnp.isinf(m_prev), -jnp.inf, m_prev)
                         - jnp.where(jnp.isinf(m_new), 0.0, m_new))
         alpha = jnp.where(jnp.isinf(m_prev), 0.0, alpha)
@@ -364,24 +369,33 @@ def _flash_bwd_masks(i, j, q_off, k_off, km, tq_real, tk_real, block_q,
     return mask
 
 
-def _flash_bwd_p_ds(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, mask,
-                    scale):
+def _flash_bwd_p_ds(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, i, j,
+                    q_off, k_off, km, tq_real, tk_real, block_q,
+                    block_k, causal, scale):
     """Recompute the probability tile and dS for the backward pass
     (FlashAttention-2 eq. dS = P ∘ (dP − Δ), Δ = rowsum(dO ∘ O)).
     Matmul operands stay in storage dtype (native bf16 MXU mode);
-    softmax math and accumulation are f32. Returned q/k/do are the
+    softmax math and accumulation are f32. The softmax scale is
+    folded into the q tile for s (and left OUT of dS — callers scale
+    dq/dk once at write-out, saving a [bq, bk] multiply per pair);
+    the mask folds into s as -inf so exp(s - lse) zeros masked
+    entries with no separate p-masking pass. Returned q/k/do are the
     storage-dtype tiles; p/ds are f32 (cast to the operand dtype at
     their consuming matmuls, FA2-style)."""
     q, k, do = q_ref[0], k_ref[0], do_ref[0]
-    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+    qs = q * q_ref.dtype.type(scale)
+    s = jnp.dot(qs, k.T, preferred_element_type=jnp.float32)
+    mask = _flash_bwd_masks(i, j, q_off, k_off, km, tq_real,
+                            tk_real, block_q, block_k, causal)
+    s = jnp.where(mask, s, -jnp.inf)
     lse = lse_ref[0][:, :1]
     lse = jnp.where(jnp.isfinite(lse), lse, 0.0)
-    p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+    p = jnp.exp(s - lse)
     delta = jnp.sum(do.astype(jnp.float32)
                     * o_ref[0].astype(jnp.float32), axis=-1,
                     keepdims=True)
     dp = jnp.dot(do, v_ref[0].T, preferred_element_type=jnp.float32)
-    ds = p * (dp - delta) * scale
+    ds = p * (dp - delta)
     return q, k, do, p, ds
 
 
@@ -405,16 +419,17 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 
     @pl.when(live)
     def _():
-        mask = _flash_bwd_masks(i, j, q_off, k_off, km, tq_real,
-                                tk_real, block_q, block_k, causal)
         _, k, _, _, ds = _flash_bwd_p_ds(
-            q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, mask, scale)
+            q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, i, j, q_off,
+            k_off, km, tq_real, tk_real, block_q, block_k, causal,
+            scale)
         acc[:] += jnp.dot(ds.astype(k.dtype), k,
                           preferred_element_type=jnp.float32)
 
     @pl.when(j == nk - 1)
     def _():
-        dq_ref[0] = acc[:].astype(dq_ref.dtype)
+        # dS carries no scale — applied once here ([bq, d] mul)
+        dq_ref[0] = (acc[:] * scale).astype(dq_ref.dtype)
 
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
@@ -439,10 +454,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 
     @pl.when(live)
     def _():
-        mask = _flash_bwd_masks(i, j, q_off, k_off, km, tq_real,
-                                tk_real, block_q, block_k, causal)
         q, _, do, p, ds = _flash_bwd_p_ds(
-            q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, mask, scale)
+            q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, i, j, q_off,
+            k_off, km, tq_real, tk_real, block_q, block_k, causal,
+            scale)
         accv[:] += jnp.dot(p.astype(do.dtype).T, do,
                           preferred_element_type=jnp.float32)
         acck[:] += jnp.dot(ds.astype(q.dtype).T, q,
@@ -450,7 +465,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 
     @pl.when(i == nq - 1)
     def _():
-        dk_ref[0] = acck[:].astype(dk_ref.dtype)
+        dk_ref[0] = (acck[:] * scale).astype(dk_ref.dtype)
         dv_ref[0] = accv[:].astype(dv_ref.dtype)
 
 
@@ -494,10 +509,10 @@ def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 
     @pl.when(live)
     def _():
-        mask = _flash_bwd_masks(i, j, q_off, k_off, km, tq_real,
-                                tk_real, block_q, block_k, causal)
         q, k, do, p, ds = _flash_bwd_p_ds(
-            q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, mask, scale)
+            q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, i, j, q_off,
+            k_off, km, tq_real, tk_real, block_q, block_k, causal,
+            scale)
         accv[:] += jnp.dot(p.astype(do.dtype).T, do,
                            preferred_element_type=jnp.float32)
         acck[:] += jnp.dot(ds.astype(q.dtype).T, q,
@@ -507,16 +522,24 @@ def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 
     @pl.when(i == nq - 1)
     def _():
-        dk_ref[0] = acck[:].astype(dk_ref.dtype)
+        # dS carries no scale — applied once at write-out
+        dk_ref[0] = (acck[:] * scale).astype(dk_ref.dtype)
         dv_ref[0] = accv[:].astype(dv_ref.dtype)
 
-    dq_ref[0] = dq_acc[pl.ds(i * block_q, block_q)].astype(dq_ref.dtype)
+    dq_ref[0] = (dq_acc[pl.ds(i * block_q, block_q)]
+                 * scale).astype(dq_ref.dtype)
 
 
-# full-length dq scratch budget for the fused backward (f32 bytes);
-# past this (T ≳ 12k at d≤128) fall back to the split kernels rather
-# than risk VMEM exhaustion (~16 MB/core on v5e)
-_FUSED_BWD_DQ_VMEM = 6 * 1024 * 1024
+# full-length dq scratch budget for the fused backward (f32 bytes).
+# The kernel's total scoped VMEM is the dq scratch + dk/dv
+# accumulators + double-buffered operand blocks (measured 17.1 MB at
+# T=8192, bq=1024, bk=1024, dp=128), which exceeds Mosaic's 16 MB
+# DEFAULT scoped-vmem limit — the fused call raises its
+# vmem_limit_bytes to _FUSED_BWD_VMEM_LIMIT (physical VMEM on v5e is
+# far larger). Past the scratch budget (T ≳ 24k at d≤128) fall back
+# to the split kernels.
+_FUSED_BWD_DQ_VMEM = 12 * 1024 * 1024
+_FUSED_BWD_VMEM_LIMIT = 48 * 1024 * 1024
 
 
 def _flash_bwd(q, k, v, out, lse, g, km, offs, causal, block_q,
@@ -595,6 +618,9 @@ def _flash_bwd(q, k, v, out, lse, g, km, offs, causal, block_q,
             scratch_shapes=[pltpu.VMEM((tq, dp), jnp.float32),
                             pltpu.VMEM((block_k, dp), jnp.float32),
                             pltpu.VMEM((block_k, dp), jnp.float32)],
+            compiler_params=None if _interpret() else
+            pltpu.CompilerParams(
+                vmem_limit_bytes=_FUSED_BWD_VMEM_LIMIT),
             interpret=_interpret(),
         )(qp, kp, vp, dop, op, lsep, kmp, offs)
         return (dq[:, :t, :d],

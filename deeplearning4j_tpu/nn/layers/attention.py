@@ -169,7 +169,9 @@ class MultiHeadAttention(Layer):
                         import ring_self_attention
                     return ring_self_attention(
                         q, k, v, ctx.mesh, axis_name=ctx.axis_name,
-                        mask=mask, causal=self.causal)
+                        mask=mask, causal=self.causal,
+                        batch_axis=getattr(ctx, "batch_axis", None),
+                        head_axis=getattr(ctx, "head_axis", None))
                 if self.sequence_parallel == "ulysses":
                     from deeplearning4j_tpu.parallel.ulysses import \
                         ulysses_self_attention
@@ -194,7 +196,9 @@ class MultiHeadAttention(Layer):
                     o = zigzag_ring_self_attention(
                         zigzag_permute(q, n), zigzag_permute(k, n),
                         zigzag_permute(v, n), ctx.mesh,
-                        axis_name=ctx.axis_name, mask=zmask)
+                        axis_name=ctx.axis_name, mask=zmask,
+                        batch_axis=getattr(ctx, "batch_axis", None),
+                        head_axis=getattr(ctx, "head_axis", None))
                     return zigzag_unpermute(o, n)
         return scaled_dot_attention(q, k, v, mask, self.causal)
 

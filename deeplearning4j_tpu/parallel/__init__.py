@@ -32,8 +32,13 @@ from deeplearning4j_tpu.parallel.ring_attention import (
     ring_self_attention, zigzag_ring_self_attention, zigzag_permute,
     zigzag_unpermute)
 from deeplearning4j_tpu.parallel.ulysses import ulysses_self_attention
+from deeplearning4j_tpu.parallel.composed import (
+    transformer_tp_specs, shard_lm_for_composed, composed_context,
+    composed_data_sharding)
 
 __all__ = [
+    "transformer_tp_specs", "shard_lm_for_composed",
+    "composed_context", "composed_data_sharding",
     "MixtureOfExperts", "pipeline_apply", "pipeline_train_step",
     "make_mlp_stage", "ring_self_attention", "ulysses_self_attention",
     "zigzag_ring_self_attention", "zigzag_permute", "zigzag_unpermute",

@@ -1,0 +1,136 @@
+"""Composed DP × SP × TP training on one multi-axis mesh.
+
+The reference composes its distributed strategies by NESTING wrappers —
+``SharedTrainingMaster`` runs a ``ParallelWrapper`` per Spark executor
+(multi-node × multi-device, SURVEY §3.5). The TPU-native composition is
+flat: ONE ``jax.sharding.Mesh`` with an axis per strategy, ONE jitted
+train step, and the XLA partitioner (GSPMD) deriving every collective
+from sharding annotations:
+
+- **data** axis: batch dim of x/y sharded; params replicated → GSPMD
+  inserts the gradient all-reduce over ('data', 'seq').
+- **seq** axis: sequence dim sharded; the ring attention is the one
+  MANUALLY mapped region (``shard_map`` inside the jit) — its
+  ``ppermute`` rotates KV blocks over 'seq' only, and
+  ``ring_self_attention(batch_axis=, head_axis=)`` threads the other
+  axes through the ring's specs so nothing re-gathers at its boundary.
+- **tensor** axis: Megatron-style col→row weight split (attention
+  QKV/out, SwiGLU up/down) → GSPMD inserts the activation psum over
+  'tensor' after each row-sharded matmul.
+
+Everything here works with the stock ``zoo.CausalTransformerLM`` /
+``MultiLayerNetwork`` train step — no composed-specific model code;
+the only glue is the per-leaf PartitionSpec map below and the ambient
+``distributed_context`` carrying (axis_name='seq', batch_axis='data',
+head_axis='tensor').
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def transformer_tp_specs(params, tensor_axis: str = "tensor"):
+    """Per-leaf PartitionSpec tree for a decoder-only transformer LM
+    param tree (``zoo.CausalTransformerLM`` layout): Megatron col→row.
+
+    - ``mha.Wq/Wk/Wv`` — column-sharded ``P(None, tensor)``: output
+      columns are head-major, so a column shard IS a head shard (the
+      mesh axis size must divide the head counts).
+    - ``mha.Wo`` / MLP ``Wd`` — row-sharded ``P(tensor, None)``: GSPMD
+      closes each with one activation psum over ``tensor_axis``.
+    - MLP ``Wg``/``Wu`` — column-sharded.
+    - embeddings, norms, biases, everything else — replicated.
+    """
+    col = {"Wq", "Wk", "Wv", "Wg", "Wu"}
+    row = {"Wo", "Wd"}
+
+    def walk(tree):
+        if isinstance(tree, dict):
+            return {k: (P(None, tensor_axis) if k in col else
+                        P(tensor_axis, None) if k in row else
+                        walk(v))
+                    for k, v in tree.items()}
+        return P()
+
+    return walk(params)
+
+
+def lm_placement_specs(params, opt_state,
+                       tensor_axis: str = "tensor"):
+    """(param_specs, opt_specs): PartitionSpec trees matching the
+    param tree and the optimizer-state tree leaf-for-leaf.
+
+    Optimizer moments live in optax wrapper nodes (PartitionState /
+    MaskedState / ScaleByAdamState) whose inner trees mirror the param
+    tree; each moment leaf is matched to its param by the DICT-KEY
+    SUFFIX of its tree path (e.g. ``(..., 'layer_1', 'mha', 'Wo')`` →
+    the Wo spec) with a shape cross-check — shape-only matching is
+    ambiguous (Wq and Wo share (hidden, hidden) with OPPOSITE col/row
+    specs). Unmatched leaves (step counts, scalars) replicate."""
+    from jax.tree_util import DictKey
+
+    param_specs = transformer_tp_specs(params, tensor_axis)
+    by_path = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        names = tuple(k.key for k in path if isinstance(k, DictKey))
+        spec = params_spec_at(param_specs, names)
+        by_path[names] = (getattr(leaf, "shape", None), spec)
+
+    def spec_for(path, leaf):
+        names = tuple(k.key for k in path if isinstance(k, DictKey))
+        for i in range(len(names)):
+            hit = by_path.get(names[i:])
+            if hit is not None:
+                shape, spec = hit
+                if getattr(leaf, "shape", None) == shape:
+                    return spec
+        return P()
+
+    if opt_state is None:
+        return param_specs, None
+    opt_specs = jax.tree_util.tree_map_with_path(spec_for, opt_state)
+    return param_specs, opt_specs
+
+
+def params_spec_at(spec_tree, names):
+    node = spec_tree
+    for n in names:
+        node = node[n]
+    return node
+
+
+def shard_lm_for_composed(net, mesh: Mesh, tensor_axis: str = "tensor"):
+    """Place a causal-LM net's params/opt state for composed training:
+    TP specs on the weights (implicitly replicated over the data/seq
+    axes), matching placement for the optimizer moments. Returns the
+    specs tree (feed x/y with ``composed_data_sharding``)."""
+    param_specs, opt_specs = lm_placement_specs(
+        net.params, getattr(net, "opt_state", None), tensor_axis)
+    put = lambda x, s: jax.device_put(x, NamedSharding(mesh, s))
+    net.params = jax.tree.map(put, net.params, param_specs)
+    if opt_specs is not None:
+        net.opt_state = jax.tree.map(put, net.opt_state, opt_specs)
+    return param_specs
+
+
+def composed_context(mesh: Mesh, data_axis: str = "data",
+                     seq_axis: str = "seq",
+                     tensor_axis: Optional[str] = "tensor"):
+    """``distributed_context`` configured for composed DP×SP×TP: the
+    sequence-parallel attention rides ``seq_axis`` while threading the
+    batch/head shardings of ``data_axis``/``tensor_axis`` through the
+    ring (see ``parallel.mesh.distributed_context``)."""
+    from deeplearning4j_tpu.parallel.mesh import distributed_context
+    return distributed_context(mesh, axis_name=seq_axis,
+                               batch_axis=data_axis,
+                               head_axis=tensor_axis)
+
+
+def composed_data_sharding(mesh: Mesh, data_axis: str = "data",
+                           seq_axis: str = "seq"):
+    """NamedSharding for [B, T] token/label batches."""
+    return NamedSharding(mesh, P(data_axis, seq_axis))

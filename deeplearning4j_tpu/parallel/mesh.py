@@ -107,11 +107,26 @@ class distributed_context:
     re-trace their jitted steps when the ambient state changes (see
     ``context_epoch``), so the same net object can fit inside and
     outside a context without stale traces.
+
+    Composed parallelism: when the mesh carries MORE axes than the
+    sequence axis (e.g. ``make_mesh({"data": 2, "seq": 2,
+    "tensor": 2})`` — DP × SP × TP in ONE jitted step),
+    ``batch_axis``/``head_axis`` name the axes the batch and
+    attention-head dims are sharded over; sequence-parallel layers
+    thread them into the ring's shard_map specs so the data/tensor
+    shardings ride through the ring instead of being re-gathered at
+    its boundary. DP gradient psums and TP matmul partials stay with
+    GSPMD (param/batch NamedShardings on the jitted step) — the ring
+    is the only manually-mapped region.
     """
 
-    def __init__(self, mesh: Mesh, axis_name: str = "seq"):
+    def __init__(self, mesh: Mesh, axis_name: str = "seq",
+                 batch_axis: Optional[str] = None,
+                 head_axis: Optional[str] = None):
         self.mesh = mesh
         self.axis_name = axis_name
+        self.batch_axis = batch_axis
+        self.head_axis = head_axis
 
     def __enter__(self):
         _stack().append(self)

@@ -63,6 +63,22 @@ def _ring_perm(n):
     return [(j, (j + 1) % n) for j in range(n)]
 
 
+def _vary_like(ref_vma, axis_name):
+    """Align a freshly-created carry array onto the varying axes of
+    the ring operands. Under a single-axis shard_map that is just
+    ``axis_name``; under a composed multi-axis mesh (DP×SP×TP — the
+    operands arrive varying over 'data'/'tensor' too) the loop carry
+    must match the body outputs' full vma set or the fori_loop
+    type-check rejects it."""
+    axes = set(ref_vma) | {axis_name}
+
+    def vary(x):
+        have = getattr(jax.typeof(x), "vma", frozenset())
+        missing = tuple(axes - set(have))
+        return lax.pcast(x, missing, to="varying") if missing else x
+    return vary
+
+
 def _ring_fwd_impl(q, k, v, km, axis_name, causal, groups):
     """q: [B·H, T_loc, D]; k,v: [B·Hkv, T_loc, D] (GQA: H = Hkv·groups
     — only the SMALL kv travels the ring; the flash kernel shares one
@@ -74,7 +90,8 @@ def _ring_fwd_impl(q, k, v, km, axis_name, causal, groups):
     my = lax.axis_index(axis_name)
     t = q.shape[1]
     has_km = km is not None
-    vary = lambda x: lax.pcast(x, (axis_name,), to="varying")
+    vary = _vary_like(getattr(jax.typeof(q), "vma", frozenset()),
+                      axis_name)
     out0 = vary(jnp.zeros(q.shape, jnp.float32))
     lse0 = vary(jnp.full(q.shape[:2] + (1,), -jnp.inf, jnp.float32))
 
@@ -101,8 +118,9 @@ def _ring_bwd_impl(q, k, v, km, out, lse, g, axis_name, causal,
     my = lax.axis_index(axis_name)
     t = q.shape[1]
     has_km = km is not None
-    zero = lambda x: lax.pcast(jnp.zeros(x.shape, jnp.float32),
-                               (axis_name,), to="varying")
+    _vary = _vary_like(getattr(jax.typeof(q), "vma", frozenset()),
+                       axis_name)
+    zero = lambda x: _vary(jnp.zeros(x.shape, jnp.float32))
 
     def body(i, carry):
         dq, dk_acc, dv_acc, k_cur, v_cur = carry[:5]
@@ -150,12 +168,20 @@ def _ring_attn_bwd(axis_name, causal, groups, res, g):
 _ring_attn.defvjp(_ring_attn_fwd, _ring_attn_bwd)
 
 
-def _fold_dispatch(attn_fn, q, k, v, mask, mesh, axis_name):
+def _fold_dispatch(attn_fn, q, k, v, mask, mesh, axis_name,
+                   batch_axis=None, head_axis=None):
     """Shared [B,T,H,D] → ring dispatch: GQA head-count check, head
     folding to [B·H, T_loc, D], key-mask folding to [B·Hkv, T_loc]
     (None stays None — no mask tensor enters the ring), shard_map over
     ``axis_name``. ``attn_fn(qf, kf, vf, km, groups)`` runs on the
-    per-device folded blocks."""
+    per-device folded blocks.
+
+    ``batch_axis`` / ``head_axis``: mesh axes the batch and head dims
+    are ALREADY sharded over (composed DP×SP×TP training — the whole
+    step runs under one jit over a multi-axis mesh). Naming them in
+    the shard_map specs lets the data/tensor shardings ride straight
+    through the ring instead of being all-gathered at its boundary;
+    the ring's collectives still touch only ``axis_name``."""
     def local(q, k, v, kmask):
         b, t, h, d = q.shape
         h_kv = k.shape[2]
@@ -169,20 +195,22 @@ def _fold_dispatch(attn_fn, q, k, v, mask, mesh, axis_name):
         o = attn_fn(fold(q), fold(k), fold(v), km, h // h_kv)
         return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
-    spec = P(None, axis_name, None, None)
+    spec = P(batch_axis, axis_name, head_axis, None)
     if mask is None:
         fn = shard_map(lambda q, k, v: local(q, k, v, None), mesh=mesh,
                        in_specs=(spec, spec, spec), out_specs=spec)
         return fn(q, k, v)
     fn = shard_map(local, mesh=mesh,
-                   in_specs=(spec, spec, spec, P(None, axis_name)),
+                   in_specs=(spec, spec, spec,
+                             P(batch_axis, axis_name)),
                    out_specs=spec)
     return fn(q, k, v, mask)
 
 
 def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
                         mask: Optional[jax.Array] = None,
-                        causal: bool = False):
+                        causal: bool = False, batch_axis=None,
+                        head_axis=None):
     """Distributed attention: inputs [B, T, H, D] sharded on T over
     ``axis_name``; returns [B, T, H, D] with identical sharding.
 
@@ -192,11 +220,13 @@ def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = "seq",
     Grouped-query attention: ``k``/``v`` may carry FEWER heads than
     ``q`` (H divisible by Hkv) — only the small kv rotates over ICI,
     expanded to the query heads at each flash call.
+    ``batch_axis``/``head_axis``: mesh axes B and H are already
+    sharded over (composed DP×SP×TP — see ``_fold_dispatch``).
     """
     return _fold_dispatch(
         lambda qf, kf, vf, km, groups: _ring_attn(
             qf, kf, vf, km, axis_name, causal, groups),
-        q, k, v, mask, mesh, axis_name)
+        q, k, v, mask, mesh, axis_name, batch_axis, head_axis)
 
 
 # Ulysses all-to-all SP lives in parallel/ulysses.py; this alias
@@ -264,7 +294,8 @@ def _zz_fwd_impl(q, k, v, km, axis_name, groups):
     my = lax.axis_index(axis_name)
     c = q.shape[1] // 2
     has_km = km is not None
-    vary = lambda x: lax.pcast(x, (axis_name,), to="varying")
+    vary = _vary_like(getattr(jax.typeof(q), "vma", frozenset()),
+                      axis_name)
     out0 = vary(jnp.zeros(q.shape, jnp.float32))
     lse0 = vary(jnp.full(q.shape[:2] + (1,), -jnp.inf, jnp.float32))
     q_ids = (my, 2 * n - 1 - my)
@@ -299,8 +330,9 @@ def _zz_bwd_impl(q, k, v, km, out, lse, g, axis_name, groups):
     my = lax.axis_index(axis_name)
     c = q.shape[1] // 2
     has_km = km is not None
-    zero = lambda x: lax.pcast(jnp.zeros(x.shape, jnp.float32),
-                               (axis_name,), to="varying")
+    _vary = _vary_like(getattr(jax.typeof(q), "vma", frozenset()),
+                       axis_name)
+    zero = lambda x: _vary(jnp.zeros(x.shape, jnp.float32))
     q_ids = (my, 2 * n - 1 - my)
     qh = (q[:, :c], q[:, c:])
     outh = (out[:, :c], out[:, c:])
@@ -360,7 +392,8 @@ _zz_ring_attn.defvjp(_zz_ring_attn_fwd, _zz_ring_attn_bwd)
 
 def zigzag_ring_self_attention(q, k, v, mesh: Mesh,
                                axis_name: str = "seq",
-                               mask: Optional[jax.Array] = None):
+                               mask: Optional[jax.Array] = None,
+                               batch_axis=None, head_axis=None):
     """Load-balanced CAUSAL ring attention. Inputs [B, T, H, D] in
     ZIGZAG layout on the T axis (see :func:`zigzag_permute`), sharded
     over ``axis_name``; returns the same layout/sharding.
@@ -381,4 +414,4 @@ def zigzag_ring_self_attention(q, k, v, mesh: Mesh,
     return _fold_dispatch(
         lambda qf, kf, vf, km, groups: _zz_ring_attn(
             qf, kf, vf, km, axis_name, groups),
-        q, k, v, mask, mesh, axis_name)
+        q, k, v, mask, mesh, axis_name, batch_axis, head_axis)

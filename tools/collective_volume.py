@@ -89,6 +89,126 @@ def collectives_of(compiled, n_devices=8):
     return out
 
 
+_GROUPS_LITERAL_RE = re.compile(r"replica_groups=\{(\{[0-9,]+\}"
+                                r"(?:,\{[0-9,]+\})*)\}")
+_GROUPS_IOTA_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\]"
+    r"(?:T\(([0-9,]+)\))?")
+
+
+def parse_replica_groups(line):
+    """Replica groups of one HLO collective line, as a frozenset of
+    frozensets of device ids — handles both the literal
+    ``{{0,2},{1,3}}`` and the iota ``[G,S]<=[dims]T(perm)`` forms."""
+    m = _GROUPS_LITERAL_RE.search(line)
+    if m:
+        return frozenset(
+            frozenset(int(d) for d in g.split(","))
+            for g in m.group(1)[1:-1].split("},{"))
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        g, s = int(m.group(1)), int(m.group(2))
+        dims = [int(d) for d in m.group(3).split(",")]
+        arr = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group(4):
+            arr = arr.transpose([int(p) for p in m.group(4).split(",")])
+        arr = arr.reshape(g, s)
+        return frozenset(frozenset(int(d) for d in row) for row in arr)
+    return None
+
+
+def axis_groups(mesh_axes):
+    """Expected replica-group partition for every non-empty subset of
+    mesh axes: {axes_tuple: frozenset of frozensets}. ``mesh_axes`` is
+    an ordered dict-like of axis name → size with MAJOR-first device
+    numbering (the ``make_mesh`` convention)."""
+    names = list(mesh_axes)
+    sizes = [mesh_axes[n] for n in names]
+    ids = np.arange(int(np.prod(sizes))).reshape(sizes)
+    out = {}
+    from itertools import combinations
+    for r in range(1, len(names) + 1):
+        for subset in combinations(range(len(names)), r):
+            other = [i for i in range(len(names)) if i not in subset]
+            moved = ids.transpose(list(other) + list(subset)).reshape(
+                -1, int(np.prod([sizes[i] for i in subset])))
+            out[tuple(names[i] for i in subset)] = frozenset(
+                frozenset(int(d) for d in row) for row in moved)
+    return out
+
+
+def collectives_with_axes(compiled, mesh_axes):
+    """[(kind, tensor_bytes, axes_or_None, in_while)] for every
+    collective in the optimized HLO — ``axes`` is the mesh-axis subset
+    whose group partition matches the op's replica groups (None when
+    the groups don't align to axes, e.g. a point-to-point permute's
+    source-target pairs; collective-permute reports the axes whose
+    subgrid contains every source→target hop instead)."""
+    expected = axis_groups(mesh_axes)
+    out = []
+    for line in compiled.as_text().splitlines():
+        head = line.split("metadata=")[0]
+        m = _LINE_RE.search(head)
+        if not m or "-done" in head:
+            continue
+        shapes, kind = m.groups()
+        nb = sum(_bytes(d, dims)
+                 for d, dims in _SHAPE_RE.findall(shapes))
+        axes = None
+        if kind == "collective-permute":
+            pm = re.search(r"source_target_pairs=\{([0-9,{} ]*)\}",
+                           line)
+            if pm:
+                pairs = [tuple(int(x) for x in p.split(","))
+                         for p in pm.group(1)[1:-1].split("},{")]
+                for ax, part in expected.items():
+                    by = {frozenset(g) for g in part}
+                    if all(any(s in g and t in g for g in by)
+                           for s, t in pairs):
+                        axes = ax
+                        break
+        else:
+            groups = parse_replica_groups(line)
+            if groups is not None:
+                for ax, part in expected.items():
+                    if groups == part:
+                        axes = ax
+                        break
+        out.append((kind, nb, axes, "/while/" in line))
+    return out
+
+
+def composed_lm(mesh_devices=8):
+    """Composed DP×SP×TP causal-LM train step on one
+    {"data":2, "seq":2, "tensor":N//4} mesh (dryrun stage 7 /
+    tests/test_composed_parallel.py workload) — for the per-axis
+    collective gates."""
+    from deeplearning4j_tpu.parallel import (
+        composed_context, composed_data_sharding, make_mesh,
+        shard_lm_for_composed)
+    from deeplearning4j_tpu.zoo import CausalTransformerLM
+
+    model = CausalTransformerLM(
+        vocab_size=64, hidden=32, n_layers=2, n_heads=2, max_len=32,
+        ffn_mult=2.0, tie_embeddings=True, sequence_parallel="ring",
+        seed=7)
+    net = model.init(seq_len=32)
+    mesh = make_mesh({"data": 2, "seq": 2,
+                      "tensor": mesh_devices // 4})
+    shard_lm_for_composed(net, mesh, tensor_axis="tensor")
+    ds = composed_data_sharding(mesh)
+    rng = np.random.default_rng(0)
+    x = jax.device_put(
+        jnp.asarray(rng.integers(0, 64, (4, 32)), jnp.int32), ds)
+    y = jax.device_put(
+        jnp.asarray(rng.integers(0, 64, (4, 32)), jnp.int32), ds)
+    step = net._make_train_step()
+    args = (net.params, net.opt_state, net.state, x, y, None, None,
+            jax.random.PRNGKey(0))
+    return step, args, composed_context(mesh), dict(
+        data=2, seq=2, tensor=mesh_devices // 4)
+
+
 def analyze(name, jitted, args, n_devices=8):
     """HLO-derived collective counts + wire bytes + projected ICI time.
 

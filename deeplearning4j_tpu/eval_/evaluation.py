@@ -82,7 +82,8 @@ class Evaluation:
         if other.confusion is None:
             # adopt an explicit pin from an empty shard so it still
             # gates later merges into this accumulator
-            self.n_classes = self.n_classes or other.n_classes
+            if self.n_classes is None:
+                self.n_classes = other.n_classes
             return self
         if self.confusion is None:
             self.n_classes = other.n_classes

@@ -153,10 +153,27 @@ class TransferLearning:
             # removed or fresh layer is dropped (the canonical
             # head-swap on a tied LM gets an ordinary fresh head —
             # silently re-tying it would shadow its new params)
+            old_ties = list(getattr(conf, "tied_weights", []))
             conf.tied_weights = [
-                t for t in getattr(conf, "tied_weights", [])
+                t for t in old_ties
                 if (t[0] < n_keep and t[2] < n_keep
                     and t[0] not in reinit and t[2] not in reinit)]
+            # a tie dropped because its SOURCE went away, whose dst
+            # layer is kept untouched, must not silently lose the
+            # trained weights: materialize the old tied value (from
+            # the source's trained masters, transposed per the tie)
+            # into the dst param so the kept layer keeps computing
+            # what it computed before the surgery
+            surviving = {(t[0], t[1]) for t in conf.tied_weights}
+            dropped_fill = {}
+            for di, dn, si, sn, tr in old_ties:
+                if ((di, dn) in surviving or di >= n_keep
+                        or di in reinit):
+                    continue
+                src_p = params.get(_lname(si), {})
+                if sn in src_p:
+                    val = src_p[sn]
+                    dropped_fill[(di, dn)] = val.T if tr else val
             if self._ftc is not None:
                 self._ftc._apply(conf, layers)
 
@@ -183,6 +200,11 @@ class TransferLearning:
                     merged = {k: v for k, v in p.items()
                               if (i, k) not in tied_dst}
                     merged.update(params[_lname(i)])
+                    # dropped-tie dst params: trained tied value, not
+                    # the fresh leaf
+                    for (di, dn), val in dropped_fill.items():
+                        if di == i:
+                            merged[dn] = val
                     new.params[_lname(i)] = merged
                     new.state[_lname(i)] = state[_lname(i)]
                 new._layer_shapes.append(shape)

@@ -427,9 +427,10 @@ class CausalTransformerLM(ZooModel):
         quantises every 2-D weight per-channel (int8 + scales,
         dequantised inside each consuming matmul) for another ~2x on
         the weight reads; biases and norm gains stay float."""
-        if self.compute_dtype is not None:
-            from deeplearning4j_tpu import dtypes
-            params = dtypes.cast_float_tree(params, self.compute_dtype)
+        # quantise FROM the full-precision masters (scales computed in
+        # f32 from unrounded values), THEN cast the remaining float
+        # leaves — quantising an already-bf16-rounded tree would
+        # compound the rounding error for no bandwidth gain
         if self.serve_quant == "int8":
             act = self.compute_dtype or "float32"
             out = {}
@@ -442,6 +443,13 @@ class CausalTransformerLM(ZooModel):
                                                                act)
                     if getattr(w, "ndim", 0) == 2 else w, blk)
             params = out
+        if self.compute_dtype is not None:
+            from deeplearning4j_tpu import dtypes
+            params = jax.tree.map(
+                lambda w: w if isinstance(w, QuantizedWeight)
+                else dtypes.cast_float_tree(w, self.compute_dtype),
+                params,
+                is_leaf=lambda x: isinstance(x, QuantizedWeight))
         return params
 
     def _decode_params(self, net):

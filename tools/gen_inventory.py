@@ -118,6 +118,17 @@ def main():
 
     # ---- per-op API reference (docs/OPS.md) ---------------------------
     # analog of the reference codegen's generated op documentation
+    # (contrib/codegen-tools): signature + alias target + OpValidation
+    # status per op, straight from the living registry and the
+    # coverage-gated validation suite
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tests"))
+    from test_op_validation import CASES  # noqa: E402
+    alias_of = {n: seen_impl[id(OPS[n])] for n in aliases}
+    n_grad = sum(1 for cs in CASES.values()
+                 if any(c[2] for c in cs))
+    n_gold = sum(1 for cs in CASES.values()
+                 if any(c[3] is not None for c in cs))
     op_lines = [
         "# SameDiff op reference (auto-generated)", "",
         "Every op is a pure jax-traceable function in "
@@ -125,7 +136,15 @@ def main():
         "`sd.math.<name>(...)` in a SameDiff graph, or via "
         "`Nd4j.exec`. Signatures below: positional args are arrays, "
         "keyword args are static attributes (reference: iArgs/tArgs/"
-        "bArgs of the declarable op).", ""]
+        "bArgs of the declarable op).", "",
+        "**OpValidation status** (reference "
+        "`org.nd4j.autodiff.opvalidation`, coverage-gated by "
+        "`tests/test_op_validation.py::test_every_op_has_validation_"
+        "case`): every op below has at least one executed forward "
+        f"case; {n_grad} are finite-difference gradient-checked "
+        f"(`grad`), {n_gold} are compared against numpy goldens "
+        "(`golden`). An op with neither marker is forward-validated "
+        "only (shape + finiteness).", ""]
     for name in sorted(OPS):
         fn = OPS[name]
         try:
@@ -134,13 +153,24 @@ def main():
             sig = "(...)"
         doc = (inspect.getdoc(fn) or "").split("\n")[0].strip()
         entry = f"- **`{name}`**`{sig}`"
+        tags = []
+        if name in alias_of:
+            tags.append(f"alias of `{alias_of[name]}`")
+        cs = CASES.get(name, [])
+        if any(c[2] for c in cs):
+            tags.append("grad")
+        if any(c[3] is not None for c in cs):
+            tags.append("golden")
+        if tags:
+            entry += f" [{', '.join(tags)}]"
         if doc and not doc.startswith("lambda"):
             entry += f" — {doc}"
         op_lines.append(entry)
     ops_out = os.path.join(os.path.dirname(out), "OPS.md")
     with open(ops_out, "w") as f:
         f.write("\n".join(op_lines) + "\n")
-    print(f"wrote {os.path.normpath(ops_out)} ({len(OPS)} ops)")
+    print(f"wrote {os.path.normpath(ops_out)} ({len(OPS)} ops, "
+          f"{n_grad} gradchecked, {n_gold} golden-checked)")
 
 
 if __name__ == "__main__":

@@ -234,3 +234,33 @@ class EncodedGradientsAccumulator:
             "tau": self.algo.update(tau, nnz / total),
         }
         return jax.tree.unflatten(treedef, decoded), new_state
+
+    def exchange_hierarchical(self, grads, state,
+                              intra_axis: str = "data",
+                              cross_axis: str = "slice"):
+        """Two-tier topology-aware gradient sync (SURVEY §2.5 DCN
+        tier): DENSE mean over ``intra_axis`` (the ICI-connected
+        slice, where an f32 psum is cheap), then THRESHOLD-ENCODED
+        packed exchange over ``cross_axis`` (the DCN-connected
+        slice-to-slice hop — 2-bit codes, 16× less wire than f32).
+        The reference's analog is EncodedGradientsAccumulator over
+        Aeron UDP between Spark executors while each executor's
+        ParallelWrapper averages densely on-node (SURVEY §3.5).
+
+        State is PER-SLICE: after the intra-slice mean every device
+        in a slice holds identical gradients, so residuals and the
+        adapted τ are consistent WITHIN a slice — but each slice
+        encodes its own mean, so residual/τ differ ACROSS slices
+        (exactly like the reference's per-node accumulators). Carry
+        the returned state sharded over ``cross_axis`` between steps
+        — e.g. stack a leading slice axis and use
+        ``in_specs/out_specs = P(cross_axis)`` for the state operand
+        in the enclosing ``shard_map``; collapsing it to a replicated
+        ``P()`` would silently feed slice-0's residuals to every
+        slice and break the error-feedback compensation.
+        """
+        n = jax.lax.psum(1, intra_axis)
+        grads = jax.tree.map(
+            lambda g: jax.lax.psum(g, intra_axis) / n, grads)
+        return self.exchange_packed(grads, state,
+                                    axis_name=cross_axis)

@@ -242,3 +242,60 @@ def test_composed_without_tp_sharding_loses_tensor_psums(cv):
     tensor_ars = [nb for k, nb, ax, _ in colls
                   if k == "all-reduce" and ax == ("tensor",)]
     assert not tensor_ars, tensor_ars
+
+
+def test_hierarchical_encoded_dp_dcn_volume(cv):
+    """Two-tier DP (VERDICT r4 ask #6): dense f32 all-reduce stays on
+    the intra-slice 'data' axis; only 2-bit-packed int32 words cross
+    the 'slice' (DCN) axis — gathered bytes ≈ grad_bytes/16 per peer.
+    The encoded path must never move dense f32 across 'slice'."""
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from deeplearning4j_tpu.parallel import EncodedGradientsAccumulator
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"slice": 2, "data": 4})
+    acc = EncodedGradientsAccumulator()
+    g_shape = (64, 2048)                      # 512 KB f32 per device
+    grads = {"w": jnp.ones((8,) + g_shape, jnp.float32) * 0.01}
+    # state is PER-SLICE (leading slice axis, carried P("slice") —
+    # see exchange_hierarchical's docstring)
+    state = jax.tree.map(
+        lambda x: jnp.stack([x, x]),
+        acc.init_state({"w": grads["w"][0]}))
+
+    def f(g, st):
+        g = jax.tree.map(lambda x: x[0], g)   # per-device block
+        st = jax.tree.map(lambda x: x[0], st)  # this slice's state
+        out, st = acc.exchange_hierarchical(g, st, intra_axis="data",
+                                            cross_axis="slice")
+        expand = lambda x: jnp.asarray(x)[None]
+        return (jax.tree.map(expand, out), jax.tree.map(expand, st))
+
+    jitted = jax.jit(shard_map(
+        f, mesh=mesh, in_specs=(P(("slice", "data")), P("slice")),
+        out_specs=(P(("slice", "data")), P("slice")),
+        check_vma=False))
+    compiled = jitted.lower(grads, state).compile()
+    colls = cv.collectives_with_axes(compiled,
+                                     dict(slice=2, data=4))
+    grad_bytes = int(np.prod(g_shape)) * 4
+
+    # dense f32 reduction: 'data' only, grad-sized
+    dense = [nb for k, nb, ax, _ in colls
+             if k == "all-reduce" and ax == ("data",)]
+    assert dense and grad_bytes * 0.95 < max(dense), (dense,
+                                                      grad_bytes)
+    # nothing grad-sized and dense crosses 'slice' (or spans both)
+    for k, nb, ax, _ in colls:
+        if ax is not None and "slice" in ax:
+            assert nb <= grad_bytes / 8, (k, nb, ax)
+    # the packed cross-slice gather exists and is ~1/16 wire: the
+    # gathered result is [2, C] int32 where C = elements/16
+    packed = [nb for k, nb, ax, _ in colls
+              if k == "all-gather" and ax == ("slice",)]
+    assert packed, "packed cross-slice exchange disappeared"
+    want = 2 * grad_bytes / 16                # both slices' words
+    assert want * 0.9 < max(packed) < want * 1.3, (packed, want)

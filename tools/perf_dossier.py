@@ -16,6 +16,7 @@ labeled ``(smoke)`` and carry no MFU claim.
 Writes a markdown table to stdout; paste into BASELINE.md.
 """
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -340,6 +341,120 @@ def lstm():
     return ("charRNN 2x512 b64 t200", b * t / dt, "chars/s", dt, flops)
 
 
+def etl():
+    """ResNet-50 train with the REAL input pipeline on the clock
+    (VERDICT r4 Missing #2): synthetic ImageNet-shaped JPEGs on disk
+    → ImageRecordReader (decode + resize) → random crop/flip augment
+    → ImagePreProcessingScaler → AsyncDataSetIterator prefetch →
+    device step. Reports end-to-end img/s AND ETL-wait% — the
+    reference PerformanceListener's ETL metric: cumulative time the
+    consumer blocked on the prefetch queue over wall-clock."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.data.image import (
+        CropImageTransform, FlipImageTransform, ImageRecordReader,
+        PipelineImageTransform)
+    from deeplearning4j_tpu.data.iterators import AsyncDataSetIterator
+    from deeplearning4j_tpu.data.normalizers import \
+        ImagePreProcessingScaler
+    from deeplearning4j_tpu.data.records import \
+        RecordReaderDataSetIterator
+    from deeplearning4j_tpu.nn import updaters as upd
+    from deeplearning4j_tpu.zoo import ResNet50
+
+    import cv2
+
+    b, size, src, n_files, classes = ((4, 32, 40, 32, 4) if SMOKE
+                                      else (256, 224, 256, 768, 10))
+    root = tempfile.mkdtemp(prefix="dl4j_etl_")
+    rng = np.random.default_rng(0)
+    try:
+        for i in range(n_files):
+            d = Path(root) / f"cls{i % classes}"
+            d.mkdir(exist_ok=True)
+            img = rng.integers(0, 256, (src, src, 3), dtype=np.uint8)
+            cv2.imwrite(str(d / f"img{i:05d}.jpg"), img)
+
+        aug = PipelineImageTransform([
+            (CropImageTransform(src - size), 1.0),
+            (FlipImageTransform(1), 0.5)])
+        reader = ImageRecordReader(size, size, 3,
+                                   transform=aug).initialize(root)
+        it = RecordReaderDataSetIterator(reader, b, label_index=1,
+                                         num_classes=classes)
+        it.set_pre_processor(ImagePreProcessingScaler())
+        ait = AsyncDataSetIterator(it, queue_size=8)
+
+        net = ResNet50(num_classes=classes, seed=1,
+                       input_shape=(size, size, 3),
+                       updater=upd.Nesterovs(learning_rate=0.1,
+                                             momentum=0.9),
+                       compute_dtype=None if SMOKE
+                       else "bfloat16").init()
+        step = net._make_train_step()
+        params, opt, state = net.params, net.opt_state, net.state
+        key = jax.random.PRNGKey(0)
+        graph = hasattr(net.conf, "inputs")
+
+        def run_epoch():
+            nonlocal params, opt, state
+            n = 0
+            loss = None
+            for ds in ait:
+                x = jnp.asarray(ds.features)
+                y = jnp.asarray(ds.labels)
+                if graph:
+                    params, opt, state, loss = step(
+                        params, opt, state,
+                        {net.conf.inputs[0]: x}, [y], {}, {}, key)
+                else:
+                    params, opt, state, loss = step(
+                        params, opt, state, x, y, None, None, key)
+                n += x.shape[0]
+            return n, loss
+
+        _, warm_loss = run_epoch()         # compile + warm the cache
+        _sync(warm_loss)                   # drain async device work
+        ait.etl_wait_seconds = 0.0
+        t0 = time.perf_counter()
+        n_imgs = 0
+        for _ in range(2 if SMOKE else 4):
+            n, loss = run_epoch()
+            n_imgs += n
+        _sync(loss)
+        wall = time.perf_counter() - t0
+        etl_pct = 100.0 * ait.etl_wait_seconds / wall
+
+        # pipeline-only rate (no device step, no transfer): what the
+        # host can decode+augment+normalize per second — the number
+        # that sizes host capacity per chip. This is a PER-HOST rate
+        # (the decode loop is single-threaded Python feeding the
+        # async queue, so on this 1-vCPU box host == core; a
+        # multi-worker reader would scale it by workers).
+        t0 = time.perf_counter()
+        n_pipe = sum(ds.features.shape[0] for ds in ait)
+        pipe_rate = n_pipe / (time.perf_counter() - t0)
+
+        cores = os.cpu_count()
+        label = (f"ResNet-50 train + REAL input pipeline "
+                 f"(jpeg decode+augment+prefetch) b{b}@{size} "
+                 f"[ETL-wait {etl_pct:.0f}%; host pipeline "
+                 f"{pipe_rate:,.0f} img/s/host ({cores} core"
+                 f"{'s' if cores != 1 else ''})]")
+        flops = 3 * 4.1e9 * n_imgs / (n_imgs / b)  # per step, as #2
+        return (label, n_imgs / wall, "img/s", wall * b / n_imgs,
+                flops, {"etl_wait_pct": etl_pct,
+                        "pipeline_img_s": pipe_rate,
+                        "n_images": n_imgs,
+                        "host_cores": os.cpu_count()})
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def flashbwd():
     """Flash-attention fwd+bwd: Pallas backward vs scan recompute."""
     import jax
@@ -382,7 +497,8 @@ def main(names):
         import jax
         jax.config.update("jax_platforms", "cpu")
     table = {"resnet50": resnet50, "bert": bert, "lstm": lstm,
-             "flashbwd": flashbwd, "gpt": gpt, "gpt8k": gpt8k}
+             "flashbwd": flashbwd, "gpt": gpt, "gpt8k": gpt8k,
+             "etl": etl}
     trace_dir = out_path = None
     for flag in ("--trace", "--out"):
         if flag in names:

@@ -1,0 +1,93 @@
+"""Per-op summary of an XProf capture (VERDICT r4 ask #5).
+
+Parses the ``*.xplane.pb`` a ``jax.profiler.trace`` run writes (e.g.
+``perf_dossier.py --trace DIR``) with ``jax.profiler.ProfileData`` —
+no tensorboard needed — and prints, from the device plane's "XLA Ops"
+line:
+
+- steps observed and mean device step time (cross-checks the
+  wall-clock differencing protocol in ``perf_dossier._timeit``);
+- total device time by op CLASS (fusion kinds, custom-call = Pallas
+  kernels, convolution/dot = MXU, copies, ...);
+- the top-K individual ops by total time with their share.
+
+    python tools/xprof_summary.py DIR [--top 10]
+
+``DIR`` is the trace dir; the newest ``*.xplane.pb`` under it is read.
+"""
+from __future__ import annotations
+
+import argparse
+import re
+from collections import defaultdict
+from pathlib import Path
+
+
+_NAME_RE = re.compile(r"%([a-zA-Z0-9_-]+?)(?:\.\d+)? =")
+_KIND_RE = re.compile(r"kind=(k\w+)")
+
+
+def _classify(name: str) -> str:
+    m = _NAME_RE.search(name)
+    base = m.group(1) if m else name.split(" ")[0].lstrip("%")
+    if base == "fusion":
+        k = _KIND_RE.search(name)
+        return f"fusion:{k.group(1)[1:].lower()}" if k else "fusion"
+    return base
+
+
+def summarize(trace_dir: str, top: int = 10):
+    import jax
+
+    paths = sorted(Path(trace_dir).rglob("*.xplane.pb"))
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
+    pd = jax.profiler.ProfileData.from_file(str(paths[-1]))
+    dev = next(p for p in pd.planes if "/device:" in p.name)
+    steps, per_op, per_class = [], defaultdict(float), \
+        defaultdict(float)
+    counts = defaultdict(int)
+    for line in dev.lines:
+        if line.name == "Steps":
+            steps = [e.duration_ns for e in line.events]
+        if line.name != "XLA Ops":
+            continue
+        for e in line.events:
+            cls = _classify(e.name)
+            if cls in ("while", "conditional", "call"):
+                continue        # containers: children counted already
+            per_op[e.name.split(" = ")[0]] += e.duration_ns
+            per_class[cls] += e.duration_ns
+            counts[cls] += 1
+    total = sum(per_class.values())
+    out = []
+    out.append(f"steps: {len(steps)}, mean device step "
+               f"{sum(steps) / max(1, len(steps)) / 1e6:.2f} ms")
+    out.append("")
+    out.append("| op class | total ms | % | count |")
+    out.append("|---|---|---|---|")
+    for cls, ns in sorted(per_class.items(), key=lambda kv: -kv[1]):
+        if ns / total < 0.005:
+            continue
+        out.append(f"| {cls} | {ns / 1e6:.2f} | "
+                   f"{100 * ns / total:.1f}% | {counts[cls]} |")
+    out.append("")
+    out.append(f"| top-{top} individual ops | total ms | % |")
+    out.append("|---|---|---|")
+    for name, ns in sorted(per_op.items(),
+                           key=lambda kv: -kv[1])[:top]:
+        out.append(f"| `{name[:70]}` | {ns / 1e6:.2f} | "
+                   f"{100 * ns / total:.1f}% |")
+    return "\n".join(out)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=10)
+    args = ap.parse_args()
+    print(summarize(args.trace_dir, args.top))
+
+
+if __name__ == "__main__":
+    main()

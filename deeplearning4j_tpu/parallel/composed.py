@@ -23,6 +23,16 @@ Everything here works with the stock ``zoo.CausalTransformerLM`` /
 the only glue is the per-leaf PartitionSpec map below and the ambient
 ``distributed_context`` carrying (axis_name='seq', batch_axis='data',
 head_axis='tensor').
+
+Sequence-parallel mode choice under composition: ``ring`` and
+``zigzag_ring`` compose with tensor parallelism because the ring
+rotates KV along the SEQUENCE axis and never touches the head axis —
+TP-sharded heads ride straight through. ``ulysses`` does NOT compose
+with TP by design: its all-to-all REDISTRIBUTES the head axis across
+the sequence axis, i.e. heads are the resource it spends, and TP has
+already spent them; use ring/zigzag when a 'tensor' axis is present
+(running ulysses inside a composed mesh still works, but XLA must
+re-gather the head sharding at the shard_map boundary).
 """
 from __future__ import annotations
 

@@ -40,6 +40,19 @@ def _rms(x, gamma):
         jnp.mean(jnp.square(x), -1, keepdims=True) + RMSNORM_EPS) * gamma
 
 
+def _quant_kv(kvr, channel_axis: int):
+    """int8 KV quantisation shared by prefill and the decode step:
+    per-slice abs-max scales over ``channel_axis`` (the D channels of
+    each k/v half), round-to-int8 codes. Returns (codes f32-rounded →
+    int8, scales f32 with the channel axis dropped)."""
+    kvr = kvr.astype(jnp.float32)
+    s = jnp.maximum(
+        jnp.max(jnp.abs(kvr), axis=channel_axis) / 127.0, 1e-8)
+    w8 = jnp.round(kvr / jnp.expand_dims(s, channel_axis)).astype(
+        jnp.int8)
+    return w8, s.astype(jnp.float32)
+
+
 @jax.tree_util.register_pytree_node_class
 class QuantizedWeight:
     """Weight-only int8 tensor for serving: stores ``w8`` (int8) +
@@ -107,6 +120,7 @@ class CausalTransformerLM(ZooModel):
                  sequence_parallel: Optional[str] = None,
                  remat: bool = False, tie_embeddings: bool = False,
                  serve_quant: Optional[str] = None,
+                 cache_quant: Optional[str] = None,
                  seed: int = 123, updater=None,
                  compute_dtype: Optional[str] = None):
         self.remat = remat
@@ -121,6 +135,17 @@ class CausalTransformerLM(ZooModel):
             raise ValueError(f"serve_quant={serve_quant!r} "
                              "(None | 'int8')")
         self.serve_quant = serve_quant
+        # "int8": KV cache stored as int8 codes + per-(row, kv-head,
+        # k/v-half, position) f32 scales — decode is cache-READ-bound
+        # (XProf round 5: the per-token attention reads ~1.3 GB of
+        # bf16 cache at B=32/1k-prompt, ~65% of the HBM roofline), so
+        # halving cache bytes is the next serving lever after bf16
+        # weights. Dequant fuses into the score/weighted-sum einsums;
+        # scales are 1/256th of the cache bytes.
+        if cache_quant not in (None, "int8"):
+            raise ValueError(f"cache_quant={cache_quant!r} "
+                             "(None | 'int8')")
+        self.cache_quant = cache_quant
         self.vocab_size = vocab_size
         self.hidden = hidden
         self.n_layers = n_layers
@@ -323,8 +348,34 @@ class CausalTransformerLM(ZooModel):
             q = rotary_embedding(q, self.rope_theta, offset=pos)[:, 0]
             k = rotary_embedding(k, self.rope_theta, offset=pos)[:, 0]
             kv = jnp.concatenate([k, v[:, 0]], axis=2)  # [rows,Kv,2D]
-            ckv = jax.lax.dynamic_update_index_in_dim(ckv, kv, pos, 3)
-            ck, cv = ckv[:, :, :hd, :], ckv[:, :, hd:, :]
+            if self.cache_quant:
+                # int8 cache: quantise this position's kv against
+                # fresh per-(row, head, half) scales, update codes +
+                # scales; dequant fuses into the einsum reads below
+                w8, sc = ckv
+                q8, s_new = _quant_kv(
+                    kv.reshape(rows, n_kv, 2, hd), 3)
+                q8 = q8.reshape(rows, n_kv, 2 * hd)
+                w8 = jax.lax.dynamic_update_index_in_dim(w8, q8, pos,
+                                                         3)
+                sc = jax.lax.dynamic_update_index_in_dim(
+                    sc, s_new, pos, 3)
+                ckv = (w8, sc)
+                dt = x.dtype
+                # scales are constant over the channel axis, so they
+                # factor OUT of both einsums: the dots read PURE int8
+                # (cast fuses into the operand read — half the cache
+                # bytes), k-scales multiply the [.., T] scores after,
+                # v-scales pre-scale the softmax weights
+                ck = w8[:, :, :hd, :].astype(dt)
+                cv = w8[:, :, hd:, :].astype(dt)
+                k_scale = sc[:, :, 0, None, :].astype(dt)
+                v_scale = sc[:, :, 1, None, :].astype(dt)
+            else:
+                ckv = jax.lax.dynamic_update_index_in_dim(ckv, kv,
+                                                          pos, 3)
+                ck, cv = ckv[:, :, :hd, :], ckv[:, :, hd:, :]
+                k_scale = v_scale = None
             # grouped einsums attend straight against the SMALL cache
             # (GQA's cache-bandwidth saving survives decode: no
             # [rows,total,H,hd] broadcast is ever materialised)
@@ -332,9 +383,13 @@ class CausalTransformerLM(ZooModel):
             qg = q.reshape(rows, n_kv, groups, hd)
             s = jnp.einsum("bkgd,bkdt->bkgt", qg, ck) / jnp.sqrt(
                 jnp.asarray(hd, x.dtype))
-            live = jnp.arange(ckv.shape[3])[None, None, None, :] <= pos
+            if k_scale is not None:
+                s = s * k_scale
+            live = jnp.arange(ck.shape[3])[None, None, None, :] <= pos
             s = jnp.where(live, s, -1e9)
             w = jax.nn.softmax(s, axis=-1)
+            if v_scale is not None:
+                w = w * v_scale
             a = jnp.einsum("bkgt,bkdt->bkgd", w, cv).reshape(rows, -1)
             x = x + a @ mha["Wo"] + mha["bo"]
             h = rms(x, pblk["ln2"]["gamma"])
@@ -399,8 +454,15 @@ class CausalTransformerLM(ZooModel):
             # on every decode step's cache read
             pad = ((0, 0), (0, 0), (0, 0), (0, cache_len - tb))
             to_t = lambda z: z.transpose(0, 2, 3, 1)
-            caches.append(jnp.pad(
-                jnp.concatenate([to_t(k), to_t(v)], axis=2), pad))
+            kv_full = jnp.concatenate([to_t(k), to_t(v)], axis=2)
+            if self.cache_quant:
+                w8, s = _quant_kv(
+                    kv_full.reshape(bsz, n_kv, 2, hd, tb), 3)
+                caches.append((
+                    jnp.pad(w8.reshape(bsz, n_kv, 2 * hd, tb), pad),
+                    jnp.pad(s, pad)))
+            else:
+                caches.append(jnp.pad(kv_full, pad))
         x = rms(x, params[f"layer_{self.n_layers + 1}"]["gamma"])
         x_last = jax.lax.dynamic_index_in_dim(x, t0 - 1, axis=1,
                                               keepdims=False)

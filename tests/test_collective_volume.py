@@ -181,10 +181,15 @@ def test_composed_dp_sp_tp_per_axis_gates(cv):
         sharded = any(ax == "tensor"
                       for ax in (leaf.sharding.spec or ()))
         grad_bytes += nb // tp if sharded else nb
+    # band: the gate must catch the regression class (a lost gradient
+    # sync drops the WHOLE volume; runaway gathering adds multiples),
+    # not pin XLA's grouping choices — small tensors (loss mean, the
+    # tied-embedding grad contribution) drift between allreduce groups
+    # across compiles, so allow ±25% around the gradient bytes
     for axis in (("data",), ("seq",)):
         got = sum(nb for k, nb, ax, _ in colls
                   if k == "all-reduce" and ax == axis)
-        assert grad_bytes * 0.9 < got < grad_bytes * 1.15, \
+        assert grad_bytes * 0.75 < got < grad_bytes * 1.25, \
             (axis, got, grad_bytes)
 
     # 4. 'tensor' all-reduces are activation partials: each op at most

@@ -122,6 +122,7 @@ def _score(out):
     return float(m.group(1))
 
 
+@pytest.mark.slow
 @pytest.mark.skipif(os.environ.get("DL4J_TPU_SKIP_MP") == "1",
                     reason="multi-process test disabled")
 def test_kill_worker_resume_converges(tmp_path):

@@ -615,3 +615,89 @@ def test_decode_params_cache_invalidation():
     refs, prepared = model._decode_params_cache
     model.generate(net, prompt, n_new=4)
     assert model._decode_params_cache[1] is prepared
+
+
+def test_head_geometry_quality_parity():
+    """The round-5 flagship geometry change (6×d=128 instead of GPT-2's
+    12×d=64, BASELINE.md round-5 §3) is a hardware-mapping knob, not a
+    capacity change: at fixed hidden width, splitting the same
+    projection matrices into fewer/wider vs more/narrower heads keeps
+    the param count IDENTICAL and converges equivalently. Train the
+    same tiny LM with head_dim=hidden (1 head) and head_dim=hidden/4
+    (4 heads) on the same data and assert parity."""
+    from deeplearning4j_tpu.zoo import CausalTransformerLM
+
+    rng = np.random.default_rng(7)
+    # learnable structure: next token = (token + 1) mod vocab with a
+    # few random corruptions, so the loss floor is well below init
+    vocab, b, t = 32, 8, 32
+    x = rng.integers(0, vocab, (b, t)).astype(np.int32)
+    y = (x + 1) % vocab
+
+    finals, counts = [], []
+    for heads in (1, 4):
+        model = CausalTransformerLM(
+            vocab_size=vocab, hidden=32, n_layers=2, n_heads=heads,
+            max_len=t, ffn_mult=2.0, tie_embeddings=True, seed=3)
+        net = model.init(seq_len=t)
+        counts.append(sum(int(np.prod(p.shape))
+                          for p in jax.tree.leaves(net.params)))
+        step = net._make_train_step()
+        params, opt, state = net.params, net.opt_state, net.state
+        key = jax.random.PRNGKey(0)
+        for _ in range(60):
+            params, opt, state, loss = step(params, opt, state,
+                                            jnp.asarray(x),
+                                            jnp.asarray(y), None,
+                                            None, key)
+        finals.append(float(loss))
+
+    assert counts[0] == counts[1], counts
+    # both learn the structure: per-token loss well under the
+    # ln(32) ≈ 3.47 init plateau (the training loss is a SUM over
+    # the b·t tokens)...
+    per_tok = [f / (b * t) for f in finals]
+    assert all(f < 0.8 for f in per_tok), per_tok
+    # ...and land in the same loss regime (measured: within 0.1% of
+    # each other at 60 steps)
+    lo, hi = sorted(finals)
+    assert hi < lo * 1.5 + 0.1, finals
+
+
+def test_int8_kv_cache_decode_matches(toy_lm):
+    """cache_quant="int8" (round 5): decode with the int8 KV cache —
+    codes + per-(row, head, half, position) scales, dequant factored
+    out of the attention einsums so the dots read pure int8 — must
+    reproduce the bf16-cache greedy output on a trained model (the
+    toy LM's confident next-token structure leaves no headroom for
+    quantisation flips), and compose with beam search and int8
+    weights."""
+    model, net, _, _ = toy_lm
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, model.vocab_size, (2, 16)).astype(np.int32)
+    base = model.generate(net, prompt, n_new=16)
+
+    # FRESH instances: generate()'s compiled-scan cache lives on the
+    # model object and its jit key doesn't include cache_quant, so a
+    # copied model would silently reuse the bf16-cache executable and
+    # this test would compare bf16 to itself
+    qm = GPTNano(vocab_size=16, max_len=64, seed=5,
+                 cache_quant="int8")
+    got = qm.generate(net, prompt, n_new=16)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(base))
+
+    beam = qm.generate_beam(net, prompt, n_new=8, beams=3)
+    assert beam.shape == (2, prompt.shape[1] + 8)
+
+    qboth = GPTNano(vocab_size=16, max_len=64, seed=5,
+                    cache_quant="int8", serve_quant="int8")
+    both = qboth.generate(net, prompt, n_new=16)
+    # int8 weights round the logits; the confident toy still matches
+    assert (np.asarray(both) == np.asarray(base)).mean() > 0.9, (
+        both, base)
+
+
+def test_cache_quant_validation():
+    from deeplearning4j_tpu.zoo import CausalTransformerLM
+    with pytest.raises(ValueError):
+        CausalTransformerLM(cache_quant="int4")

@@ -75,6 +75,7 @@ WORKER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow
 @pytest.mark.skipif(os.environ.get("DL4J_TPU_SKIP_MP") == "1",
                     reason="multi-process test disabled")
 def test_two_process_distributed_training(tmp_path):
@@ -165,6 +166,7 @@ ENCODED_DCN_WORKER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow
 @pytest.mark.skipif(os.environ.get("DL4J_TPU_SKIP_MP") == "1",
                     reason="multi-process test disabled")
 def test_two_process_hierarchical_encoded_dp(tmp_path):

@@ -71,6 +71,7 @@ WORKER = textwrap.dedent("""
 """)
 
 
+@pytest.mark.slow
 @pytest.mark.skipif(os.environ.get("DL4J_TPU_SKIP_MP") == "1",
                     reason="multi-process test disabled")
 def test_two_process_ring_attention(tmp_path):

@@ -215,29 +215,40 @@ def gpt():
     # sync/dispatch floor (~100–150 ms per generate() — each call
     # blocks on host output), leaving the pure per-token device rate.
     # The token loop itself is a device-side lax.scan, so there is no
-    # per-token host cost to hide.
+    # per-token host cost to hide. Also measured with the int8 KV
+    # cache (cache_quant="int8", round 5): decode is cache-READ-bound
+    # at batch, so int8 codes halve the dominant traffic.
     t0_len, n_new = (8, 8) if SMOKE else (1024, 128)
+    q_model = CausalTransformerLM(
+        vocab_size=model.vocab_size, hidden=model.hidden,
+        n_layers=model.n_layers, n_heads=model.n_heads,
+        max_len=model.max_len, ffn_mult=model.ffn_mult,
+        tie_embeddings=model.tie_embeddings, cache_quant="int8",
+        compute_dtype=model.compute_dtype) if not SMOKE else None
     decode = {}
     for db in ((1, 2) if SMOKE else (1, 32)):
         prompt = np.asarray(rng.integers(0, 200, (db, t0_len)), np.int32)
         n_lo, n_hi = n_new, 3 * n_new
-        model.generate(net, prompt, n_new=n_lo)       # compile both
-        model.generate(net, prompt, n_new=n_hi)       # scan lengths
-        est = []
-        for _ in range(3):
-            tt = time.perf_counter()
-            model.generate(net, prompt, n_new=n_lo)   # blocks (host out)
-            t1 = time.perf_counter()
-            model.generate(net, prompt, n_new=n_hi)
-            est.append(((time.perf_counter() - t1), (t1 - tt)))
-        diff = sorted(hi_t - lo_t for hi_t, lo_t in est)[1]
-        # jitter guard (same as _timeit): an RTT spike inside the
-        # short leg can make the diff non-positive — fall back to the
-        # raw long-leg rate (overstates per-token cost, never negative)
-        if diff <= 0:
-            diff = sorted(hi_t for hi_t, _ in est)[1] \
-                * (n_hi - n_lo) / n_hi
-        decode[f"B{db}"] = db * (n_hi - n_lo) / diff
+        variants = [("", model)] + ([("_int8kv", q_model)]
+                                    if q_model is not None else [])
+        for suffix, m in variants:
+            m.generate(net, prompt, n_new=n_lo)      # compile both
+            m.generate(net, prompt, n_new=n_hi)      # scan lengths
+            est = []
+            for _ in range(3):
+                tt = time.perf_counter()
+                m.generate(net, prompt, n_new=n_lo)  # blocks (host out)
+                t1 = time.perf_counter()
+                m.generate(net, prompt, n_new=n_hi)
+                est.append(((time.perf_counter() - t1), (t1 - tt)))
+            diff = sorted(hi_t - lo_t for hi_t, lo_t in est)[1]
+            # jitter guard (same as _timeit): an RTT spike inside the
+            # short leg can make the diff non-positive — fall back to
+            # the raw long-leg rate (overstates, never negative)
+            if diff <= 0:
+                diff = sorted(hi_t for hi_t, _ in est)[1] \
+                    * (n_hi - n_lo) / n_hi
+            decode[f"B{db}{suffix}"] = db * (n_hi - n_lo) / diff
     # decode figures ride in the structured payload (BASELINE cfg #6
     # sets hard bars on them), not just the label
     extra = {"decode_tok_s": decode, "decode_prompt_len": t0_len,
@@ -445,7 +456,7 @@ def etl():
                  f"[ETL-wait {etl_pct:.0f}%; host pipeline "
                  f"{pipe_rate:,.0f} img/s/host ({cores} core"
                  f"{'s' if cores != 1 else ''})]")
-        flops = 3 * 4.1e9 * n_imgs / (n_imgs / b)  # per step, as #2
+        flops = 3 * 4.1e9 * b          # per step, same model as #2
         return (label, n_imgs / wall, "img/s", wall * b / n_imgs,
                 flops, {"etl_wait_pct": etl_pct,
                         "pipeline_img_s": pipe_rate,

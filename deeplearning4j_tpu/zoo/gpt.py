@@ -141,7 +141,8 @@ class CausalTransformerLM(ZooModel):
         # bf16 cache at B=32/1k-prompt, ~65% of the HBM roofline), so
         # halving cache bytes is the next serving lever after bf16
         # weights. Dequant fuses into the score/weighted-sum einsums;
-        # scales are 1/256th of the cache bytes.
+        # scale overhead is one f32 per head-half position =
+        # 4/head_dim of the int8 code bytes (1/32 at d=128).
         if cache_quant not in (None, "int8"):
             raise ValueError(f"cache_quant={cache_quant!r} "
                              "(None | 'int8')")
@@ -237,8 +238,13 @@ class CausalTransformerLM(ZooModel):
         # training never runs against a stale compiled decode; t0 and
         # top_p are TRACED scalars. Cast/quantisation happens once per
         # params version in _decode_params, not per call.
+        # cache_quant is read from the closure at trace time (the KV
+        # caches are BUILT inside the jitted fn), so it must be part
+        # of the key — a model copy flipping the attribute would
+        # otherwise silently reuse the other mode's executable
         fn = self._jit_cached(
-            (b, tb, n_new, temperature > 0, top_k, top_p is not None),
+            (b, tb, n_new, temperature > 0, top_k, top_p is not None,
+             self.cache_quant),
             lambda: functools.partial(
                 self._decode_gen, b=b, tb=tb, n_new=n_new,
                 sample=temperature > 0, top_k=top_k,
@@ -364,9 +370,11 @@ class CausalTransformerLM(ZooModel):
                 dt = x.dtype
                 # scales are constant over the channel axis, so they
                 # factor OUT of both einsums: the dots read PURE int8
-                # (cast fuses into the operand read — half the cache
-                # bytes), k-scales multiply the [.., T] scores after,
-                # v-scales pre-scale the softmax weights
+                # (the astype fuses into the operand read — half the
+                # cache bytes; a mixed int8×bf16 dot_general was also
+                # measured and is slightly slower), k-scales multiply
+                # the [.., T] scores after the dot, v-scales pre-scale
+                # the softmax weights
                 ck = w8[:, :, :hd, :].astype(dt)
                 cv = w8[:, :, hd:, :].astype(dt)
                 k_scale = sc[:, :, 0, None, :].astype(dt)
@@ -587,7 +595,7 @@ class CausalTransformerLM(ZooModel):
             return np.asarray(np.asarray(prompt, np.int32))
         prompt_np, prompt_pad, b, t0, tb = prep
         fn = self._jit_cached(
-            ("beam", b, beams, tb, n_new),
+            ("beam", b, beams, tb, n_new, self.cache_quant),
             lambda: functools.partial(self._beam_scan, b=b,
                                       beams=beams, tb=tb, n_new=n_new))
         gen = np.asarray(fn(self._decode_params(net), prompt_pad,

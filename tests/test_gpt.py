@@ -677,10 +677,9 @@ def test_int8_kv_cache_decode_matches(toy_lm):
     prompt = rng.integers(0, model.vocab_size, (2, 16)).astype(np.int32)
     base = model.generate(net, prompt, n_new=16)
 
-    # FRESH instances: generate()'s compiled-scan cache lives on the
-    # model object and its jit key doesn't include cache_quant, so a
-    # copied model would silently reuse the bf16-cache executable and
-    # this test would compare bf16 to itself
+    # FRESH instances (and the jit key now carries cache_quant, so
+    # even a copied model with the attribute flipped retraces instead
+    # of silently reusing the bf16-cache executable)
     qm = GPTNano(vocab_size=16, max_len=64, seed=5,
                  cache_quant="int8")
     got = qm.generate(net, prompt, n_new=16)

@@ -326,6 +326,11 @@ def main():
                         ("SP ring attention T=8k causal", sp_ring)]:
         jitted, a = build()
         rows.append(analyze(name, jitted, a))
+    # composed DP×SP×TP LM step: compiled under its ambient context
+    step, a, ctx, _axes = composed_lm()
+    with ctx:
+        rows.append(analyze("Composed DP×SP×TP causal-LM step", step,
+                            a))
 
     if args.markdown:
         print("| config | collectives (count × kind) | wire MB/step "

@@ -235,18 +235,21 @@ def gpt():
             m.generate(net, prompt, n_new=n_lo)      # compile both
             m.generate(net, prompt, n_new=n_hi)      # scan lengths
             est = []
-            for _ in range(3):
+            # B=1 is the noisiest row (small absolute times vs RTT
+            # jitter): give it more paired estimates
+            for _ in range(5 if db == 1 else 3):
                 tt = time.perf_counter()
                 m.generate(net, prompt, n_new=n_lo)  # blocks (host out)
                 t1 = time.perf_counter()
                 m.generate(net, prompt, n_new=n_hi)
                 est.append(((time.perf_counter() - t1), (t1 - tt)))
-            diff = sorted(hi_t - lo_t for hi_t, lo_t in est)[1]
+            mid = len(est) // 2               # true median index
+            diff = sorted(hi_t - lo_t for hi_t, lo_t in est)[mid]
             # jitter guard (same as _timeit): an RTT spike inside the
             # short leg can make the diff non-positive — fall back to
             # the raw long-leg rate (overstates, never negative)
             if diff <= 0:
-                diff = sorted(hi_t for hi_t, _ in est)[1] \
+                diff = sorted(hi_t for hi_t, _ in est)[mid] \
                     * (n_hi - n_lo) / n_hi
             decode[f"B{db}{suffix}"] = db * (n_hi - n_lo) / diff
     # decode figures ride in the structured payload (BASELINE cfg #6

@@ -246,14 +246,26 @@ class ImageRecordReader(RecordReader):
                  label_generator=None,
                  labels: Optional[List[str]] = None,
                  transform: Optional[ImageTransform] = None,
-                 channels_first: bool = False, seed: int = 0):
+                 channels_first: bool = False, seed: int = 0,
+                 workers: int = 0):
         self.loader = NativeImageLoader(height, width, channels,
                                         channels_first)
         self.label_generator = label_generator \
             or ParentPathLabelGenerator()
         self.labels = list(labels) if labels else None
         self.transform = transform
+        self.seed = seed
+        #: decode/augment parallelism: >1 maps the per-file work over
+        #: a thread pool (cv2 releases the GIL, so this scales on
+        #: multi-core hosts — the BASELINE.md ETL sizing says ~10
+        #: cores feed one v5e chip at full ResNet-50 rate), with
+        #: bounded read-ahead and ORDERED yield. Augmentation rng is
+        #: per-file (seeded by (seed, epoch, index)) so output is
+        #: deterministic regardless of thread timing while each epoch
+        #: still draws fresh augments.
+        self.workers = workers
         self._rng = np.random.default_rng(seed)
+        self._epoch = 0
         self._files: List[str] = []
 
     def initialize(self, root: str) -> "ImageRecordReader":
@@ -273,24 +285,58 @@ class ImageRecordReader(RecordReader):
     def num_labels(self) -> int:
         return len(self.labels or [])
 
+    def _load(self, f: str, rng) -> list:
+        """Per-file decode → augment → resize → label (shared by the
+        sequential and thread-pool paths)."""
+        img = self.loader._decode(f)
+        if self.transform is not None:
+            img = self.transform.transform(img, rng)
+        cv2 = _cv2()
+        if img.shape[:2] != (self.loader.height, self.loader.width):
+            img = cv2.resize(
+                img, (self.loader.width, self.loader.height),
+                interpolation=cv2.INTER_AREA)
+            if img.ndim == 2:
+                img = img[..., None]
+        x = img.astype(np.float32)
+        if self.loader.channels_first:
+            x = np.transpose(x, (2, 0, 1))
+        lab = self.labels.index(self.label_generator.get_label(f))
+        return [x, lab]
+
     def __iter__(self):
+        if self.workers and self.workers > 1:
+            # ordered parallel decode with a bounded in-flight window
+            # (2× workers) so memory stays O(workers), not O(dataset).
+            # Augment rng is keyed (seed, epoch, index): deterministic
+            # under any thread timing, but fresh per epoch like the
+            # sequential stream
+            from collections import deque
+            from concurrent.futures import ThreadPoolExecutor
+
+            epoch = self._epoch
+            self._epoch += 1
+
+            def task(i, f):
+                return self._load(
+                    f, np.random.default_rng([self.seed, epoch, i]))
+
+            ex = ThreadPoolExecutor(self.workers)
+            try:
+                window: deque = deque()
+                for i, f in enumerate(self._files):
+                    window.append(ex.submit(task, i, f))
+                    if len(window) >= 2 * self.workers:
+                        yield window.popleft().result()
+                while window:
+                    yield window.popleft().result()
+            finally:
+                # a consumer abandoning the generator mid-epoch must
+                # not block on up to 2×workers in-flight decodes
+                ex.shutdown(wait=False, cancel_futures=True)
+            return
         for f in self._files:
-            img = self.loader._decode(f)
-            if self.transform is not None:
-                img = self.transform.transform(img, self._rng)
-            cv2 = _cv2()
-            if img.shape[:2] != (self.loader.height, self.loader.width):
-                img = cv2.resize(
-                    img, (self.loader.width, self.loader.height),
-                    interpolation=cv2.INTER_AREA)
-                if img.ndim == 2:
-                    img = img[..., None]
-            x = img.astype(np.float32)
-            if self.loader.channels_first:
-                x = np.transpose(x, (2, 0, 1))
-            lab = self.labels.index(
-                self.label_generator.get_label(f))
-            yield [x, lab]
+            yield self._load(f, self._rng)
 
     def reset(self):
         pass

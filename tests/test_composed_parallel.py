@@ -101,3 +101,43 @@ def test_composed_params_actually_sharded():
             assert spec[0] == "tensor", (names, spec)
             found_row = True
     assert found_col and found_row
+
+
+def test_composed_gqa_matches_single_device():
+    """Composed mesh with grouped-query attention: kv heads (2) shard
+    over 'tensor' alongside the query heads (4) — the ring carries the
+    SMALL kv per shard. One train step must match the single-device
+    step. (Masked ring attention under a composed mesh is covered at
+    the layer level by test_composed_dp_sp_tp_matches_single_device's
+    zigzag variant machinery + tests/test_parallel.py's masked rings —
+    the LM's fit path itself doesn't thread key masks.)"""
+    from deeplearning4j_tpu.parallel import (
+        composed_context, composed_data_sharding, make_mesh,
+        shard_lm_for_composed)
+    from deeplearning4j_tpu.zoo import CausalTransformerLM
+
+    def build():
+        model = CausalTransformerLM(
+            vocab_size=VOCAB, hidden=HID, n_layers=2, n_heads=4,
+            n_kv_heads=2, max_len=T, ffn_mult=2.0,
+            tie_embeddings=True, seed=9, sequence_parallel="ring")
+        return model.init(seq_len=T)
+
+    x, y = _batch()
+    ref_losses, ref_params = _run_steps(build(), x, y, n=1)
+
+    net = build()
+    mesh = make_mesh({"data": 2, "seq": 2, "tensor": 2})
+    shard_lm_for_composed(net, mesh)
+    ds = composed_data_sharding(mesh)
+    xs, ys = jax.device_put(x, ds), jax.device_put(y, ds)
+    with composed_context(mesh):
+        losses, params = _run_steps(net, xs, ys, n=1)
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-5)
+    for (ka, a), (kb, b) in zip(
+            jax.tree_util.tree_leaves_with_path(params),
+            jax.tree_util.tree_leaves_with_path(ref_params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5,
+            err_msg=str(ka))

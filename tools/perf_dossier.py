@@ -396,8 +396,13 @@ def etl():
         aug = PipelineImageTransform([
             (CropImageTransform(src - size), 1.0),
             (FlipImageTransform(1), 0.5)])
-        reader = ImageRecordReader(size, size, 3,
-                                   transform=aug).initialize(root)
+        # decode over all host cores (ordered thread-pool map; cv2
+        # releases the GIL) — a no-op on this 1-vCPU box, the real
+        # lever on production hosts (BASELINE.md: ~10 cores feed one
+        # v5e at the full ResNet-50 rate)
+        reader = ImageRecordReader(
+            size, size, 3, transform=aug,
+            workers=os.cpu_count() or 1).initialize(root)
         it = RecordReaderDataSetIterator(reader, b, label_index=1,
                                          num_classes=classes)
         it.set_pre_processor(ImagePreProcessingScaler())
@@ -445,10 +450,10 @@ def etl():
 
         # pipeline-only rate (no device step, no transfer): what the
         # host can decode+augment+normalize per second — the number
-        # that sizes host capacity per chip. This is a PER-HOST rate
-        # (the decode loop is single-threaded Python feeding the
-        # async queue, so on this 1-vCPU box host == core; a
-        # multi-worker reader would scale it by workers).
+        # that sizes host capacity per chip. This is a PER-HOST rate:
+        # the reader maps decode over workers=os.cpu_count() threads
+        # (see above), so on a multi-core host this is already the
+        # whole-host rate; on this 1-vCPU box host == core.
         t0 = time.perf_counter()
         n_pipe = sum(ds.features.shape[0] for ds in ait)
         pipe_rate = n_pipe / (time.perf_counter() - t0)

@@ -10,7 +10,8 @@ counters from the XProf capture (VERDICT r1 #5 asks for exactly that).
 
 Run on the real chip:
   python tools/perf_dossier.py [--trace DIR] [--out FILE] [config ...]
-Configs: resnet50 bert lstm flashbwd gpt gpt8k (default: all).
+Configs: resnet50 bert lstm flashbwd gpt gpt8k etl lenet
+(default: all).
 ``--smoke``: tiny CPU shapes to validate wiring — table rows are
 labeled ``(smoke)`` and carry no MFU claim.
 Writes a markdown table to stdout; paste into BASELINE.md.
@@ -74,6 +75,30 @@ def _timeit(fn, sync_out, n=20, warmup=5):
 SMOKE = False        # --smoke: tiny shapes on CPU to validate wiring
 
 
+def _drive_train_step(net, feed, ys):
+    """One-arg step driver shared by the image-model configs: handles
+    the graph-style vs sequential calling convention and carries the
+    donated params/opt/state across calls."""
+    import jax
+    step = net._make_train_step()
+    state = {"p": net.params, "o": net.opt_state, "s": net.state}
+    key = jax.random.PRNGKey(0)
+    graph = hasattr(net.conf, "inputs")
+
+    def one():
+        if graph:
+            state["p"], state["o"], state["s"], loss = step(
+                state["p"], state["o"], state["s"],
+                {net.conf.inputs[0]: feed}, [ys], {}, {}, key)
+        else:
+            state["p"], state["o"], state["s"], loss = step(
+                state["p"], state["o"], state["s"], feed, ys,
+                None, None, key)
+        return loss
+
+    return one, state
+
+
 def resnet50():
     """ResNet-50 train step, batch 256 @ 224² bf16 (BASELINE cfg #2)."""
     import jax
@@ -91,23 +116,7 @@ def resnet50():
                     jnp.float32)
     y = jnp.asarray(np.eye(1000, dtype=np.float32)[
         rng.integers(0, 1000, batch)])
-    step = net._make_train_step()
-    params, opt, state = net.params, net.opt_state, net.state
-    key = jax.random.PRNGKey(0)
-    # graph-style nets take ({name: x}, [y], masks, lmasks, rng)
-    graph = hasattr(net.conf, "inputs")
-
-    def one():
-        nonlocal params, opt, state
-        if graph:
-            params, opt, state, loss = step(
-                params, opt, state, {net.conf.inputs[0]: x}, [y],
-                {}, {}, key)
-        else:
-            params, opt, state, loss = step(params, opt, state, x, y,
-                                            None, None, key)
-        return loss
-
+    one, _ = _drive_train_step(net, x, y)
     dt = _timeit(one, lambda l: l)
     # ResNet-50 fwd ≈ 4.1 GFLOP @224²/img; train ≈ 3x fwd
     flops = 3 * 4.1e9 * batch
@@ -355,6 +364,31 @@ def lstm():
     return ("charRNN 2x512 b64 t200", b * t / dt, "chars/s", dt, flops)
 
 
+def lenet():
+    """LeNet MNIST-shape train step (BASELINE cfg #1 throughput half;
+    the ACCURACY half runs on real files via DL4J_TPU_MNIST_DIR —
+    synthetic-shape throughput is labeled as such)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.zoo import LeNet
+
+    b = 8 if SMOKE else 512
+    net = LeNet(num_classes=10, seed=0).init()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((b, 28, 28, 1)), jnp.float32)
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[
+        rng.integers(0, 10, b)])
+    one, _ = _drive_train_step(net, x, y)
+    dt = _timeit(one, lambda l: l, n=30)
+    # the ZOO LeNet (20ch 5×5 SAME conv + 50ch 5×5 SAME conv + dense
+    # 500): fwd ≈ 0.78M (conv1) + 9.8M (conv2) + 2.45M (dense) ≈
+    # 13.1 MFLOP/img; train ≈ 3× fwd
+    flops = 3 * 13.1e6 * b
+    return ("LeNet train b512 @28x28 (synthetic MNIST shapes)",
+            b / dt, "img/s", dt, flops)
+
+
 def etl():
     """ResNet-50 train with the REAL input pipeline on the clock
     (VERDICT r4 Missing #2): synthetic ImageNet-shaped JPEGs on disk
@@ -517,7 +551,7 @@ def main(names):
         jax.config.update("jax_platforms", "cpu")
     table = {"resnet50": resnet50, "bert": bert, "lstm": lstm,
              "flashbwd": flashbwd, "gpt": gpt, "gpt8k": gpt8k,
-             "etl": etl}
+             "etl": etl, "lenet": lenet}
     trace_dir = out_path = None
     for flag in ("--trace", "--out"):
         if flag in names:

@@ -170,9 +170,14 @@ class TransferLearning:
                 if ((di, dn) in surviving or di >= n_keep
                         or di in reinit):
                     continue
-                src_p = params.get(_lname(si), {})
+                # read from the SOURCE net's full params (the local
+                # `params` dict only carries kept layers — a tie whose
+                # source layer was REMOVED is exactly the case that
+                # needs this fill); copy so the new net's donated
+                # buffers never alias the source net's arrays
+                src_p = src.params.get(_lname(si), {})
                 if sn in src_p:
-                    val = src_p[sn]
+                    val = jnp.array(src_p[sn])
                     dropped_fill[(di, dn)] = val.T if tr else val
             if self._ftc is not None:
                 self._ftc._apply(conf, layers)

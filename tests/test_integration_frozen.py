@@ -150,3 +150,47 @@ if __name__ == "__main__":
     import jax
     jax.config.update("jax_platforms", "cpu")
     _generate()
+
+
+def test_dropped_tie_with_removed_source_restores_trained_value():
+    """A tie whose SOURCE layer is removed by surgery while its dst
+    layer is kept must materialize the TRAINED tied value into the
+    kept layer — not silently re-randomize it (round-5 review: the
+    fill must read the source net's FULL params, since the kept-layers
+    dict no longer contains the removed source)."""
+    import jax
+    import numpy as np
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn import updaters as upd
+    from deeplearning4j_tpu.nn.transferlearning import TransferLearning
+
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater(upd.Sgd(learning_rate=1e-2)).list()
+            .layer(DenseLayer(n_out=4, activation="tanh"))
+            .layer(DenseLayer(n_out=4, activation="tanh"))
+            .layer(OutputLayer(n_out=4, activation="softmax",
+                               loss="mcxent"))
+            # downward tie: layer_0's W materializes FROM layer_1's W
+            .tie_weights(0, "W", 1, "W")
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 4)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 32)]
+    for _ in range(3):
+        net.fit(x, y)
+    trained_src = np.asarray(net.params["layer_1"]["W"])
+
+    # surgery removes layers 1..2 (the tie SOURCE goes away), puts a
+    # fresh head on; layer_0 is kept untouched
+    new = (TransferLearning.builder(net)
+           .remove_layers_from_output(2)
+           .add_layer(OutputLayer(n_out=4, activation="softmax",
+                                  loss="mcxent"))
+           .build())
+    assert not getattr(new.conf, "tied_weights", [])
+    got = np.asarray(new.params["layer_0"]["W"])
+    np.testing.assert_array_equal(got, trained_src)

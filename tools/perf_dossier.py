@@ -55,6 +55,7 @@ def _timeit(fn, sync_out, n=20, warmup=5):
         out = fn()
     _sync(sync_out(out))
     est = []
+    longs = []
     for _ in range(3):
         t0 = time.perf_counter()
         for _ in range(n):
@@ -66,26 +67,29 @@ def _timeit(fn, sync_out, n=20, warmup=5):
         _sync(sync_out(out))
         t2 = time.perf_counter()
         est.append(((t2 - t1) - (t1 - t0)) / (2 * n))
+        longs.append(t2 - t1)
     dt = sorted(est)[1]
     # jitter guard: a negative/degenerate diff (RTT spike inside the
-    # short leg) falls back to the raw long-leg rate
-    return dt if dt > 0 else (t2 - t1) / (3 * n)
+    # short leg) falls back to the MEDIAN raw long-leg rate
+    return dt if dt > 0 else sorted(longs)[1] / (3 * n)
 
 
 SMOKE = False        # --smoke: tiny shapes on CPU to validate wiring
 
 
-def _drive_train_step(net, feed, ys):
-    """One-arg step driver shared by the image-model configs: handles
-    the graph-style vs sequential calling convention and carries the
-    donated params/opt/state across calls."""
+def _drive_train_step(net):
+    """Step driver shared by the image-model configs: handles the
+    graph-style vs sequential calling convention and carries the
+    donated params/opt/state across calls. Returns ``run(feed, ys)``
+    (per-call data — the etl config feeds a fresh batch every call)
+    plus the live state dict."""
     import jax
     step = net._make_train_step()
     state = {"p": net.params, "o": net.opt_state, "s": net.state}
     key = jax.random.PRNGKey(0)
     graph = hasattr(net.conf, "inputs")
 
-    def one():
+    def run(feed, ys):
         if graph:
             state["p"], state["o"], state["s"], loss = step(
                 state["p"], state["o"], state["s"],
@@ -96,7 +100,7 @@ def _drive_train_step(net, feed, ys):
                 None, None, key)
         return loss
 
-    return one, state
+    return run, state
 
 
 def resnet50():
@@ -116,7 +120,8 @@ def resnet50():
                     jnp.float32)
     y = jnp.asarray(np.eye(1000, dtype=np.float32)[
         rng.integers(0, 1000, batch)])
-    one, _ = _drive_train_step(net, x, y)
+    run, _ = _drive_train_step(net)
+    one = lambda: run(x, y)
     dt = _timeit(one, lambda l: l)
     # ResNet-50 fwd ≈ 4.1 GFLOP @224²/img; train ≈ 3x fwd
     flops = 3 * 4.1e9 * batch
@@ -379,7 +384,8 @@ def lenet():
     x = jnp.asarray(rng.standard_normal((b, 28, 28, 1)), jnp.float32)
     y = jnp.asarray(np.eye(10, dtype=np.float32)[
         rng.integers(0, 10, b)])
-    one, _ = _drive_train_step(net, x, y)
+    run, _ = _drive_train_step(net)
+    one = lambda: run(x, y)
     dt = _timeit(one, lambda l: l, n=30)
     # the ZOO LeNet (20ch 5×5 SAME conv + 50ch 5×5 SAME conv + dense
     # 500): fwd ≈ 0.78M (conv1) + 9.8M (conv2) + 2.45M (dense) ≈
@@ -448,25 +454,14 @@ def etl():
                                              momentum=0.9),
                        compute_dtype=None if SMOKE
                        else "bfloat16").init()
-        step = net._make_train_step()
-        params, opt, state = net.params, net.opt_state, net.state
-        key = jax.random.PRNGKey(0)
-        graph = hasattr(net.conf, "inputs")
+        run, _ = _drive_train_step(net)
 
         def run_epoch():
-            nonlocal params, opt, state
             n = 0
             loss = None
             for ds in ait:
                 x = jnp.asarray(ds.features)
-                y = jnp.asarray(ds.labels)
-                if graph:
-                    params, opt, state, loss = step(
-                        params, opt, state,
-                        {net.conf.inputs[0]: x}, [y], {}, {}, key)
-                else:
-                    params, opt, state, loss = step(
-                        params, opt, state, x, y, None, None, key)
+                loss = run(x, jnp.asarray(ds.labels))
                 n += x.shape[0]
             return n, loss
 

@@ -39,11 +39,17 @@ def _classify(name: str) -> str:
 def summarize(trace_dir: str, top: int = 10):
     import jax
 
-    paths = sorted(Path(trace_dir).rglob("*.xplane.pb"))
+    paths = sorted(Path(trace_dir).rglob("*.xplane.pb"),
+                   key=lambda p: p.stat().st_mtime)
     if not paths:
         raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
     pd = jax.profiler.ProfileData.from_file(str(paths[-1]))
-    dev = next(p for p in pd.planes if "/device:" in p.name)
+    dev = next((p for p in pd.planes if "/device:" in p.name), None)
+    if dev is None:
+        raise SystemExit(
+            f"{paths[-1]} has no device plane — was the capture taken "
+            "on CPU, or did every traced run fail before touching the "
+            "device?")
     steps, per_op, per_class = [], defaultdict(float), \
         defaultdict(float)
     counts = defaultdict(int)
@@ -60,6 +66,10 @@ def summarize(trace_dir: str, top: int = 10):
             per_class[cls] += e.duration_ns
             counts[cls] += 1
     total = sum(per_class.values())
+    if not total:
+        raise SystemExit(
+            f"{paths[-1]}'s device plane has no 'XLA Ops' events — "
+            "nothing executed under the trace")
     out = []
     out.append(f"steps: {len(steps)}, mean device step "
                f"{sum(steps) / max(1, len(steps)) / 1e6:.2f} ms")

@@ -171,6 +171,13 @@ def bench_body():
     runs = sorted(timed_run() for _ in range(3))
     images_per_sec = runs[1]  # median of 3 paired estimates
 
+    # compile subsystem (perf/): wall-time XLA spent compiling this
+    # run's entry points and whether the persistent cache paid for any
+    # of it — a second bench run against a warm DL4J_TPU_COMPILE_CACHE
+    # should show persistent_hits == compile_requests
+    from deeplearning4j_tpu.perf import compile_report
+    compile_rec = compile_report()
+
     print(json.dumps({
         "metric": METRIC,
         "value": round(images_per_sec, 1),
@@ -183,6 +190,7 @@ def bench_body():
         "image_size": size,
         "compute_dtype": "bfloat16" if on_tpu else "float32",
         "platform": jax.devices()[0].platform,
+        "compile": compile_rec,
     }), flush=True)
 
 

@@ -37,4 +37,11 @@ if environment.get_flag("DL4J_TPU_DEFAULT_DTYPE") != "float32":
         environment.get_flag("DL4J_TPU_DEFAULT_DTYPE"))
 environment.apply_startup_flags()
 
+# persistent XLA compile cache (perf/compile_cache.py): configured at
+# import so every jit in this process — and every sibling worker
+# process — reads/writes the shared on-disk cache (DL4J_TPU_COMPILE_CACHE)
+from deeplearning4j_tpu.perf import compile_cache as _compile_cache
+
+_compile_cache.configure_from_env()
+
 __all__ = ["NDArray", "Nd4j", "dtypes", "environment", "__version__"]

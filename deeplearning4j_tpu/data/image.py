@@ -267,6 +267,8 @@ class ImageRecordReader(RecordReader):
         self._rng = np.random.default_rng(seed)
         self._epoch = 0
         self._files: List[str] = []
+        self._pool = None          # one executor per reader (lazy)
+        self._inflight: set = set()
 
     def initialize(self, root: str) -> "ImageRecordReader":
         """Scan root/<label>/ for images (reference
@@ -304,6 +306,16 @@ class ImageRecordReader(RecordReader):
         lab = self.labels.index(self.label_generator.get_label(f))
         return [x, lab]
 
+    def _executor(self):
+        """ONE pool per reader, not per epoch: a training run iterates
+        this reader epochs×, and thread create/teardown per ``__iter__``
+        is pure churn (plus a warm pool keeps cv2's per-thread state
+        hot). Lazy so workers<=1 readers never spin threads."""
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(self.workers)
+        return self._pool
+
     def __iter__(self):
         if self.workers and self.workers > 1:
             # ordered parallel decode with a bounded in-flight window
@@ -312,7 +324,6 @@ class ImageRecordReader(RecordReader):
             # under any thread timing, but fresh per epoch like the
             # sequential stream
             from collections import deque
-            from concurrent.futures import ThreadPoolExecutor
 
             epoch = self._epoch
             self._epoch += 1
@@ -321,25 +332,69 @@ class ImageRecordReader(RecordReader):
                 return self._load(
                     f, np.random.default_rng([self.seed, epoch, i]))
 
-            ex = ThreadPoolExecutor(self.workers)
+            ex = self._executor()
+            window: deque = deque()
             try:
-                window: deque = deque()
                 for i, f in enumerate(self._files):
-                    window.append(ex.submit(task, i, f))
+                    fut = ex.submit(task, i, f)
+                    window.append(fut)
+                    self._inflight.add(fut)
+                    # self-prune on completion (late-bound so close()
+                    # can swap the set out from under old epochs): an
+                    # abandoned epoch must not pin decoded arrays in
+                    # _inflight for the reader's lifetime
+                    fut.add_done_callback(
+                        lambda f: self._inflight.discard(f))
                     if len(window) >= 2 * self.workers:
                         yield window.popleft().result()
                 while window:
                     yield window.popleft().result()
             finally:
                 # a consumer abandoning the generator mid-epoch must
-                # not block on up to 2×workers in-flight decodes
-                ex.shutdown(wait=False, cancel_futures=True)
+                # not leave a dead epoch decoding: cancel what hasn't
+                # started (running decodes finish into _inflight and
+                # are joined by close()); the pool itself stays up for
+                # the next epoch
+                for fut in window:
+                    fut.cancel()
             return
         for f in self._files:
             yield self._load(f, self._rng)
 
     def reset(self):
         pass
+
+    def close(self):
+        """Join in-flight decode futures and tear the pool down — an
+        abandoned partial epoch must not keep worker threads churning
+        past the reader's lifetime. Idempotent; the reader is reusable
+        after close (the pool respawns lazily)."""
+        import concurrent.futures
+        # swap first: done-callbacks resolve self._inflight late, so
+        # they prune the fresh set; drain the old one with atomic
+        # pop()s — a straggler callback may still hold a reference to
+        # it, and list(set) can blow up mid-iteration on a concurrent
+        # discard
+        inflight, self._inflight = self._inflight, set()
+        futs = []
+        while inflight:
+            try:
+                futs.append(inflight.pop())
+            except KeyError:
+                break
+        for fut in futs:
+            fut.cancel()
+        if futs:
+            concurrent.futures.wait(futs)
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class BatchImageETL:

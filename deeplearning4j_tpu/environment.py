@@ -66,6 +66,27 @@ _register("DL4J_TPU_FLASH_MIN_T", 1024, int,
           "dispatches to the Pallas flash kernel on TPU (crossover "
           "measured on v5e, tools/flash_crossover.py)")
 
+# -- compile subsystem (perf/: persistent XLA cache + retrace sentry) ------
+_register("DL4J_TPU_COMPILE_CACHE",
+          os.path.expanduser("~/.dl4j_tpu/compile_cache"), str,
+          "persistent XLA compilation cache dir shared across "
+          "processes/restarts ('' | '0' | 'off' | 'none' disables; "
+          "the default applies only on accelerator platforms — CPU "
+          "processes must opt in by setting the var)")
+_register("DL4J_TPU_COMPILE_CACHE_MIN_BYTES", -1, int,
+          "min serialized-executable size eligible for the persistent "
+          "cache (-1: cache everything)")
+_register("DL4J_TPU_COMPILE_CACHE_MIN_SECS", 0.0, float,
+          "min compile wall-time eligible for the persistent cache "
+          "(0: cache everything)")
+_register("DL4J_TPU_RETRACE_BUDGET", 16, int,
+          "distinct UNPLANNED traced shapes tolerated per jitted entry "
+          "point before the retrace sentry warns (warmed-up shapes "
+          "don't count against it)")
+_register("DL4J_TPU_RETRACE_STRICT", False, _bool,
+          "retrace sentry raises RetraceBudgetExceeded instead of "
+          "warning when a function blows its retrace budget")
+
 # -- UI / examples ---------------------------------------------------------
 _register("DL4J_TPU_UI_PORT", 9000, int,
           "training dashboard HTTP port (DL4JSystemProperties UI port)")

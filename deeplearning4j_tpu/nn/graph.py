@@ -30,6 +30,7 @@ from deeplearning4j_tpu.nn.layers.special import FrozenLayer
 from deeplearning4j_tpu.nn.multilayer import _FUSABLE
 from deeplearning4j_tpu.nn.vertices import (GraphVertex, vertex_from_dict)
 from deeplearning4j_tpu.ops import losses as losses_mod
+from deeplearning4j_tpu.perf import sentry
 
 
 @dataclass
@@ -384,7 +385,9 @@ class ComputationGraph:
         return params, opt_state, new_state, loss
 
     def _make_train_step(self):
-        return jax.jit(self._update, donate_argnums=(0, 1, 2))
+        return sentry.jit(self._update,
+                          name="ComputationGraph.train_step",
+                          donate_argnums=(0, 1, 2))
 
     def _make_train_loop(self):
         """K train steps per dispatched executable (``lax.scan`` over
@@ -411,7 +414,8 @@ class ComputationGraph:
                  rng_stack))
             return p, o, s, losses
 
-        return jax.jit(loop, donate_argnums=(0, 1, 2))
+        return sentry.jit(loop, name="ComputationGraph.train_loop",
+                          donate_argnums=(0, 1, 2))
 
     def _refresh_ambient_trace(self):
         """Drop jitted caches when the ambient distributed context has
@@ -566,28 +570,39 @@ class ComputationGraph:
             l.iteration_done(self, self.iteration, self.epoch)
 
     # ------------------------------------------------------------------
+    def _make_output_fn(self):
+        cd = self.conf.compute_dtype
+
+        def infer(params, state, inputs):
+            if cd is not None:
+                params = dtypes.cast_float_tree(params, cd)
+                state = dtypes.cast_float_tree(state, cd)
+                inputs = dtypes.cast_float_tree(inputs, cd)
+            acts, _ = self._forward(params, state, inputs,
+                                    train=False, rng=None)
+            outs = [acts[o] for o in self.conf.outputs]
+            if cd is not None:
+                outs = [o.astype(jnp.float32) for o in outs]
+            return outs
+
+        return sentry.jit(infer, name="ComputationGraph.output")
+
     def output(self, *features, train: bool = False):
         """Returns a list of output activations (reference
         ComputationGraph.output)."""
         self._refresh_ambient_trace()
         if self._output_fn is None:
-            cd = self.conf.compute_dtype
-
-            def infer(params, state, inputs):
-                if cd is not None:
-                    params = dtypes.cast_float_tree(params, cd)
-                    state = dtypes.cast_float_tree(state, cd)
-                    inputs = dtypes.cast_float_tree(inputs, cd)
-                acts, _ = self._forward(params, state, inputs,
-                                        train=False, rng=None)
-                outs = [acts[o] for o in self.conf.outputs]
-                if cd is not None:
-                    outs = [o.astype(jnp.float32) for o in outs]
-                return outs
-            self._output_fn = jax.jit(infer)
+            self._output_fn = self._make_output_fn()
         inputs = {n: jnp.asarray(np.asarray(x))
                   for n, x in zip(self.conf.inputs, features)}
         return self._output_fn(self.params, self.state, inputs)
+
+    def warmup(self, specs):
+        """AOT-compile the train step, scanned loop, and output fn for
+        every declared shape bucket (see ``perf.warmup``)."""
+        from deeplearning4j_tpu.perf.warmup import warmup_network
+        self._refresh_ambient_trace()
+        return warmup_network(self, specs)
 
     def output_single(self, *features):
         return self.output(*features)[0]

@@ -32,6 +32,7 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
 from deeplearning4j_tpu.nn.layers.special import FrozenLayer
 from deeplearning4j_tpu.nn import updaters as upd
 from deeplearning4j_tpu.ops import losses as losses_mod
+from deeplearning4j_tpu.perf import sentry
 
 # losses that support the fused from_logits path, keyed by activation
 _FUSABLE = {
@@ -300,7 +301,9 @@ class MultiLayerNetwork:
         return params, opt_state, new_state, loss
 
     def _make_train_step(self):
-        return jax.jit(self._update, donate_argnums=(0, 1, 2))
+        return sentry.jit(self._update,
+                          name="MultiLayerNetwork.train_step",
+                          donate_argnums=(0, 1, 2))
 
     def _make_train_loop(self):
         """K train steps per dispatched executable (``lax.scan`` over
@@ -320,7 +323,8 @@ class MultiLayerNetwork:
                 (x_stack, y_stack, rng_stack))
             return p, o, s, losses
 
-        return jax.jit(loop, donate_argnums=(0, 1, 2))
+        return sentry.jit(loop, name="MultiLayerNetwork.train_loop",
+                          donate_argnums=(0, 1, 2))
 
     def _refresh_ambient_trace(self):
         """Nets whose layers consult the ambient distributed context
@@ -498,8 +502,9 @@ class MultiLayerNetwork:
                         seg, (params, opt_state, state, rnn, key),
                         (xstack, ystack))
                     return p, o, s, r, losses[-1]
-                self._tbptt_loop_fn_ = jax.jit(loop,
-                                               donate_argnums=(0, 1, 2))
+                self._tbptt_loop_fn_ = sentry.jit(
+                    loop, name="MultiLayerNetwork.tbptt_loop",
+                    donate_argnums=(0, 1, 2))
             n_seg = t // k - 1
             xstack = jnp.swapaxes(
                 x[:, k:].reshape(x.shape[0], n_seg, k, *x.shape[2:]),
@@ -570,28 +575,42 @@ class MultiLayerNetwork:
             params = self._apply_constraints(params)
             return params, opt_state, new_state, rnn_states, loss
 
-        return jax.jit(step)
+        return sentry.jit(step, name="MultiLayerNetwork.tbptt_step")
 
     # ------------------------------------------------------------------
     # inference
     # ------------------------------------------------------------------
+    def _make_output_fn(self):
+        cd = self.conf.compute_dtype
+
+        def infer(params, state, x, mask):
+            if cd is not None:
+                params = dtypes.cast_float_tree(params, cd)
+                state = dtypes.cast_float_tree(state, cd)
+                x = dtypes.cast_float_tree(x, cd)
+            out, _, _ = self._forward(params, state, x, train=False,
+                                      rng=None, mask=mask)
+            return out.astype(jnp.float32) if cd is not None else out
+
+        return sentry.jit(infer, name="MultiLayerNetwork.output")
+
     def output(self, x, train: bool = False, mask=None):
         """Reference: MultiLayerNetwork.output (SURVEY §3.3)."""
         x = jnp.asarray(np.asarray(x))
         self._refresh_ambient_trace()
         if self._output_fn is None:
-            cd = self.conf.compute_dtype
-
-            def infer(params, state, x, mask):
-                if cd is not None:
-                    params = dtypes.cast_float_tree(params, cd)
-                    state = dtypes.cast_float_tree(state, cd)
-                    x = dtypes.cast_float_tree(x, cd)
-                out, _, _ = self._forward(params, state, x, train=False,
-                                          rng=None, mask=mask)
-                return out.astype(jnp.float32) if cd is not None else out
-            self._output_fn = jax.jit(infer)
+            self._output_fn = self._make_output_fn()
         return self._output_fn(self.params, self.state, x, mask)
+
+    def warmup(self, specs):
+        """AOT-compile the train step, scanned loop, and output fn for
+        every declared shape bucket BEFORE the first batch/request (see
+        ``perf.warmup``): ``.lower().compile()`` from abstract shapes —
+        no real data, no device stall at first use. Returns
+        ``{"compiled": n, "seconds": t}``."""
+        from deeplearning4j_tpu.perf.warmup import warmup_network
+        self._refresh_ambient_trace()
+        return warmup_network(self, specs)
 
     def feed_forward(self, x, train: bool = False):
         """All layer activations (reference feedForward): list, input

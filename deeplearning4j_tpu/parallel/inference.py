@@ -117,6 +117,16 @@ class ParallelInference:
             self._worker.start()
 
     # -- public API (reference ParallelInference.output) ----------------
+    def warmup(self, feature_shape, dtype: str = "float32"):
+        """AOT-compile the serving forward for EVERY declared batch
+        bucket before the first request (see ``perf.warmup``):
+        ``feature_shape`` is one example's shape (no batch dim). The
+        batching worker pads every request group to a bucket, so after
+        this no request ever waits on an XLA compile. Returns
+        ``{"compiled": n, "seconds": t}``."""
+        from deeplearning4j_tpu.perf.warmup import warmup_inference
+        return warmup_inference(self, feature_shape, dtype)
+
     def output(self, x, timeout: Optional[float] = 30.0):
         x = np.asarray(x)
         if self.mode == self.INPLACE:

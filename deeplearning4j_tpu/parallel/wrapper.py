@@ -44,6 +44,7 @@ from jax import shard_map
 from deeplearning4j_tpu.parallel.compression import \
     EncodedGradientsAccumulator
 from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
+from deeplearning4j_tpu.perf import sentry
 
 
 class ParallelWrapper:
@@ -154,8 +155,8 @@ class ParallelWrapper:
             params = net._apply_constraints(params)
             return params, opt_state, new_state, loss
 
-        return jax.jit(
-            step,
+        return sentry.jit(
+            step, name="ParallelWrapper.sync_step",
             in_shardings=(repl, repl, repl, shard, shard, repl),
             out_shardings=(repl, repl, repl, repl),
             donate_argnums=(0, 1, 2))
@@ -187,7 +188,8 @@ class ParallelWrapper:
             in_specs=(pspec, pspec, pspec, dspec, dspec, dspec, pspec),
             out_specs=(pspec, pspec, pspec, dspec, pspec),
             check_vma=False)
-        return jax.jit(smapped, donate_argnums=(0, 1, 2, 3))
+        return sentry.jit(smapped, name="ParallelWrapper.encoded_step",
+                          donate_argnums=(0, 1, 2, 3))
 
     def _build_async_step(self):
         net = self.net
@@ -221,7 +223,8 @@ class ParallelWrapper:
             in_specs=(pdev, pdev, repl, pdev, pdev, pdev, repl),
             out_specs=(pdev, pdev, repl, pdev, repl),
             check_vma=False)
-        return jax.jit(smapped, donate_argnums=(0, 1, 3))
+        return sentry.jit(smapped, name="ParallelWrapper.async_step",
+                          donate_argnums=(0, 1, 3))
 
     def _build_averaging_step(self):
         net = self.net
@@ -267,7 +270,8 @@ class ParallelWrapper:
             in_specs=(pdev, pdev, repl, pdev, pdev, repl, repl),
             out_specs=(pdev, pdev, repl, repl),
             check_vma=False)
-        return jax.jit(smapped, donate_argnums=(0, 1))
+        return sentry.jit(smapped, name="ParallelWrapper.averaging_step",
+                          donate_argnums=(0, 1))
 
     # -------------------------------------------------------------------
     def _prepare(self):
@@ -309,6 +313,51 @@ class ParallelWrapper:
                 )
         else:
             raise ValueError(f"unknown mode {self.mode!r}")
+
+    def warmup(self, specs):
+        """AOT-compile the SPMD train step for every declared batch
+        shape before the first real batch (see ``perf.warmup``): the
+        first step of a fresh worker process otherwise stalls the whole
+        mesh on its compile. Spec features/labels carry the GLOBAL
+        batch dim (what ``fit`` feeds the step after trimming)."""
+        from deeplearning4j_tpu.perf.warmup import (_feature_sds,
+                                                    _label_sds)
+        net = self.net
+        if self._step is None:
+            self._prepare()
+        # fit feeds batch-sharded global arrays (make_global_batch /
+        # the SYNC in_shardings), and jit's dispatch cache keys on
+        # input sharding — lower from the SAME sharding or the first
+        # real step recompiles invisibly (sentry signatures ignore
+        # sharding by design)
+        dshard = NamedSharding(self.mesh, P("data"))
+        as_sharded = lambda t: jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                           sharding=dshard), t)
+        rng = jax.random.fold_in(jax.random.PRNGKey(net.conf.seed), 0)
+        compiled, seconds = 0, 0.0
+        for spec in specs:
+            if not spec.train:
+                continue
+            x = as_sharded(_feature_sds(spec, net.conf))
+            y = as_sharded(_label_sds(spec, net.conf))
+            if self.mode == self.SYNC:
+                dt = self._step.warmup(net.params, net.opt_state,
+                                       net.state, x, y, rng)
+            elif self.mode == self.ENCODED:
+                dt = self._step.warmup(net.params, net.opt_state,
+                                       net.state, self._dp_state, x, y,
+                                       rng)
+            elif self.mode == self.ASYNC:
+                p, o, a = self._dp_state
+                dt = self._step.warmup(p, o, net.state, a, x, y, rng)
+            else:  # AVERAGING
+                p, o = self._dp_state
+                dt = self._step.warmup(p, o, net.state, x, y, rng,
+                                       jnp.asarray(0, jnp.int32))
+            compiled += dt > 0
+            seconds += dt
+        return {"compiled": compiled, "seconds": seconds}
 
     def fit(self, iterator, epochs: int = 1):
         """Reference: ParallelWrapper.fit(DataSetIterator).

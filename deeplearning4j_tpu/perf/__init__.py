@@ -1,0 +1,45 @@
+"""Compile subsystem: persistent XLA cache, AOT warmup, retrace sentry.
+
+Three legs, one goal — compilation is a managed artifact, not an
+ambient surprise:
+
+- :mod:`~deeplearning4j_tpu.perf.compile_cache` — JAX's on-disk
+  compilation cache wired to the tier-2 flag system; restarts and
+  multi-process workers reuse each other's compiles.
+- :mod:`~deeplearning4j_tpu.perf.warmup` — ``.lower().compile()``
+  every declared shape bucket from abstract shapes before traffic.
+- :mod:`~deeplearning4j_tpu.perf.sentry` — count distinct traced
+  avals per jitted entry point, record compile wall-time, warn/raise
+  on retrace storms.
+
+See ARCHITECTURE.md "Compilation lifecycle".
+"""
+from deeplearning4j_tpu.perf import compile_cache as compile_cache
+from deeplearning4j_tpu.perf import sentry as sentry
+from deeplearning4j_tpu.perf import warmup as warmup
+from deeplearning4j_tpu.perf.sentry import (
+    RetraceBudgetExceeded as RetraceBudgetExceeded)
+from deeplearning4j_tpu.perf.warmup import (
+    WarmupSpec as WarmupSpec, warmup_plan as warmup_plan)
+
+
+def compile_report() -> dict:
+    """One-shot compile-subsystem summary for end-of-run reporters
+    (``bench.py``'s ``compile`` section, the dossier's
+    ``compile_subsystem`` entry): sentry totals + persistent-cache
+    state. Walks the cache dir — don't call per iteration
+    (``StatsListener`` uses :func:`compile_cache.counters`)."""
+    cache = compile_cache.cache_stats()
+    return {
+        "compile_time_s": round(sentry.total_compile_time_s(), 3),
+        "traces": sentry.total_traces(),
+        "per_function": sentry.stats(),
+        "cache_dir": cache["dir"],
+        "cache_entries": cache["entries"],
+        "cache_hits": cache["persistent_hits"],
+        "cache_misses": cache["persistent_misses"],
+    }
+
+
+__all__ = ["compile_cache", "sentry", "warmup", "WarmupSpec",
+           "warmup_plan", "RetraceBudgetExceeded", "compile_report"]

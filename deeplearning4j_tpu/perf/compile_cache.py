@@ -1,0 +1,192 @@
+"""Persistent XLA compilation cache — compiles survive the process.
+
+Every fresh process pays full XLA compilation on the first step of
+every ``(model, bucket)`` pair; on TPU a big train step is tens of
+seconds. JAX ships the fix (``jax_compilation_cache_dir``: serialized
+executables keyed by HLO + compile options, shared on disk) and this
+module wires it into the tier-2 flag system: :func:`configure_from_env`
+runs at package import, so restarts, ``ParallelWrapper`` worker
+processes and ``tests/mp_harness.py`` children all reuse each other's
+compiles with zero per-callsite code.
+
+Flags (``environment.py``):
+
+- ``DL4J_TPU_COMPILE_CACHE`` — cache dir (default
+  ``~/.dl4j_tpu/compile_cache``, applied only when a non-CPU platform
+  is configured — see :func:`configure`; '' / '0' / 'off' / 'none'
+  disables).
+- ``DL4J_TPU_COMPILE_CACHE_MIN_BYTES`` / ``_MIN_SECS`` — eligibility
+  floors (both default to "cache everything": first-request latency is
+  the target, and small entries are exactly the many-bucket serving
+  case).
+
+Hit/miss counters come from ``jax.monitoring`` events and surface in
+:func:`cache_stats` (consumed by ``bench.py`` and the perf dossier).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+_LOCK = threading.Lock()
+_state: Dict[str, Any] = {
+    "dir": None,             # active cache dir (None -> disabled)
+    "listeners": False,      # monitoring listeners installed
+    "requests": 0,           # compile requests eligible for the cache
+    "hits": 0,               # persistent-cache hits
+}
+
+_DISABLED = {"", "0", "off", "none", "false", "disabled"}
+
+
+def _on_event(event: str, **kw) -> None:
+    if event.endswith("/compilation_cache/compile_requests_use_cache"):
+        with _LOCK:
+            _state["requests"] += 1
+    elif event.endswith("/compilation_cache/cache_hits"):
+        with _LOCK:
+            _state["hits"] += 1
+
+
+def _install_listeners() -> None:
+    if _state["listeners"]:
+        return
+    try:
+        import jax.monitoring
+        jax.monitoring.register_event_listener(_on_event)
+        _state["listeners"] = True
+    except Exception:       # monitoring API moved/absent: keep serving
+        pass
+
+
+def _accelerator_configured() -> bool:
+    """True when the process has a non-CPU platform explicitly
+    configured (the TPU box's sitecustomize pins ``axon,cpu``). Read
+    from config/env only — never from ``jax.devices()``, which would
+    initialize a backend at package import. Auto-detect (nothing
+    configured) counts as False: the default-on cache must never reach
+    a plain-CPU process."""
+    import jax
+    plats = (jax.config.jax_platforms
+             or os.environ.get("JAX_PLATFORMS", ""))
+    names = [p.strip() for p in str(plats).split(",") if p.strip()]
+    return any(n != "cpu" for n in names)
+
+
+def configure(cache_dir: Optional[str] = None,
+              min_entry_size_bytes: Optional[int] = None,
+              min_compile_time_secs: Optional[float] = None
+              ) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``cache_dir``
+    (created if missing) and drop the eligibility floors. Arguments
+    default to the ``DL4J_TPU_COMPILE_CACHE*`` flags. Returns the
+    active dir, or None when disabled. Safe to call repeatedly and
+    before/after backends initialize (``jax.config`` updates apply to
+    subsequent compiles).
+
+    The DEFAULT dir applies only when a non-CPU platform is configured:
+    jaxlib 0.4.x can segfault deserializing some XLA:CPU executables
+    from the cache (measured here: the pretrained-zoo forward), so
+    CPU processes get caching only via an explicit
+    ``DL4J_TPU_COMPILE_CACHE`` env var / ``cache_dir`` argument."""
+    from deeplearning4j_tpu import environment
+    import jax
+
+    if cache_dir is None:
+        if "DL4J_TPU_COMPILE_CACHE" not in os.environ \
+                and not _accelerator_configured():
+            with _LOCK:
+                _state["dir"] = None
+            return None
+        cache_dir = environment.get_flag("DL4J_TPU_COMPILE_CACHE")
+    if cache_dir is None or str(cache_dir).strip().lower() in _DISABLED:
+        with _LOCK:
+            _state["dir"] = None
+        return None
+    cache_dir = os.path.expanduser(str(cache_dir))
+    os.makedirs(cache_dir, exist_ok=True)
+    if min_entry_size_bytes is None:
+        min_entry_size_bytes = environment.get_flag(
+            "DL4J_TPU_COMPILE_CACHE_MIN_BYTES")
+    if min_compile_time_secs is None:
+        min_compile_time_secs = environment.get_flag(
+            "DL4J_TPU_COMPILE_CACHE_MIN_SECS")
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # the floors are newer knobs — a missing one must not take the
+    # whole cache down with it
+    for knob, val in (
+            ("jax_persistent_cache_min_entry_size_bytes",
+             int(min_entry_size_bytes)),
+            ("jax_persistent_cache_min_compile_time_secs",
+             float(min_compile_time_secs))):
+        try:
+            jax.config.update(knob, val)
+        except Exception:
+            pass
+    _install_listeners()
+    with _LOCK:
+        _state["dir"] = cache_dir
+    return cache_dir
+
+
+def configure_from_env() -> Optional[str]:
+    """Import-time entry point (called from the package ``__init__``):
+    configure entirely from flags, never raise — an unwritable cache
+    dir degrades to no caching, not an import error."""
+    try:
+        return configure()
+    except Exception:
+        with _LOCK:
+            _state["dir"] = None
+        return None
+
+
+def cache_dir() -> Optional[str]:
+    return _state["dir"]
+
+
+def counters() -> Dict[str, int]:
+    """In-process compile-request/hit counters only — no disk walk, so
+    safe on the per-iteration training hot path (``cache_stats`` walks
+    the whole cache dir and belongs in once-per-run reporters)."""
+    with _LOCK:
+        requests, hits = _state["requests"], _state["hits"]
+    return {"compile_requests": requests, "persistent_hits": hits,
+            "persistent_misses": max(0, requests - hits)}
+
+
+def cache_stats() -> Dict[str, Any]:
+    """On-disk + in-process view of the persistent cache: entry count
+    and bytes in the dir, and this process's eligible compile requests
+    vs persistent hits (misses = requests - hits; a miss is a compile
+    another process can now skip)."""
+    d = _state["dir"]
+    entries = 0
+    size = 0
+    if d and os.path.isdir(d):
+        for root, _dirs, files in os.walk(d):
+            for f in files:
+                if f.endswith("-atime"):    # LRU bookkeeping, not entries
+                    continue
+                entries += 1
+                try:
+                    size += os.path.getsize(os.path.join(root, f))
+                except OSError:
+                    pass
+    with _LOCK:
+        requests, hits = _state["requests"], _state["hits"]
+    return {
+        "dir": d,
+        "enabled": d is not None,
+        "entries": entries,
+        "bytes": size,
+        "compile_requests": requests,
+        "persistent_hits": hits,
+        "persistent_misses": max(0, requests - hits),
+    }
+
+
+def reset_counters() -> None:
+    with _LOCK:
+        _state["requests"] = _state["hits"] = 0

@@ -1,0 +1,306 @@
+"""Retrace sentry — trace/compile accounting for every jitted hot path.
+
+TPU-native necessity with no reference equivalent (the reference's
+eager kernels never compile): on XLA every distinct argument signature
+(pytree structure + leaf shapes/dtypes) traced through a ``jax.jit``
+entry point costs a full recompile. A retrace storm — e.g. an
+unbucketed sequence length slipping past ``BucketedSequenceIterator``,
+or a serving queue fed raw request sizes — degrades throughput
+silently: every "step" is really a compile.
+
+:func:`jit` is a drop-in for ``jax.jit`` that counts distinct traced
+avals per function, records compile wall-time, and warns (or raises
+under :func:`strict` / ``DL4J_TPU_RETRACE_STRICT``) once the number of
+UNPLANNED signatures exceeds the budget (``DL4J_TPU_RETRACE_BUDGET``).
+Shapes registered ahead of traffic through :meth:`SentryJit.warmup`
+(see ``perf/warmup.py``) are *planned* and never count against the
+budget — the budget meters surprises, not declared buckets.
+
+Metrics surface through :func:`stats` (consumed by
+``train.stats.StatsListener``, ``bench.py`` and
+``tools/perf_dossier.py``).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import logging
+import threading
+import time
+import weakref
+from typing import Any, Dict, List, Optional
+
+_log = logging.getLogger("deeplearning4j_tpu.perf")
+
+_LOCK = threading.RLock()
+# weakrefs: stats live exactly as long as their SentryJit (and thus the
+# net) does — a long-running server constructing models repeatedly must
+# not accumulate dead ledgers. Call under _LOCK.
+_REGISTRY: List["weakref.ref[FunctionStats]"] = []
+
+
+def _live_stats() -> List["FunctionStats"]:
+    out = [s for s in (r() for r in _REGISTRY) if s is not None]
+    if len(out) != len(_REGISTRY):
+        _REGISTRY[:] = [r for r in _REGISTRY if r() is not None]
+    return out
+
+# strict()/budget() context overrides (None -> read the env flags)
+_STRICT_OVERRIDE: Optional[bool] = None
+_BUDGET_OVERRIDE: Optional[int] = None
+
+
+class RetraceBudgetExceeded(RuntimeError):
+    """A jitted entry point traced more distinct unplanned shapes than
+    its retrace budget allows (retrace storm)."""
+
+
+def _flag(name):
+    from deeplearning4j_tpu import environment
+    return environment.get_flag(name)
+
+
+def _is_strict() -> bool:
+    if _STRICT_OVERRIDE is not None:
+        return _STRICT_OVERRIDE
+    return bool(_flag("DL4J_TPU_RETRACE_STRICT"))
+
+
+def _default_budget() -> int:
+    if _BUDGET_OVERRIDE is not None:
+        return _BUDGET_OVERRIDE
+    return int(_flag("DL4J_TPU_RETRACE_BUDGET"))
+
+
+def signature(tree) -> tuple:
+    """Hashable aval signature of an argument pytree: treedef + per-leaf
+    (shape, dtype). Works on concrete arrays, tracers, and
+    ``ShapeDtypeStruct``s alike — the same triple ``jax.jit`` keys its
+    trace cache on (sans weak-type/sharding, which never differ along
+    our entry points' call paths)."""
+    import jax
+    leaves, treedef = jax.tree.flatten(tree)
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        if shape is not None:
+            sig.append((tuple(shape), str(getattr(leaf, "dtype", "?"))))
+        else:                       # python scalar / static-ish leaf
+            sig.append(("py", type(leaf).__name__))
+    return (treedef, tuple(sig))
+
+
+class FunctionStats:
+    """Per-entry-point counters (one per SentryJit instance; sharing a
+    name across instances is fine — :func:`stats` merges by name)."""
+
+    def __init__(self, name: str, budget: Optional[int]):
+        self.name = name
+        self.budget = budget          # None -> global flag/override
+        self.traces = 0               # total tracings (incl. planned)
+        self.compiles = 0             # compiles observed on live calls
+        self.warmed = 0               # compiles done ahead of traffic
+        self.aot_hits = 0             # live calls served by a warmed
+                                      # executable (zero-compile proof)
+        self.compile_time_s = 0.0     # wall-time spent compiling
+        self.signatures: set = set()  # every distinct traced aval sig
+        self.planned: set = set()     # declared via warmup()
+
+    # -- accounting -----------------------------------------------------
+    def note_plan(self, sig):
+        with _LOCK:
+            self.planned.add(sig)
+
+    def note_trace(self, sig):
+        with _LOCK:
+            self.traces += 1
+            self.signatures.add(sig)
+            unplanned = len(self.signatures - self.planned)
+            budget = (self.budget if self.budget is not None
+                      else _default_budget())
+            over = unplanned > budget
+        if over:
+            msg = (f"retrace sentry: {self.name!r} traced {unplanned} "
+                   f"distinct unplanned shapes (budget {budget}) — "
+                   "likely a retrace storm; bucket the offending "
+                   "shapes (BucketedSequenceIterator / ParallelInference "
+                   "buckets) or declare them via warmup()")
+            if _is_strict():
+                raise RetraceBudgetExceeded(msg)
+            _log.warning(msg)
+
+    def unplanned(self) -> int:
+        with _LOCK:
+            return len(self.signatures - self.planned)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with _LOCK:
+            return {
+                "traces": self.traces,
+                "distinct_shapes": len(self.signatures),
+                "unplanned_shapes": len(self.signatures - self.planned),
+                "planned_shapes": len(self.planned),
+                "compiles": self.compiles,
+                "warmed": self.warmed,
+                "aot_hits": self.aot_hits,
+                "compile_time_s": self.compile_time_s,
+            }
+
+
+class SentryJit:
+    """``jax.jit`` plus trace accounting and AOT warmup.
+
+    Un-warmed calls dispatch through the wrapped jit exactly as
+    before; the only interception is a counter bump at TRACE time (the
+    wrapped python fn body runs once per cache miss), so their
+    steady-state dispatch overhead is zero. ``warmup(*args)``
+    lowers+compiles from (possibly abstract) arguments and KEEPS the
+    compiled executable: on this jax the AOT ``.lower().compile()``
+    path does not populate jit's own dispatch cache (only the trace
+    cache), so a warmed signature is routed straight to its stored
+    executable — the first real call on it neither traces nor compiles
+    (``aot_hits`` in the stats is the proof).
+    """
+
+    def __init__(self, fn, name: Optional[str] = None,
+                 budget: Optional[int] = None, **jit_kwargs):
+        import jax
+        self._fn = fn
+        self._aot: Dict[tuple, Any] = {}   # sig -> Compiled
+        self.name = name or getattr(fn, "__name__", "jit_fn")
+        self.stats = FunctionStats(self.name, budget)
+        stats = self.stats
+
+        @functools.wraps(fn)
+        def counted(*args, **kwargs):
+            stats.note_trace(signature((args, kwargs)))
+            return fn(*args, **kwargs)
+
+        self._jitted = jax.jit(counted, **jit_kwargs)
+        with _LOCK:
+            _REGISTRY.append(weakref.ref(stats))
+
+    def __call__(self, *args, **kwargs):
+        st = self.stats
+        if self._aot:
+            compiled = self._aot.get(signature((args, kwargs)))
+            if compiled is not None:
+                try:
+                    out = compiled(*args, **kwargs)
+                except (TypeError, ValueError):
+                    # pre-execution arg rejection (layout/sharding
+                    # drifted from the warmed executable): fall
+                    # through to jit, whose trace/compile the counters
+                    # then see. Runtime failures (OOM, debug_nans)
+                    # must propagate — donated buffers are gone and
+                    # the crash handlers key on the original error
+                    pass
+                else:
+                    with _LOCK:
+                        st.aot_hits += 1
+                    return out
+        before = st.traces
+        t0 = time.perf_counter()
+        out = self._jitted(*args, **kwargs)
+        if st.traces != before:     # this call traced -> it compiled
+            dt = time.perf_counter() - t0
+            with _LOCK:
+                st.compiles += 1
+                st.compile_time_s += dt
+        return out
+
+    def warmup(self, *args, **kwargs):
+        """AOT-compile for the given argument signature (concrete
+        arrays and ``ShapeDtypeStruct``s mix freely), keep the
+        executable for dispatch, and mark the signature PLANNED.
+        Idempotent per signature. Returns compile seconds (0.0 when
+        the signature was already traced)."""
+        st = self.stats
+        sig = signature((args, kwargs))
+        st.note_plan(sig)
+        with _LOCK:
+            if sig in st.signatures:
+                return 0.0          # already traced/compiled
+        t0 = time.perf_counter()
+        self._aot[sig] = self._jitted.lower(*args, **kwargs).compile()
+        dt = time.perf_counter() - t0
+        with _LOCK:
+            st.warmed += 1
+            st.compile_time_s += dt
+        return dt
+
+    # AOT inspection passthroughs
+    def lower(self, *args, **kwargs):
+        return self._jitted.lower(*args, **kwargs)
+
+    @property
+    def __wrapped__(self):
+        return self._fn
+
+
+def jit(fn, *, name: Optional[str] = None,
+        budget: Optional[int] = None, **jit_kwargs) -> SentryJit:
+    """Drop-in ``jax.jit`` with retrace accounting (see module doc)."""
+    return SentryJit(fn, name=name, budget=budget, **jit_kwargs)
+
+
+# -- global controls --------------------------------------------------------
+
+@contextlib.contextmanager
+def strict(budget: Optional[int] = None):
+    """Within the context, blowing a retrace budget RAISES
+    :class:`RetraceBudgetExceeded` instead of warning; ``budget``
+    optionally overrides every function's budget. The CI tier-1 fence
+    runs a tiny fit under ``sentry.strict()`` so a future PR that
+    introduces a retrace storm fails loudly."""
+    global _STRICT_OVERRIDE, _BUDGET_OVERRIDE
+    prev = (_STRICT_OVERRIDE, _BUDGET_OVERRIDE)
+    _STRICT_OVERRIDE = True
+    if budget is not None:
+        _BUDGET_OVERRIDE = budget
+    try:
+        yield
+    finally:
+        _STRICT_OVERRIDE, _BUDGET_OVERRIDE = prev
+
+
+def stats() -> Dict[str, Dict[str, Any]]:
+    """Merged per-name counter snapshot for every sentried entry point
+    that traced or warmed at least once."""
+    with _LOCK:
+        recs = [(s.name, s.snapshot()) for s in _live_stats()]
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, snap in recs:
+        if snap["traces"] == 0 and snap["warmed"] == 0:
+            continue
+        if name not in out:
+            out[name] = snap
+        else:
+            agg = out[name]
+            for k, v in snap.items():
+                agg[k] = agg[k] + v
+    return out
+
+
+def total_traces() -> int:
+    """Total tracings across every sentried entry point — the
+    zero-new-compiles assertion anchor for warmup tests."""
+    with _LOCK:
+        return sum(s.traces for s in _live_stats())
+
+
+def total_compile_time_s() -> float:
+    with _LOCK:
+        return sum(s.compile_time_s for s in _live_stats())
+
+
+def reset() -> None:
+    """Zero every counter and forget dead entries (stats of live
+    SentryJit instances are zeroed in place — their jit caches and
+    warmed executables survive)."""
+    with _LOCK:
+        for s in _live_stats():
+            s.traces = s.compiles = s.warmed = s.aot_hits = 0
+            s.compile_time_s = 0.0
+            s.signatures.clear()
+            s.planned.clear()

@@ -1,0 +1,238 @@
+"""AOT warmup — compile every declared shape bucket before traffic.
+
+The serving/training stack bounds its compiled-program count by
+snapping shapes to buckets (``BucketedSequenceIterator`` time buckets,
+``ParallelInference`` batch buckets, GPT decode's power-of-two prompt
+buckets) — but each bucket still pays its compile at FIRST use, i.e.
+on a real request/step. This module moves those compiles ahead of
+traffic: ``.lower().compile()`` from abstract ``ShapeDtypeStruct``s —
+no real data, no device stalls — through the same sentried jit entry
+points live calls use, so the first real step/request on a warmed
+bucket executes with zero new traces (asserted via
+``perf.sentry.total_traces``). With the persistent compile cache
+configured, warmup in one process pre-pays every process.
+
+Use::
+
+    specs = warmup_plan(iterator, batch_size=32, feature_dims=(64,),
+                        label_dims=(10,))
+    net.warmup(specs)                       # train step + output fn
+    pi.warmup(feature_shape=(64,))          # every serving bucket
+    model.warmup_decode(net, batch_sizes=(1, 8), prompt_lens=(1024,),
+                        n_new=128)          # GPT decode buckets
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, Optional, Sequence, Tuple, Union
+
+ShapeLike = Union[Tuple[int, ...], Dict[str, Tuple[int, ...]],
+                  Sequence[Tuple[int, ...]]]
+
+
+def sds(shape, dtype="float32"):
+    """Abstract array stand-in (shape+dtype, no buffer)."""
+    import jax
+    import jax.numpy as jnp
+    return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
+
+
+@dataclass(frozen=True)
+class WarmupSpec:
+    """One shape bucket to pre-compile. ``features``/``labels`` are
+    batch-inclusive shape tuples — or, for ComputationGraph, a dict
+    (by input name) / sequence (by output position) of them."""
+    features: ShapeLike
+    labels: Optional[ShapeLike] = None
+    features_mask: Optional[Tuple[int, ...]] = None
+    labels_mask: Optional[Tuple[int, ...]] = None
+    dtype: str = "float32"
+    labels_dtype: Optional[str] = None    # None -> same as dtype
+    train: bool = True                    # warm the train step
+    serve: bool = True                    # warm the output fn
+    steps_per_loop: int = 0               # >0: also warm the scanned loop
+
+
+def _label_dtype(spec: WarmupSpec) -> str:
+    return spec.labels_dtype or spec.dtype
+
+
+def _feature_sds(spec: WarmupSpec, conf):
+    """Spec features -> the network's feed structure."""
+    graph_inputs = getattr(conf, "inputs", None)
+    f = spec.features
+    if graph_inputs is not None:
+        if isinstance(f, dict):
+            return {n: sds(s, spec.dtype) for n, s in f.items()}
+        shapes = [f] if isinstance(f[0], int) else list(f)
+        return {n: sds(s, spec.dtype)
+                for n, s in zip(graph_inputs, shapes)}
+    return sds(f, spec.dtype)
+
+
+def _label_sds(spec: WarmupSpec, conf):
+    graph_inputs = getattr(conf, "inputs", None)
+    y = spec.labels
+    if y is None:
+        raise ValueError("WarmupSpec.labels is required for train "
+                         "warmup (set train=False for serve-only "
+                         "buckets)")
+    dt = _label_dtype(spec)
+    if graph_inputs is not None:
+        if isinstance(y, dict):
+            shapes = list(y.values())
+        elif isinstance(y[0], int):
+            shapes = [y]            # one output, bare shape tuple
+        else:
+            shapes = list(y)
+        return [sds(s, dt) for s in shapes]
+    return sds(y, dt)
+
+
+def warmup_network(net, specs: Iterable[WarmupSpec]) -> Dict[str, Any]:
+    """AOT-compile a ``MultiLayerNetwork``/``ComputationGraph``'s train
+    step, scanned loop, and output fn for every spec. Uses the live
+    params/opt-state pytrees as structure donors (lowering never
+    consumes them) and abstract data shapes. Returns a report:
+    ``{"compiled": n_new_executables, "seconds": compile_wall_time}``.
+    """
+    import jax
+
+    if not getattr(net, "params", None):
+        raise RuntimeError("warmup needs an initialized network — "
+                           "call init() first")
+    graph = hasattr(net.conf, "inputs")
+    compiled, seconds = 0, 0.0
+    rng = jax.random.fold_in(jax.random.PRNGKey(net.conf.seed), 0)
+    for spec in specs:
+        x = _feature_sds(spec, net.conf)
+        if spec.train:
+            if net._train_step_fn is None:
+                net._train_step_fn = net._make_train_step()
+            y = _label_sds(spec, net.conf)
+            fm = (sds(spec.features_mask, spec.dtype)
+                  if spec.features_mask else None)
+            lm = (sds(spec.labels_mask, spec.dtype)
+                  if spec.labels_mask else None)
+            if graph:
+                masks = {} if fm is None else {net.conf.inputs[0]: fm}
+                lmasks = ({} if lm is None
+                          else {net.conf.outputs[0]: lm})
+                args = (net.params, net.opt_state, net.state, x, y,
+                        masks, lmasks, rng)
+            else:
+                args = (net.params, net.opt_state, net.state, x, y,
+                        fm, lm, rng)
+            dt = net._train_step_fn.warmup(*args)
+            compiled += dt > 0
+            seconds += dt
+        if spec.train and spec.steps_per_loop > 0 \
+                and not spec.features_mask and not spec.labels_mask:
+            if net._train_loop_fn is None:
+                net._train_loop_fn = net._make_train_loop()
+            k = spec.steps_per_loop
+            stack = lambda a: sds((k,) + tuple(a.shape), a.dtype)
+            rngs = jax.numpy.stack([rng] * k)
+            if graph:
+                xs = {n: stack(s) for n, s in x.items()}
+                ys = [stack(s) for s in _label_sds(spec, net.conf)]
+                dt = net._train_loop_fn.warmup(
+                    net.params, net.opt_state, net.state, xs, ys,
+                    {}, {}, rngs)
+            else:
+                dt = net._train_loop_fn.warmup(
+                    net.params, net.opt_state, net.state, stack(x),
+                    stack(_label_sds(spec, net.conf)), rngs)
+            compiled += dt > 0
+            seconds += dt
+        if spec.serve:
+            if net._output_fn is None:
+                net._output_fn = net._make_output_fn()
+            if graph:
+                dt = net._output_fn.warmup(net.params, net.state, x)
+            else:
+                fm = (sds(spec.features_mask, spec.dtype)
+                      if spec.features_mask else None)
+                dt = net._output_fn.warmup(net.params, net.state, x, fm)
+            compiled += dt > 0
+            seconds += dt
+    return {"compiled": compiled, "seconds": seconds}
+
+
+def warmup_inference(pi, feature_shape: Tuple[int, ...],
+                     dtype: str = "float32") -> Dict[str, Any]:
+    """AOT-compile a ``ParallelInference`` queue's forward for every
+    declared batch bucket. ``feature_shape`` is ONE example's shape
+    (no batch dim)."""
+    specs = [WarmupSpec(features=(b,) + tuple(feature_shape),
+                        dtype=dtype, train=False, serve=True)
+             for b in pi.buckets]
+    return warmup_network(pi.net, specs)
+
+
+def warmup(target, specs: Optional[Iterable[WarmupSpec]] = None,
+           **kw) -> Dict[str, Any]:
+    """Generic entry: dispatches on target type (network vs
+    ParallelInference)."""
+    if hasattr(target, "buckets") and hasattr(target, "net"):
+        return warmup_inference(target, **kw)
+    return warmup_network(target, specs or [])
+
+
+def warmup_plan(source, *, batch_size: Optional[int] = None,
+                feature_dims: Tuple[int, ...] = (),
+                label_dims: Optional[Tuple[int, ...]] = None,
+                seq_labels: bool = True,
+                dtype: str = "float32",
+                labels_dtype: Optional[str] = None,
+                train: bool = True, serve: bool = True,
+                steps_per_loop: int = 0) -> list:
+    """Derive the WarmupSpec set from an existing bucket table.
+
+    ``source`` is one of:
+
+    - a ``BucketedSequenceIterator`` (or anything with TIME buckets in
+      ``.buckets``): one spec per bucket length, features
+      ``[batch_size, T, *feature_dims]`` with the [B, T] masks the
+      iterator attaches when it pads; labels ``[B, T, *label_dims]``
+      when ``seq_labels`` else ``[B, *label_dims]``;
+    - a ``ParallelInference`` (BATCH buckets in ``.buckets``): one
+      serve-only spec per bucket, features ``[bucket, *feature_dims]``;
+    - a plain iterable of ints: treated as batch buckets.
+    """
+    from deeplearning4j_tpu.data.iterators import (
+        BucketedSequenceIterator)
+
+    time_bucketed = isinstance(source, BucketedSequenceIterator) or (
+        hasattr(source, "buckets") and hasattr(source, "base"))
+    if not time_bucketed:
+        # batch buckets: a ParallelInference or a plain int iterable
+        buckets = (source.buckets if hasattr(source, "buckets")
+                   else list(source))
+        return [WarmupSpec(
+            features=(b,) + tuple(feature_dims),
+            labels=((b,) + tuple(label_dims)
+                    if label_dims is not None else None),
+            dtype=dtype, labels_dtype=labels_dtype,
+            train=train and label_dims is not None, serve=serve)
+            for b in buckets]
+    # time-bucketed sequences
+    bsz = batch_size or getattr(source, "batch_size", None)
+    if not bsz:
+        raise ValueError("warmup_plan needs batch_size for "
+                         "time-bucketed sources")
+    out = []
+    for t in source.buckets:
+        lab = None
+        lmask = None
+        if label_dims is not None:
+            lab = ((bsz, t) + tuple(label_dims) if seq_labels
+                   else (bsz,) + tuple(label_dims))
+            lmask = (bsz, t) if seq_labels else None
+        out.append(WarmupSpec(
+            features=(bsz, t) + tuple(feature_dims),
+            labels=lab, features_mask=(bsz, t), labels_mask=lmask,
+            dtype=dtype, labels_dtype=labels_dtype,
+            train=train and lab is not None, serve=serve,
+            steps_per_loop=steps_per_loop))
+    return out

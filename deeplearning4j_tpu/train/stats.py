@@ -145,6 +145,7 @@ class StatsListener(TrainingListener):
         self._t0 = time.time()
         self._last_rec: Optional[tuple] = None   # (time, iteration)
         self._last_etl = 0.0
+        self._prev_compile: Optional[tuple] = None
 
     def iteration_done(self, net, iteration, epoch):
         if iteration % self.frequency:
@@ -174,6 +175,7 @@ class StatsListener(TrainingListener):
             sys_rec["etl_wait_ms"] = (etl - self._last_etl) * 1e3 / iters
             self._last_etl = etl
         rec["sys"] = sys_rec
+        rec["compile"] = self._compile_rec()
         if self._prev_params is not None:
             import jax
             import jax.numpy as jnp
@@ -205,6 +207,31 @@ class StatsListener(TrainingListener):
         import jax.numpy as jnp
         self._prev_params = jax.tree.map(jnp.array, net.params)
         self.storage.put_record(self.session_id, rec)
+
+    def _compile_rec(self) -> Optional[Dict[str, Any]]:
+        """Compile-subsystem deltas over the recording interval (perf
+        sentry + persistent cache): a step that recompiled shows up
+        here as nonzero ``traces``/``time_ms`` next to its inflated
+        ``step_time_ms`` — the retrace-storm signature the dashboard
+        exists to catch. None once compilation has settled."""
+        from deeplearning4j_tpu.perf import compile_cache, sentry
+        traces = sentry.total_traces()
+        tcomp = sentry.total_compile_time_s()
+        # counters() not cache_stats(): this runs every recording
+        # interval and must not walk the cache dir
+        hits = compile_cache.counters()["persistent_hits"]
+        prev = self._prev_compile
+        self._prev_compile = (traces, tcomp, hits)
+        if prev is not None and (traces, tcomp, hits) == prev:
+            return None
+        d_traces = traces - (prev[0] if prev else 0)
+        unplanned = sum(s["unplanned_shapes"]
+                        for s in sentry.stats().values())
+        return {"traces": d_traces,
+                "time_ms": (tcomp - (prev[1] if prev else 0.0)) * 1e3,
+                "cache_hits": hits - (prev[2] if prev else 0),
+                "total_traces": traces,
+                "unplanned_shapes": unplanned}
 
     def _activation_hists(self, net):
         try:

@@ -284,8 +284,54 @@ class CausalTransformerLM(ZooModel):
         if cache is None:
             cache = self._gen_cache = {}
         if key not in cache:
-            cache[key] = jax.jit(make_fn())
+            from deeplearning4j_tpu.perf import sentry
+            cache[key] = sentry.jit(make_fn(),
+                                    name="CausalTransformerLM.decode")
         return cache[key]
+
+    def warmup_decode(self, net, *, n_new: int, batch_sizes=(1,),
+                      prompt_lens=None, temperature: float = 0.0,
+                      top_k: Optional[int] = None,
+                      top_p: Optional[float] = None):
+        """AOT-compile the decode executable for every (batch, prompt
+        bucket) pair BEFORE the first request (see ``perf.warmup``):
+        prompts snap to power-of-two length buckets, so the compile
+        set is O(batch_sizes × log max_len) and a cold server's first
+        generate() on a warmed bucket runs with zero new traces.
+        ``prompt_lens`` (true prompt lengths; bucketed here) defaults
+        to every reachable bucket given ``n_new``. Sampling flags must
+        match the serving call — they are static trace keys. Returns
+        ``{"compiled": n, "seconds": t}``."""
+        if prompt_lens is None:
+            # every legal prompt length, bucketed exactly the way
+            # generate() snaps it — including the max_len-clamped top
+            # bucket, which is the slowest compile of the lot
+            prompt_lens = range(1, self.max_len - n_new + 1)
+        buckets = sorted({min(self._bucket(t0), self.max_len)
+                          for t0 in prompt_lens})
+        rng = jax.random.fold_in(jax.random.PRNGKey(0), 0)
+        params = self._decode_params(net)
+        compiled, seconds = 0, 0.0
+        for b in batch_sizes:
+            for tb in buckets:
+                fn = self._jit_cached(
+                    (b, tb, n_new, temperature > 0, top_k,
+                     top_p is not None, self.cache_quant),
+                    lambda b=b, tb=tb: functools.partial(
+                        self._decode_gen, b=b, tb=tb, n_new=n_new,
+                        sample=temperature > 0, top_k=top_k,
+                        nucleus=top_p is not None))
+                dt = fn.warmup(
+                    params,
+                    jax.ShapeDtypeStruct((b, tb), jnp.int32),
+                    jnp.asarray(tb, jnp.int32),
+                    jnp.asarray(temperature or 1.0, jnp.float32),
+                    jnp.asarray(1.0 if top_p is None else top_p,
+                                jnp.float32),
+                    rng)
+                compiled += dt > 0
+                seconds += dt
+        return {"compiled": compiled, "seconds": seconds}
 
     @staticmethod
     def _filter_logits(logits, top_k, top_p, nucleus):
@@ -374,11 +420,15 @@ class CausalTransformerLM(ZooModel):
                 # cache bytes; a mixed int8×bf16 dot_general was also
                 # measured and is slightly slower), k-scales multiply
                 # the [.., T] scores after the dot, v-scales pre-scale
-                # the softmax weights
+                # the softmax weights. The scales STAY f32 — the
+                # scale-multiplies upcast and only their result casts
+                # back to the compute dtype, so bf16 rounding hits each
+                # value once, not twice (scale bytes are 4/head_dim of
+                # the cache read — f32 here is free bandwidth-wise)
                 ck = w8[:, :, :hd, :].astype(dt)
                 cv = w8[:, :, hd:, :].astype(dt)
-                k_scale = sc[:, :, 0, None, :].astype(dt)
-                v_scale = sc[:, :, 1, None, :].astype(dt)
+                k_scale = sc[:, :, 0, None, :]
+                v_scale = sc[:, :, 1, None, :]
             else:
                 ckv = jax.lax.dynamic_update_index_in_dim(ckv, kv,
                                                           pos, 3)
@@ -392,12 +442,12 @@ class CausalTransformerLM(ZooModel):
             s = jnp.einsum("bkgd,bkdt->bkgt", qg, ck) / jnp.sqrt(
                 jnp.asarray(hd, x.dtype))
             if k_scale is not None:
-                s = s * k_scale
+                s = (s * k_scale).astype(x.dtype)
             live = jnp.arange(ck.shape[3])[None, None, None, :] <= pos
             s = jnp.where(live, s, -1e9)
             w = jax.nn.softmax(s, axis=-1)
             if v_scale is not None:
-                w = w * v_scale
+                w = (w * v_scale).astype(x.dtype)
             a = jnp.einsum("bkgt,bkdt->bkgd", w, cv).reshape(rows, -1)
             x = x + a @ mha["Wo"] + mha["bo"]
             h = rms(x, pblk["ln2"]["gamma"])

@@ -178,6 +178,13 @@ def bench_body():
     from deeplearning4j_tpu.perf import compile_report
     compile_rec = compile_report()
 
+    # telemetry spine (obs/): the instrumentation rides every step, so
+    # its tracing-OFF cost must be provably negligible — measured here
+    # against this run's real step time (acceptance: < 1%)
+    from deeplearning4j_tpu import obs
+    obs_rec = obs.overhead_report(step_seconds=batch / images_per_sec)
+    obs_rec["step_summary"] = obs.metrics.step_summary()
+
     print(json.dumps({
         "metric": METRIC,
         "value": round(images_per_sec, 1),
@@ -191,6 +198,7 @@ def bench_body():
         "compute_dtype": "bfloat16" if on_tpu else "float32",
         "platform": jax.devices()[0].platform,
         "compile": compile_rec,
+        "obs": obs_rec,
     }), flush=True)
 
 

@@ -11,6 +11,7 @@ from typing import Iterator, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.data.dataset import DataSet
 
 
@@ -99,6 +100,7 @@ class AsyncDataSetIterator(DataSetIterator):
         err: List[BaseException] = []
 
         def worker():
+            obs.trace.set_thread_name("etl-prefetch")
             try:
                 for ds in self.base:
                     if not q.put(ds):      # consumer closed early
@@ -110,16 +112,21 @@ class AsyncDataSetIterator(DataSetIterator):
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        import time as _time
         try:
             while True:
-                t0 = _time.perf_counter()
+                t0 = obs.now()
                 try:
                     item = q.get()
                 except StopIteration:
                     break
                 finally:
-                    self.etl_wait_seconds += _time.perf_counter() - t0
+                    dt = obs.now() - t0
+                    self.etl_wait_seconds += dt
+                    obs.metrics.PREFETCH_WAIT.inc(dt)
+                    obs.metrics.PREFETCH_DEPTH.set(q.qsize())
+                    if obs.trace.enabled():
+                        obs.trace.add_span("AsyncDataSetIterator/wait",
+                                           t0, t0 + dt)
                 yield item
         finally:
             q.close()                      # unblock producer on break

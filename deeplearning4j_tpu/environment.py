@@ -87,6 +87,20 @@ _register("DL4J_TPU_RETRACE_STRICT", False, _bool,
           "retrace sentry raises RetraceBudgetExceeded instead of "
           "warning when a function blows its retrace budget")
 
+# -- telemetry spine (obs/: span tracer + metrics + worker health) ---------
+_register("DL4J_TPU_TRACE", "", str,
+          "span tracer (obs/trace.py): '' off; '1' writes Chrome-trace "
+          "JSONL to dl4j_tpu_trace_<pid>.jsonl; any other value is the "
+          "output path (drop the file into chrome://tracing/Perfetto)")
+_register("DL4J_TPU_TRACE_RING", 4096, int,
+          "in-memory span ring size (crash dumps carry its tail)")
+_register("DL4J_TPU_METRICS_PORT", 0, int,
+          "serve Prometheus /metrics + /healthz on this port from "
+          "startup (0: don't autostart; obs.metrics.start_server() "
+          "starts it on demand, port 0 -> ephemeral)")
+_register("DL4J_TPU_STALE_WORKER_SECS", 30.0, float,
+          "heartbeat age beyond which /healthz flags a worker stale")
+
 # -- UI / examples ---------------------------------------------------------
 _register("DL4J_TPU_UI_PORT", 9000, int,
           "training dashboard HTTP port (DL4JSystemProperties UI port)")
@@ -121,3 +135,11 @@ def apply_startup_flags() -> None:
         prof.enable_verbose_mode(True)
     if get_flag("DL4J_TPU_PROFILING"):
         prof.enabled = True
+    # telemetry spine: gate on the raw env so an idle process never
+    # pays the obs import
+    if os.environ.get("DL4J_TPU_TRACE", "").strip():
+        from deeplearning4j_tpu.obs import trace as obs_trace
+        obs_trace.configure_from_env()
+    if get_flag("DL4J_TPU_METRICS_PORT"):
+        from deeplearning4j_tpu.obs import metrics as obs_metrics
+        obs_metrics.start_server()

@@ -21,6 +21,7 @@ import numpy as np
 import optax
 
 from deeplearning4j_tpu import dtypes
+from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.nn import updaters as upd
 from deeplearning4j_tpu.nn.config import InputType
 from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict
@@ -435,6 +436,7 @@ class ComputationGraph:
     def _fit_group(self, group):
         """Run a group of uniformly-shaped batches (same mask
         structure) in one scanned call (see ``_make_train_loop``)."""
+        t0 = obs.now()
         self._refresh_ambient_trace()
         if self._train_loop_fn is None:
             self._train_loop_fn = self._make_train_loop()
@@ -456,6 +458,7 @@ class ComputationGraph:
         base = jax.random.PRNGKey(self.conf.seed)
         rngs = jnp.stack([jax.random.fold_in(base, self.iteration + i)
                           for i in range(len(group))])
+        t1 = obs.now()
         try:
             self.params, self.opt_state, self.state, losses = \
                 self._train_loop_fn(self.params, self.opt_state,
@@ -472,12 +475,20 @@ class ComputationGraph:
                         f"on device — try a smaller value); crash dump "
                         f"written to {path}") from e
             raise
+        t2 = obs.now()
         losses = np.asarray(losses)   # one host transfer for the group
+        t3 = obs.now()
+        obs.record_step("ComputationGraph.fit", t0, t1, t2, t3,
+                        args={"steps": len(group)})
+        tl0 = obs.now()
         for loss in losses:
             self.score_ = float(loss)
             self.iteration += 1
             for l in self.listeners:
                 l.iteration_done(self, self.iteration, self.epoch)
+        if self.listeners and obs.trace.enabled():
+            obs.trace.add_span("ComputationGraph.fit/listeners",
+                               tl0, obs.now())
 
     def fit(self, features, labels=None, *, epochs: int = 1,
             features_masks=None, labels_masks=None,
@@ -502,7 +513,14 @@ class ComputationGraph:
                 it.reset()
             group: list = []
             prev_sig = None
-            for mds in it:
+            src = iter(it)
+            while True:
+                te0 = obs.now()     # iterator wait = ETL attribution
+                try:
+                    mds = next(src)
+                except StopIteration:
+                    break
+                obs.record_etl("ComputationGraph.fit", te0, obs.now())
                 if hasattr(mds, "features"):
                     xs = (mds.features
                           if isinstance(mds.features, list)
@@ -547,6 +565,7 @@ class ComputationGraph:
         group.clear()
 
     def _fit_batch(self, xs, ys, fms=None, lms=None):
+        t0 = obs.now()
         self._refresh_ambient_trace()
         if self._train_step_fn is None:
             self._train_step_fn = self._make_train_step()
@@ -561,13 +580,20 @@ class ComputationGraph:
                   if m is not None}
         rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed),
                                  self.iteration)
+        t1 = obs.now()
         self.params, self.opt_state, self.state, loss = \
             self._train_step_fn(self.params, self.opt_state, self.state,
                                 inputs, labels, masks, lmasks, rng)
-        self.score_ = float(loss)
+        t2 = obs.now()
+        self.score_ = float(loss)     # blocking device sync
+        obs.record_step("ComputationGraph.fit", t0, t1, t2, obs.now())
         self.iteration += 1
+        tl0 = obs.now()
         for l in self.listeners:
             l.iteration_done(self, self.iteration, self.epoch)
+        if self.listeners and obs.trace.enabled():
+            obs.trace.add_span("ComputationGraph.fit/listeners",
+                               tl0, obs.now())
 
     # ------------------------------------------------------------------
     def _make_output_fn(self):

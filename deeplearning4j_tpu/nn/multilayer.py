@@ -24,6 +24,7 @@ import numpy as np
 import optax
 
 from deeplearning4j_tpu import dtypes
+from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers.base import Layer
 from deeplearning4j_tpu.nn.layers.core import OutputLayer, LossLayer
@@ -344,6 +345,7 @@ class MultiLayerNetwork:
             self._output_fn = None
 
     def _fit_group(self, group):
+        t0 = obs.now()
         self._refresh_ambient_trace()
         if self._train_loop_fn is None:
             self._train_loop_fn = self._make_train_loop()
@@ -352,6 +354,7 @@ class MultiLayerNetwork:
         base = jax.random.PRNGKey(self.conf.seed)
         rngs = jnp.stack([jax.random.fold_in(base, self.iteration + i)
                           for i in range(len(group))])
+        t1 = obs.now()
         try:
             self.params, self.opt_state, self.state, losses = \
                 self._train_loop_fn(self.params, self.opt_state,
@@ -367,11 +370,20 @@ class MultiLayerNetwork:
                         f"on device — try a smaller value); crash dump "
                         f"written to {path}") from e
             raise
-        for loss in np.asarray(losses):
+        t2 = obs.now()
+        losses = np.asarray(losses)   # blocking device sync
+        t3 = obs.now()
+        obs.record_step("MultiLayerNetwork.fit", t0, t1, t2, t3,
+                        args={"steps": len(group)})
+        tl0 = obs.now()
+        for loss in losses:
             self.score_ = float(loss)
             self.iteration += 1
             for l in self.listeners:
                 l.iteration_done(self, self.iteration, self.epoch)
+        if self.listeners and obs.trace.enabled():
+            obs.trace.add_span("MultiLayerNetwork.fit/listeners",
+                               tl0, obs.now())
 
     def _flush_group(self, group):
         if not group:
@@ -410,7 +422,14 @@ class MultiLayerNetwork:
             if hasattr(it, "reset"):
                 it.reset()
             group: list = []
-            for ds in it:
+            src = iter(it)
+            while True:
+                te0 = obs.now()     # iterator wait = ETL attribution
+                try:
+                    ds = next(src)
+                except StopIteration:
+                    break
+                obs.record_etl("MultiLayerNetwork.fit", te0, obs.now())
                 if hasattr(ds, "features"):
                     x, y = ds.features, ds.labels
                     fm = getattr(ds, "features_mask", None)
@@ -438,20 +457,23 @@ class MultiLayerNetwork:
         return self
 
     def _fit_batch(self, x, y, fmask=None, lmask=None):
+        t0 = obs.now()
         x = jnp.asarray(np.asarray(x))
         y = jnp.asarray(np.asarray(y))
         if (self.conf.backprop_type == "TruncatedBPTT" and x.ndim == 3):
-            return self._fit_tbptt(x, y, fmask, lmask)
+            return self._fit_tbptt(x, y, fmask, lmask, _t0=t0)
         self._refresh_ambient_trace()
         if self._train_step_fn is None:
             self._train_step_fn = self._make_train_step()
         rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed),
                                  self.iteration)
+        t1 = obs.now()
         try:
             self.params, self.opt_state, self.state, loss = \
                 self._train_step_fn(self.params, self.opt_state,
                                     self.state, x, y, fmask, lmask, rng)
-            self.score_ = float(loss)
+            t2 = obs.now()
+            self.score_ = float(loss)   # blocking device sync
         except Exception as e:       # HBM OOM → diagnostic dump
             from deeplearning4j_tpu.utils import crashreport
             if crashreport.is_oom(e):
@@ -461,13 +483,20 @@ class MultiLayerNetwork:
                         f"training step ran out of device memory; "
                         f"crash dump written to {path}") from e
             raise
+        obs.record_step("MultiLayerNetwork.fit", t0, t1, t2, obs.now())
         self.iteration += 1
+        tl0 = obs.now()
         for l in self.listeners:
             l.iteration_done(self, self.iteration, self.epoch)
+        if self.listeners and obs.trace.enabled():
+            obs.trace.add_span("MultiLayerNetwork.fit/listeners",
+                               tl0, obs.now())
 
     # -- truncated BPTT (reference: fit segments of tbpttLength, carrying
     #    rnn state across segments; MultiLayerNetwork truncated-BPTT path)
-    def _fit_tbptt(self, x, y, fmask, lmask):
+    def _fit_tbptt(self, x, y, fmask, lmask, _t0=None):
+        t0 = obs.now() if _t0 is None else _t0
+        t1 = obs.now()
         k = self.conf.tbptt_fwd_length
         t = x.shape[1]
         rnn_states = None
@@ -528,10 +557,17 @@ class MultiLayerNetwork:
                     self.params, self.opt_state, self.state, rnn_states,
                     xs, ys, fs, ls, rng)
                 # segments stay enqueued on device (no per-segment sync)
+        t2 = obs.now()
         self.score_ = float(loss)      # one device->host sync per batch
+        obs.record_step("MultiLayerNetwork.fit_tbptt", t0, t1, t2,
+                        obs.now())
         self.iteration += 1
+        tl0 = obs.now()
         for l in self.listeners:
             l.iteration_done(self, self.iteration, self.epoch)
+        if self.listeners and obs.trace.enabled():
+            obs.trace.add_span("MultiLayerNetwork.fit/listeners",
+                               tl0, obs.now())
 
     _tbptt_step_fn_ = None
     _tbptt_loop_fn_ = None

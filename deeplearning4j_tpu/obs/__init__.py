@@ -1,0 +1,145 @@
+"""Telemetry spine — spans, metrics, worker health, one merged report.
+
+Replaces the three ad-hoc timing mechanisms that grew around the stack
+(``train/stats.py`` wall clocks, ``utils/profiler.py`` sections,
+per-tool private formats) with one layer (ARCHITECTURE.md §9):
+
+- :mod:`~deeplearning4j_tpu.obs.trace` — process-wide span tracer
+  writing Chrome-trace/Perfetto JSONL (``DL4J_TPU_TRACE``); nesting,
+  explicit t0/t1, thread/worker ids, bounded ring; the off path is one
+  branch.
+- :mod:`~deeplearning4j_tpu.obs.metrics` — counters/gauges/histograms
+  with Prometheus text exposition on a stdlib ``/metrics`` +
+  ``/healthz`` endpoint; the retrace sentry and persistent compile
+  cache join as pull-time collector families.
+- :mod:`~deeplearning4j_tpu.obs.health` — worker heartbeats + stale
+  detection.
+- :func:`report` — the merged JSON snapshot consumed by
+  ``StatsListener`` records, ``bench.py``'s ``obs`` section,
+  ``tools/perf_dossier.py``, and ``utils/crashreport.py``.
+
+Hot-path contract: instrumented loops call :func:`record_step` /
+:func:`record_etl` with explicit :func:`now` timestamps — metrics are
+always on (a few dict lookups + float adds per step), spans cost one
+branch when tracing is off (asserted by ``tests/test_obs.py`` and
+measured as the ``obs`` section of ``bench.py``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from deeplearning4j_tpu.obs import health as health
+from deeplearning4j_tpu.obs import metrics as metrics
+from deeplearning4j_tpu.obs import trace as trace
+from deeplearning4j_tpu.obs.trace import now as now, span as span
+
+
+def record_step(entry: str, t0: float, t1: float, t2: float,
+                t3: float, args: Optional[Dict[str, Any]] = None
+                ) -> None:
+    """One completed train/serve step with phase attribution:
+    ``t0→t1`` host→device feed, ``t1→t2`` dispatch (async on TPU),
+    ``t2→t3`` blocking device sync. Metrics always; spans when
+    tracing."""
+    metrics.observe_step(entry, t3 - t0, t1 - t0, t3 - t2)
+    if trace.enabled():
+        trace.add_span(entry + "/step", t0, t3, args)
+        trace.add_span(entry + "/h2d", t0, t1)
+        trace.add_span(entry + "/dispatch", t1, t2)
+        trace.add_span(entry + "/sync", t2, t3)
+
+
+def record_etl(entry: str, t0: float, t1: float) -> None:
+    """Fit-loop wait on its data iterator."""
+    metrics.FIT_ETL_SECONDS.labels(entry=entry).inc(t1 - t0)
+    if trace.enabled():
+        trace.add_span(entry + "/etl", t0, t1)
+
+
+def record_worker_step(worker: str, t0: float, t1: float, t2: float,
+                       t3: float) -> None:
+    """ParallelWrapper worker loop: per-worker latency histogram,
+    collective-sync wall time, liveness heartbeat, spans."""
+    metrics.WORKER_STEP.labels(worker=worker).observe(t3 - t0)
+    metrics.WORKER_SYNC.labels(worker=worker).inc(t3 - t2)
+    health.heartbeat(worker)
+    if trace.enabled():
+        w = {"worker": worker}
+        trace.add_span("ParallelWrapper.fit/step", t0, t3, w)
+        trace.add_span("ParallelWrapper.fit/h2d", t0, t1)
+        trace.add_span("ParallelWrapper.fit/dispatch", t1, t2)
+        trace.add_span("ParallelWrapper.fit/collective_sync", t2, t3)
+
+
+def summary() -> Dict[str, Any]:
+    """Compact per-interval view (embedded in every ``StatsListener``
+    record — scalars only, never the full family dump)."""
+    return {
+        "tracing": trace.enabled(),
+        "trace_events": trace.events_recorded(),
+        "stale_workers": health.stale_workers(),
+        "step": metrics.step_summary(),
+    }
+
+
+def report(spans: int = 20) -> Dict[str, Any]:
+    """The merged telemetry snapshot: tracer state + last ``spans``
+    ring events, every metric family (sentry/compile-cache collector
+    families included), and worker health. Crash dumps call this with
+    a larger ``spans`` so the last moments of a dying run survive."""
+    return {
+        "trace": {
+            "enabled": trace.enabled(),
+            "path": trace.trace_path(),
+            "events_recorded": trace.events_recorded(),
+        },
+        "spans": trace.events(last=spans) if spans else [],
+        "metrics": metrics.snapshot(),
+        "health": health.check(),
+    }
+
+
+def overhead_report(step_seconds: Optional[float] = None,
+                    iters: int = 2000) -> Dict[str, Any]:
+    """Measure the tracing-OFF per-step cost of the instrumentation
+    (the exact calls ``record_step``+``record_etl`` make on the off
+    path) and express it as a fraction of ``step_seconds`` — the
+    ``obs`` section of ``bench.py`` / the dossier. Restores the
+    tracer's enabled state."""
+    was_enabled = trace.enabled()
+    # flip the gate only (file/ring untouched) so the off path is what
+    # gets timed even mid-trace
+    trace._enabled = False
+    try:
+        t0 = now()
+        for _ in range(iters):
+            a = now()
+            record_step("obs_overhead_probe", a, a, a, now())
+            b = now()
+            record_etl("obs_overhead_probe", b, now())
+        per_step = (now() - t0) / iters
+    finally:
+        trace._enabled = was_enabled
+        # scrub the probe's synthetic samples — they measured the off
+        # path but must not masquerade as real telemetry in /metrics,
+        # step_summary(), or StatsListener records
+        metrics.drop_entry("obs_overhead_probe")
+    out: Dict[str, Any] = {
+        "tracing": was_enabled,
+        "off_path_cost_us": round(per_step * 1e6, 3),
+    }
+    if step_seconds:
+        out["step_ms"] = round(step_seconds * 1e3, 3)
+        out["overhead_pct_of_step"] = round(
+            100.0 * per_step / step_seconds, 4)
+    return out
+
+
+# snapshot() convenience re-export used by reporters
+def snapshot() -> Dict[str, Any]:
+    return metrics.snapshot()
+
+
+__all__ = ["trace", "metrics", "health", "span", "now",
+           "record_step", "record_etl", "record_worker_step",
+           "summary", "report", "overhead_report", "snapshot"]

@@ -1,0 +1,64 @@
+"""Worker heartbeats + stale-worker detection.
+
+Reference: ``ParallelWrapper``'s per-GPU trainer threads died loudly
+(a worker thread exception surfaced in fit); here the failure mode is
+quieter — a mesh collective can wedge one process of a multi-host job,
+a serving worker can stall on a poisoned batch — so liveness is an
+explicit, scrapeable signal: every worker loop calls
+:func:`heartbeat` once per step, ``/healthz`` (and the
+``dl4j_tpu_worker_stale`` metric family) flags any worker whose last
+beat is older than ``DL4J_TPU_STALE_WORKER_SECS``.
+
+Timestamps are :func:`obs.trace.now` (monotonic); :func:`heartbeat`
+and :func:`check` take explicit time arguments so tests can flag a
+deliberately-stalled worker without sleeping.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.obs import trace as _trace
+
+_lock = threading.Lock()
+_beats: Dict[str, float] = {}
+
+
+def heartbeat(worker: str, t: Optional[float] = None) -> None:
+    """Record that ``worker`` is alive at ``t`` (default: now)."""
+    with _lock:
+        _beats[str(worker)] = _trace.now() if t is None else t
+
+
+def retire(worker: str) -> None:
+    """Forget ``worker``'s heartbeat — called when a worker loop exits
+    NORMALLY (``ParallelWrapper.fit`` completing its epochs). Without
+    this a finished training loop reads as a permanently stale worker
+    in a long-lived train-then-serve process. A crashed loop never
+    retires, so the stale alarm still fires for real wedges."""
+    with _lock:
+        _beats.pop(str(worker), None)
+
+
+def check(stale_after: Optional[float] = None,
+          now: Optional[float] = None) -> Dict[str, Dict]:
+    """``{worker: {"age_s", "stale"}}`` for every known worker."""
+    if stale_after is None:
+        from deeplearning4j_tpu import environment
+        stale_after = environment.get_flag("DL4J_TPU_STALE_WORKER_SECS")
+    now = _trace.now() if now is None else now
+    with _lock:
+        beats = dict(_beats)
+    return {w: {"age_s": now - t, "stale": (now - t) > stale_after}
+            for w, t in beats.items()}
+
+
+def stale_workers(stale_after: Optional[float] = None,
+                  now: Optional[float] = None) -> List[str]:
+    return sorted(w for w, s in check(stale_after, now).items()
+                  if s["stale"])
+
+
+def reset() -> None:
+    with _lock:
+        _beats.clear()

@@ -29,6 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu import obs
+
 
 def shard_model_params(net, mesh, axis: str = "model"):
     """Shard a network's parameters over ``mesh[axis]`` for serving.
@@ -70,6 +72,7 @@ class _Observable:
 
     def __init__(self, x):
         self.x = x
+        self.t_enqueue = obs.now()   # request-latency anchor
         self._event = threading.Event()
         self._result = None
         self._error = None
@@ -130,15 +133,23 @@ class ParallelInference:
     def output(self, x, timeout: Optional[float] = 30.0):
         x = np.asarray(x)
         if self.mode == self.INPLACE:
-            return np.asarray(self.net.output(x))
-        obs = _Observable(x)
-        self._q.put(obs)
-        return obs.get(timeout)
+            t0 = obs.now()
+            out = np.asarray(self.net.output(x))
+            obs.metrics.INFER_REQS.inc()
+            obs.metrics.INFER_LATENCY.observe(obs.now() - t0)
+            return out
+        ob = _Observable(x)
+        obs.metrics.INFER_REQS.inc()
+        self._q.put(ob)
+        obs.metrics.INFER_QUEUE.set(self._q.qsize())
+        return ob.get(timeout)
 
     def output_async(self, x) -> _Observable:
-        obs = _Observable(np.asarray(x))
-        self._q.put(obs)
-        return obs
+        ob = _Observable(np.asarray(x))
+        obs.metrics.INFER_REQS.inc()
+        self._q.put(ob)
+        obs.metrics.INFER_QUEUE.set(self._q.qsize())
+        return ob
 
     def shutdown(self):
         self._stop.set()
@@ -162,6 +173,7 @@ class ParallelInference:
         return np.asarray(out)[:n]
 
     def _loop(self):
+        obs.trace.set_thread_name("pi-serving")
         while not self._stop.is_set():
             first = self._q.get()
             if first is None:
@@ -178,15 +190,26 @@ class ParallelInference:
                     break
                 group.append(nxt)
                 count += nxt.x.shape[0] if nxt.x.ndim > 1 else 1
+            obs.metrics.INFER_QUEUE.set(self._q.qsize())
             try:
                 arrays = [o.x if o.x.ndim > 1 else o.x[None]
                           for o in group]
                 sizes = [a.shape[0] for a in arrays]
                 batch = np.concatenate(arrays)
+                tb0 = obs.now()
                 out = self._infer(batch)
+                if obs.trace.enabled():
+                    obs.trace.add_span(
+                        "ParallelInference/batch", tb0, obs.now(),
+                        args={"requests": len(group),
+                              "examples": int(batch.shape[0])})
+                obs.metrics.INFER_BATCH.observe(batch.shape[0])
+                done = obs.now()
                 ofs = 0
                 for o, s in zip(group, sizes):
                     res = out[ofs:ofs + s]
+                    obs.metrics.INFER_LATENCY.observe(
+                        done - o.t_enqueue)
                     o.set(res if o.x.ndim > 1 else res[0])
                     ofs += s
             except Exception as e:  # deliver errors to all waiters

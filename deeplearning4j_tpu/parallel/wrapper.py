@@ -41,6 +41,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
+from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.parallel.compression import \
     EncodedGradientsAccumulator
 from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
@@ -406,13 +407,24 @@ class ParallelWrapper:
                     f"smaller than local device count ({local_n})")
         it = AsyncDataSetIterator(iterator, self.prefetch_buffer) \
             if self.prefetch_buffer else iterator
+        # worker identity for telemetry: one fit loop per process; the
+        # heartbeat gauge + stale detector key on it (obs/health.py)
+        worker = f"proc{jax.process_index()}"
         for _ in range(epochs):
             if hasattr(it, "reset"):
                 it.reset()
             step_i = 0
-            for ds in it:
+            src = iter(it)
+            while True:
+                te0 = obs.now()     # iterator wait = ETL attribution
+                try:
+                    ds = next(src)
+                except StopIteration:
+                    break
+                obs.record_etl("ParallelWrapper.fit", te0, obs.now())
                 if n_steps is not None and step_i >= n_steps:
                     break               # stay in lockstep across hosts
+                t0 = obs.now()
                 x, y = ds.features, ds.labels
                 bsz = jax.tree.leaves(x)[0].shape[0]
                 b = b_local if multi else bsz - (bsz % self.n)
@@ -441,6 +453,7 @@ class ParallelWrapper:
                     y = jax.tree.map(jnp.asarray, y)
                 rng = jax.random.fold_in(
                     jax.random.PRNGKey(net.conf.seed), net.iteration)
+                t1 = obs.now()
                 if self.mode == self.SYNC:
                     net.params, net.opt_state, net.state, loss = \
                         self._step(net.params, net.opt_state, net.state,
@@ -461,11 +474,20 @@ class ParallelWrapper:
                         p, o, net.state, x, y, rng,
                         jnp.asarray(net.iteration, jnp.int32))
                     self._dp_state = (p, o)
+                t2 = obs.now()
+                # the float() blocks on the step AND its averaging /
+                # all-reduce collective — this wait is the visible
+                # collective-sync wall time
                 net.score_ = float(loss)
+                obs.record_worker_step(worker, t0, t1, t2, obs.now())
                 net.iteration += 1
                 for l in net.listeners:
                     l.iteration_done(net, net.iteration, net.epoch)
             net.epoch += 1
+        # normal completion: retire the liveness beat so a lingering
+        # process doesn't read as a stale worker forever (a crashed
+        # loop skips this and the alarm fires, as it should)
+        obs.health.retire(worker)
         if self.mode in (self.AVERAGING, self.ASYNC):
             self._sync_back()
         return net

@@ -27,6 +27,15 @@ class TrainingListener:
         pass
 
 
+def _step_score(net) -> float:
+    """The fit loop's already-computed step loss (``net.score_``) —
+    listeners must never call ``net.score()`` per iteration: a
+    dataset-scoring override would run an extra forward (device sync,
+    possible retrace) just to log a number the step already produced."""
+    score = getattr(net, "score_", None)
+    return net.score() if score is None else score
+
+
 class ScoreIterationListener(TrainingListener):
     """Logs score every N iterations (reference ScoreIterationListener)."""
 
@@ -36,7 +45,7 @@ class ScoreIterationListener(TrainingListener):
     def iteration_done(self, net, iteration, epoch):
         if iteration % self.n == 0:
             logger.info("Score at iteration %d is %s", iteration,
-                        net.score())
+                        _step_score(net))
 
 
 class PerformanceListener(TrainingListener):
@@ -64,7 +73,7 @@ class PerformanceListener(TrainingListener):
             iters = iteration - self._last_iter
             if dt > 0 and iters > 0:
                 msg = (f"iter {iteration}: {iters / dt:.1f} iter/sec, "
-                       f"score {net.score():.5f}")
+                       f"score {_step_score(net):.5f}")
                 etl = getattr(self._iterator, "etl_wait_seconds", None)
                 if etl is not None:
                     msg += (f", ETL wait "
@@ -169,4 +178,4 @@ class CollectScoresListener(TrainingListener):
         self.scores = []
 
     def iteration_done(self, net, iteration, epoch):
-        self.scores.append((iteration, net.score()))
+        self.scores.append((iteration, _step_score(net)))

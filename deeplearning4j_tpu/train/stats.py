@@ -15,14 +15,15 @@ on-device, only scalars cross to host.
 """
 from __future__ import annotations
 
+import datetime
 import json
 import threading
-import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.train.listeners import TrainingListener
 
 
@@ -137,12 +138,14 @@ class StatsListener(TrainingListener):
                  activation_sample=None, iterator=None):
         self.storage = storage
         self.frequency = max(1, frequency)
-        self.session_id = session_id or f"session_{int(time.time())}"
+        self.session_id = session_id or (
+            "session_"
+            + datetime.datetime.now().strftime("%Y%m%d_%H%M%S"))
         self.collect_histograms = collect_histograms
         self.activation_sample = activation_sample
         self.iterator = iterator
         self._prev_params: Optional[Dict[str, Any]] = None
-        self._t0 = time.time()
+        self._t0 = obs.now()    # the obs clock is the one step clock
         self._last_rec: Optional[tuple] = None   # (time, iteration)
         self._last_etl = 0.0
         self._prev_compile: Optional[tuple] = None
@@ -150,7 +153,7 @@ class StatsListener(TrainingListener):
     def iteration_done(self, net, iteration, epoch):
         if iteration % self.frequency:
             return          # keep _prev_params from the last recorded iter
-        now = time.time()
+        now = obs.now()
         # per-iteration averages over the recording interval, so step
         # time and ETL wait stay comparable at any frequency
         step_ms = None
@@ -176,6 +179,10 @@ class StatsListener(TrainingListener):
             self._last_etl = etl
         rec["sys"] = sys_rec
         rec["compile"] = self._compile_rec()
+        # telemetry spine: compact merged snapshot (tracing state,
+        # per-entry step means, stale workers) — obs.report() scalars,
+        # never the full metric family dump
+        rec["obs"] = obs.summary()
         if self._prev_params is not None:
             import jax
             import jax.numpy as jnp

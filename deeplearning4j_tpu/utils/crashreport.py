@@ -70,6 +70,31 @@ def _live_arrays_report(limit: int = 30) -> str:
     return "\n".join(lines)
 
 
+def _compile_subsystem_report() -> str:
+    """Compile-subsystem state at crash time (``perf.compile_report``):
+    a crash right after a trace/compile spike is the retrace-storm
+    signature, and the dump is where it must be visible."""
+    import json
+
+    from deeplearning4j_tpu import perf
+    try:
+        return json.dumps(perf.compile_report(), indent=1, default=str)
+    except Exception as e:
+        return f"compile report unavailable: {e!r}"
+
+
+def _telemetry_report() -> str:
+    """Merged obs snapshot: metric values, worker health, and the last
+    spans from the trace ring — the dying run's final moments."""
+    import json
+
+    from deeplearning4j_tpu import obs
+    try:
+        return json.dumps(obs.report(spans=30), indent=1, default=str)
+    except Exception as e:
+        return f"obs report unavailable: {e!r}"
+
+
 def generate_memory_status_report(net: Any = None) -> str:
     """Reference: CrashReportingUtil.generateMemoryStatus."""
     parts = [
@@ -78,6 +103,10 @@ def generate_memory_status_report(net: Any = None) -> str:
         "", "--- device memory (XLA allocator) ---",
         _device_memory_stats(),
         "", "--- live device arrays ---", _live_arrays_report(),
+        "", "--- compile subsystem (perf.compile_report) ---",
+        _compile_subsystem_report(),
+        "", "--- telemetry (obs.report: metrics + health + last spans) "
+        "---", _telemetry_report(),
     ]
     if net is not None:
         parts.append("")

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.zoo.pretrained import ZooModel
 from deeplearning4j_tpu.nn.config import InputType, NeuralNetConfiguration
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
@@ -226,6 +227,7 @@ class CausalTransformerLM(ZooModel):
                              f"{self.vocab_size}]")
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p={top_p} outside (0, 1]")
+        ts0 = obs.now()
         prep = self._prep_decode(prompt, n_new)
         if prep is None:
             return np.asarray(np.asarray(prompt, np.int32))
@@ -249,12 +251,18 @@ class CausalTransformerLM(ZooModel):
                 self._decode_gen, b=b, tb=tb, n_new=n_new,
                 sample=temperature > 0, top_k=top_k,
                 nucleus=top_p is not None))
-        gen = np.asarray(fn(
+        ts1 = obs.now()
+        out = fn(
             self._decode_params(net), prompt_pad,
             jnp.asarray(t0, jnp.int32),
             jnp.asarray(temperature or 1.0, jnp.float32),
             jnp.asarray(1.0 if top_p is None else top_p, jnp.float32),
-            rng))
+            rng)
+        ts2 = obs.now()
+        gen = np.asarray(out)         # blocking device sync
+        obs.record_step("CausalTransformerLM.generate", ts0, ts1, ts2,
+                        obs.now(),
+                        args={"batch": b, "bucket": tb, "n_new": n_new})
         return np.concatenate([prompt_np, gen], axis=1)
 
     @staticmethod

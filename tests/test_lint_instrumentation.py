@@ -1,0 +1,34 @@
+"""Tier-1 fence: every ``sentry.jit`` hot path emits obs telemetry and
+nothing outside ``obs/`` step-times with ``time.time()`` — run as part
+of the suite so a future PR that adds an uninstrumented jitted path
+(or reintroduces a second wall clock) fails CI loudly."""
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+import lint_instrumentation  # noqa: E402
+
+
+def test_package_passes_instrumentation_lint():
+    problems = lint_instrumentation.run()
+    assert not problems, "\n".join(problems)
+
+
+def test_lint_catches_uninstrumented_hot_path(tmp_path):
+    (tmp_path / "hot.py").write_text(
+        "from deeplearning4j_tpu.perf import sentry\n"
+        "step = sentry.jit(lambda x: x)\n")
+    (tmp_path / "clock.py").write_text(
+        "import time\nstart = time.time()\n")
+    (tmp_path / "fine.py").write_text(
+        "from deeplearning4j_tpu.perf import sentry\n"
+        "from deeplearning4j_tpu import obs\n"
+        "step = sentry.jit(lambda x: x)\n"
+        "obs.record_step('e', 0.0, 0.0, 0.0, 0.0)\n")
+    problems = lint_instrumentation.run(tmp_path)
+    assert len(problems) == 2
+    assert any("hot.py" in p and "sentry.jit" in p for p in problems)
+    assert any("clock.py" in p and "time.time()" in p
+               for p in problems)

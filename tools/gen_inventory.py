@@ -18,6 +18,53 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+# hand-maintained operations doc, re-emitted on every regeneration so
+# the auto-generated op reference never clobbers it (ISSUE 2 satellite:
+# the telemetry workflow lives in docs/OPS.md)
+TELEMETRY_OPS_SECTION = """
+## Telemetry operations (obs/)
+
+Operating a run with the telemetry spine (ARCHITECTURE.md §9):
+
+**Capture a timeline.** `DL4J_TPU_TRACE=1 python train.py` writes
+`dl4j_tpu_trace_<pid>.jsonl` (or set the flag to an explicit path).
+Drop the file into `chrome://tracing` or https://ui.perfetto.dev to
+see per-thread `fit/etl` / `fit/step` / `fit/h2d` / `fit/dispatch` /
+`fit/sync` spans. Summarize from the shell with
+
+    python tools/xprof_summary.py dl4j_tpu_trace_<pid>.jsonl
+
+(the same tool's XProf mode covers the device side: point it at a
+`jax.profiler.trace` capture dir).
+
+**Scrape metrics.** Start the endpoint with
+`DL4J_TPU_METRICS_PORT=9464` (or `obs.metrics.start_server()` in
+code), then point Prometheus — or `curl` — at
+`http://127.0.0.1:9464/metrics`; `/healthz` returns 503 naming any
+worker whose heartbeat is older than `DL4J_TPU_STALE_WORKER_SECS`.
+Step-latency histograms, ETL waits, serving queue depth, retrace
+sentry and compile-cache counters all appear as `dl4j_tpu_*`
+families.
+
+**Watch a long round.** `tools/tpu_watch.py` samples the same
+surfaces between backend probes:
+
+    python tools/tpu_watch.py --interval 600 \\
+        --metrics-url http://127.0.0.1:9464/metrics \\
+        --healthz-url http://127.0.0.1:9464/healthz \\
+        --trace-jsonl dl4j_tpu_trace_<pid>.jsonl
+
+appending one structured JSONL line per sample to
+`TPU_RETRY_LOG.jsonl` (step counts/latency sums, retrace/compile
+counters, stale workers, top span totals).
+
+**Post-mortems.** HBM-OOM crash dumps (`utils/crashreport.py`) carry
+`perf.compile_report()` and `obs.report()` — metric values, worker
+health, and the last spans of the dying run — next to the device
+memory map.
+"""
+
+
 def main():
     import warnings
     warnings.filterwarnings("ignore")
@@ -166,6 +213,7 @@ def main():
         if doc and not doc.startswith("lambda"):
             entry += f" — {doc}"
         op_lines.append(entry)
+    op_lines += ["", TELEMETRY_OPS_SECTION.strip()]
     ops_out = os.path.join(os.path.dirname(out), "OPS.md")
     with open(ops_out, "w") as f:
         f.write("\n".join(op_lines) + "\n")

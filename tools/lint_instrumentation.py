@@ -1,0 +1,118 @@
+"""Instrumentation lint — the telemetry spine's CI fence (tier-1 via
+``tests/test_lint_instrumentation.py``).
+
+Two AST rules over ``deeplearning4j_tpu/``:
+
+1. **Every ``sentry.jit``-wrapped hot path emits obs telemetry.** A
+   module that builds jitted entry points with ``sentry.jit(...)`` is
+   a hot path by definition; it must also call one of the obs
+   emission APIs (``obs.record_step`` / ``record_etl`` /
+   ``record_worker_step`` / ``span`` / ``trace.add_span``) so the
+   timeline can attribute the wall time those entry points consume.
+   Without this rule a future PR can add a jitted path whose cost is
+   invisible to ``chrome://tracing`` and ``/metrics``.
+
+2. **No ``time.time()`` for step timing outside ``obs/``.** The spine
+   has ONE step clock — ``obs.now`` (``time.perf_counter``): mixing in
+   wall clocks reintroduces exactly the disconnected-timing mess this
+   layer replaced (non-monotonic under NTP slew, incomparable bases).
+   Allowlisted: modules using wall time for *calendar* purposes
+   (termination deadlines, record timestamps), never step timing.
+
+Exit status 0 = clean; 1 = violations (printed one per line).
+"""
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "deeplearning4j_tpu"
+
+# wall-clock (calendar) users, not step timers — keep this list short
+# and justified:
+TIME_TIME_ALLOWLIST = {
+    # max-seconds termination condition compares against a deadline
+    "train/earlystopping.py",
+    # cluster-event records carry epoch timestamps for cross-host logs
+    "train/fault_tolerance.py",
+}
+
+_OBS_EMITTERS = {"record_step", "record_etl", "record_worker_step",
+                 "span", "add_span", "instant", "observe_step"}
+
+
+def _calls(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def _attr_chain(func: ast.AST) -> str:
+    """Dotted name of a call target ('sentry.jit', 'obs.trace.add_span',
+    'time.time') — '' for anything fancier."""
+    parts: List[str] = []
+    while isinstance(func, ast.Attribute):
+        parts.append(func.attr)
+        func = func.value
+    if isinstance(func, ast.Name):
+        parts.append(func.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def lint_file(path: Path, rel: str) -> List[str]:
+    try:
+        tree = ast.parse(path.read_text())
+    except SyntaxError as e:
+        return [f"{rel}: unparseable ({e})"]
+    chains = [_attr_chain(c.func) for c in _calls(tree)]
+    problems = []
+
+    uses_sentry_jit = any(ch == "sentry.jit" or ch.endswith(".sentry.jit")
+                          for ch in chains)
+    emits_obs = any(ch.split(".")[-1] in _OBS_EMITTERS and
+                    ("obs" in ch.split(".") or ch.startswith("trace."))
+                    for ch in chains)
+    if uses_sentry_jit and not emits_obs:
+        problems.append(
+            f"{rel}: builds sentry.jit hot paths but never emits an "
+            "obs span/metric (obs.record_step / obs.span / "
+            "obs.trace.add_span) — jitted wall time would be invisible "
+            "to the telemetry spine")
+
+    in_obs = rel.startswith("obs/")
+    if not in_obs and rel not in TIME_TIME_ALLOWLIST:
+        for c in _calls(tree):
+            if _attr_chain(c.func) == "time.time":
+                problems.append(
+                    f"{rel}:{c.lineno}: time.time() outside obs/ — "
+                    "use obs.now (the one step clock) or, for "
+                    "calendar timestamps, datetime + an allowlist "
+                    "entry here")
+    return problems
+
+
+def run(package_dir: Path = PACKAGE) -> List[str]:
+    problems: List[str] = []
+    for path in sorted(package_dir.rglob("*.py")):
+        rel = path.relative_to(package_dir).as_posix()
+        problems.extend(lint_file(path, rel))
+    return problems
+
+
+def main() -> int:
+    problems = run()
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} instrumentation lint violation(s)")
+        return 1
+    print("instrumentation lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -647,6 +647,14 @@ def main(names):
     from deeplearning4j_tpu.perf import compile_report
     payload.append({"config": "compile_subsystem", **compile_report(),
                     "smoke": SMOKE})
+    # telemetry spine (obs/): off-path instrumentation cost vs the
+    # median measured step, plus the merged metric/health summary
+    from deeplearning4j_tpu import obs
+    steps = sorted(r[3] for r in rows) or [None]
+    payload.append({"config": "obs_telemetry",
+                    **obs.overhead_report(
+                        step_seconds=steps[len(steps) // 2]),
+                    "summary": obs.summary(), "smoke": SMOKE})
     if out_path:
         Path(out_path).write_text(json.dumps(payload, indent=1))
     if SMOKE:

@@ -1,5 +1,15 @@
 """TPU-tunnel watcher: timestamped retry log + auto-dossier on success.
 
+Telemetry (PR 2): pass ``--metrics-url http://HOST:PORT/metrics`` (and
+optionally ``--healthz-url``, ``--trace-jsonl PATH``) to also scrape a
+live run's telemetry endpoint each interval — step counts/latency
+sums, retrace/compile counters, stale workers, and the top span names
+from the Chrome-trace JSONL — appending one structured line per
+sample to the same retry log. This replaces the old private-format
+approach: the watcher reads the SAME ``/metrics`` exposition and trace
+JSONL every other consumer uses (``docs/OPS.md`` "Telemetry
+operations").
+
 VERDICT r3 Next #1: the perf dossier must land the instant the tunnel
 answers, and if it never does the round must carry "a timestamped retry
 log proving the tunnel never came up". This script is that loop:
@@ -38,12 +48,106 @@ def _log(**fields) -> None:
     print(json.dumps(fields), flush=True)
 
 
+# incremental trace tail: the JSONL is append-only and can reach
+# hundreds of MB over a traced multi-hour round — re-reading it whole
+# every interval would grow without bound, so track (offset, partial
+# last line) per file and accumulate span totals across samples
+_TRACE_POS: dict = {}      # path -> (byte offset, carry-over fragment)
+_SPAN_TOTALS: dict = {}    # span name -> total dur (us)
+
+
+def _trace_tail(path):
+    offset, carry = _TRACE_POS.get(path, (0, ""))
+    with open(path) as f:
+        f.seek(offset)
+        chunk = f.read()
+        offset = f.tell()
+    text = carry + chunk
+    lines = text.split("\n")
+    carry = lines.pop()            # possibly-partial last line
+    _TRACE_POS[path] = (offset, carry)
+    for line in lines:
+        line = line.strip().rstrip(",")
+        if not line or line in ("[", "]"):
+            continue
+        try:
+            yield json.loads(line)
+        except ValueError:
+            continue
+
+
+_METRIC_KEYS = ("dl4j_tpu_step_latency_seconds_count",
+                "dl4j_tpu_step_latency_seconds_sum",
+                "dl4j_tpu_steps_total",
+                "dl4j_tpu_fit_etl_seconds_total",
+                "dl4j_tpu_retrace_", "dl4j_tpu_compile_",
+                "dl4j_tpu_worker_stale",
+                "dl4j_tpu_inference_requests_total")
+
+
+def _scrape_telemetry(metrics_url, healthz_url, trace_jsonl) -> None:
+    """One sample of a live run's telemetry, appended to the log.
+    Scrape failures are logged, never fatal — the run may simply not
+    have started its endpoint yet."""
+    import urllib.error
+    import urllib.request
+
+    from deeplearning4j_tpu.obs import metrics as obs_metrics
+
+    if metrics_url:
+        try:
+            with urllib.request.urlopen(metrics_url, timeout=5) as r:
+                fams = obs_metrics.parse_exposition(r.read().decode())
+            sample = {f"{name}{dict(labels) if labels else ''}": v
+                      for (name, labels), v in sorted(fams.items())
+                      if name.startswith(_METRIC_KEYS)}
+            _log(event="metrics", url=metrics_url, sample=sample)
+        except Exception as e:
+            _log(event="metrics", url=metrics_url, error=repr(e))
+    if healthz_url:
+        try:
+            with urllib.request.urlopen(healthz_url, timeout=5) as r:
+                _log(event="healthz", url=healthz_url,
+                     body=json.loads(r.read().decode()))
+        except urllib.error.HTTPError as e:
+            # /healthz answers 503 WITH a body naming the stale
+            # workers — the one payload this flag exists to capture
+            try:
+                body = json.loads(e.read().decode())
+            except Exception:
+                body = None
+            _log(event="healthz", url=healthz_url, status=e.code,
+                 body=body)
+        except Exception as e:
+            _log(event="healthz", url=healthz_url, error=repr(e))
+    if trace_jsonl:
+        try:
+            for ev in _trace_tail(trace_jsonl):
+                if ev.get("ph") == "X":
+                    _SPAN_TOTALS[ev["name"]] = \
+                        _SPAN_TOTALS.get(ev["name"], 0.0) \
+                        + ev.get("dur", 0.0)
+            top = sorted(_SPAN_TOTALS.items(),
+                         key=lambda kv: -kv[1])[:8]
+            _log(event="trace", path=trace_jsonl,
+                 top_spans_ms={k: round(v / 1e3, 3) for k, v in top})
+        except Exception as e:
+            _log(event="trace", path=trace_jsonl, error=repr(e))
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--interval", type=int, default=600)
     ap.add_argument("--probe-timeout", type=int, default=120)
     ap.add_argument("--max-attempts", type=int, default=0,
                     help="stop after N failed attempts (0 = forever)")
+    ap.add_argument("--metrics-url", default=None,
+                    help="Prometheus /metrics endpoint of a live run "
+                         "to sample each interval")
+    ap.add_argument("--healthz-url", default=None,
+                    help="/healthz endpoint to sample each interval")
+    ap.add_argument("--trace-jsonl", default=None,
+                    help="obs trace JSONL to summarize each interval")
     args = ap.parse_args()
 
     sys.path.insert(0, str(REPO))
@@ -52,6 +156,9 @@ def main() -> int:
     attempt = 0
     while True:
         attempt += 1
+        if args.metrics_url or args.healthz_url or args.trace_jsonl:
+            _scrape_telemetry(args.metrics_url, args.healthz_url,
+                              args.trace_jsonl)
         ok, info = probe_backend(timeout=args.probe_timeout)
         _log(event="probe", attempt=attempt, ok=ok, info=info)
         if ok:

@@ -1,9 +1,10 @@
-"""Per-op summary of an XProf capture (VERDICT r4 ask #5).
+"""Per-op summary of an XProf capture (VERDICT r4 ask #5) — and, since
+PR 2, of an ``obs`` span-trace JSONL.
 
-Parses the ``*.xplane.pb`` a ``jax.profiler.trace`` run writes (e.g.
-``perf_dossier.py --trace DIR``) with ``jax.profiler.ProfileData`` —
-no tensorboard needed — and prints, from the device plane's "XLA Ops"
-line:
+XProf mode parses the ``*.xplane.pb`` a ``jax.profiler.trace`` run
+writes (e.g. ``perf_dossier.py --trace DIR``) with
+``jax.profiler.ProfileData`` — no tensorboard needed — and prints,
+from the device plane's "XLA Ops" line:
 
 - steps observed and mean device step time (cross-checks the
   wall-clock differencing protocol in ``perf_dossier._timeit``);
@@ -11,9 +12,17 @@ line:
   kernels, convolution/dot = MXU, copies, ...);
 - the top-K individual ops by total time with their share.
 
-    python tools/xprof_summary.py DIR [--top 10]
+Obs mode reads the Chrome-trace JSONL the telemetry spine writes
+(``DL4J_TPU_TRACE=...``, ``deeplearning4j_tpu/obs/trace.py``) — the
+host-side step/ETL/sync attribution complementing XProf's device view
+— and prints per-span-name totals, counts, and share of the traced
+wall time per thread.
 
-``DIR`` is the trace dir; the newest ``*.xplane.pb`` under it is read.
+    python tools/xprof_summary.py DIR_OR_TRACE [--top 10]
+
+A ``*.jsonl``/``*.json`` path (or a dir containing one but no
+``*.xplane.pb``) selects obs mode; otherwise the newest
+``*.xplane.pb`` under the dir is read.
 """
 from __future__ import annotations
 
@@ -91,12 +100,81 @@ def summarize(trace_dir: str, top: int = 10):
     return "\n".join(out)
 
 
+def summarize_obs(path: str, top: int = 10) -> str:
+    """Span-name totals from an obs trace JSONL: wall coverage per
+    thread, per-name total/count/share — the table the acceptance
+    criterion ("spans cover >= 95% of wall time with ETL/step/sync
+    attribution") is eyeballed against."""
+    import sys as _sys
+    _sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from deeplearning4j_tpu.obs import trace as obs_trace
+
+    events = obs_trace.read_trace(path)
+    spans = [e for e in events if e.get("ph") == "X"]
+    if not spans:
+        raise SystemExit(f"{path} contains no complete ('X') spans")
+    names = {}
+    tid_names = {e["tid"]: e["args"]["name"] for e in events
+                 if e.get("ph") == "M"
+                 and e.get("name") == "thread_name"}
+    by_tid = defaultdict(list)
+    for e in spans:
+        by_tid[e["tid"]].append(e)
+        k = e["name"]
+        tot, cnt = names.get(k, (0.0, 0))
+        names[k] = (tot + e.get("dur", 0.0), cnt + 1)
+    wall = (max(e["ts"] + e.get("dur", 0.0) for e in spans)
+            - min(e["ts"] for e in spans))
+    out = [f"events: {len(spans)} spans over {wall / 1e3:.1f} ms "
+           f"wall, {len(by_tid)} thread(s)"]
+    for tid, evs in sorted(by_tid.items()):
+        t_wall = (max(e["ts"] + e.get("dur", 0.0) for e in evs)
+                  - min(e["ts"] for e in evs)) or 1.0
+        # top-level spans only (not contained in any other span of the
+        # thread) so nested phases don't double-count coverage
+        evs_sorted = sorted(evs, key=lambda e: (e["ts"],
+                                                -e.get("dur", 0.0)))
+        covered = end = 0.0
+        for e in evs_sorted:
+            s, d = e["ts"], e.get("dur", 0.0)
+            if s + d <= end:
+                continue
+            covered += (s + d) - max(s, end)
+            end = s + d
+        out.append(f"thread {tid_names.get(tid, tid)}: "
+                   f"{100 * covered / t_wall:.1f}% of "
+                   f"{t_wall / 1e3:.1f} ms covered by spans")
+    out.append("")
+    out.append(f"| span | total ms | % | count |")
+    out.append("|---|---|---|---|")
+    for k, (tot, cnt) in sorted(names.items(),
+                                key=lambda kv: -kv[1][0])[:top]:
+        out.append(f"| {k} | {tot / 1e3:.2f} | "
+                   f"{100 * tot / wall:.1f}% | {cnt} |")
+    return "\n".join(out)
+
+
+def _is_obs_trace(path: Path) -> bool:
+    if path.is_file():
+        return path.suffix in (".jsonl", ".json")
+    return (not any(path.rglob("*.xplane.pb"))
+            and any(path.rglob("*.jsonl")))
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("trace_dir")
+    ap.add_argument("trace_dir",
+                    help="XProf capture dir, or an obs trace JSONL")
     ap.add_argument("--top", type=int, default=10)
     args = ap.parse_args()
-    print(summarize(args.trace_dir, args.top))
+    p = Path(args.trace_dir)
+    if _is_obs_trace(p):
+        if p.is_dir():
+            p = sorted(p.rglob("*.jsonl"),
+                       key=lambda q: q.stat().st_mtime)[-1]
+        print(summarize_obs(str(p), args.top))
+    else:
+        print(summarize(args.trace_dir, args.top))
 
 
 if __name__ == "__main__":

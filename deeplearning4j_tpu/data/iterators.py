@@ -13,6 +13,7 @@ import numpy as np
 
 from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.resilience import faults
 
 
 class DataSetIterator:
@@ -30,6 +31,9 @@ class DataSetIterator:
         self.pre_processor = p
 
     def _apply_pp(self, ds: DataSet) -> DataSet:
+        # site: iterator next — every batch any subclass yields passes
+        # through here (resilience/faults.py; off path is one branch)
+        faults.inject("iterator")
         if self.pre_processor is not None:
             ds = self.pre_processor.transform_dataset(ds)
         return ds

@@ -101,6 +101,14 @@ _register("DL4J_TPU_METRICS_PORT", 0, int,
 _register("DL4J_TPU_STALE_WORKER_SECS", 30.0, float,
           "heartbeat age beyond which /healthz flags a worker stale")
 
+# -- resilience (resilience/: fault injection + hardened recovery) ---------
+_register("DL4J_TPU_FAULT_PLAN", "", str,
+          "deterministic fault-injection plan (resilience/faults.py): "
+          "'' off (one-branch zero-overhead path); a named plan "
+          "(ckpt-io-flake, worker-crash, etl-flake, serving-crash, "
+          "preempt) or 'site:error=OSError:p=0.5:seed=3;...' rule "
+          "syntax — see docs/OPS.md failure & recovery runbook")
+
 # -- UI / examples ---------------------------------------------------------
 _register("DL4J_TPU_UI_PORT", 9000, int,
           "training dashboard HTTP port (DL4JSystemProperties UI port)")
@@ -143,3 +151,8 @@ def apply_startup_flags() -> None:
     if get_flag("DL4J_TPU_METRICS_PORT"):
         from deeplearning4j_tpu.obs import metrics as obs_metrics
         obs_metrics.start_server()
+    # fault injection: gate on the raw env so the unset path never
+    # imports the resilience package at startup
+    if os.environ.get("DL4J_TPU_FAULT_PLAN", "").strip():
+        from deeplearning4j_tpu.resilience import faults
+        faults.configure_from_env()

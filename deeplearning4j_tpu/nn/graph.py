@@ -32,6 +32,7 @@ from deeplearning4j_tpu.nn.multilayer import _FUSABLE
 from deeplearning4j_tpu.nn.vertices import (GraphVertex, vertex_from_dict)
 from deeplearning4j_tpu.ops import losses as losses_mod
 from deeplearning4j_tpu.perf import sentry
+from deeplearning4j_tpu.resilience import faults
 
 
 @dataclass
@@ -437,6 +438,7 @@ class ComputationGraph:
         """Run a group of uniformly-shaped batches (same mask
         structure) in one scanned call (see ``_make_train_loop``)."""
         t0 = obs.now()
+        faults.inject("step")       # site: step dispatch (resilience/)
         self._refresh_ambient_trace()
         if self._train_loop_fn is None:
             self._train_loop_fn = self._make_train_loop()
@@ -566,6 +568,7 @@ class ComputationGraph:
 
     def _fit_batch(self, xs, ys, fms=None, lms=None):
         t0 = obs.now()
+        faults.inject("step")       # site: step dispatch (resilience/)
         self._refresh_ambient_trace()
         if self._train_step_fn is None:
             self._train_step_fn = self._make_train_step()

@@ -34,6 +34,7 @@ from deeplearning4j_tpu.nn.layers.special import FrozenLayer
 from deeplearning4j_tpu.nn import updaters as upd
 from deeplearning4j_tpu.ops import losses as losses_mod
 from deeplearning4j_tpu.perf import sentry
+from deeplearning4j_tpu.resilience import faults
 
 # losses that support the fused from_logits path, keyed by activation
 _FUSABLE = {
@@ -346,6 +347,7 @@ class MultiLayerNetwork:
 
     def _fit_group(self, group):
         t0 = obs.now()
+        faults.inject("step")       # site: step dispatch (resilience/)
         self._refresh_ambient_trace()
         if self._train_loop_fn is None:
             self._train_loop_fn = self._make_train_loop()
@@ -458,6 +460,7 @@ class MultiLayerNetwork:
 
     def _fit_batch(self, x, y, fmask=None, lmask=None):
         t0 = obs.now()
+        faults.inject("step")       # site: step dispatch (resilience/)
         x = jnp.asarray(np.asarray(x))
         y = jnp.asarray(np.asarray(y))
         if (self.conf.backprop_type == "TruncatedBPTT" and x.ndim == 3):

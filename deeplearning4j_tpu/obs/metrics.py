@@ -333,6 +333,23 @@ INFER_BATCH = REGISTRY.histogram(
     "dl4j_tpu_inference_batch_size",
     "examples per dispatched serving batch", buckets=SIZE_BUCKETS)
 
+# resilience subsystem (resilience/ + train/fault_tolerance.py)
+RESILIENCE_RESTARTS = REGISTRY.counter(
+    "dl4j_tpu_resilience_restarts_total",
+    "restore-and-continue restarts by FaultTolerantTrainer")
+REQS_SHED = REGISTRY.counter(
+    "dl4j_tpu_inference_requests_shed_total",
+    "serving requests shed instead of served", ("reason",))
+CKPT_QUARANTINED = REGISTRY.counter(
+    "dl4j_tpu_checkpoints_quarantined_total",
+    "corrupt/partial checkpoints moved to corrupt/")
+FAULTS_INJECTED = REGISTRY.counter(
+    "dl4j_tpu_faults_injected_total",
+    "faults fired by the DL4J_TPU_FAULT_PLAN harness", ("site",))
+PREEMPTIONS = REGISTRY.counter(
+    "dl4j_tpu_preemptions_total",
+    "SIGTERM preemption notices honored (checkpoint-and-exit)")
+
 
 def drop_entry(entry: str) -> None:
     """Remove one ``entry`` labelset from every per-entry family —

@@ -1,0 +1,26 @@
+"""jax API compatibility for the parallel package.
+
+``shard_map`` moved namespaces across jax versions: modern jax exports
+``jax.shard_map`` (with a ``check_vma`` kwarg); 0.4.x ships it as
+``jax.experimental.shard_map.shard_map`` (kwarg ``check_rep``). The
+TPU image runs modern jax; CI/dev boxes may carry 0.4.x — without this
+shim every module in the package (and everything importing
+``deeplearning4j_tpu.parallel``, including the serving path the
+resilience tests exercise) fails at import on the older runtime.
+"""
+from __future__ import annotations
+
+try:                                # modern jax: public API
+    from jax import shard_map as _shard_map
+    _CHECK_KW = "check_vma"
+except ImportError:                 # jax 0.4.x: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+    """``jax.shard_map`` with the replication-check kwarg translated to
+    whatever this jax version calls it."""
+    kw[_CHECK_KW] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)

@@ -30,6 +30,27 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu import obs
+from deeplearning4j_tpu.resilience import faults
+
+
+class QueueFullError(RuntimeError):
+    """The bounded serving queue is full: the request is SHED (counted
+    in ``dl4j_tpu_inference_requests_shed_total{reason="queue_full"}``)
+    instead of blocking the caller indefinitely — under overload a fast
+    error beats an unbounded latency tail."""
+
+
+class ServingShutdownError(RuntimeError):
+    """The serving queue was shut down before this request dispatched;
+    ``shutdown()`` delivers it to every queued observable so pending
+    ``get()`` calls return immediately instead of burning their full
+    timeout."""
+
+
+class DeadlineExpiredError(TimeoutError):
+    """The request's deadline passed while it sat in the queue; the
+    dispatch worker skips it (no point computing an answer nobody is
+    waiting for) and errors the observable out."""
 
 
 def shard_model_params(net, mesh, axis: str = "model"):
@@ -68,11 +89,14 @@ def shard_model_params(net, mesh, axis: str = "model"):
 
 
 class _Observable:
-    """Reference: InferenceObservable — a future for one request."""
+    """Reference: InferenceObservable — a future for one request.
+    ``deadline`` (absolute ``obs.now()`` time, None = none) rides along
+    to the dispatch worker, which skips the request once expired."""
 
-    def __init__(self, x):
+    def __init__(self, x, deadline: Optional[float] = None):
         self.x = x
         self.t_enqueue = obs.now()   # request-latency anchor
+        self.deadline = deadline
         self._event = threading.Event()
         self._result = None
         self._error = None
@@ -113,6 +137,7 @@ class ParallelInference:
             shard_model_params(net, mesh, model_axis)
         self._q: queue.Queue = queue.Queue(maxsize=queue_limit)
         self._stop = threading.Event()
+        self._shutdown = threading.Event()
         self._worker = None
         self._infer_cache = {}
         if mode == self.BATCHED:
@@ -131,6 +156,10 @@ class ParallelInference:
         return warmup_inference(self, feature_shape, dtype)
 
     def output(self, x, timeout: Optional[float] = 30.0):
+        """``timeout`` doubles as the request DEADLINE: once it passes,
+        the dispatch worker drops the request unserved (the caller's
+        ``get`` has already timed out — computing the answer would only
+        steal batch capacity from live requests)."""
         x = np.asarray(x)
         if self.mode == self.INPLACE:
             t0 = obs.now()
@@ -138,24 +167,77 @@ class ParallelInference:
             obs.metrics.INFER_REQS.inc()
             obs.metrics.INFER_LATENCY.observe(obs.now() - t0)
             return out
-        ob = _Observable(x)
-        obs.metrics.INFER_REQS.inc()
-        self._q.put(ob)
-        obs.metrics.INFER_QUEUE.set(self._q.qsize())
+        ob = _Observable(
+            x, deadline=obs.now() + timeout if timeout else None)
+        self._submit(ob)
         return ob.get(timeout)
 
-    def output_async(self, x) -> _Observable:
-        ob = _Observable(np.asarray(x))
-        obs.metrics.INFER_REQS.inc()
-        self._q.put(ob)
-        obs.metrics.INFER_QUEUE.set(self._q.qsize())
+    def output_async(self, x,
+                     deadline_s: Optional[float] = None) -> _Observable:
+        """Enqueue without waiting. ``deadline_s`` (seconds from now,
+        None = no deadline) bounds how long the request may wait in the
+        queue before the worker drops it."""
+        ob = _Observable(
+            np.asarray(x),
+            deadline=obs.now() + deadline_s if deadline_s else None)
+        self._submit(ob)
         return ob
 
-    def shutdown(self):
+    def _submit(self, ob: _Observable) -> None:
+        """Bounded enqueue: a full queue SHEDS (raises QueueFullError)
+        instead of blocking the caller into an unbounded latency tail
+        — the load-shedding half of ARCHITECTURE.md §10."""
+        if self._shutdown.is_set():
+            raise ServingShutdownError(
+                "ParallelInference is shut down; request refused")
+        obs.metrics.INFER_REQS.inc()   # every arrival: shed rate is a
+        try:                           # subset of requests_total
+            self._q.put_nowait(ob)
+        except queue.Full:
+            obs.metrics.REQS_SHED.labels(reason="queue_full").inc()
+            raise QueueFullError(
+                f"serving queue full ({self._q.maxsize} pending "
+                f"requests); shedding — retry with backoff or scale "
+                f"out replicas") from None
+        if self._shutdown.is_set():
+            # raced with shutdown(): its drain may already be past the
+            # queue, leaving this observable unserved — error it out
+            # here so no get() ever waits out its full timeout
+            obs.metrics.REQS_SHED.labels(reason="shutdown").inc()
+            ob.set_error(ServingShutdownError(
+                "ParallelInference shut down; request refused"))
+            raise ServingShutdownError(
+                "ParallelInference shut down; request refused")
+        obs.metrics.INFER_QUEUE.set(self._q.qsize())
+
+    def shutdown(self, timeout: float = 5.0):
+        """Graceful drain: refuse new requests, stop the worker (its
+        in-flight batch completes and delivers), then error out every
+        still-queued observable so pending ``get()`` calls return
+        immediately instead of waiting out their full timeout."""
+        self._shutdown.set()
         self._stop.set()
         if self._worker:
-            self._q.put(None)
-            self._worker.join(timeout=5)
+            try:
+                self._q.put_nowait(None)   # wake a blocked get()
+            except queue.Full:
+                pass                       # worker is mid-drain: it
+            self._worker.join(timeout)     # will see _stop on its own
+        drained = 0
+        while True:
+            try:
+                ob = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if ob is None or ob._event.is_set():
+                continue            # already delivered/errored elsewhere
+            obs.metrics.REQS_SHED.labels(reason="shutdown").inc()
+            ob.set_error(ServingShutdownError(
+                "ParallelInference shut down before this request was "
+                "dispatched"))
+            drained += 1
+        obs.metrics.INFER_QUEUE.set(0)
+        return drained
 
     # -- batching worker (reference BatchedInferenceObservable) ---------
     def _bucket(self, n):
@@ -191,7 +273,25 @@ class ParallelInference:
                 group.append(nxt)
                 count += nxt.x.shape[0] if nxt.x.ndim > 1 else 1
             obs.metrics.INFER_QUEUE.set(self._q.qsize())
+            # deadline propagation: skip requests that expired in the
+            # queue — their callers' get() already timed out, and
+            # computing them would steal batch capacity from live ones
+            now = obs.now()
+            live = []
+            for o in group:
+                if o.deadline is not None and now > o.deadline:
+                    obs.metrics.REQS_SHED.labels(reason="deadline").inc()
+                    o.set_error(DeadlineExpiredError(
+                        f"request deadline expired after "
+                        f"{now - o.t_enqueue:.3f}s in the serving "
+                        f"queue; dropped undispatched"))
+                else:
+                    live.append(o)
+            group = live
+            if not group:
+                continue
             try:
+                faults.inject("serving")  # site: serving worker batch
                 arrays = [o.x if o.x.ndim > 1 else o.x[None]
                           for o in group]
                 sizes = [a.shape[0] for a in arrays]

@@ -27,7 +27,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+from deeplearning4j_tpu.parallel._compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 

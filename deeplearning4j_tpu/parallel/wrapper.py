@@ -39,13 +39,14 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from deeplearning4j_tpu.parallel._compat import shard_map
 
 from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.parallel.compression import \
     EncodedGradientsAccumulator
 from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
 from deeplearning4j_tpu.perf import sentry
+from deeplearning4j_tpu.resilience import faults
 
 
 class ParallelWrapper:
@@ -422,6 +423,7 @@ class ParallelWrapper:
                 except StopIteration:
                     break
                 obs.record_etl("ParallelWrapper.fit", te0, obs.now())
+                faults.inject("worker_step")  # site: worker loop body
                 if n_steps is not None and step_i >= n_steps:
                     break               # stay in lockstep across hosts
                 t0 = obs.now()

@@ -1,0 +1,48 @@
+"""Resilience subsystem — failure as a managed, testable artifact.
+
+PR 1 made compilation a managed artifact, PR 2 made runtime behavior
+observable; this package does the same for *failure* (ARCHITECTURE.md
+§10): the reference's recovery idiom (CheckpointListener +
+ModelSerializer resume + Spark task retry, SURVEY §5) is rebuilt
+robust-by-construction and verified by injected faults — the posture
+PyGraph (PAPERS.md) takes for CUDA-graph capture.
+
+- :mod:`~deeplearning4j_tpu.resilience.faults` — deterministic,
+  seedable fault injection at named sites threaded through the real
+  code paths (checkpoint IO, step dispatch, iterator, worker loop,
+  serving worker); env-gated by ``DL4J_TPU_FAULT_PLAN``, one-branch
+  off path.
+- :mod:`~deeplearning4j_tpu.resilience.checkpoint` — atomic
+  tmp+fsync+replace checkpoint publication, CRC32 manifests,
+  :func:`~deeplearning4j_tpu.resilience.checkpoint.verify_checkpoint`,
+  quarantine of corrupt files to ``corrupt/``, newest-*valid* fallback.
+- :mod:`~deeplearning4j_tpu.resilience.policy` — error classification
+  (transient vs deterministic), :class:`RetryPolicy` exponential
+  backoff with seeded jitter, SIGTERM :class:`PreemptionHandler` for
+  checkpoint-and-exit-cleanly.
+
+Consumers: ``ModelSerializer``/``ShardedCheckpointer``
+(``serialization.py``), ``FaultTolerantTrainer``
+(``train/fault_tolerance.py``), ``ParallelInference`` load-shedding
+(``parallel/inference.py``), and ``tools/chaos.py``.
+"""
+from deeplearning4j_tpu.resilience import checkpoint as checkpoint
+from deeplearning4j_tpu.resilience import faults as faults
+from deeplearning4j_tpu.resilience import policy as policy
+from deeplearning4j_tpu.resilience.checkpoint import (
+    newest_valid_checkpoint, quarantine, verify_checkpoint,
+    write_manifest)
+from deeplearning4j_tpu.resilience.faults import (FaultPlan, FaultRule,
+                                                  InjectedFault,
+                                                  NAMED_PLANS)
+from deeplearning4j_tpu.resilience.policy import (Preempted,
+                                                  PreemptionHandler,
+                                                  RetryPolicy, classify)
+
+__all__ = [
+    "checkpoint", "faults", "policy",
+    "newest_valid_checkpoint", "quarantine", "verify_checkpoint",
+    "write_manifest", "FaultPlan", "FaultRule", "InjectedFault",
+    "NAMED_PLANS", "Preempted", "PreemptionHandler", "RetryPolicy",
+    "classify",
+]

@@ -1,0 +1,187 @@
+"""Retry, backoff, error classification, preemption — the decision
+layer between "something threw" and "restore and continue".
+
+The seed's ``FaultTolerantTrainer`` retried *unconditionally* and
+*immediately*: a deterministic shape error burned every restart in
+milliseconds, and a flaky filesystem got hammered in a tight loop.
+This module packages the policy the TPU job actually needs:
+
+- :func:`classify` — transient (``OSError``/``ConnectionError``/
+  ``TimeoutError``/plain ``RuntimeError``: chip drops, network flakes,
+  IO hiccups → retry with backoff) vs. deterministic (shape/dtype/
+  NaN/Inf messages, ``ValueError``/``TypeError``/``FloatingPointError``:
+  the same input will crash the same way → at most ONE
+  restore-and-retry, then re-raise loudly).
+- :class:`RetryPolicy` — exponential backoff with seeded jitter
+  (deterministic in tests, decorrelated in fleets) and a generic
+  :meth:`RetryPolicy.call` runner.
+- :class:`PreemptionHandler` — SIGTERM (the preemption notice TPU
+  slices get) sets a cooperative flag; the training loop observes it
+  at the next iteration boundary, checkpoints, and exits cleanly
+  (exit code 0 — the restarted job resumes via ``resume_or_init``).
+  :class:`Preempted` is the control-flow signal, a ``BaseException``
+  so no retry loop mistakes it for a failure.
+"""
+from __future__ import annotations
+
+import logging
+import random
+import re
+import signal
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+TRANSIENT = "transient"
+DETERMINISTIC = "deterministic"
+
+#: message shapes that mean "same input → same crash": retrying without
+#: changing anything cannot help (one restore MAY — a corrupt in-memory
+#: buffer or poisoned optimizer state goes away with the rollback)
+_DETERMINISTIC_RE = re.compile(
+    r"shape|dtype|rank|dimension mismatch|incompatible|"
+    r"\bnan\b|\binf\b|not finite|non-finite", re.IGNORECASE)
+
+#: exception types that are transient by nature regardless of message
+_TRANSIENT_TYPES: Tuple[type, ...] = (OSError, ConnectionError,
+                                      TimeoutError)
+
+
+def classify(exc: BaseException) -> str:
+    """``transient`` → retry with backoff; ``deterministic`` → one
+    restore, then re-raise. Message patterns outrank types: a
+    RuntimeError carrying "shape mismatch" is deterministic even
+    though bare RuntimeErrors (XLA's habitual wrapper for runtime
+    faults) default to transient."""
+    if isinstance(exc, (FloatingPointError, ZeroDivisionError)):
+        return DETERMINISTIC
+    if _DETERMINISTIC_RE.search(str(exc)):
+        return DETERMINISTIC
+    if isinstance(exc, _TRANSIENT_TYPES):
+        return TRANSIENT
+    if isinstance(exc, RuntimeError):
+        return TRANSIENT
+    return DETERMINISTIC
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff with seeded jitter.
+
+    ``delay(attempt)`` (1-based) = ``base * 2^(attempt-1)`` clamped to
+    ``max_delay_s``, scaled by a uniform jitter in ``[1-jitter, 1+jitter]``
+    drawn from a per-(seed, attempt) RNG — deterministic for tests,
+    decorrelated across a fleet of restarting workers (every worker
+    passes its rank as ``seed``)."""
+
+    max_retries: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 10.0
+    jitter: float = 0.5
+    seed: int = 0
+
+    def delay(self, attempt: int) -> float:
+        d = min(self.max_delay_s,
+                self.base_delay_s * (2.0 ** max(0, attempt - 1)))
+        if not self.jitter:
+            return d
+        r = random.Random(self.seed * 1000003 + attempt)
+        return d * (1.0 + self.jitter * (2.0 * r.random() - 1.0))
+
+    def call(self, fn: Callable[[], "object"], *,
+             classify_fn: Callable[[BaseException], str] = classify,
+             on_retry: Optional[Callable] = None,
+             sleep: Callable[[float], None] = time.sleep):
+        """Run ``fn()`` under this policy: transient errors retry with
+        backoff up to ``max_retries``; a deterministic error is retried
+        at most once (immediately), then re-raised."""
+        attempt = 0
+        det_retried = False
+        while True:
+            try:
+                return fn()
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:
+                kind = classify_fn(e)
+                attempt += 1
+                if attempt > self.max_retries or (
+                        kind == DETERMINISTIC and det_retried):
+                    raise
+                if kind == DETERMINISTIC:
+                    det_retried = True
+                    d = 0.0
+                else:
+                    d = self.delay(attempt)
+                logger.warning("retry %d/%d after %s error (%s); "
+                               "backoff %.3fs", attempt,
+                               self.max_retries, kind, e, d)
+                if on_retry is not None:
+                    on_retry(e, attempt, kind)
+                if d:
+                    sleep(d)
+
+
+class Preempted(BaseException):
+    """Control flow, not an error: the loop was asked to stop, has
+    checkpointed, and is unwinding cleanly. BaseException so generic
+    ``except Exception`` retry machinery can never swallow it."""
+
+
+class PreemptionHandler:
+    """Cooperative SIGTERM handling for checkpoint-and-exit.
+
+    ``install()`` registers a handler (main thread only — Python's
+    signal contract) that sets a flag and chains any previously
+    installed Python-level handler. The training loop polls
+    :attr:`requested` at iteration boundaries — the handler itself
+    never checkpoints (saving from signal context could tear the very
+    file the restart needs)."""
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self.signals = tuple(signals)
+        self._requested = threading.Event()
+        self._prev: dict = {}
+        self._installed = False
+
+    def install(self) -> "PreemptionHandler":
+        for s in self.signals:
+            self._prev[s] = signal.signal(s, self._on_signal)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev if prev is not None
+                              else signal.SIG_DFL)
+            except (ValueError, TypeError):   # non-main thread/odd prev
+                pass
+        self._prev.clear()
+        self._installed = False
+
+    def _on_signal(self, signum, frame):
+        self._requested.set()
+        logger.warning("preemption notice (signal %d): will checkpoint "
+                       "and exit at the next iteration boundary", signum)
+        prev = self._prev.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+
+    @property
+    def requested(self) -> bool:
+        return self._requested.is_set()
+
+    def clear(self) -> None:
+        self._requested.clear()
+
+    def __enter__(self) -> "PreemptionHandler":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

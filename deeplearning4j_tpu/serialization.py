@@ -17,12 +17,19 @@ from __future__ import annotations
 
 import io
 import json
+import logging
+import os
 import zipfile
 from pathlib import Path
 from typing import Any, Optional
 
 import jax
 import numpy as np
+
+from deeplearning4j_tpu.resilience import checkpoint as _ckpt
+from deeplearning4j_tpu.resilience import faults as _faults
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 
 def _flatten_with_paths(tree):
@@ -70,9 +77,23 @@ class ModelSerializer:
     @staticmethod
     def write_model(net, path, save_updater: bool = True,
                     normalizer=None) -> None:
+        """Atomic publication: the zip is assembled in a same-directory
+        tmp file, fsync'd, and ``os.replace``d into place — a crash at
+        any byte leaves either the previous complete checkpoint or the
+        new complete checkpoint, never a truncated newest-by-mtime file
+        for the restart loop to trip on (resilience/checkpoint.py)."""
+        import zlib
         path = Path(path)
         path.parent.mkdir(parents=True, exist_ok=True)
-        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        _faults.inject("ckpt_write")
+        meta = {"iteration": net.iteration, "epoch": net.epoch,
+                "format_version": _ckpt.FORMAT_VERSION}
+        # assemble the zip in memory (this is the single-host exchange
+        # format — the GB-scale path is the orbax ShardedCheckpointer);
+        # the buffer is what gets CRC'd for the manifest, so the file
+        # is never re-read after its fsync
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
             _writestr_det(zf, "configuration.json", net.conf.to_json())
             _save_npz(zf, "params.npz", net.params)
             _save_npz(zf, "state.npz", net.state)
@@ -81,8 +102,6 @@ class ModelSerializer:
             if normalizer is not None:
                 _writestr_det(zf, "normalizer.json",
                               json.dumps(normalizer.state_dict()))
-            meta = {"iteration": net.iteration, "epoch": net.epoch,
-                    "format_version": 1}
             ishape = getattr(net, "_input_shape", None)
             if ishape:
                 meta["input_shape"] = list(ishape)
@@ -93,6 +112,25 @@ class ModelSerializer:
                 meta["input_shapes"] = {
                     n: list(shapes[n]) for n in net.conf.inputs}
             _writestr_det(zf, "meta.json", json.dumps(meta))
+        data = buf.getvalue()
+        tmp = _ckpt.tmp_path_for(path)
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            _faults.inject("ckpt_commit")
+            os.replace(tmp, path)
+        except BaseException:
+            tmp.unlink(missing_ok=True)
+            raise
+        _ckpt.fsync_dir(path.parent)
+        # sidecar manifest (CRC32 + size + counters) AFTER the replace:
+        # losing it to a crash only downgrades verification to the
+        # zip-level checks
+        _ckpt.write_manifest(path, {"iteration": net.iteration,
+                                    "epoch": net.epoch},
+                             crc32=zlib.crc32(data) & 0xFFFFFFFF)
 
     @staticmethod
     def _restore(zf: zipfile.ZipFile, net, meta: dict,
@@ -166,6 +204,8 @@ class ShardedCheckpointer:
         self._ocp = ocp
         self.directory = Path(directory).absolute()
         self.directory.mkdir(parents=True, exist_ok=True)
+        self._keep_last = keep_last
+        self._async_save = async_save
         self.mngr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -185,6 +225,7 @@ class ShardedCheckpointer:
         layer state + counters) or an explicit pytree."""
         if tree is None:
             tree = self._net_tree(net)
+        _faults.inject("ckpt_write")
         self.mngr.save(step, args=self._ocp.args.StandardSave(tree))
         if wait:
             self.mngr.wait_until_finished()
@@ -214,6 +255,52 @@ class ShardedCheckpointer:
             net.epoch = int(tree["meta"]["epoch"])
             return net
         return tree
+
+    def restore_latest_valid(self, net=None, *, target=None):
+        """Restore the newest step that actually restores, walking
+        newest→oldest; an unrestorable (corrupt/partial) step dir is
+        quarantined to ``corrupt/`` and the scan falls back — the
+        sharded-path analog of
+        ``resilience.checkpoint.newest_valid_checkpoint``."""
+        last_err: Optional[Exception] = None
+        while True:
+            steps = sorted(self.all_steps(), reverse=True)
+            if not steps:
+                raise FileNotFoundError(
+                    f"no restorable checkpoints under {self.directory}"
+                ) from last_err
+            step = steps[0]
+            try:
+                return self.restore(step, net=net, target=target)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                last_err = e
+                logger.warning("sharded checkpoint step %d unrestorable "
+                               "(%s); quarantining and falling back",
+                               step, e)
+                if not self._quarantine_step(step, str(e)):
+                    # the corrupt step could not be moved aside (e.g.
+                    # read-only mount): the next scan would retry the
+                    # SAME step forever — fail loudly instead
+                    raise
+
+    def _quarantine_step(self, step: int, reason: str) -> bool:
+        """Move a step dir to ``corrupt/``; returns False when nothing
+        moved (caller must not loop on the same step)."""
+        from deeplearning4j_tpu.resilience import checkpoint as _rck
+        step_dir = self.directory / str(step)
+        # the manager caches its step list (and may hold handles into
+        # the dir): close, move, re-open
+        self.mngr.close()
+        moved = (step_dir.is_dir()
+                 and _rck.quarantine(step_dir, reason) is not None)
+        self.mngr = self._ocp.CheckpointManager(
+            self.directory,
+            options=self._ocp.CheckpointManagerOptions(
+                max_to_keep=self._keep_last,
+                enable_async_checkpointing=self._async_save))
+        return moved
 
     def latest_step(self) -> Optional[int]:
         return self.mngr.latest_step()

@@ -124,6 +124,10 @@ class CheckpointListener(TrainingListener):
                        key=lambda p: p.stat().st_mtime)
         for old in ckpts[:-self.keep_last]:
             old.unlink()
+            # drop the CRC manifest sidecar with its checkpoint
+            from deeplearning4j_tpu.resilience.checkpoint import \
+                manifest_path
+            manifest_path(old).unlink(missing_ok=True)
 
     def iteration_done(self, net, iteration, epoch):
         if self.every_iter and iteration % self.every_iter == 0:

@@ -1,0 +1,56 @@
+"""tools/chaos.py tier-1 smoke: the chaos harness itself must stay
+runnable — one training plan and one serving plan end-to-end in
+subprocesses, asserting convergence-to-baseline under injected faults
+(ISSUE 3 satellite; the full plan sweep is a shell away:
+``python tools/chaos.py --plan <each>``)."""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+CHAOS = str(REPO / "tools" / "chaos.py")
+
+
+def _run(*args):
+    r = subprocess.run(
+        [sys.executable, CHAOS, *args], cwd=REPO, text=True,
+        capture_output=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = r.stdout[r.stdout.index("{"):]
+    return json.loads(payload)
+
+
+def test_chaos_list_names_every_plan():
+    r = subprocess.run([sys.executable, CHAOS, "--list"], cwd=REPO,
+                       text=True, capture_output=True, timeout=120,
+                       env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0
+    from deeplearning4j_tpu.resilience.faults import NAMED_PLANS
+    for name in NAMED_PLANS:
+        assert name in r.stdout
+
+
+def test_chaos_training_plan_converges_to_baseline():
+    out = _run("--plan", "worker-crash", "--epochs", "3")
+    assert out["ok"] is True
+    res = out["results"][0]
+    assert res["faults_fired"] >= 1
+    assert res["restarts"] >= 1
+    # clean restore path: the recovered trajectory is bit-identical
+    assert res["exact_resume"] is True
+
+
+def test_chaos_serving_plan_sheds_and_survives():
+    out = _run("--plan", "serving-crash")
+    assert out["ok"] is True
+    res = out["results"][0]
+    assert res["faults_fired"] >= 1
+    assert res["shed_at_enqueue"] > 0
+    assert res["errored_by_fault"] > 0
+    assert res["completed"] > 0
+    assert res["worker_survived"] is True

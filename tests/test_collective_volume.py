@@ -17,6 +17,8 @@ import jax
 import numpy as np
 import pytest
 
+from conftest import requires_modern_jax
+
 pytestmark = pytest.mark.skipif(
     len(jax.devices()) < 8, reason="needs 8 virtual devices")
 
@@ -30,7 +32,6 @@ def _load_cv():
     sys.modules.setdefault("collective_volume", mod)
     spec.loader.exec_module(mod)
     return mod
-
 
 @pytest.fixture(scope="module")
 def cv():
@@ -92,6 +93,7 @@ def test_tp_mlp_activation_allreduce_only(cv):
     assert not [k for k, _, _ in colls if k == "collective-permute"]
 
 
+@requires_modern_jax
 def test_sp_ring_volume_and_no_mask_tensor(cv):
     """SP causal ring fwd+bwd at T=8k: KV blocks + gradient
     accumulators ride collective-permute for n trips; with no key mask
@@ -115,6 +117,7 @@ def test_sp_ring_volume_and_no_mask_tensor(cv):
         (got, want_lo, want_hi)
 
 
+@requires_modern_jax
 def test_sp_ring_masked_adds_only_mask_bytes(cv):
     """With a key mask the ring carries ONE extra small tensor: volume
     grows by ≈ n·(mask shard bytes)·trips and nothing else."""
@@ -145,6 +148,7 @@ def test_sp_ring_masked_adds_only_mask_bytes(cv):
     assert 0 < extra <= want_extra * 1.3, (extra, want_extra)
 
 
+@requires_modern_jax
 def test_composed_dp_sp_tp_per_axis_gates(cv):
     """Composed DP×SP×TP step (VERDICT r4 Missing #1): every
     collective rides its OWN mesh axis — ppermutes only on 'seq'
@@ -209,6 +213,7 @@ def test_composed_dp_sp_tp_per_axis_gates(cv):
     assert not bad, bad
 
 
+@requires_modern_jax
 def test_composed_without_tp_sharding_loses_tensor_psums(cv):
     """Canary: the same composed step with params fully REPLICATED
     (the lost-TP regression) emits no 'tensor'-axis activation
@@ -249,6 +254,7 @@ def test_composed_without_tp_sharding_loses_tensor_psums(cv):
     assert not tensor_ars, tensor_ars
 
 
+@requires_modern_jax
 def test_hierarchical_encoded_dp_dcn_volume(cv):
     """Two-tier DP (VERDICT r4 ask #6): dense f32 all-reduce stays on
     the intra-slice 'data' axis; only 2-bit-packed int32 words cross

@@ -4,17 +4,25 @@ import os
 import runpy
 from pathlib import Path
 
+import jax
 import pytest
 
+from conftest import requires_modern_jax as ring
+
 EXAMPLES = Path(__file__).parent.parent / "examples"
+
 
 
 @pytest.mark.parametrize("name", [
     "lenet_mnist", "char_rnn_textgen", "bert_finetune",
     "distributed_data_parallel", "samediff_autodiff",
-    "parallelism_modes", "hyperparameter_search", "transfer_learning",
-    "model_serving", "pretrained_zoo", "long_context_attention",
-    "sharded_serving", "causal_lm", "bert_pretrain_mlm",
+    pytest.param("parallelism_modes", marks=ring),
+    "hyperparameter_search", "transfer_learning",
+    "model_serving", "pretrained_zoo",
+    pytest.param("long_context_attention", marks=ring),
+    "sharded_serving",
+    pytest.param("causal_lm", marks=ring),
+    "bert_pretrain_mlm",
 ])
 def test_example_runs(name, monkeypatch, capsys):
     monkeypatch.setenv("DL4J_TPU_EXAMPLE_FAST", "1")

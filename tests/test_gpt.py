@@ -7,11 +7,12 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from conftest import requires_modern_jax
+
 from deeplearning4j_tpu.nn.layers.attention import (
     MultiHeadAttention, repeat_kv_heads, rotary_embedding,
     scaled_dot_attention)
 from deeplearning4j_tpu.zoo import GPTNano
-
 
 def test_rope_relative_position_invariance(rng):
     """RoPE scores depend only on RELATIVE position: applying a common
@@ -151,6 +152,7 @@ def test_generate_uses_current_params(toy_lm):
     net.params = old                           # restore for other tests
 
 
+@requires_modern_jax
 def test_ring_attention_gqa_matches_dense():
     """GQA through the distributed ring: kv with fewer heads must
     equal dense attention with kv heads broadcast (only the small kv
@@ -177,6 +179,7 @@ def test_ring_attention_gqa_matches_dense():
                                rtol=2e-4, atol=2e-5)
 
 
+@requires_modern_jax
 def test_lm_trains_sequence_parallel():
     """The flagship long-context combination: the causal LM trains
     with ring sequence parallelism purely via the layer API."""

@@ -436,8 +436,11 @@ def test_parallel_wrapper_heartbeat_flags_stalled_worker():
     # the fit loop heart-beat once per step and timed every worker step
     assert metrics.WORKER_STEP.labels(worker="proc0").count \
         - before >= 4
+    # normal completion RETIRES the beat (PR 2 review fix: a finished
+    # fit must not read as a permanently stale worker in a
+    # train-then-serve process); only a crashed loop leaves one behind
     chk = health.check(stale_after=30)
-    assert "proc0" in chk and not chk["proc0"]["stale"]
+    assert "proc0" not in chk
     # a worker that stops beating (stalled collective) gets flagged
     health.heartbeat("proc1", t=obs.now() - 1e3)
     assert health.stale_workers(stale_after=30) == ["proc1"]

@@ -64,6 +64,69 @@ health, and the last spans of the dying run — next to the device
 memory map.
 """
 
+# hand-maintained operations doc, re-emitted on every regeneration
+# (ISSUE 3 satellite: the failure & recovery runbook lives in
+# docs/OPS.md next to the telemetry workflow)
+RESILIENCE_OPS_SECTION = """
+## Failure & recovery runbook (resilience/)
+
+Operating a run through failure (ARCHITECTURE.md §10):
+
+**Preemption.** SIGTERM (what a preemptible slice receives) is honored
+at the next iteration boundary when training under
+`FaultTolerantTrainer`: the run checkpoints, persists `progress.json`
+(with the mid-epoch `batch_in_epoch` position), and returns cleanly —
+exit code 0. Re-running the same script resumes via
+`resume_or_init(factory, ckpt_dir)`: newest *valid* checkpoint +
+progress counters, replaying the exact uninterrupted trajectory
+(`dl4j_tpu_preemptions_total` counts the clean stops).
+
+**Corrupt checkpoints.** Every restore path verifies before it
+restores (zip CRC sweep + required entries + the sidecar
+`*.manifest.json` CRC32/size). A corrupt or partial checkpoint is
+moved to `<ckpt_dir>/corrupt/` — inspect it there, it never blocks
+the restart loop — and restore falls back to the newest valid one
+(`dl4j_tpu_checkpoints_quarantined_total`). Writes are atomic
+(tmp+fsync+`os.replace`), so only an external writer or disk fault
+can produce one. The orbax sharded path behaves the same:
+`ShardedCheckpointer.restore_latest_valid()` quarantines unrestorable
+step dirs.
+
+**Retries.** `FaultTolerantTrainer` classifies errors
+(`resilience.policy.classify`): transient (OSError/ConnectionError/
+TimeoutError/bare RuntimeError) → restore newest valid checkpoint and
+retry under exponential backoff with seeded jitter; deterministic
+(shape/dtype/NaN messages) → ONE restore, then re-raise. Watch
+`dl4j_tpu_resilience_restarts_total` — a climbing counter with flat
+loss means the job is paying restore tax, not training.
+
+**Serving under overload.** `ParallelInference` sheds instead of
+blocking: a full queue raises `QueueFullError` at enqueue; a request
+whose deadline (the `output(timeout=)` budget, or
+`output_async(deadline_s=)`) expires in the queue is dropped
+undispatched; `shutdown()` errors queued requests out immediately.
+All three surface as
+`dl4j_tpu_inference_requests_shed_total{reason=queue_full|deadline|shutdown}`
+— alert on its rate vs `dl4j_tpu_inference_requests_total`.
+
+**Fault drills.** Inject failures into a real run with
+`DL4J_TPU_FAULT_PLAN` — named plans (`ckpt-io-flake`, `worker-crash`,
+`etl-flake`, `serving-crash`, `preempt`) or rule syntax
+`site:error=OSError:p=0.5:seed=3:max=2;...` over sites `ckpt_write`,
+`ckpt_commit`, `step`, `iterator`, `worker_step`, `serving`. Unset,
+the sites cost one branch (counter-asserted). Fires appear in
+`dl4j_tpu_faults_injected_total{site=}`. The standing drill harness:
+
+    python tools/chaos.py --plan ckpt-io-flake     # train scenario
+    python tools/chaos.py --plan serving-crash     # serving scenario
+    python tools/chaos.py --plan "ckpt_write:error=OSError:nth=1" --example lenet_mnist
+    python tools/chaos.py --list
+
+asserts convergence-to-baseline under each plan (bit-exact resume for
+clean restore paths) and exits nonzero on any regression — run it
+after touching checkpoint, trainer, or serving code.
+"""
+
 
 def main():
     import warnings
@@ -213,7 +276,8 @@ def main():
         if doc and not doc.startswith("lambda"):
             entry += f" — {doc}"
         op_lines.append(entry)
-    op_lines += ["", TELEMETRY_OPS_SECTION.strip()]
+    op_lines += ["", TELEMETRY_OPS_SECTION.strip(),
+                 "", RESILIENCE_OPS_SECTION.strip()]
     ops_out = os.path.join(os.path.dirname(out), "OPS.md")
     with open(ops_out, "w") as f:
         f.write("\n".join(op_lines) + "\n")

@@ -185,6 +185,17 @@ def bench_body():
     obs_rec = obs.overhead_report(step_seconds=batch / images_per_sec)
     obs_rec["step_summary"] = obs.metrics.step_summary()
 
+    # numerics observatory (obs/numerics.py): diagnostics-on vs -off
+    # step time on this run's model — the in-step per-layer stats must
+    # cost a small, measured fraction of the step (acceptance: <= 5%
+    # on the smoke model), with scalars-only host traffic at cadence.
+    # NB: reuses the live post-timing (params, opt_state, state) — the
+    # scanned loop donated net's original buffers.
+    numerics_rec = obs.numerics.measure_diag_overhead(
+        net, params, opt_state, state, ({"input": x}, [y], {}, {}),
+        jax.random.fold_in(jax.random.PRNGKey(0), 0),
+        k=4 if on_tpu else 2)
+
     print(json.dumps({
         "metric": METRIC,
         "value": round(images_per_sec, 1),
@@ -199,6 +210,7 @@ def bench_body():
         "platform": jax.devices()[0].platform,
         "compile": compile_rec,
         "obs": obs_rec,
+        "numerics": numerics_rec,
     }), flush=True)
 
 

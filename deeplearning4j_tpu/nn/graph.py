@@ -204,6 +204,9 @@ class ComputationGraph:
         self._output_fn = None
         self._optimizer = None
         self._shapes: Dict[str, tuple] = {}
+        self._numerics = None        # obs.numerics.NumericsMonitor
+        self._diag_step_fn = None
+        self.last_numerics = None    # last processed diag record
 
     # ------------------------------------------------------------------
     def init(self, input_shapes: Optional[Dict[str, tuple]] = None):
@@ -258,7 +261,7 @@ class ComputationGraph:
     # ------------------------------------------------------------------
     def _forward(self, params, state, inputs: Dict[str, jax.Array], *,
                  train: bool, rng, masks=None,
-                 pre_output: bool = False):
+                 pre_output: bool = False, stats_out=None):
         acts: Dict[str, jax.Array] = dict(inputs)
         new_state = {}
         masks = dict(masks or {})
@@ -290,6 +293,8 @@ class ComputationGraph:
                 acts[node.name] = z
                 new_state[node.name] = state.get(node.name, {})
                 masks[node.name] = m
+                if stats_out is not None:
+                    stats_out[node.name] = obs.numerics.act_summary(z)
                 continue
             y, s = layer.apply(params.get(node.name, {}),
                                state.get(node.name, {}), xs[0],
@@ -299,6 +304,10 @@ class ComputationGraph:
                                     if isinstance(layer,
                                                   BaseRecurrentLayer)
                                     else s)
+            if stats_out is not None:
+                # diagnostic step: tap this node's output AS TRACED —
+                # scalars become aux outputs of the same XLA program
+                stats_out[node.name] = obs.numerics.act_summary(y)
             masks[node.name] = layer.propagate_mask(m, None)
         return acts, new_state
 
@@ -346,7 +355,8 @@ class ComputationGraph:
                    and getattr(node.obj, "weight_noise", None) is not None
                    for node in self.order)
 
-    def _loss_fn(self, params, state, inputs, labels, masks, lmasks, rng):
+    def _loss_fn(self, params, state, inputs, labels, masks, lmasks, rng,
+                 act_stats=None):
         any_fused = any(self._out_loss(o)[1] for o in self.conf.outputs)
         cd = self.conf.compute_dtype
         if self._has_weight_noise():
@@ -358,7 +368,8 @@ class ComputationGraph:
             inputs = dtypes.cast_float_tree(inputs, cd)
         acts, new_state = self._forward(params, state, inputs, train=True,
                                         rng=rng, masks=masks,
-                                        pre_output=any_fused)
+                                        pre_output=any_fused,
+                                        stats_out=act_stats)
         total = 0.0
         for name, y in zip(self.conf.outputs, labels):
             loss_name, fused = self._out_loss(name)
@@ -390,6 +401,92 @@ class ComputationGraph:
         return sentry.jit(self._update,
                           name="ComputationGraph.train_step",
                           donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------
+    # numerics observatory (obs/numerics.py — ARCHITECTURE.md §11)
+    # ------------------------------------------------------------------
+    def _layer_names(self):
+        """Parametrized nodes in topological order — the attribution
+        ordering the NaN sentinel scans."""
+        return [n.name for n in self.order if n.kind == "layer"]
+
+    def monitor_numerics(self, every: int = 1,
+                         histograms: bool = False,
+                         raise_on_nonfinite: bool = True):
+        """Attach the numerics observatory (see
+        ``MultiLayerNetwork.monitor_numerics``)."""
+        self._numerics = obs.numerics.NumericsMonitor(
+            every=every, histograms=histograms,
+            raise_on_nonfinite=raise_on_nonfinite)
+        self._diag_step_fn = None   # config is traced into the program
+        return self
+
+    def _make_diag_step(self):
+        histograms = self._numerics.histograms \
+            if self._numerics is not None else False
+        layers = self._layer_names()
+
+        def diag_update(params, opt_state, state, inputs, labels,
+                        masks, lmasks, rng):
+            def lf(p):
+                stats = {}
+                loss, new_state = self._loss_fn(
+                    p, state, inputs, labels, masks, lmasks, rng,
+                    act_stats=stats)
+                return loss, (new_state, stats)
+
+            (loss, (new_state, act_stats)), grads = jax.value_and_grad(
+                lf, has_aux=True)(params)
+            updates, new_opt = self._optimizer.update(grads, opt_state,
+                                                      params)
+            new_params = optax.apply_updates(params, updates)
+            new_params = self._apply_constraints(new_params)
+            diag = obs.numerics.build_diag(
+                new_params, grads, updates, act_stats, layers,
+                histograms=histograms)
+            return new_params, new_opt, new_state, loss, diag
+
+        return sentry.jit(diag_update,
+                          name="ComputationGraph.diag_step",
+                          donate_argnums=(0, 1, 2))
+
+    def _fit_batch_diag(self, inputs, labels, masks, lmasks, t0):
+        """Cadence-gated diagnostic step (see
+        ``MultiLayerNetwork._fit_batch_diag``)."""
+        if self._diag_step_fn is None:
+            self._diag_step_fn = self._make_diag_step()
+        rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed),
+                                 self.iteration)
+        t1 = obs.now()
+        try:
+            self.params, self.opt_state, self.state, loss, diag = \
+                self._diag_step_fn(self.params, self.opt_state,
+                                   self.state, inputs, labels, masks,
+                                   lmasks, rng)
+            t2 = obs.now()
+            self.score_ = float(loss)   # blocking device sync
+        except Exception as e:       # HBM OOM → diagnostic dump
+            from deeplearning4j_tpu.utils import crashreport
+            if crashreport.is_oom(e):
+                path = crashreport.write_memory_crash_dump(self, e)
+                if path:
+                    raise RuntimeError(
+                        f"diagnostic training step ran out of device "
+                        f"memory (the numerics aux outputs keep "
+                        f"grads+updates alive together — try a "
+                        f"sparser cadence); crash dump written to "
+                        f"{path}") from e
+            raise
+        obs.record_step("ComputationGraph.fit", t0, t1, t2, obs.now())
+        self.iteration += 1
+        self._numerics.process(self, diag, self._layer_names(),
+                               entry="ComputationGraph")
+        tl0 = obs.now()
+        for l in self.listeners:
+            l.iteration_done(self, self.iteration, self.epoch)
+        if self.listeners and obs.trace.enabled():
+            obs.trace.add_span("ComputationGraph.fit/listeners",
+                               tl0, obs.now())
 
     def _make_train_loop(self):
         """K train steps per dispatched executable (``lax.scan`` over
@@ -433,10 +530,21 @@ class ComputationGraph:
             self._train_step_fn = None
             self._train_loop_fn = None
             self._output_fn = None
+            self._diag_step_fn = None
 
     def _fit_group(self, group):
         """Run a group of uniformly-shaped batches (same mask
         structure) in one scanned call (see ``_make_train_loop``)."""
+        nm = self._numerics
+        if nm is not None and any(nm.due(self.iteration + i)
+                                  for i in range(len(group))):
+            # a diagnostic step is due inside this group: the scanned
+            # loop has no per-step aux outputs, so run the group's
+            # batches individually (the cadence path, not the hot one)
+            nm.note_group_split(len(group))
+            for item in group:
+                self._fit_batch(*item)
+            return
         t0 = obs.now()
         faults.inject("step")       # site: step dispatch (resilience/)
         self._refresh_ambient_trace()
@@ -488,6 +596,8 @@ class ComputationGraph:
             self.iteration += 1
             for l in self.listeners:
                 l.iteration_done(self, self.iteration, self.epoch)
+        if nm is not None:
+            nm.note_score(self.score_)
         if self.listeners and obs.trace.enabled():
             obs.trace.add_span("ComputationGraph.fit/listeners",
                                tl0, obs.now())
@@ -581,6 +691,10 @@ class ComputationGraph:
         lmasks = {n: jnp.asarray(np.asarray(m))
                   for n, m in zip(self.conf.outputs, lms or [])
                   if m is not None}
+        nm = self._numerics     # off path: one attribute check
+        if nm is not None and nm.due(self.iteration):
+            return self._fit_batch_diag(inputs, labels, masks, lmasks,
+                                        t0)
         rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed),
                                  self.iteration)
         t1 = obs.now()
@@ -591,6 +705,8 @@ class ComputationGraph:
         self.score_ = float(loss)     # blocking device sync
         obs.record_step("ComputationGraph.fit", t0, t1, t2, obs.now())
         self.iteration += 1
+        if nm is not None:
+            nm.note_score(self.score_)
         tl0 = obs.now()
         for l in self.listeners:
             l.iteration_done(self, self.iteration, self.epoch)

@@ -66,6 +66,9 @@ class MultiLayerNetwork:
         self._output_fn = None
         self._optimizer = None
         self.score_ = float("nan")
+        self._numerics = None        # obs.numerics.NumericsMonitor
+        self._diag_step_fn = None
+        self.last_numerics = None    # last processed diag record
 
     # ------------------------------------------------------------------
     # init
@@ -159,7 +162,7 @@ class MultiLayerNetwork:
     # ------------------------------------------------------------------
     def _forward(self, params, state, x, *, train, rng, mask=None,
                  rnn_init=None, stop_at: Optional[int] = None,
-                 pre_output_last: bool = False):
+                 pre_output_last: bool = False, stats_out=None):
         """Returns (activation, new_state, rnn_states)."""
         if not params:
             raise RuntimeError(
@@ -195,6 +198,8 @@ class MultiLayerNetwork:
                     z = z + params[name]["b"]
                 x = z
                 new_state[name] = state.get(name, {})
+                if stats_out is not None:
+                    stats_out[name] = obs.numerics.act_summary(x)
                 continue
             x, s = layer.apply(params.get(name, {}), state.get(name, {}),
                                x, train=train, rng=sub, mask=mask, **kwargs)
@@ -203,6 +208,10 @@ class MultiLayerNetwork:
                 new_state[name] = state.get(name, {})
             else:
                 new_state[name] = s
+            if stats_out is not None:
+                # diagnostic step: tap this layer's output AS TRACED —
+                # scalars become aux outputs of the same XLA program
+                stats_out[name] = obs.numerics.act_summary(x)
             mask = layer.propagate_mask(mask, None)
         return x, new_state, rnn_states
 
@@ -263,7 +272,8 @@ class MultiLayerNetwork:
                 out[_lname(i)] = p
         return out
 
-    def _loss_fn(self, params, state, x, y, mask, lmask, rng):
+    def _loss_fn(self, params, state, x, y, mask, lmask, rng,
+                 act_stats=None):
         loss_name, fused = self._last_loss()
         cd = self.conf.compute_dtype
         master = params
@@ -278,7 +288,7 @@ class MultiLayerNetwork:
             x = dtypes.cast_float_tree(x, cd)
         out, new_state, _ = self._forward(
             params, state, x, train=True, rng=rng, mask=mask,
-            pre_output_last=fused)
+            pre_output_last=fused, stats_out=act_stats)
         loss_fn = losses_mod.get(loss_name)
         if cd is not None and losses_mod.wants_f32_logits(loss_fn,
                                                           fused):
@@ -306,6 +316,94 @@ class MultiLayerNetwork:
         return sentry.jit(self._update,
                           name="MultiLayerNetwork.train_step",
                           donate_argnums=(0, 1, 2))
+
+    # ------------------------------------------------------------------
+    # numerics observatory (obs/numerics.py — ARCHITECTURE.md §11)
+    # ------------------------------------------------------------------
+    def _layer_names(self):
+        return [_lname(i) for i in range(len(self.layers))]
+
+    def monitor_numerics(self, every: int = 1,
+                         histograms: bool = False,
+                         raise_on_nonfinite: bool = True):
+        """Attach the numerics observatory: every ``every``-th step is
+        a *diagnostic step* — a second compiled variant of the train
+        step whose aux outputs are per-layer gradient/update/param
+        norms, activation stats from the real training forward, and
+        the non-finite sentinel (see ``obs/numerics.py``). Off the
+        cadence, the default step runs untouched."""
+        self._numerics = obs.numerics.NumericsMonitor(
+            every=every, histograms=histograms,
+            raise_on_nonfinite=raise_on_nonfinite)
+        self._diag_step_fn = None   # config is traced into the program
+        return self
+
+    def _make_diag_step(self):
+        histograms = self._numerics.histograms \
+            if self._numerics is not None else False
+        layers = self._layer_names()
+
+        def diag_update(params, opt_state, state, x, y, mask, lmask,
+                        rng):
+            def lf(p):
+                stats = {}
+                loss, new_state = self._loss_fn(
+                    p, state, x, y, mask, lmask, rng, act_stats=stats)
+                return loss, (new_state, stats)
+
+            (loss, (new_state, act_stats)), grads = jax.value_and_grad(
+                lf, has_aux=True)(params)
+            updates, new_opt = self._optimizer.update(grads, opt_state,
+                                                      params)
+            new_params = optax.apply_updates(params, updates)
+            new_params = self._apply_constraints(new_params)
+            diag = obs.numerics.build_diag(
+                new_params, grads, updates, act_stats, layers,
+                histograms=histograms)
+            return new_params, new_opt, new_state, loss, diag
+
+        return sentry.jit(diag_update,
+                          name="MultiLayerNetwork.diag_step",
+                          donate_argnums=(0, 1, 2))
+
+    def _fit_batch_diag(self, x, y, fmask, lmask, t0):
+        """Cadence-gated diagnostic step: same update, plus the
+        numerics aux outputs (scalars-only host pull at cadence)."""
+        if self._diag_step_fn is None:
+            self._diag_step_fn = self._make_diag_step()
+        rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed),
+                                 self.iteration)
+        t1 = obs.now()
+        try:
+            self.params, self.opt_state, self.state, loss, diag = \
+                self._diag_step_fn(self.params, self.opt_state,
+                                   self.state, x, y, fmask, lmask, rng)
+            t2 = obs.now()
+            self.score_ = float(loss)   # blocking device sync
+        except Exception as e:       # HBM OOM → diagnostic dump
+            from deeplearning4j_tpu.utils import crashreport
+            if crashreport.is_oom(e):
+                path = crashreport.write_memory_crash_dump(self, e)
+                if path:
+                    raise RuntimeError(
+                        f"diagnostic training step ran out of device "
+                        f"memory (the numerics aux outputs keep "
+                        f"grads+updates alive together — try a "
+                        f"sparser cadence); crash dump written to "
+                        f"{path}") from e
+            raise
+        obs.record_step("MultiLayerNetwork.fit", t0, t1, t2, obs.now())
+        self.iteration += 1
+        # publishes gauges/trace counters and raises NonFiniteError
+        # naming the origin layer when the sentinel fired
+        self._numerics.process(self, diag, self._layer_names(),
+                               entry="MultiLayerNetwork")
+        tl0 = obs.now()
+        for l in self.listeners:
+            l.iteration_done(self, self.iteration, self.epoch)
+        if self.listeners and obs.trace.enabled():
+            obs.trace.add_span("MultiLayerNetwork.fit/listeners",
+                               tl0, obs.now())
 
     def _make_train_loop(self):
         """K train steps per dispatched executable (``lax.scan`` over
@@ -344,8 +442,19 @@ class MultiLayerNetwork:
             self._train_step_fn = None
             self._train_loop_fn = None
             self._output_fn = None
+            self._diag_step_fn = None
 
     def _fit_group(self, group):
+        nm = self._numerics
+        if nm is not None and any(nm.due(self.iteration + i)
+                                  for i in range(len(group))):
+            # a diagnostic step is due inside this group: the scanned
+            # loop has no per-step aux outputs, so run the group's
+            # batches individually (the cadence path, not the hot one)
+            nm.note_group_split(len(group))
+            for x, y in group:
+                self._fit_batch(x, y)
+            return
         t0 = obs.now()
         faults.inject("step")       # site: step dispatch (resilience/)
         self._refresh_ambient_trace()
@@ -383,6 +492,8 @@ class MultiLayerNetwork:
             self.iteration += 1
             for l in self.listeners:
                 l.iteration_done(self, self.iteration, self.epoch)
+        if nm is not None:
+            nm.note_score(self.score_)
         if self.listeners and obs.trace.enabled():
             obs.trace.add_span("MultiLayerNetwork.fit/listeners",
                                tl0, obs.now())
@@ -466,6 +577,9 @@ class MultiLayerNetwork:
         if (self.conf.backprop_type == "TruncatedBPTT" and x.ndim == 3):
             return self._fit_tbptt(x, y, fmask, lmask, _t0=t0)
         self._refresh_ambient_trace()
+        nm = self._numerics     # off path: one attribute check
+        if nm is not None and nm.due(self.iteration):
+            return self._fit_batch_diag(x, y, fmask, lmask, t0)
         if self._train_step_fn is None:
             self._train_step_fn = self._make_train_step()
         rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed),
@@ -488,6 +602,8 @@ class MultiLayerNetwork:
             raise
         obs.record_step("MultiLayerNetwork.fit", t0, t1, t2, obs.now())
         self.iteration += 1
+        if nm is not None:
+            nm.note_score(self.score_)
         tl0 = obs.now()
         for l in self.listeners:
             l.iteration_done(self, self.iteration, self.epoch)
@@ -565,6 +681,8 @@ class MultiLayerNetwork:
         obs.record_step("MultiLayerNetwork.fit_tbptt", t0, t1, t2,
                         obs.now())
         self.iteration += 1
+        if self._numerics is not None:   # tbptt has no diag variant:
+            self._numerics.note_score(self.score_)   # escalation only
         tl0 = obs.now()
         for l in self.listeners:
             l.iteration_done(self, self.iteration, self.epoch)

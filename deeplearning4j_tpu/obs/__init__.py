@@ -14,6 +14,9 @@ per-tool private formats) with one layer (ARCHITECTURE.md §9):
   cache join as pull-time collector families.
 - :mod:`~deeplearning4j_tpu.obs.health` — worker heartbeats + stale
   detection.
+- :mod:`~deeplearning4j_tpu.obs.numerics` — in-step per-layer
+  gradient/activation health with NaN attribution (cadence-gated
+  diagnostic steps; ARCHITECTURE.md §11).
 - :func:`report` — the merged JSON snapshot consumed by
   ``StatsListener`` records, ``bench.py``'s ``obs`` section,
   ``tools/perf_dossier.py``, and ``utils/crashreport.py``.
@@ -30,6 +33,7 @@ from typing import Any, Dict, Optional
 
 from deeplearning4j_tpu.obs import health as health
 from deeplearning4j_tpu.obs import metrics as metrics
+from deeplearning4j_tpu.obs import numerics as numerics
 from deeplearning4j_tpu.obs import trace as trace
 from deeplearning4j_tpu.obs.trace import now as now, span as span
 
@@ -140,6 +144,6 @@ def snapshot() -> Dict[str, Any]:
     return metrics.snapshot()
 
 
-__all__ = ["trace", "metrics", "health", "span", "now",
+__all__ = ["trace", "metrics", "health", "numerics", "span", "now",
            "record_step", "record_etl", "record_worker_step",
            "summary", "report", "overhead_report", "snapshot"]

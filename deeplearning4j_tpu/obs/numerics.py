@@ -1,0 +1,505 @@
+"""Numerics observatory — in-step gradient/activation health with
+per-layer NaN attribution.
+
+Reference: the DL4J Training UI's headline diagnostic is per-layer
+training health (``StatsListener`` update:param ratios, gradient and
+activation distributions — SURVEY §5), but the reference collects all
+of it host-side AFTER the step: a second forward pass for activations,
+a full previous-parameters copy for update deltas, and a NaN that
+surfaces only as a scoreless iteration with zero attribution.
+
+TPU-native redesign: the statistics are auxiliary outputs of the SAME
+XLA program that computes the update. A cadence-gated *diagnostic
+step* (a second ``sentry.jit``-wrapped compile of the net's update,
+AOT-warmable like every other bucket — ``perf/warmup.py``) returns,
+next to the new params, a ``diag`` pytree of per-layer scalars:
+
+- gradient / update / parameter L2 norms (update:param ratio follows
+  from two scalars on host),
+- activation mean/std/absmax from the REAL training forward (no extra
+  forward pass — ``_forward(stats_out=...)`` taps each layer's output
+  as it is traced),
+- per-layer non-finite counts for gradients and activations — the NaN
+  sentinel: the first layer (forward order) with non-finite
+  activations, or the last layer (backward order) with non-finite
+  gradients, names the origin,
+- optional fixed-bucket log2-scale histogram sketches (``HIST_BINS``
+  buckets over ``2**HIST_LO .. 2**HIST_HI``) for gradients and
+  updates,
+- on the ``ParallelWrapper`` SPMD path, per-layer replica divergence
+  (``pmax − pmin`` of the per-replica gradient norms).
+
+Only these scalars cross to host, and only at cadence. The off path
+is one attribute check in the fit loop: with no monitor attached the
+default compiled step is byte-identical and :func:`diag_dispatches` /
+:func:`host_pulls` stay 0 for the process lifetime (the same
+counter-asserted contract as the span tracer's and fault injector's
+off paths).
+
+A non-finite origin raises :class:`NonFiniteError` — a structured
+``FloatingPointError`` carrying ``layer``/``kind``/``iteration`` that
+``resilience.policy.classify`` routes as deterministic (one
+restore-and-retry, then re-raise): "loss is NaN" becomes "layer
+gpt.h3.attn gradients overflowed at iter 412, restored from iter 400".
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.obs import metrics as _metrics
+from deeplearning4j_tpu.obs import trace as _trace
+
+#: log2-scale sketch geometry: HIST_BINS buckets of 2 exponents each
+#: over |v| in [2**HIST_LO, 2**HIST_HI); zeros are excluded, out-of-
+#: range magnitudes clamp into the edge buckets
+HIST_BINS = 16
+HIST_LO = -24.0
+HIST_HI = 8.0
+_HIST_STEP = (HIST_HI - HIST_LO) / HIST_BINS
+
+
+class NonFiniteError(FloatingPointError):
+    """Structured NaN/Inf sentinel. ``FloatingPointError`` + a
+    "non-finite" message so ``resilience.policy.classify`` routes it
+    deterministic (one restore, then re-raise) through both its type
+    and message rules."""
+
+    def __init__(self, message: Optional[str] = None, *,
+                 layer: Optional[str] = None,
+                 kind: Optional[str] = None,
+                 iteration: Optional[int] = None):
+        self.layer = layer
+        self.kind = kind
+        self.iteration = iteration
+        if message is None:
+            message = (f"non-finite {kind or 'values'} detected in "
+                       f"layer {layer!r} at iteration {iteration}")
+        super().__init__(message)
+
+
+# -- metric families (scraped as dl4j_tpu_numerics_* on /metrics) ------------
+
+GRAD_NORM = _metrics.REGISTRY.gauge(
+    "dl4j_tpu_numerics_grad_norm",
+    "per-layer gradient L2 norm at the last diagnostic step",
+    ("layer",))
+UPDATE_RATIO = _metrics.REGISTRY.gauge(
+    "dl4j_tpu_numerics_update_ratio",
+    "per-layer update:param norm ratio at the last diagnostic step",
+    ("layer",))
+ACT_ABSMAX = _metrics.REGISTRY.gauge(
+    "dl4j_tpu_numerics_activation_absmax",
+    "per-layer activation |max| from the training forward",
+    ("layer",))
+REPLICA_DIVERGENCE = _metrics.REGISTRY.gauge(
+    "dl4j_tpu_numerics_replica_divergence",
+    "per-layer max-min spread of per-replica gradient norms "
+    "(ParallelWrapper SPMD path)", ("layer",))
+NONFINITE = _metrics.REGISTRY.counter(
+    "dl4j_tpu_numerics_nonfinite_total",
+    "non-finite origins pinpointed by the NaN sentinel",
+    ("layer", "kind"))
+DIAG_STEPS = _metrics.REGISTRY.counter(
+    "dl4j_tpu_numerics_diag_steps_total",
+    "diagnostic steps dispatched (cadence-gated)")
+
+# -- off-path fence counters (tests assert both stay 0 with no monitor) ------
+
+_lock = threading.Lock()
+_counters = {"diag_dispatches": 0, "host_pulls": 0}
+
+
+def diag_dispatches() -> int:
+    """Diagnostic steps processed since the last reset — stays 0 for
+    the whole process lifetime when no monitor is attached (the
+    off-path zero-overhead assertion)."""
+    return _counters["diag_dispatches"]
+
+
+def host_pulls() -> int:
+    """Device→host diag transfers — the scalars-only-at-cadence
+    assertion anchor (one pull per diagnostic step, 0 otherwise)."""
+    return _counters["host_pulls"]
+
+
+def reset_counters() -> None:
+    """Tests only."""
+    with _lock:
+        _counters["diag_dispatches"] = 0
+        _counters["host_pulls"] = 0
+
+
+# -- in-program stat builders (traced inside the diagnostic step) ------------
+
+def act_summary(x) -> Dict[str, Any]:
+    """Scalar summary of one layer's activation tensor, traced inside
+    the training forward: mean/std/absmax over the finite mask plus a
+    non-finite count (the attribution signal — masking keeps the
+    summary stats themselves finite even mid-divergence)."""
+    import jax.numpy as jnp
+
+    v = x.astype(jnp.float32)
+    finite = jnp.isfinite(v)
+    n_bad = jnp.asarray(v.size, jnp.int32) - jnp.sum(
+        finite, dtype=jnp.int32)
+    safe = jnp.where(finite, v, 0.0)
+    n = jnp.maximum(jnp.sum(finite, dtype=jnp.int32), 1)
+    mean = jnp.sum(safe) / n
+    var = jnp.sum(jnp.where(finite, jnp.square(v - mean), 0.0)) / n
+    return {"mean": mean, "std": jnp.sqrt(var),
+            "absmax": jnp.max(jnp.abs(safe)), "nonfinite": n_bad}
+
+
+def _zero_act_summary():
+    import jax.numpy as jnp
+    z = jnp.float32(0.0)
+    return {"mean": z, "std": z, "absmax": z,
+            "nonfinite": jnp.int32(0)}
+
+
+def layer_summary(sub) -> Tuple[Any, Any, Any]:
+    """(l2_norm, absmax, nonfinite_count) over one layer's leaves —
+    norms over the finite mask (the count carries the NaN signal)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree.leaves(sub)
+    if not leaves:
+        return jnp.float32(0.0), jnp.float32(0.0), jnp.int32(0)
+    sq = jnp.float32(0.0)
+    am = jnp.float32(0.0)
+    nf = jnp.int32(0)
+    for leaf in leaves:
+        v = leaf.astype(jnp.float32)
+        finite = jnp.isfinite(v)
+        nf = nf + jnp.asarray(v.size, jnp.int32) - jnp.sum(
+            finite, dtype=jnp.int32)
+        safe = jnp.where(finite, v, 0.0)
+        sq = sq + jnp.sum(jnp.square(safe))
+        am = jnp.maximum(am, jnp.max(jnp.abs(safe)))
+    return jnp.sqrt(sq), am, nf
+
+
+def layer_norm(sub):
+    """Plain (unmasked) L2 norm over one layer's leaves — the cheap
+    reduction for trees that don't need attribution counts (updates,
+    post-update params): a non-finite leaf simply propagates into the
+    norm, which is itself diagnostic."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree.leaves(sub)
+    if not leaves:
+        return jnp.float32(0.0)
+    sq = jnp.float32(0.0)
+    for leaf in leaves:
+        sq = sq + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    return jnp.sqrt(sq)
+
+
+def log2_sketch(sub):
+    """Fixed-bucket log2-magnitude histogram over one layer's leaves:
+    ``HIST_BINS`` int32 counts, zeros excluded, magnitudes clamped to
+    the edge buckets. Fixed buckets make sketches comparable across
+    layers, steps, and runs (no data-dependent edges to recompute)."""
+    import jax
+    import jax.numpy as jnp
+
+    counts = jnp.zeros((HIST_BINS,), jnp.int32)
+    for leaf in jax.tree.leaves(sub):
+        v = jnp.abs(leaf.astype(jnp.float32)).ravel()
+        ok = jnp.isfinite(v) & (v > 0)
+        e = jnp.log2(jnp.where(ok, v, 1.0))
+        idx = jnp.clip(((e - HIST_LO) / _HIST_STEP).astype(jnp.int32),
+                       0, HIST_BINS - 1)
+        counts = counts + jnp.bincount(
+            idx, weights=ok.astype(jnp.int32),
+            length=HIST_BINS).astype(jnp.int32)
+    return counts
+
+
+def layer_norms_vector(tree, layers: List[str]):
+    """Per-layer L2 norms stacked into one [L] vector (the shape the
+    SPMD divergence pmax/pmin reduces over)."""
+    import jax.numpy as jnp
+    return jnp.stack([layer_summary(tree.get(l, {}))[0]
+                      for l in layers])
+
+
+def build_diag(params, grads, updates, act_stats,
+               layers: List[str], histograms: bool = False
+               ) -> Dict[str, Any]:
+    """Assemble the diagnostic aux pytree — stacked [L] scalar vectors
+    (plus [L, HIST_BINS] sketches when requested), traced inside the
+    diagnostic step so the whole thing is aux outputs of the one XLA
+    program. ``params`` are the POST-update params (the ratio's
+    denominator, matching the reference's current-param semantics)."""
+    import jax.numpy as jnp
+
+    g = [layer_summary(grads.get(l, {})) for l in layers]
+    a = [act_stats.get(l) or _zero_act_summary() for l in layers]
+    diag: Dict[str, Any] = {
+        "grad_norm": jnp.stack([t[0] for t in g]),
+        "grad_absmax": jnp.stack([t[1] for t in g]),
+        "grad_nonfinite": jnp.stack([t[2] for t in g]),
+        "update_norm": jnp.stack(
+            [layer_norm(updates.get(l, {})) for l in layers]),
+        "param_norm": jnp.stack(
+            [layer_norm(params.get(l, {})) for l in layers]),
+        "act_mean": jnp.stack([s["mean"] for s in a]),
+        "act_std": jnp.stack([s["std"] for s in a]),
+        "act_absmax": jnp.stack([s["absmax"] for s in a]),
+        "act_nonfinite": jnp.stack([s["nonfinite"] for s in a]),
+    }
+    if histograms:
+        diag["grad_hist"] = jnp.stack(
+            [log2_sketch(grads.get(l, {})) for l in layers])
+        diag["update_hist"] = jnp.stack(
+            [log2_sketch(updates.get(l, {})) for l in layers])
+    return diag
+
+
+def reduce_act_stats(act_stats, axis_name: str):
+    """Cross-replica reduction of per-layer activation summaries on
+    the SPMD path: means/stds pmean, absmax pmax, non-finite counts
+    psum (a NaN on ANY replica must attribute)."""
+    import jax
+
+    out = {}
+    for name, s in act_stats.items():
+        out[name] = {
+            "mean": jax.lax.pmean(s["mean"], axis_name),
+            "std": jax.lax.pmean(s["std"], axis_name),
+            "absmax": jax.lax.pmax(s["absmax"], axis_name),
+            "nonfinite": jax.lax.psum(s["nonfinite"], axis_name),
+        }
+    return out
+
+
+# -- host-side helpers -------------------------------------------------------
+
+_TREE_NORMS_FN = None
+
+
+def tree_norms(tree) -> Dict[str, float]:
+    """Per-layer L2 norms of a params-like tree in ONE jitted fused
+    reduction — the sanctioned replacement for listener-side
+    per-layer ``jnp`` loops (``tools/lint_instrumentation.py`` flags
+    those in listener/stats paths; this module is the allowlisted
+    home). One device→host transfer of L scalars per call."""
+    global _TREE_NORMS_FN
+    import jax
+
+    if _TREE_NORMS_FN is None:
+        def impl(t):
+            return {name: layer_summary(sub)[0]
+                    for name, sub in t.items()}
+        _TREE_NORMS_FN = jax.jit(impl)
+    host = jax.device_get(_TREE_NORMS_FN(tree or {}))
+    return {k: float(v) for k, v in host.items()}
+
+
+def sketch_as_histogram(counts) -> Dict[str, Any]:
+    """Render a log2 sketch in the dashboard's ``{counts, min, max}``
+    histogram shape (bucket-range bounds as the edges)."""
+    return {"counts": [int(c) for c in counts],
+            "min": float(2.0 ** HIST_LO), "max": float(2.0 ** HIST_HI),
+            "log2": True}
+
+
+def first_nonfinite(num: Dict[str, Any], layers: List[str]
+                    ) -> Optional[Tuple[str, str]]:
+    """Pinpoint the origin layer of a non-finite event from the
+    per-layer counts. Forward activations propagate a NaN/Inf from
+    its origin ONWARD, so the first layer (forward order) with
+    non-finite activations is the origin; backward gradients
+    propagate it toward EARLIER layers, so absent an activation
+    signal the origin is the last layer (forward order) with
+    non-finite gradients."""
+    act = num.get("act_nonfinite") or {}
+    for l in layers:
+        if act.get(l, 0) > 0:
+            return l, "activations"
+    grad = num.get("grad_nonfinite") or {}
+    hits = [l for l in layers if grad.get(l, 0) > 0]
+    if hits:
+        return hits[-1], "gradients"
+    return None
+
+
+def measure_diag_overhead(net, p, o, s, feed, rng, k: int = 10
+                          ) -> Dict[str, Any]:
+    """Time ``k`` plain steps vs ``k`` diagnostic steps (cadence=1,
+    per-step loss sync, scalars-only diag pull) on a live
+    (params, opt_state, state) tree — the shared harness behind
+    ``bench.py``'s ``numerics`` section and the dossier's
+    ``numerics_observatory`` entry. ``feed`` is the net's step feed
+    after (p, o, s): e.g. ``(x, y, None, None)`` for a
+    MultiLayerNetwork, ``({name: x}, [y], {}, {})`` for a
+    ComputationGraph. Attaches a non-raising monitor when none is
+    present; consumes/returns nothing from the passed trees (donated
+    buffers are replaced step over step)."""
+    import jax
+
+    if getattr(net, "_numerics", None) is None:
+        net.monitor_numerics(every=1, raise_on_nonfinite=False)
+    plain = net._make_train_step()
+    diag = net._make_diag_step()
+
+    def timed(step, with_diag):
+        nonlocal p, o, s
+        out = step(p, o, s, *feed, rng)          # compile + warm
+        p, o, s = out[0], out[1], out[2]
+        float(out[3])
+        t0 = _trace.now()
+        for _ in range(k):
+            out = step(p, o, s, *feed, rng)
+            p, o, s = out[0], out[1], out[2]
+            float(out[3])                  # per-step loss sync
+            if with_diag:
+                jax.device_get(out[4])     # the scalars-only pull
+        return (_trace.now() - t0) / k
+
+    t_off = timed(plain, False)
+    t_on = timed(diag, True)
+    return {
+        "step_ms_off": round(t_off * 1e3, 3),
+        "step_ms_on": round(t_on * 1e3, 3),
+        "overhead_pct": round(100.0 * (t_on - t_off) / t_off, 2)
+        if t_off > 0 else None,
+    }
+
+
+class NumericsMonitor:
+    """Cadence config + host-side processing for a network's
+    diagnostic steps. Attach with ``net.monitor_numerics(...)``; the
+    fit loops consult :meth:`due` per iteration (one attribute check
+    plus a modulo when attached, one ``is None`` check otherwise).
+
+    ``due`` fires when the POST-step iteration lands on the cadence
+    (``(iteration + 1) % every == 0``) so a diagnostic record aligns
+    with ``StatsListener``'s ``iteration % frequency == 0`` records,
+    and unconditionally on the step after a non-finite score
+    (:meth:`note_score` escalation — attribution arrives one step
+    after a NaN even at a sparse cadence)."""
+
+    def __init__(self, every: int = 1, histograms: bool = False,
+                 raise_on_nonfinite: bool = True):
+        self.every = max(1, int(every))
+        self.histograms = bool(histograms)
+        self.raise_on_nonfinite = bool(raise_on_nonfinite)
+        self.force = False
+        self._warned_group_split = False
+
+    def due(self, iteration: int) -> bool:
+        return self.force or ((iteration + 1) % self.every == 0)
+
+    def note_score(self, score: float) -> None:
+        """Called by the fit loops after NON-diagnostic steps: a
+        non-finite loss escalates the next step to a diagnostic one."""
+        if not math.isfinite(score):
+            self.force = True
+
+    def note_group_split(self, group_len: int) -> None:
+        """Called when a diagnostic-due iteration forces a scanned
+        ``steps_per_loop`` group to run per-batch — warn ONCE so the
+        trade (per-step diagnostics vs scan amortization) is visible;
+        raise ``every`` above ``steps_per_loop`` to keep most groups
+        scanned."""
+        if self._warned_group_split:
+            return
+        self._warned_group_split = True
+        import logging
+        logging.getLogger("deeplearning4j_tpu").warning(
+            "numerics observatory: diagnostic cadence (every=%d) falls "
+            "inside a steps_per_loop=%d group — such groups run "
+            "per-batch instead of as one scanned executable. Use a "
+            "cadence larger than steps_per_loop (or detach the "
+            "monitor) to keep the device loop.", self.every, group_len)
+
+    def process(self, net, diag, layers: List[str], *,
+                entry: str = "net") -> Dict[str, Any]:
+        """Pull the diag scalars (ONE device→host transfer), publish
+        them (``net.last_numerics``, metric gauges, Perfetto counter
+        tracks), and raise :class:`NonFiniteError` naming the origin
+        layer when the sentinel fired."""
+        import jax
+        import numpy as np
+
+        t0 = _trace.now()
+        host = jax.device_get(diag)
+        with _lock:
+            _counters["diag_dispatches"] += 1
+            _counters["host_pulls"] += 1
+        DIAG_STEPS.inc()
+        it = net.iteration
+
+        def per_layer(key, cast=float):
+            return {l: cast(host[key][i]) for i, l in enumerate(layers)}
+
+        num: Dict[str, Any] = {
+            "iteration": it, "entry": entry,
+            "grad_norm": per_layer("grad_norm"),
+            "grad_absmax": per_layer("grad_absmax"),
+            "grad_nonfinite": per_layer("grad_nonfinite", int),
+            "update_norm": per_layer("update_norm"),
+            "param_norm": per_layer("param_norm"),
+            "act_mean": per_layer("act_mean"),
+            "act_std": per_layer("act_std"),
+            "act_absmax": per_layer("act_absmax"),
+            "act_nonfinite": per_layer("act_nonfinite", int),
+        }
+        num["update_ratio"] = {
+            l: (num["update_norm"][l] / num["param_norm"][l]
+                if math.isfinite(num["param_norm"][l])
+                and math.isfinite(num["update_norm"][l])
+                and num["param_norm"][l] > 0 else 0.0)
+            for l in layers}
+        if "replica_divergence" in host:
+            num["replica_divergence"] = {
+                l: float(host["replica_divergence"][i])
+                for i, l in enumerate(layers)}
+        for key in ("grad_hist", "update_hist"):
+            if key in host:
+                num[key] = {l: np.asarray(host[key][i]).tolist()
+                            for i, l in enumerate(layers)}
+        net.last_numerics = num
+
+        for l in layers:
+            GRAD_NORM.labels(layer=l).set(num["grad_norm"][l])
+            UPDATE_RATIO.labels(layer=l).set(num["update_ratio"][l])
+            ACT_ABSMAX.labels(layer=l).set(num["act_absmax"][l])
+        if "replica_divergence" in num:
+            for l in layers:
+                REPLICA_DIVERGENCE.labels(layer=l).set(
+                    num["replica_divergence"][l])
+        if _trace.enabled():
+            _trace.counter("numerics/grad_norm", num["grad_norm"])
+            _trace.counter("numerics/update_ratio",
+                           num["update_ratio"])
+            if "replica_divergence" in num:
+                _trace.counter("numerics/replica_divergence",
+                               num["replica_divergence"])
+            _trace.add_span("numerics/process", t0, _trace.now(),
+                            args={"iteration": it})
+
+        self.force = False
+        origin = first_nonfinite(num, layers)
+        if origin is not None:
+            layer, kind = origin
+            num["nonfinite"] = {"layer": layer, "kind": kind}
+            NONFINITE.labels(layer=layer, kind=kind).inc()
+            if self.raise_on_nonfinite:
+                raise NonFiniteError(layer=layer, kind=kind,
+                                     iteration=it)
+        return num
+
+
+__all__ = ["NonFiniteError", "NumericsMonitor", "act_summary",
+           "layer_summary", "log2_sketch", "layer_norms_vector",
+           "build_diag", "reduce_act_stats", "tree_norms",
+           "sketch_as_histogram", "first_nonfinite",
+           "diag_dispatches", "host_pulls", "reset_counters",
+           "HIST_BINS", "HIST_LO", "HIST_HI"]

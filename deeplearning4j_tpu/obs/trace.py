@@ -186,6 +186,23 @@ def add_span(name: str, t0: float, t1: float,
     _emit(ev)
 
 
+def counter(name: str, values: Dict[str, Any],
+            t: Optional[float] = None) -> None:
+    """Record one sample on a Perfetto counter track (Chrome ``C``
+    event): ``values`` maps series name → number, so e.g. per-layer
+    gradient norms render as stacked counter series alongside the
+    span timeline. Same off-path contract as :func:`add_span`."""
+    if not _enabled:
+        return
+    ev: Dict[str, Any] = {
+        "ph": "C", "name": name,
+        "ts": round((now() if t is None else t) * 1e6, 3),
+        "pid": os.getpid(), "tid": threading.get_ident(),
+        "args": {k: float(v) for k, v in values.items()},
+    }
+    _emit(ev)
+
+
 def instant(name: str, args: Optional[Dict[str, Any]] = None) -> None:
     """Record a point-in-time marker (Chrome ``i`` event)."""
     if not _enabled:

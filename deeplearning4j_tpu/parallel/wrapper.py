@@ -86,17 +86,22 @@ class ParallelWrapper:
         if hasattr(net.conf, "inputs"):
             ins = net.conf.inputs
 
-            def _graph_loss(p, s, x, y, rng):
+            def _graph_loss(p, s, x, y, rng, stats=None):
                 xd = x if isinstance(x, dict) else (
                     dict(zip(ins, x)) if isinstance(x, (list, tuple))
                     else {ins[0]: x})
                 yl = list(y) if isinstance(y, (list, tuple)) else [y]
-                return net._loss_fn(p, s, xd, yl, {}, {}, rng)
+                return net._loss_fn(p, s, xd, yl, {}, {}, rng,
+                                    act_stats=stats)
 
             self._loss = _graph_loss
         else:
-            self._loss = lambda p, s, x, y, rng: net._loss_fn(
-                p, s, x, y, None, None, rng)
+            self._loss = lambda p, s, x, y, rng, stats=None: \
+                net._loss_fn(p, s, x, y, None, None, rng,
+                             act_stats=stats)
+        self._diag_step = None      # numerics diagnostic step (SYNC)
+        self._diag_step_monitor = None   # monitor it was built for
+        self._diag_unsupported_warned = False
 
     # -- builder parity (reference ParallelWrapper.Builder) -------------
     class Builder:
@@ -162,6 +167,60 @@ class ParallelWrapper:
             in_shardings=(repl, repl, repl, shard, shard, repl),
             out_shardings=(repl, repl, repl, repl),
             donate_argnums=(0, 1, 2))
+
+    def _build_sync_diag_step(self):
+        """Diagnostic variant of the SYNC step (obs/numerics.py,
+        ARCHITECTURE.md §11): an explicit ``shard_map`` computes each
+        replica's local gradients, reduces them with ``pmean`` (the
+        same mean the plain step's XLA-inserted allreduce produces on
+        equal shards), and emits the numerics aux outputs — including
+        per-layer replica divergence, the ``pmax − pmin`` spread of
+        the per-replica gradient norms that the fused global-gradient
+        program cannot see."""
+        from deeplearning4j_tpu.obs import numerics
+        net = self.net
+        mesh = self.mesh
+        optimizer = net._optimizer
+        nm = net._numerics
+        histograms = nm.histograms if nm is not None else False
+        layers = net._layer_names()
+
+        def local_step(params, opt_state, state, x, y, rng):
+            def lf(p):
+                stats = {}
+                loss, new_state = self._loss(p, state, x, y, rng,
+                                             stats)
+                return loss, (new_state, stats)
+
+            (loss, (new_state, act_stats)), grads = jax.value_and_grad(
+                lf, has_aux=True)(params)
+            # per-replica grad-norm spread BEFORE the mean erases it
+            local_norms = numerics.layer_norms_vector(grads, layers)
+            divergence = (jax.lax.pmax(local_norms, "data")
+                          - jax.lax.pmin(local_norms, "data"))
+            grads = jax.tree.map(
+                lambda g: jax.lax.pmean(g, "data"), grads)
+            act_stats = numerics.reduce_act_stats(act_stats, "data")
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
+            params = optax.apply_updates(params, updates)
+            params = net._apply_constraints(params)
+            diag = numerics.build_diag(params, grads, updates,
+                                       act_stats, layers,
+                                       histograms=histograms)
+            diag["replica_divergence"] = divergence
+            loss = jax.lax.pmean(loss, "data")
+            return params, opt_state, new_state, loss, diag
+
+        pspec = P()          # replicated params/state/diag
+        dspec = P("data")    # sharded batch
+        smapped = shard_map(
+            local_step, mesh=mesh,
+            in_specs=(pspec, pspec, pspec, dspec, dspec, pspec),
+            out_specs=(pspec, pspec, pspec, pspec, pspec),
+            check_vma=False)
+        return sentry.jit(smapped, name="ParallelWrapper.sync_diag_step",
+                          donate_argnums=(0, 1, 2))
 
     def _build_encoded_step(self):
         net = self.net
@@ -456,7 +515,29 @@ class ParallelWrapper:
                 rng = jax.random.fold_in(
                     jax.random.PRNGKey(net.conf.seed), net.iteration)
                 t1 = obs.now()
-                if self.mode == self.SYNC:
+                diag = None
+                nm = getattr(net, "_numerics", None)
+                diag_due = nm is not None and nm.due(net.iteration)
+                if diag_due and self.mode != self.SYNC and \
+                        not self._diag_unsupported_warned:
+                    self._diag_unsupported_warned = True
+                    import logging
+                    logging.getLogger("deeplearning4j_tpu").warning(
+                        "numerics observatory: diagnostic steps are "
+                        "implemented for SYNC mode only; %r trains "
+                        "without in-step diagnostics", self.mode)
+                if diag_due and self.mode == self.SYNC:
+                    if self._diag_step is None or \
+                            self._diag_step_monitor is not nm:
+                        # (re)build: the monitor's config (histogram
+                        # sketches on/off) is traced into the program
+                        self._diag_step = self._build_sync_diag_step()
+                        self._diag_step_monitor = nm
+                    (net.params, net.opt_state, net.state, loss,
+                     diag) = self._diag_step(
+                        net.params, net.opt_state, net.state, x, y,
+                        rng)
+                elif self.mode == self.SYNC:
                     net.params, net.opt_state, net.state, loss = \
                         self._step(net.params, net.opt_state, net.state,
                                    x, y, rng)
@@ -483,6 +564,14 @@ class ParallelWrapper:
                 net.score_ = float(loss)
                 obs.record_worker_step(worker, t0, t1, t2, obs.now())
                 net.iteration += 1
+                if diag is not None:
+                    # publishes per-layer gauges incl. the replica-
+                    # divergence family; raises NonFiniteError with
+                    # cross-replica attribution when the sentinel fired
+                    nm.process(net, diag, net._layer_names(),
+                               entry="ParallelWrapper")
+                elif nm is not None:
+                    nm.note_score(net.score_)
                 for l in net.listeners:
                     l.iteration_done(net, net.iteration, net.epoch)
             net.epoch += 1

@@ -126,6 +126,16 @@ def warmup_network(net, specs: Iterable[WarmupSpec]) -> Dict[str, Any]:
             dt = net._train_step_fn.warmup(*args)
             compiled += dt > 0
             seconds += dt
+            if getattr(net, "_numerics", None) is not None:
+                # numerics observatory attached: the cadence-gated
+                # diagnostic step is a second compiled program over
+                # the same signature — warm it too or the first
+                # diagnostic iteration stalls on its compile
+                if net._diag_step_fn is None:
+                    net._diag_step_fn = net._make_diag_step()
+                dt = net._diag_step_fn.warmup(*args)
+                compiled += dt > 0
+                seconds += dt
         if spec.train and spec.steps_per_loop > 0 \
                 and not spec.features_mask and not spec.labels_mask:
             if net._train_loop_fn is None:

@@ -76,6 +76,21 @@ ERRORS = {
     "FloatingPointError": FloatingPointError,
 }
 
+#: error kinds resolved lazily at fire time (importing them here would
+#: couple this module's import to theirs); validated by name like the
+#: ERRORS entries
+_LAZY_ERRORS = {"NonFiniteError"}
+
+
+def _error_class(name: str):
+    if name == "NonFiniteError":
+        # the numerics observatory's structured NaN sentinel — lets a
+        # step-site rule drill the whole attribute-classify-restore
+        # path (classified deterministic by resilience.policy)
+        from deeplearning4j_tpu.obs.numerics import NonFiniteError
+        return NonFiniteError
+    return ERRORS[name]
+
 #: every site threaded into the codebase (the table above) — literal
 #: rule sites are validated against this at parse time so a typo'd
 #: plan fails loudly instead of silently never firing
@@ -107,10 +122,12 @@ class FaultRule:
     def __init__(self, site: str, error: str = "InjectedFault",
                  p: float = 1.0, nth: int = 0, every: int = 0,
                  max_fires: int = 1 << 30, seed: int = 0):
-        if error not in ERRORS and error not in ("sigterm", "exit"):
+        if error not in ERRORS and error not in _LAZY_ERRORS \
+                and error not in ("sigterm", "exit"):
             raise ValueError(
                 f"fault rule {site!r}: unknown error kind {error!r} "
-                f"(one of {sorted(ERRORS)} | sigterm | exit)")
+                f"(one of {sorted(ERRORS) + sorted(_LAZY_ERRORS)} "
+                "| sigterm | exit)")
         self.site = site
         self.error = error
         self.p = float(p)
@@ -242,7 +259,7 @@ def _inject_active(site: str) -> None:
     if fire_rule.error == "sigterm":
         os.kill(os.getpid(), signal.SIGTERM)
         return                      # the preemption handler takes over
-    raise ERRORS[fire_rule.error](
+    raise _error_class(fire_rule.error)(
         f"injected fault at site {site!r} "
         f"(rule {fire_rule.describe()}, fire {fire_rule.fires})")
 

@@ -55,7 +55,15 @@ def classify(exc: BaseException) -> str:
     restore, then re-raise. Message patterns outrank types: a
     RuntimeError carrying "shape mismatch" is deterministic even
     though bare RuntimeErrors (XLA's habitual wrapper for runtime
-    faults) default to transient."""
+    faults) default to transient.
+
+    The numerics observatory's structured ``NonFiniteError``
+    (``obs.numerics`` — a ``FloatingPointError`` carrying
+    ``layer``/``kind``/``iteration``) lands here as deterministic
+    through both its type and its "non-finite" message: one restore
+    MAY clear it (a poisoned batch or corrupted optimizer state rolls
+    back), a second occurrence re-raises with the attribution intact
+    (see :func:`describe` for the log line)."""
     if isinstance(exc, (FloatingPointError, ZeroDivisionError)):
         return DETERMINISTIC
     if _DETERMINISTIC_RE.search(str(exc)):
@@ -65,6 +73,19 @@ def classify(exc: BaseException) -> str:
     if isinstance(exc, RuntimeError):
         return TRANSIENT
     return DETERMINISTIC
+
+
+def describe(exc: BaseException) -> str:
+    """Human log line for a classified failure — surfaces the numerics
+    observatory's structured attribution when present, so the restart
+    log reads "layer gpt.h3.attn gradients overflowed at iter 412"
+    instead of "loss is NaN"."""
+    layer = getattr(exc, "layer", None)
+    if layer is not None:
+        return (f"layer {layer} {getattr(exc, 'kind', None) or 'values'}"
+                f" went non-finite at iteration "
+                f"{getattr(exc, 'iteration', '?')}")
+    return f"{type(exc).__name__}: {exc}"
 
 
 @dataclass

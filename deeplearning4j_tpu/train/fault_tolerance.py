@@ -38,7 +38,8 @@ from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.resilience import checkpoint as rck
 from deeplearning4j_tpu.resilience.policy import (Preempted,
                                                   PreemptionHandler,
-                                                  RetryPolicy, classify)
+                                                  RetryPolicy, classify,
+                                                  describe)
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
@@ -226,7 +227,7 @@ class FaultTolerantTrainer:
             self._tracker.reset_epoch_tracking()
             return
         logger.warning("training failure (%s); restoring %s "
-                       "(restart %d/%d)", e, ckpt,
+                       "(restart %d/%d)", describe(e), ckpt,
                        self.restarts, self.max_restarts)
         t0 = obs.now()
         restored = _restore_net(ckpt, template=self.net)

@@ -85,20 +85,6 @@ class FileStatsStorage(StatsStorage):
 
 # --- listener ---------------------------------------------------------------
 
-def _tree_norms(tree) -> Dict[str, float]:
-    """Per-layer L2 norms, computed on-device, scalars to host."""
-    import jax
-    import jax.numpy as jnp
-
-    out = {}
-    for name, sub in (tree or {}).items():
-        leaves = jax.tree.leaves(sub)
-        if leaves:
-            out[name] = float(jnp.sqrt(sum(jnp.sum(jnp.square(l))
-                                           for l in leaves)))
-    return out
-
-
 def _rss_mb() -> Optional[float]:
     """Host resident set size in MB (reference StatsListener system
     metrics: JVM/offheap memory → host RSS here)."""
@@ -124,18 +110,38 @@ class StatsListener(TrainingListener):
     StatsListener; update:param ratios are the reference's headline
     training-health diagnostic).
 
-    Collected per record: score, per-layer param/update norms and
-    update:param ratios, optional per-layer parameter AND update
+    Collected per record: score, per-layer param/gradient/update norms
+    and update:param ratios, optional per-layer parameter AND update
     histograms, optional activation histograms (extra forward on a
-    held sample batch — the reference collects them from the training
-    pass), and system metrics (host RSS, wall step time, ETL wait read
-    off an ``AsyncDataSetIterator`` when one is provided).
+    held sample batch — the in-step activation stats from the numerics
+    observatory cover the training pass itself), and system metrics
+    (host RSS, wall step time, ETL wait read off an
+    ``AsyncDataSetIterator`` when one is provided).
+
+    Per-layer training health comes from the numerics observatory
+    (``obs/numerics.py``): the listener attaches a cadence-aligned
+    monitor to the net on first sight (``use_numerics``), and every
+    record reads the per-layer scalars the diagnostic step already
+    produced ON DEVICE — no previous-params tree copy, no per-layer
+    host reduction loop (both of which this listener used to do, at
+    the cost of pinning a second full param set between records).
+    Only per-layer scalars live between records.
+
+    ``use_numerics=False`` (or a net without ``monitor_numerics``)
+    records score/param-norms/system metrics only — update:param
+    ratios, grad norms and update histograms REQUIRE the in-step
+    observatory; the host-side previous-params diff that used to
+    approximate them is deliberately gone (lint rule 3). Note the
+    cadence trade: a monitor at ``every <= steps_per_loop`` makes
+    diag-due groups run per-batch instead of as one scanned
+    executable (warned once at runtime).
     """
 
     def __init__(self, storage: StatsStorage, frequency: int = 1,
                  session_id: Optional[str] = None,
                  collect_histograms: bool = False,
-                 activation_sample=None, iterator=None):
+                 activation_sample=None, iterator=None,
+                 use_numerics: bool = True):
         self.storage = storage
         self.frequency = max(1, frequency)
         self.session_id = session_id or (
@@ -144,15 +150,26 @@ class StatsListener(TrainingListener):
         self.collect_histograms = collect_histograms
         self.activation_sample = activation_sample
         self.iterator = iterator
-        self._prev_params: Optional[Dict[str, Any]] = None
+        self.use_numerics = use_numerics
         self._t0 = obs.now()    # the obs clock is the one step clock
         self._last_rec: Optional[tuple] = None   # (time, iteration)
         self._last_etl = 0.0
         self._prev_compile: Optional[tuple] = None
 
     def iteration_done(self, net, iteration, epoch):
+        if self.use_numerics and getattr(net, "_numerics", None) \
+                is None and hasattr(net, "monitor_numerics"):
+            # first sight of the net: attach a record-aligned monitor
+            # (diag iterations land exactly on this listener's
+            # recording iterations). raise_on_nonfinite stays off —
+            # the listener's job is to RECORD divergence, not to turn
+            # every monitored run into a raising one (attach an
+            # explicit monitor_numerics() for the resilience path).
+            net.monitor_numerics(every=self.frequency,
+                                 histograms=self.collect_histograms,
+                                 raise_on_nonfinite=False)
         if iteration % self.frequency:
-            return          # keep _prev_params from the last recorded iter
+            return
         now = obs.now()
         # per-iteration averages over the recording interval, so step
         # time and ETL wait stay comparable at any frequency
@@ -163,13 +180,22 @@ class StatsListener(TrainingListener):
             iters = max(1, iteration - it_prev)
             step_ms = (now - t_prev) * 1e3 / iters
         self._last_rec = (now, iteration)
+        # in-step numerics from the diagnostic step that produced THIS
+        # iteration (stale records from an off-cadence monitor are
+        # never misattributed)
+        num = getattr(net, "last_numerics", None)
+        if num is not None and num.get("iteration") != iteration:
+            num = None
         rec: Dict[str, Any] = {
             "iteration": iteration,
             "epoch": epoch,
             "time": now - self._t0,
             "score": float(net.score_)
             if np.isfinite(net.score_) else None,
-            "param_norms": _tree_norms(net.params),
+            # fallback (first record / numerics off): ONE jitted fused
+            # reduction in obs/numerics.py, scalars to host
+            "param_norms": (dict(num["param_norm"]) if num
+                            else obs.numerics.tree_norms(net.params)),
         }
         sys_rec: Dict[str, Any] = {"mem_rss_mb": _rss_mb(),
                                    "step_time_ms": step_ms}
@@ -183,36 +209,39 @@ class StatsListener(TrainingListener):
         # per-entry step means, stale workers) — obs.report() scalars,
         # never the full metric family dump
         rec["obs"] = obs.summary()
-        if self._prev_params is not None:
-            import jax
-            import jax.numpy as jnp
-            ratios = {}
-            updates = {}
-            for name, sub in net.params.items():
-                prev = self._prev_params.get(name)
-                if prev is None:
-                    continue
-                upd = jax.tree.map(lambda a, b: a - b, sub, prev)
-                updates[name] = upd
-                un = float(jnp.sqrt(sum(jnp.sum(jnp.square(l))
-                                        for l in jax.tree.leaves(upd))))
-                pn = rec["param_norms"].get(name, 0.0)
-                ratios[name] = un / pn if pn > 0 else 0.0
-            rec["update_ratios"] = ratios
-            if self.collect_histograms:
+        if num is not None:
+            rec["update_ratios"] = dict(num["update_ratio"])
+            rec["grad_norms"] = dict(num["grad_norm"])
+            rec["update_norms"] = dict(num["update_norm"])
+            rec["activation_stats"] = {
+                l: {"mean": num["act_mean"][l],
+                    "std": num["act_std"][l],
+                    "absmax": num["act_absmax"][l]}
+                for l in num["act_mean"]}
+            if "replica_divergence" in num:
+                rec["replica_divergence"] = dict(
+                    num["replica_divergence"])
+            if "nonfinite" in num:
+                rec["nonfinite"] = dict(num["nonfinite"])
+            if self.collect_histograms and "update_hist" in num:
                 rec["update_histograms"] = {
-                    name: self._hist(sub)
-                    for name, sub in updates.items()}
+                    l: obs.numerics.sketch_as_histogram(c)
+                    for l, c in num["update_hist"].items()}
+            if self.collect_histograms and "grad_hist" in num:
+                rec["grad_histograms"] = {
+                    l: obs.numerics.sketch_as_histogram(c)
+                    for l, c in num["grad_hist"].items()}
         if self.collect_histograms:
             rec["histograms"] = {
                 name: self._hist(sub) for name, sub in net.params.items()}
         if self.activation_sample is not None:
-            rec["activation_histograms"] = self._activation_hists(net)
-        # keep a COPY — the net's next jitted step donates (deletes) the
-        # current param buffers
-        import jax
-        import jax.numpy as jnp
-        self._prev_params = jax.tree.map(jnp.array, net.params)
+            # full-distribution histograms on a HELD sample are a
+            # separate opt-in (the training pass's activation stats
+            # arrive in-step above); the extra forward runs under its
+            # own span so it can never masquerade as step device time
+            with obs.span("numerics.activations"):
+                rec["activation_histograms"] = \
+                    self._activation_hists(net)
         self.storage.put_record(self.session_id, rec)
 
     def _compile_rec(self) -> Optional[Dict[str, Any]]:
@@ -343,6 +372,22 @@ async function tick() {
     pts: recs.map(r => [r.iteration,
       r.update_ratios && r.update_ratios[l] > 0 ?
       Math.log10(r.update_ratios[l]) : null])})));
+  const glayers = Object.keys(recs[recs.length-1].grad_norms || {});
+  line(document.getElementById('gradnorm'), glayers.map(l => ({
+    name: l,
+    pts: recs.map(r => [r.iteration,
+      r.grad_norms && r.grad_norms[l] > 0 ?
+      Math.log10(r.grad_norms[l]) : null])})));
+  const dlayers = Object.keys(
+    recs[recs.length-1].replica_divergence || {});
+  line(document.getElementById('divergence'), dlayers.map(l => ({
+    name: l,
+    pts: recs.map(r => [r.iteration,
+      r.replica_divergence ? r.replica_divergence[l] : null])})));
+  const nf = recs.map(r => r.nonfinite).filter(Boolean);
+  document.getElementById('nf').textContent = nf.length ?
+    ('NON-FINITE: layer ' + nf[nf.length-1].layer + ' (' +
+     nf[nf.length-1].kind + ')') : '';
   line(document.getElementById('steptime'),
        [{name:'step ms', pts: recs.map(r =>
           [r.iteration, r.sys ? r.sys.step_time_ms : null])},
@@ -376,6 +421,11 @@ _DASH_HTML = """<html><head><title>deeplearning4j_tpu training UI</title>
 <svg id="score" viewBox="0 0 640 180" width="640" height="180"></svg>
 <h2>update:param ratio per layer (log10)</h2>
 <svg id="ratios" viewBox="0 0 640 180" width="640" height="180"></svg>
+<p id="nf" style="color:#dc2626;font-weight:bold;"></p>
+<h2>gradient norm per layer (log10)</h2>
+<svg id="gradnorm" viewBox="0 0 640 180" width="640" height="180"></svg>
+<h2>replica divergence (max−min grad norm)</h2>
+<svg id="divergence" viewBox="0 0 640 180" width="640" height="180"></svg>
 <h2>step time / ETL wait (ms)</h2>
 <svg id="steptime" viewBox="0 0 640 180" width="640" height="180"></svg>
 <h2>parameter histograms (latest)</h2><div id="phist"></div>
@@ -387,9 +437,11 @@ _DASH_HTML = """<html><head><title>deeplearning4j_tpu training UI</title>
 class UIServer:
     """Training dashboard (reference UIServer/VertxUIServer): live
     2-second polling of ``/json``, client-rendered score chart,
-    per-layer update:param ratio chart, step-time/ETL chart, and
-    parameter/update/activation histograms, plus host system metrics.
-    Stdlib-only server, dependency-free inline JS.
+    per-layer update:param ratio, gradient-norm and replica-divergence
+    charts (numerics observatory), a non-finite alarm line,
+    step-time/ETL chart, and parameter/update/activation histograms,
+    plus host system metrics. Stdlib-only server, dependency-free
+    inline JS.
     """
 
     _instance = None
@@ -445,6 +497,7 @@ class UIServer:
                     # final record — strip them elsewhere so the poll
                     # payload stays O(scalars), not O(layers·bins)
                     bulky = ("histograms", "update_histograms",
+                             "grad_histograms",
                              "activation_histograms")
                     recs = [
                         {k: v for k, v in r.items() if k not in bulky}

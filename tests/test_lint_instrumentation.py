@@ -32,3 +32,27 @@ def test_lint_catches_uninstrumented_hot_path(tmp_path):
     assert any("hot.py" in p and "sentry.jit" in p for p in problems)
     assert any("clock.py" in p and "time.time()" in p
                for p in problems)
+
+
+def test_lint_catches_listener_side_device_reductions(tmp_path):
+    """Rule 3: jnp / jax.tree.map reductions in listener/stats paths
+    (the old StatsListener._prev_params pattern) are flagged; the
+    numpy-over-leaves host histogram opt-in stays legal."""
+    stats_dir = tmp_path / "train"
+    stats_dir.mkdir()
+    (stats_dir / "stats.py").write_text(
+        "import jax\nimport jax.numpy as jnp\n"
+        "def norms(params, prev):\n"
+        "    upd = jax.tree.map(lambda a, b: a - b, params, prev)\n"
+        "    return jnp.sqrt(sum(jnp.sum(jnp.square(l))\n"
+        "                        for l in jax.tree.leaves(upd)))\n")
+    (stats_dir / "listeners.py").write_text(
+        "import jax\nimport numpy as np\n"
+        "def hist(sub):\n"
+        "    return np.concatenate([np.asarray(l).ravel()\n"
+        "                           for l in jax.tree.leaves(sub)])\n")
+    problems = lint_instrumentation.run(tmp_path)
+    assert any("train/stats.py" in p and "jax.tree.map" in p
+               for p in problems)
+    assert any("train/stats.py" in p and "jnp." in p for p in problems)
+    assert not any("train/listeners.py" in p for p in problems)

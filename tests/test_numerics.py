@@ -1,0 +1,352 @@
+"""Numerics observatory (obs/numerics.py, ARCHITECTURE.md §11):
+off-path zero-cost fence, in-step per-layer health, NaN attribution,
+replica divergence, and the resilience restore path end-to-end."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                   NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.config import InputType
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn import updaters as upd
+from deeplearning4j_tpu.obs import numerics
+from deeplearning4j_tpu.obs.numerics import NonFiniteError
+from deeplearning4j_tpu.resilience import faults
+
+N_IN, HIDDEN, CLASSES = 6, 10, 3
+
+
+def _mk_net(seed=7):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(upd.Adam(learning_rate=1e-2)).list()
+            .layer(DenseLayer(n_out=HIDDEN, activation="relu"))
+            .layer(DenseLayer(n_out=HIDDEN, activation="relu"))
+            .layer(OutputLayer(n_out=CLASSES, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(N_IN)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(rng, n=32):
+    x = rng.normal(size=(n, N_IN)).astype(np.float32)
+    y = np.eye(CLASSES, dtype=np.float32)[
+        rng.integers(0, CLASSES, n)]
+    return x, y
+
+
+def _poison(net, layer="layer_1"):
+    net.params[layer]["W"] = np.asarray(
+        net.params[layer]["W"]) * 0 + np.inf
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    numerics.reset_counters()
+    yield
+    numerics.reset_counters()
+    faults.reset()
+
+
+# --- off-path fence ---------------------------------------------------------
+
+def test_off_path_is_byte_identical_and_transfer_free(rng):
+    """Acceptance fence: with no monitor (and with one whose cadence
+    never fires) the default compiled step's outputs are byte-identical
+    and the numerics counters prove zero diag dispatches and zero
+    diag device→host transfers."""
+    import jax
+    x, y = _data(rng)
+    a, b = _mk_net(), _mk_net()
+    b.monitor_numerics(every=10 ** 9)   # attached, never due
+    for _ in range(3):
+        a.fit(x, y)
+        b.fit(x, y)
+    jax.tree.map(
+        lambda u, v: np.testing.assert_array_equal(
+            np.asarray(u), np.asarray(v)), a.params, b.params)
+    assert numerics.diag_dispatches() == 0
+    assert numerics.host_pulls() == 0
+    assert b._diag_step_fn is None      # diag program never even built
+
+
+# --- in-step health ---------------------------------------------------------
+
+def test_diag_step_reports_per_layer_health(rng):
+    x, y = _data(rng)
+    net = _mk_net()
+    net.monitor_numerics(every=1, histograms=True)
+    for _ in range(2):
+        net.fit(x, y)
+    num = net.last_numerics
+    assert num["iteration"] == net.iteration == 2
+    layers = {"layer_0", "layer_1", "layer_2"}
+    for key in ("grad_norm", "update_norm", "param_norm",
+                "update_ratio", "act_absmax"):
+        assert set(num[key]) == layers, key
+        assert all(v > 0 for v in num[key].values()), key
+    assert all(v == 0 for v in num["grad_nonfinite"].values())
+    # log2 sketches: fixed bins, populated for real updates
+    assert len(num["update_hist"]["layer_0"]) == numerics.HIST_BINS
+    assert sum(num["update_hist"]["layer_0"]) > 0
+    assert numerics.diag_dispatches() == 2
+    assert numerics.host_pulls() == 2   # ONE pull per diag step
+
+
+def test_diag_step_update_matches_plain_step(rng):
+    """The diagnostic step is the same update plus aux outputs — the
+    trained params must match the plain step's."""
+    x, y = _data(rng)
+    a, b = _mk_net(), _mk_net()
+    b.monitor_numerics(every=1)
+    for _ in range(3):
+        a.fit(x, y)
+        b.fit(x, y)
+    import jax
+    jax.tree.map(
+        lambda u, v: np.testing.assert_allclose(
+            np.asarray(u), np.asarray(v), rtol=1e-6, atol=1e-7),
+        a.params, b.params)
+
+
+def test_metrics_families_and_trace_counter_tracks(rng):
+    from deeplearning4j_tpu.obs import metrics, trace
+    x, y = _data(rng)
+    net = _mk_net()
+    net.monitor_numerics(every=1)
+    trace.enable()                      # ring-only
+    try:
+        net.fit(x, y)
+        evs = trace.events()
+    finally:
+        trace.reset()
+    counters = [e for e in evs if e.get("ph") == "C"]
+    assert any(e["name"] == "numerics/grad_norm" and
+               "layer_0" in e["args"] for e in counters)
+    assert any(e["name"] == "numerics/update_ratio"
+               for e in counters)
+    text = metrics.exposition()
+    fams = metrics.parse_exposition(text)   # must stay well-formed
+    assert ("dl4j_tpu_numerics_grad_norm",
+            (("layer", "layer_0"),)) in fams
+    assert ("dl4j_tpu_numerics_update_ratio",
+            (("layer", "layer_2"),)) in fams
+
+
+# --- NaN attribution --------------------------------------------------------
+
+def test_nan_attribution_names_poisoned_layer(rng):
+    x, y = _data(rng)
+    net = _mk_net()
+    net.monitor_numerics(every=1)
+    net.fit(x, y)
+    _poison(net, "layer_1")
+    with pytest.raises(NonFiniteError) as ei:
+        net.fit(x, y)
+    e = ei.value
+    assert e.layer == "layer_1"         # forward origin, not layer_2
+    assert e.kind == "activations"
+    assert e.iteration == 2
+    assert "non-finite" in str(e)
+    num = net.last_numerics
+    assert num["nonfinite"] == {"layer": "layer_1",
+                                "kind": "activations"}
+    # downstream of the origin is poisoned too — attribution picked
+    # the FIRST forward-order layer, which is the point
+    assert num["act_nonfinite"]["layer_2"] > 0
+    assert num["act_nonfinite"]["layer_0"] == 0
+
+
+def test_nonfinite_score_escalates_sparse_cadence(rng):
+    """At a sparse cadence a NaN between diagnostic steps still gets
+    attributed: the non-finite score forces the NEXT step to run as a
+    diagnostic one."""
+    x, y = _data(rng)
+    net = _mk_net()
+    net.monitor_numerics(every=1000)    # effectively never due
+    net.fit(x, y)
+    _poison(net, "layer_0")
+    # plain step: loss goes non-finite, note_score arms escalation
+    net.fit(x, y)
+    assert net._numerics.force
+    with pytest.raises(NonFiniteError) as ei:
+        net.fit(x, y)
+    assert ei.value.layer == "layer_0"
+    assert numerics.diag_dispatches() == 1   # only the escalated step
+
+
+def test_graph_diag_and_attribution(rng):
+    from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder
+    g = (GraphBuilder()
+         .add_inputs("in")
+         .add_layer("h1", DenseLayer(n_out=HIDDEN, activation="relu"),
+                    "in")
+         .add_layer("h2", DenseLayer(n_out=HIDDEN, activation="relu"),
+                    "h1")
+         .add_layer("out", OutputLayer(n_out=CLASSES,
+                                       activation="softmax",
+                                       loss="mcxent"), "h2")
+         .set_outputs("out")
+         .set_input_types(**{"in": InputType.feed_forward(N_IN)}))
+    net = ComputationGraph(g.build()).init()
+    net.monitor_numerics(every=1)
+    x, y = _data(rng)
+    net.fit(x, y)
+    num = net.last_numerics
+    assert set(num["grad_norm"]) == {"h1", "h2", "out"}
+    assert all(v > 0 for v in num["grad_norm"].values())
+    net.params["h2"]["W"] = np.asarray(
+        net.params["h2"]["W"]) * 0 + np.inf
+    with pytest.raises(NonFiniteError) as ei:
+        net.fit(x, y)
+    assert ei.value.layer == "h2" and ei.value.kind == "activations"
+
+
+# --- ParallelWrapper SPMD path ----------------------------------------------
+
+def test_wrapper_sync_diag_reports_replica_divergence(rng):
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+    x, y = _data(rng, n=32)
+    net = _mk_net(seed=3)
+    net.monitor_numerics(every=1)
+    pw = ParallelWrapper(net, workers=2, mode=ParallelWrapper.SYNC)
+    it = ListDataSetIterator(DataSet(x, y), batch_size=16)
+    pw.fit(it, epochs=1)
+    num = net.last_numerics
+    assert num["entry"] == "ParallelWrapper"
+    assert set(num["replica_divergence"]) == {"layer_0", "layer_1",
+                                              "layer_2"}
+    # the two replicas saw different shards — their local grad norms
+    # must differ (the signal the fused global-grad step cannot see)
+    assert max(num["replica_divergence"].values()) > 0
+    assert all(v >= 0 for v in num["replica_divergence"].values())
+    assert all(v > 0 for v in num["grad_norm"].values())
+
+
+# --- resilience restore path ------------------------------------------------
+
+class _Poisoner:
+    """Listener that poisons one layer's params at a given iteration
+    (persistently: also after a restore rewinds past it)."""
+
+    def __init__(self, at_iteration, layer="layer_1", once=False):
+        self.at = at_iteration
+        self.layer = layer
+        self.once = once
+        self.fired = 0
+
+    def iteration_done(self, net, iteration, epoch):
+        if iteration >= self.at and not (self.once and self.fired):
+            self.fired += 1
+            _poison(net, self.layer)
+
+    def on_epoch_start(self, net):
+        pass
+
+    def on_epoch_end(self, net):
+        pass
+
+
+def test_trainer_restores_once_then_continues_after_poison(rng,
+                                                           tmp_path):
+    """One-shot poison: NonFiniteError attributes the layer, the
+    trainer restores the newest valid checkpoint (PR 3 deterministic
+    semantics) and training completes."""
+    from deeplearning4j_tpu.train import FaultTolerantTrainer
+    x, y = _data(rng, n=48)
+    net = _mk_net(seed=11)
+    net.monitor_numerics(every=1)
+    net.listeners.append(_Poisoner(at_iteration=5, once=True))
+    trainer = FaultTolerantTrainer(net, tmp_path,
+                                   save_every_n_iterations=2)
+    it = ListDataSetIterator(DataSet(x, y), batch_size=16)
+    trainer.fit(it, epochs=4)
+    assert trainer.restarts == 1
+    assert np.isfinite(net.score_)
+    assert net.epoch == 4               # full run completed
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in __import__("jax").tree.leaves(net.params))
+
+
+def test_trainer_reraises_on_second_nonfinite(rng, tmp_path):
+    """Persistent poison: ONE restore, then the NonFiniteError
+    re-raises loudly with the attribution intact."""
+    from deeplearning4j_tpu.train import FaultTolerantTrainer
+    x, y = _data(rng, n=48)
+    net = _mk_net(seed=11)
+    net.monitor_numerics(every=1)
+    net.listeners.append(_Poisoner(at_iteration=5))
+    trainer = FaultTolerantTrainer(net, tmp_path,
+                                   save_every_n_iterations=2)
+    it = ListDataSetIterator(DataSet(x, y), batch_size=16)
+    with pytest.raises(NonFiniteError) as ei:
+        trainer.fit(it, epochs=4)
+    assert ei.value.layer == "layer_1"
+    assert trainer.restarts == 2        # restore, recur, re-raise
+
+
+def test_fault_plan_injects_nonfinite_and_trainer_recovers(
+        rng, tmp_path, monkeypatch):
+    """DL4J_TPU_FAULT_PLAN step-site rule firing the structured
+    sentinel: classified deterministic, one restore, run completes."""
+    from deeplearning4j_tpu.train import FaultTolerantTrainer
+    monkeypatch.setenv("DL4J_TPU_FAULT_PLAN",
+                       "step:error=NonFiniteError:nth=4:max=1")
+    faults.configure_from_env()
+    try:
+        x, y = _data(rng, n=48)
+        net = _mk_net(seed=2)
+        trainer = FaultTolerantTrainer(net, tmp_path,
+                                       save_every_n_iterations=2)
+        it = ListDataSetIterator(DataSet(x, y), batch_size=16)
+        trainer.fit(it, epochs=3)
+        assert trainer.restarts == 1
+        assert net.epoch == 3
+        st = faults.stats()
+        assert sum(s["fires"] for s in st.values()) == 1
+    finally:
+        faults.reset()
+
+
+# --- warmup + listener integration ------------------------------------------
+
+def test_warmup_covers_diag_step(rng):
+    from deeplearning4j_tpu.perf import sentry
+    from deeplearning4j_tpu.perf.warmup import WarmupSpec
+    x, y = _data(rng, n=8)
+    net = _mk_net()
+    net.monitor_numerics(every=1)
+    rep = net.warmup([WarmupSpec(features=(8, N_IN),
+                                 labels=(8, CLASSES))])
+    assert rep["compiled"] >= 3         # train + DIAG + output
+    before = sentry.total_traces()
+    net.fit(x, y)                       # first step IS a diag step
+    assert numerics.diag_dispatches() == 1
+    assert sentry.total_traces() == before   # zero new traces
+
+
+def test_stats_listener_consumes_in_step_numerics(rng):
+    from deeplearning4j_tpu.train import InMemoryStatsStorage, StatsListener
+    x, y = _data(rng, n=64)
+    storage = InMemoryStatsStorage()
+    net = _mk_net()
+    listener = StatsListener(storage, frequency=1, session_id="nx",
+                             collect_histograms=True)
+    net.set_listeners(listener)
+    net.fit(ListDataSetIterator(DataSet(x, y), batch_size=32),
+            epochs=2)
+    # the listener attached a record-aligned, non-raising monitor
+    assert net._numerics is not None
+    assert net._numerics.every == 1
+    assert not net._numerics.raise_on_nonfinite
+    recs = storage.get_records("nx")
+    assert all("param_norms" in r for r in recs)
+    last = recs[-1]
+    for key in ("grad_norms", "update_norms", "update_ratios",
+                "activation_stats"):
+        assert set(last[key]) == set(net.params), key
+    assert all(v > 0 for v in last["grad_norms"].values())
+    h = last["update_histograms"]["layer_0"]
+    assert sum(h["counts"]) > 0 and h["min"] < h["max"]
+    # the old host-side previous-params copy is gone for good
+    assert not hasattr(listener, "_prev_params")

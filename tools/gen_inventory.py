@@ -128,6 +128,64 @@ after touching checkpoint, trainer, or serving code.
 """
 
 
+# hand-maintained operations doc, re-emitted on every regeneration
+# (ISSUE 4 satellite: the divergence-diagnosis runbook lives in
+# docs/OPS.md next to the telemetry workflow)
+NUMERICS_OPS_SECTION = """
+## Diagnosing divergence (obs/numerics.py)
+
+Operating a run through numeric trouble (ARCHITECTURE.md §11):
+
+**Turn the observatory on.** `net.monitor_numerics(every=N)` makes
+every N-th step a *diagnostic step*: the same XLA program returns
+per-layer gradient/update/param norms, activation stats from the real
+training forward, and non-finite counts as aux outputs — only scalars
+cross to host, only at cadence. A `StatsListener` attaches a
+record-aligned monitor automatically, so the training dashboard's
+grad-norm / update:param-ratio / replica-divergence panels fill in
+with zero extra configuration.
+
+**Read the panels.** Healthy runs show update:param ratios drifting
+around 1e-3 (the reference StatsListener's rule of thumb) and
+per-layer grad norms moving together. A layer whose ratio runs orders
+of magnitude hotter than its peers is mis-scaled (LR override,
+init); a grad norm collapsing to 0 is a dead layer (check the
+`dl4j_tpu_numerics_grad_norm` family); absmax activations marching
+toward 3e38 forecast an overflow before it happens.
+
+**NaN attribution.** When gradients or activations go non-finite, the
+sentinel raises `NonFiniteError{layer, kind, iteration}` — forward
+origin for activations (first layer in forward order), backward
+origin for gradients. Under `FaultTolerantTrainer` this classifies
+deterministic: ONE restore from the newest valid checkpoint, then
+re-raise if it recurs — the log reads "layer gpt.h3.attn gradients
+went non-finite at iteration 412 ... restoring iter_400". A
+non-finite *score* at a sparse cadence escalates the next step to a
+diagnostic one, so attribution is at most one step late.
+
+**Replica divergence.** On the `ParallelWrapper` SYNC path, the
+diagnostic step is an explicit `shard_map`: per-replica gradient
+norms are `pmax − pmin` reduced before the mean erases them, and the
+spread surfaces as `dl4j_tpu_numerics_replica_divergence{layer=}`. A
+growing spread with healthy per-replica losses is the signature of a
+sick chip (or a desynced data shard) — restart that worker before
+the allreduce averages the damage into every replica.
+
+**Watch remotely.** `tools/tpu_watch.py --metrics-url ...` renders a
+`numerics` view per sample: top-k update:param outliers, a
+total-grad-norm sparkline, worst replica divergence, and a
+NONFINITE_ALARM line from the `dl4j_tpu_numerics_nonfinite_total`
+counters. With `DL4J_TPU_TRACE` on, per-layer norms also stream as
+Perfetto counter tracks (`numerics/grad_norm`) next to the step
+spans.
+
+**Drill it.** `DL4J_TPU_FAULT_PLAN="step:error=NonFiniteError:nth=6"`
+injects the structured sentinel at the step site — the standing way
+to verify the attribute-classify-restore path end-to-end without
+poisoning real params.
+"""
+
+
 def main():
     import warnings
     warnings.filterwarnings("ignore")
@@ -277,7 +335,8 @@ def main():
             entry += f" — {doc}"
         op_lines.append(entry)
     op_lines += ["", TELEMETRY_OPS_SECTION.strip(),
-                 "", RESILIENCE_OPS_SECTION.strip()]
+                 "", RESILIENCE_OPS_SECTION.strip(),
+                 "", NUMERICS_OPS_SECTION.strip()]
     ops_out = os.path.join(os.path.dirname(out), "OPS.md")
     with open(ops_out, "w") as f:
         f.write("\n".join(op_lines) + "\n")

@@ -1,7 +1,7 @@
 """Instrumentation lint — the telemetry spine's CI fence (tier-1 via
 ``tests/test_lint_instrumentation.py``).
 
-Two AST rules over ``deeplearning4j_tpu/``:
+Three AST rules over ``deeplearning4j_tpu/``:
 
 1. **Every ``sentry.jit``-wrapped hot path emits obs telemetry.** A
    module that builds jitted entry points with ``sentry.jit(...)`` is
@@ -18,6 +18,19 @@ Two AST rules over ``deeplearning4j_tpu/``:
    layer replaced (non-monotonic under NTP slew, incomparable bases).
    Allowlisted: modules using wall time for *calendar* purposes
    (termination deadlines, record timestamps), never step timing.
+
+3. **No host-side device reductions over params/grads in
+   listener/stats paths.** Listener code (``train/stats.py``,
+   ``train/listeners.py``) runs per recording interval on the host;
+   building ``jnp``/``jax.tree.map`` reductions there re-dispatches
+   a device program per layer per record AND pins full param trees
+   between records (the old ``StatsListener._prev_params`` copy this
+   rule fences out). Per-layer training health is computed IN-STEP
+   by the numerics observatory — ``obs/numerics.py`` is the
+   allowlisted home for these reductions (it lives outside the
+   scanned listener set by construction); listeners consume its
+   scalars. ``jax.tree.leaves`` + numpy stays legal (the explicit
+   opt-in host histograms).
 
 Exit status 0 = clean; 1 = violations (printed one per line).
 """
@@ -41,7 +54,13 @@ TIME_TIME_ALLOWLIST = {
 }
 
 _OBS_EMITTERS = {"record_step", "record_etl", "record_worker_step",
-                 "span", "add_span", "instant", "observe_step"}
+                 "span", "add_span", "instant", "counter",
+                 "observe_step"}
+
+# listener/stats paths scanned by rule 3 — per-record host code where
+# device reductions over params/grads are banned (obs/numerics.py is
+# the sanctioned in-step home, outside this set by construction)
+LISTENER_STATS_PATHS = {"train/stats.py", "train/listeners.py"}
 
 
 def _calls(tree: ast.AST):
@@ -92,6 +111,19 @@ def lint_file(path: Path, rel: str) -> List[str]:
                     "use obs.now (the one step clock) or, for "
                     "calendar timestamps, datetime + an allowlist "
                     "entry here")
+
+    if rel in LISTENER_STATS_PATHS:
+        for c in _calls(tree):
+            ch = _attr_chain(c.func)
+            if ch.startswith("jnp.") or ch.startswith("jax.numpy.") \
+                    or ch in ("jax.tree.map", "jax.tree_map"):
+                problems.append(
+                    f"{rel}:{c.lineno}: host-side device reduction "
+                    f"({ch}) in a listener/stats path — per-layer "
+                    "training health is computed in-step by the "
+                    "numerics observatory (obs/numerics.py, the "
+                    "allowlisted home); consume net.last_numerics / "
+                    "obs.numerics.tree_norms scalars instead")
     return problems
 
 

@@ -577,6 +577,32 @@ def flashbwd():
     return (label, 1.0 / dtf, "steps/s", dtf, flops)
 
 
+def _numerics_section():
+    """Diagnostics-on vs -off step time on the LeNet smoke model: the
+    cadence-gated diagnostic step (per-layer grad/update/activation
+    stats as aux outputs of the same XLA program, obs/numerics.py)
+    must stay within a few percent of the plain step. Shares the
+    timing harness with bench.py's ``numerics`` section."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.obs import numerics
+    from deeplearning4j_tpu.zoo import LeNet
+
+    b = 8 if SMOKE else 256
+    net = LeNet(num_classes=10, seed=0).init()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((b, 28, 28, 1)), jnp.float32)
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[
+        rng.integers(0, 10, b)])
+    feed = ({net.conf.inputs[0]: x}, [y], {}, {}) \
+        if hasattr(net.conf, "inputs") else (x, y, None, None)
+    return {"model": f"LeNet b{b}@28x28",
+            **numerics.measure_diag_overhead(
+                net, net.params, net.opt_state, net.state, feed,
+                jax.random.fold_in(jax.random.PRNGKey(0), 0))}
+
+
 def main(names):
     global SMOKE
     if "--smoke" in names:
@@ -655,6 +681,15 @@ def main(names):
                     **obs.overhead_report(
                         step_seconds=steps[len(steps) // 2]),
                     "summary": obs.summary(), "smoke": SMOKE})
+    # numerics observatory (obs/numerics.py): diagnostics-on vs -off
+    # step time on the smoke model (acceptance: <= 5% overhead with
+    # scalars-only host traffic at cadence)
+    try:
+        payload.append({"config": "numerics_observatory",
+                        **_numerics_section(), "smoke": SMOKE})
+    except Exception as e:
+        print(f"numerics_observatory: FAILED {type(e).__name__}: {e}")
+        failed.append("numerics_observatory")
     if out_path:
         Path(out_path).write_text(json.dumps(payload, indent=1))
     if SMOKE:

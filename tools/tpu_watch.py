@@ -5,7 +5,11 @@ optionally ``--healthz-url``, ``--trace-jsonl PATH``) to also scrape a
 live run's telemetry endpoint each interval — step counts/latency
 sums, retrace/compile counters, stale workers, and the top span names
 from the Chrome-trace JSONL — appending one structured line per
-sample to the same retry log. This replaces the old private-format
+sample to the same retry log. When the run publishes numerics
+observatory families (``dl4j_tpu_numerics_*``, PR 4) each sample also
+emits a ``numerics`` view: top-k update:param ratio outliers, a
+total-grad-norm sparkline across samples, worst replica divergence,
+and a NaN alarm from the nonfinite counters. This replaces the old private-format
 approach: the watcher reads the SAME ``/metrics`` exposition and trace
 JSONL every other consumer uses (``docs/OPS.md`` "Telemetry
 operations").
@@ -82,7 +86,60 @@ _METRIC_KEYS = ("dl4j_tpu_step_latency_seconds_count",
                 "dl4j_tpu_fit_etl_seconds_total",
                 "dl4j_tpu_retrace_", "dl4j_tpu_compile_",
                 "dl4j_tpu_worker_stale",
-                "dl4j_tpu_inference_requests_total")
+                "dl4j_tpu_inference_requests_total",
+                "dl4j_tpu_numerics_")
+
+# numerics view state: total-grad-norm history across samples feeds the
+# sparkline (bounded — one char per retained sample)
+_GRAD_HISTORY: list = []
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values, width=32) -> str:
+    vals = values[-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(_SPARK[int((v - lo) / span * (len(_SPARK) - 1))]
+                   for v in vals)
+
+
+def _numerics_view(fams) -> dict:
+    """Render the numerics observatory families from one /metrics
+    scrape: top-k update:param ratio outliers, a total-grad-norm
+    sparkline across samples, worst replica divergence, and a NaN
+    alarm (nonzero nonfinite counters)."""
+    def family(name):
+        return {dict(labels).get("layer", ""): v
+                for (n, labels), v in fams.items() if n == name}
+
+    ratios = family("dl4j_tpu_numerics_update_ratio")
+    grads = family("dl4j_tpu_numerics_grad_norm")
+    diverg = family("dl4j_tpu_numerics_replica_divergence")
+    nonfinite = {
+        (dict(labels).get("layer", ""), dict(labels).get("kind", "")): v
+        for (n, labels), v in fams.items()
+        if n == "dl4j_tpu_numerics_nonfinite_total"}
+    view: dict = {}
+    if ratios:
+        top = sorted(ratios.items(), key=lambda kv: -kv[1])[:5]
+        view["top_update_ratios"] = {l: round(v, 6) for l, v in top}
+    if grads:
+        total = sum(grads.values())
+        _GRAD_HISTORY.append(total)
+        del _GRAD_HISTORY[:-64]
+        view["grad_norm_total"] = round(total, 6)
+        view["grad_norm_sparkline"] = _sparkline(_GRAD_HISTORY)
+    if diverg:
+        worst = max(diverg.items(), key=lambda kv: kv[1])
+        view["replica_divergence_max"] = {"layer": worst[0],
+                                          "value": round(worst[1], 6)}
+    alarms = {f"{l}/{k}": int(v) for (l, k), v in nonfinite.items()
+              if v > 0}
+    if alarms:
+        view["NONFINITE_ALARM"] = alarms
+    return view
 
 
 def _scrape_telemetry(metrics_url, healthz_url, trace_jsonl) -> None:
@@ -102,6 +159,9 @@ def _scrape_telemetry(metrics_url, healthz_url, trace_jsonl) -> None:
                       for (name, labels), v in sorted(fams.items())
                       if name.startswith(_METRIC_KEYS)}
             _log(event="metrics", url=metrics_url, sample=sample)
+            view = _numerics_view(fams)
+            if view:
+                _log(event="numerics", url=metrics_url, **view)
         except Exception as e:
             _log(event="metrics", url=metrics_url, error=repr(e))
     if healthz_url:

@@ -87,6 +87,13 @@ def _run_bench_child():
         tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-3:]
         _skip("bench child failed rc=%d: %s"
               % (proc.returncode, " | ".join(tail)))
+    # ZeRO-DP sharded weight update (parallel/zero.py): before/after
+    # row — replicated vs sharded SYNC step time and per-device
+    # optimizer-state bytes on an 8-virtual-device mesh. Runs in its
+    # own forced-CPU subprocess so a tunnel outage (or a 1-device box)
+    # never blanks the headline number.
+    from deeplearning4j_tpu.parallel import zero
+    parsed["zero_dp"] = zero.subprocess_report()
     print(json.dumps(parsed))
 
 

@@ -350,6 +350,14 @@ PREEMPTIONS = REGISTRY.counter(
     "dl4j_tpu_preemptions_total",
     "SIGTERM preemption notices honored (checkpoint-and-exit)")
 
+# parallel training (parallel/wrapper.py): the optimizer-state HBM
+# footprint the ZeRO sharded update divides by N — layout is
+# "replicated" (every device holds full moments) or "sharded" (1/N)
+OPT_STATE_BYTES = REGISTRY.gauge(
+    "dl4j_tpu_opt_state_bytes_per_device",
+    "optimizer-state bytes resident per device for the active "
+    "ParallelWrapper training layout", ("layout",))
+
 
 def drop_entry(entry: str) -> None:
     """Remove one ``entry`` labelset from every per-entry family —

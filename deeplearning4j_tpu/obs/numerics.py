@@ -27,7 +27,10 @@ next to the new params, a ``diag`` pytree of per-layer scalars:
   buckets over ``2**HIST_LO .. 2**HIST_HI``) for gradients and
   updates,
 - on the ``ParallelWrapper`` SPMD path, per-layer replica divergence
-  (``pmax − pmin`` of the per-replica gradient norms).
+  (``pmax − pmin`` of the per-replica gradient norms), and — under
+  the ZeRO sharded weight update — per-layer ``pmax − pmin`` of the
+  per-replica POST-GATHER param norms (the lockstep fence: exactly 0
+  while every replica reassembles identical params).
 
 Only these scalars cross to host, and only at cadence. The off path
 is one attribute check in the fit loop: with no monitor attached the
@@ -97,6 +100,12 @@ REPLICA_DIVERGENCE = _metrics.REGISTRY.gauge(
     "dl4j_tpu_numerics_replica_divergence",
     "per-layer max-min spread of per-replica gradient norms "
     "(ParallelWrapper SPMD path)", ("layer",))
+PARAM_REPLICA_DIVERGENCE = _metrics.REGISTRY.gauge(
+    "dl4j_tpu_numerics_param_replica_divergence",
+    "per-layer max-min spread of per-replica PARAM norms after the "
+    "ZeRO sharded-update all-gather — the lockstep invariant: "
+    "exactly 0 while every replica reassembles identical params",
+    ("layer",))
 NONFINITE = _metrics.REGISTRY.counter(
     "dl4j_tpu_numerics_nonfinite_total",
     "non-finite origins pinpointed by the NaN sentinel",
@@ -457,10 +466,10 @@ class NumericsMonitor:
                 and math.isfinite(num["update_norm"][l])
                 and num["param_norm"][l] > 0 else 0.0)
             for l in layers}
-        if "replica_divergence" in host:
-            num["replica_divergence"] = {
-                l: float(host["replica_divergence"][i])
-                for i, l in enumerate(layers)}
+        for dkey in ("replica_divergence", "param_replica_divergence"):
+            if dkey in host:
+                num[dkey] = {l: float(host[dkey][i])
+                             for i, l in enumerate(layers)}
         for key in ("grad_hist", "update_hist"):
             if key in host:
                 num[key] = {l: np.asarray(host[key][i]).tolist()
@@ -475,6 +484,10 @@ class NumericsMonitor:
             for l in layers:
                 REPLICA_DIVERGENCE.labels(layer=l).set(
                     num["replica_divergence"][l])
+        if "param_replica_divergence" in num:
+            for l in layers:
+                PARAM_REPLICA_DIVERGENCE.labels(layer=l).set(
+                    num["param_replica_divergence"][l])
         if _trace.enabled():
             _trace.counter("numerics/grad_norm", num["grad_norm"])
             _trace.counter("numerics/update_ratio",

@@ -14,6 +14,9 @@ from deeplearning4j_tpu.parallel.mesh import (make_mesh, data_parallel_mesh,
                                               distributed_context,
                                               active_context)
 from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+from deeplearning4j_tpu.parallel.zero import (FlatShardLayout,
+                                              per_device_bytes,
+                                              zero_dp_report)
 from deeplearning4j_tpu.parallel.inference import (ParallelInference,
                                                    shard_model_params)
 from deeplearning4j_tpu.parallel.compression import (

@@ -24,3 +24,45 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
     kw[_CHECK_KW] = check_vma
     return _shard_map(f, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, **kw)
+
+
+# ``lax.psum_scatter`` / ``lax.all_gather`` power the ZeRO-style
+# sharded weight update (parallel/zero.py, wrapper sharded_update).
+# Both exist on every jax this repo targets, but a jaxlib old enough
+# to predate them must degrade to a clear capability signal (tests
+# skip, the wrapper raises) rather than an AttributeError mid-trace —
+# the same posture as the shard_map shim above.
+try:
+    from jax.lax import psum_scatter as _psum_scatter
+except ImportError:                 # pragma: no cover - ancient jaxlib
+    _psum_scatter = None
+try:
+    from jax.lax import all_gather as _all_gather
+except ImportError:                 # pragma: no cover - ancient jaxlib
+    _all_gather = None
+
+
+def supports_psum_scatter() -> bool:
+    """Can this runtime express the sharded weight update's
+    reduce-scatter + all-gather pair?"""
+    return _psum_scatter is not None and _all_gather is not None
+
+
+def psum_scatter(x, axis_name, *, tiled=False):
+    """``lax.psum_scatter`` or a loud capability error on a runtime
+    that cannot express it (callers gate on
+    :func:`supports_psum_scatter` and skip/raise up front)."""
+    if _psum_scatter is None:
+        raise RuntimeError(
+            "this jax has no lax.psum_scatter — the ZeRO sharded "
+            "weight update cannot run; use sharded_update=False")
+    return _psum_scatter(x, axis_name, tiled=tiled)
+
+
+def all_gather(x, axis_name, *, tiled=False):
+    """``lax.all_gather`` behind the same capability gate."""
+    if _all_gather is None:
+        raise RuntimeError(
+            "this jax has no lax.all_gather — the ZeRO sharded "
+            "weight update cannot run; use sharded_update=False")
+    return _all_gather(x, axis_name, tiled=tiled)

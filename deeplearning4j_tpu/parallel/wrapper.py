@@ -12,6 +12,16 @@ hops. One jitted SPMD train step over a ``Mesh``:
    batch sharded over the 'data' axis, params replicated; XLA inserts
    the ICI allreduce for the gradient mean. This is the mode that
    should win every benchmark.
+ - SYNC + ``sharded_update=True`` (ZeRO-style, arxiv 2004.13336 /
+   parallel/zero.py): same data parallelism, but the gradient
+   ``pmean`` becomes a per-leaf flat ``psum_scatter``, the optimizer
+   state lives on device only as 1/N shards (materialized directly
+   sharded from the net's — possibly checkpoint-restored — opt
+   state, whose replicated copy is then evicted to host memory),
+   each replica updates only its slice, and an ``all_gather``
+   rebuilds the full params for the next forward. Identical wire
+   volume to the allreduce it replaces; optimizer-state HBM and
+   update FLOPs drop by N.
  - ENCODED (≙ SHARED_GRADIENTS + EncodedGradientsAccumulator): explicit
    ``shard_map`` step; per-device grads go through threshold encoding
    with local residuals, the ternary updates are psum'd (what would
@@ -27,6 +37,13 @@ hops. One jitted SPMD train step over a ``Mesh``:
    accumulating locally — the Hogwild-flavor DP the reference runs
    over Aeron, expressed as one SPMD step with carried in-flight
    state.
+
+Every step variant shares one gradient helper (``_local_grads``) and
+one update helper (``_apply_update``); every variant donates its full
+carried state (params, optimizer state, layer state, accumulator
+state) so XLA can reuse the buffers in place — and, for the sharded
+update, overlap the parameter all-gather with the next step where the
+schedule allows.
 """
 from __future__ import annotations
 
@@ -39,7 +56,10 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from deeplearning4j_tpu.parallel import _compat
 from deeplearning4j_tpu.parallel._compat import shard_map
+from deeplearning4j_tpu.parallel.zero import (FlatShardLayout,
+                                              per_device_bytes)
 
 from deeplearning4j_tpu import obs
 from deeplearning4j_tpu.parallel.compression import \
@@ -47,6 +67,25 @@ from deeplearning4j_tpu.parallel.compression import \
 from deeplearning4j_tpu.parallel.mesh import data_parallel_mesh
 from deeplearning4j_tpu.perf import sentry
 from deeplearning4j_tpu.resilience import faults
+
+
+def _replica_view(tree):
+    """Strip the leading per-device axis a ``P('data')`` spec leaves on
+    stacked replica state inside ``shard_map``."""
+    return jax.tree.map(lambda a: a[0], tree)
+
+
+def _stacked(tree):
+    """Re-add the leading axis for a ``P('data')`` out spec."""
+    return jax.tree.map(lambda a: a[None], tree)
+
+
+#: gradient-normalization modes that reduce ACROSS a layer/tree —
+#: not expressible on 1/N parameter shards (the shard-local norm is
+#: not the layer norm); sharded_update rejects them up front
+_CROSS_LEAF_GRAD_NORMS = frozenset({
+    "clipl2perlayer", "clipl2perparamtype",
+    "renormalizel2perlayer", "renormalizel2perparamtype"})
 
 
 class ParallelWrapper:
@@ -61,7 +100,8 @@ class ParallelWrapper:
                  average_updaters: bool = True,
                  accumulator: Optional[EncodedGradientsAccumulator] = None,
                  mesh: Optional[Mesh] = None,
-                 prefetch_buffer: int = 4):
+                 prefetch_buffer: int = 4,
+                 sharded_update: bool = False):
         self.net = net
         self.mesh = mesh or data_parallel_mesh(workers)
         self.n = int(np.prod(self.mesh.devices.shape))
@@ -75,8 +115,16 @@ class ParallelWrapper:
             EncodedGradientsAccumulator()
             if mode in (self.ENCODED, self.ASYNC) else None)
         self.prefetch_buffer = prefetch_buffer
+        if sharded_update and mode != self.SYNC:
+            raise ValueError(
+                "sharded_update is a SYNC-mode optimization (the "
+                f"ZeRO weight-update sharding); mode {mode!r} carries "
+                "per-replica state that is already not replicated")
+        self.sharded_update = bool(sharded_update)
         self._step = None
+        self._step_builder = None
         self._dp_state = None  # mode-specific device state
+        self._shard_layout = None
         # MultiLayerNetwork takes (x, y); ComputationGraph takes
         # ({name: x}, [y]) — adapt here so every mode's step body can
         # stay network-agnostic. Multi-input/multi-output graphs pass
@@ -124,6 +172,10 @@ class ParallelWrapper:
             self._kw["average_updaters"] = flag
             return self
 
+        def sharded_update(self, flag: bool = True):
+            self._kw["sharded_update"] = flag
+            return self
+
         def gradients_accumulator(self, acc):
             self._kw["accumulator"] = acc
             # an accumulator implies an encoded-family mode; a prior
@@ -146,20 +198,237 @@ class ParallelWrapper:
     def builder(net) -> "ParallelWrapper.Builder":
         return ParallelWrapper.Builder(net)
 
+    # -- shared step pieces (every variant composes these) ---------------
+    def _local_grads(self, params, state, x, y, rng, want_stats=False):
+        """Loss + gradients of this replica's (or the global) batch.
+        With ``want_stats`` the activation taps of the numerics
+        observatory ride the same forward (diagnostic steps only — the
+        plain variants trace without them so the default program stays
+        byte-identical)."""
+        if not want_stats:
+            (loss, new_state), grads = jax.value_and_grad(
+                self._loss, has_aux=True)(params, state, x, y, rng)
+            return loss, new_state, grads, None
+
+        def lf(p):
+            stats = {}
+            loss, new_state = self._loss(p, state, x, y, rng, stats)
+            return loss, (new_state, stats)
+
+        (loss, (new_state, stats)), grads = jax.value_and_grad(
+            lf, has_aux=True)(params)
+        return loss, new_state, grads, stats
+
+    def _apply_update(self, params, opt_state, grads, constrain=True):
+        """One optimizer application: update, apply, (optionally)
+        constrain. ``constrain=False`` for flat parameter shards —
+        constraints are per-layer reductions and run on the gathered
+        full tree instead."""
+        net = self.net
+        updates, opt_state = net._optimizer.update(grads, opt_state,
+                                                   params)
+        params = optax.apply_updates(params, updates)
+        if constrain:
+            params = net._apply_constraints(params)
+        return params, opt_state, updates
+
+    # -- ZeRO sharded-update plumbing ------------------------------------
+    def _layout(self) -> FlatShardLayout:
+        if self._shard_layout is None:
+            self._shard_layout = FlatShardLayout(self.net.params,
+                                                 self.n)
+        return self._shard_layout
+
+    def _check_sharded_update_supported(self):
+        if not _compat.supports_psum_scatter():
+            raise RuntimeError(
+                "sharded_update needs lax.psum_scatter/all_gather, "
+                "which this jax runtime cannot express — train with "
+                "sharded_update=False")
+        gn = getattr(self.net.conf, "gradient_normalization", None)
+        if gn and str(gn).lower() in _CROSS_LEAF_GRAD_NORMS:
+            raise ValueError(
+                f"sharded_update applies the optimizer to 1/{self.n} "
+                f"parameter shards; gradient normalization {gn!r} "
+                "reduces across a whole layer/tree and would see only "
+                "the local shard — use sharded_update=False, or "
+                "elementwise clipping (ClipElementWiseAbsoluteValue)")
+
+    def _opt_shard_init_fn(self):
+        layout = self._layout()
+        optimizer = self.net._optimizer
+
+        def init(params):
+            return optimizer.init(layout.flatten(params))
+
+        return init
+
+    def _opt_shard_specs(self):
+        """PartitionSpec tree for the sharded optimizer state: moment
+        leaves (flat, padded to a multiple of n) ride ``P('data')``,
+        scalar counters stay replicated."""
+        from deeplearning4j_tpu.parallel.zero import sharded_leaf
+        shapes = jax.eval_shape(self._opt_shard_init_fn(),
+                                self.net.params)
+        return jax.tree.map(
+            lambda l: P("data") if sharded_leaf(l, self.n) else P(),
+            shapes)
+
+    def _init_sharded_opt(self):
+        """Optimizer state born as 1/N shards: compiled with per-leaf
+        ``P('data')`` out_shardings so the flat layout is materialized
+        directly sharded. The wrapped net's current ``opt_state`` —
+        fresh init OR a zip/trainer-restored one — is what gets
+        re-sharded, so resume re-enters the exact moments the
+        checkpoint held; only a net without any opt_state falls back
+        to ``optimizer.init`` from scratch."""
+        from deeplearning4j_tpu.parallel.zero import sharded_leaf
+        mesh = self.mesh
+        ref = jax.eval_shape(self._opt_shard_init_fn(),
+                             self.net.params)
+        out_sh = jax.tree.map(
+            lambda l: NamedSharding(
+                mesh, P("data") if sharded_leaf(l, self.n) else P()),
+            ref)
+        src = self.net.opt_state
+        if src is None:
+            return jax.jit(self._opt_shard_init_fn(),
+                           out_shardings=out_sh)(self.net.params)
+        ref_leaves = jax.tree_util.tree_leaves(ref)
+        ref_def = jax.tree_util.tree_structure(ref)
+        src_leaves = jax.tree_util.tree_leaves(src)
+        if len(src_leaves) != len(ref_leaves):
+            raise ValueError(
+                "net.opt_state does not match the optimizer layout "
+                f"({len(src_leaves)} leaves vs {len(ref_leaves)}) — "
+                "was the updater reconfigured after restore?")
+
+        def reshard(leaves):
+            out = []
+            for cur, want in zip(leaves, ref_leaves):
+                cur = jnp.asarray(cur)
+                if tuple(cur.shape) != tuple(want.shape):
+                    cur = jnp.pad(jnp.ravel(cur),
+                                  (0, int(want.shape[0]) - cur.size))
+                out.append(cur.astype(want.dtype))
+            return jax.tree_util.tree_unflatten(ref_def, out)
+
+        return jax.jit(reshard, out_shardings=out_sh)(src_leaves)
+
+    def _ensure_sharded_state(self):
+        """(Re)build the 1/N optimizer shards when missing — first
+        ``fit`` or after a resilience restore nulled ``_dp_state``:
+        the shards come from the net's current (possibly restored)
+        ``opt_state``, whose replicated copy is then evicted to host
+        memory so it stops pinning N× the sharded footprint in HBM.
+        The identity-tracked backref lets ``ModelSerializer``'s zip
+        export fold the live shards for exactly as long as this
+        wrapper owns the net's optimizer state."""
+        if self._dp_state is not None:
+            return
+        import weakref
+        net = self.net
+        self._dp_state = self._init_sharded_opt()
+        net.opt_state = jax.device_get(net.opt_state)
+        self._evicted_opt = net.opt_state
+        net._zero_wrapper = weakref.ref(self)
+
+    def _ensure_ready(self):
+        """Step + mode state ready to train: builds on first use, and
+        rebuilds mode-specific device state that a resilience restore
+        dropped (``FaultTolerantTrainer._restore`` nulls ``_dp_state``
+        so it is rebuilt from the RESTORED net)."""
+        needs_state = (self._dp_state is None
+                       and (self.mode != self.SYNC
+                            or self.sharded_update))
+        if self._step is None or needs_state:
+            self._prepare()
+
+    def gather_opt_state(self):
+        """Materialize the sharded optimizer state in the replicated
+        ``net.opt_state`` layout — export/interop only (zip
+        checkpoints, updater inspection); it recreates exactly the N
+        copies the sharded mode exists to avoid, so never call it in
+        the training loop. Sharded checkpoints go through
+        ``ShardedCheckpointer.save_wrapper`` instead."""
+        if self._dp_state is None or not self.sharded_update:
+            return self.net.opt_state
+        ref = jax.eval_shape(self.net._optimizer.init, self.net.params)
+        flat_ref = jax.tree_util.tree_leaves(ref)
+        flat_cur = jax.tree_util.tree_leaves(self._dp_state)
+        out = []
+        for cur, want in zip(flat_cur, flat_ref):
+            if tuple(cur.shape) != tuple(want.shape):
+                size = int(np.prod(want.shape)) if want.shape else 1
+                cur = cur[:size].reshape(want.shape)
+            out.append(cur)
+        return jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(ref), out)
+
+    # -- checkpoint glue (ShardedCheckpointer.save/restore_wrapper) ------
+    def checkpoint_tree(self):
+        """The wrapper's full training state as one pytree. In sharded
+        mode the optimizer entry is the sharded state — each device
+        saves only its 1/N (orbax/tensorstore writes shards), and a
+        restore with this tree as target lands them back on the same
+        topology without ever materializing the replicated layout."""
+        self._ensure_ready()
+        net = self.net
+        opt = self._dp_state if self.sharded_update else net.opt_state
+        return {"params": net.params, "opt": opt, "state": net.state,
+                "meta": {"iteration": net.iteration,
+                         "epoch": net.epoch}}
+
+    def checkpoint_target(self):
+        """Restore target for :meth:`checkpoint_tree`: abstract leaves
+        carrying the mesh placement the step expects — params/state
+        replicated over the mesh, optimizer moments back on their
+        ``P('data')`` shards — so a restore lands every buffer where
+        the compiled step will consume it."""
+        tree = self.checkpoint_tree()
+        repl = NamedSharding(self.mesh, P())
+
+        def sds(leaf, sharding):
+            return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype,
+                                        sharding=sharding)
+
+        return {
+            "params": jax.tree.map(lambda l: sds(l, repl),
+                                   tree["params"]),
+            "opt": jax.tree.map(
+                lambda l: sds(l, getattr(l, "sharding", repl) or repl)
+                if self.sharded_update else sds(l, repl), tree["opt"]),
+            "state": jax.tree.map(lambda l: sds(l, repl),
+                                  tree["state"]),
+            "meta": tree["meta"],
+        }
+
+    def load_checkpoint_tree(self, tree):
+        """Inverse of :meth:`checkpoint_tree` (same mode/topology)."""
+        self._ensure_ready()
+        net = self.net
+        net.params = tree["params"]
+        net.state = tree["state"]
+        if self.sharded_update:
+            self._dp_state = tree["opt"]
+        else:
+            net.opt_state = tree["opt"]
+        net.iteration = int(tree["meta"]["iteration"])
+        net.epoch = int(tree["meta"]["epoch"])
+        return self
+
     # -------------------------------------------------------------------
     def _build_sync_step(self):
         net = self.net
         mesh = self.mesh
-        optimizer = net._optimizer
         repl = NamedSharding(mesh, P())
         shard = NamedSharding(mesh, P("data"))
 
         def step(params, opt_state, state, x, y, rng):
-            (loss, new_state), grads = jax.value_and_grad(
-                self._loss, has_aux=True)(params, state, x, y, rng)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            params = net._apply_constraints(params)
+            loss, new_state, grads, _ = self._local_grads(
+                params, state, x, y, rng)
+            params, opt_state, _ = self._apply_update(params, opt_state,
+                                                      grads)
             return params, opt_state, new_state, loss
 
         return sentry.jit(
@@ -167,6 +436,42 @@ class ParallelWrapper:
             in_shardings=(repl, repl, repl, shard, shard, repl),
             out_shardings=(repl, repl, repl, repl),
             donate_argnums=(0, 1, 2))
+
+    def _build_sync_sharded_step(self):
+        """ZeRO-style SYNC step (arxiv 2004.13336): reduce-scatter the
+        gradient mean, update this replica's 1/N flat parameter slice
+        against its resident 1/N optimizer shards, all-gather the
+        updated params for the next forward. Donating params lets XLA
+        write the gathered result in place and start the gather before
+        the host sees the step complete."""
+        net = self.net
+        mesh = self.mesh
+        layout = self._layout()
+        ospec = self._opt_shard_specs()
+
+        def local_step(params, opt_shards, state, x, y, rng):
+            loss, new_state, grads, _ = self._local_grads(
+                params, state, x, y, rng)
+            gshard = layout.scatter_mean(grads, "data")
+            pshard = layout.shard(layout.flatten(params),
+                                  jax.lax.axis_index("data"))
+            pshard, opt_shards, _ = self._apply_update(
+                pshard, opt_shards, gshard, constrain=False)
+            params = net._apply_constraints(
+                layout.gather(pshard, "data"))
+            loss = jax.lax.pmean(loss, "data")
+            return params, opt_shards, new_state, loss
+
+        pspec = P()
+        dspec = P("data")
+        smapped = shard_map(
+            local_step, mesh=mesh,
+            in_specs=(pspec, ospec, pspec, dspec, dspec, pspec),
+            out_specs=(pspec, ospec, pspec, pspec),
+            check_vma=False)
+        return sentry.jit(smapped,
+                          name="ParallelWrapper.sync_sharded_step",
+                          donate_argnums=(0, 1, 2))
 
     def _build_sync_diag_step(self):
         """Diagnostic variant of the SYNC step (obs/numerics.py,
@@ -180,20 +485,13 @@ class ParallelWrapper:
         from deeplearning4j_tpu.obs import numerics
         net = self.net
         mesh = self.mesh
-        optimizer = net._optimizer
         nm = net._numerics
         histograms = nm.histograms if nm is not None else False
         layers = net._layer_names()
 
         def local_step(params, opt_state, state, x, y, rng):
-            def lf(p):
-                stats = {}
-                loss, new_state = self._loss(p, state, x, y, rng,
-                                             stats)
-                return loss, (new_state, stats)
-
-            (loss, (new_state, act_stats)), grads = jax.value_and_grad(
-                lf, has_aux=True)(params)
+            loss, new_state, grads, act_stats = self._local_grads(
+                params, state, x, y, rng, want_stats=True)
             # per-replica grad-norm spread BEFORE the mean erases it
             local_norms = numerics.layer_norms_vector(grads, layers)
             divergence = (jax.lax.pmax(local_norms, "data")
@@ -201,10 +499,8 @@ class ParallelWrapper:
             grads = jax.tree.map(
                 lambda g: jax.lax.pmean(g, "data"), grads)
             act_stats = numerics.reduce_act_stats(act_stats, "data")
-            updates, opt_state = optimizer.update(grads, opt_state,
-                                                  params)
-            params = optax.apply_updates(params, updates)
-            params = net._apply_constraints(params)
+            params, opt_state, updates = self._apply_update(
+                params, opt_state, grads)
             diag = numerics.build_diag(params, grads, updates,
                                        act_stats, layers,
                                        histograms=histograms)
@@ -222,25 +518,82 @@ class ParallelWrapper:
         return sentry.jit(smapped, name="ParallelWrapper.sync_diag_step",
                           donate_argnums=(0, 1, 2))
 
-    def _build_encoded_step(self):
+    def _build_sync_sharded_diag_step(self):
+        """Diagnostic variant of the SHARDED SYNC step: the exact
+        scatter→shard-update→gather math of the plain sharded step
+        (so diag iterations stay on the training trajectory), plus the
+        numerics aux outputs. Emits BOTH divergence fences: the PR 4
+        per-replica grad-norm spread (nonzero by design — replicas see
+        different shards) and ``param_replica_divergence``, the spread
+        of per-replica norms of the POST-GATHER params — the ZeRO
+        lockstep invariant, exactly 0.0 while replicas agree
+        bit-for-bit."""
+        from deeplearning4j_tpu.obs import numerics
         net = self.net
         mesh = self.mesh
-        optimizer = net._optimizer
+        layout = self._layout()
+        ospec = self._opt_shard_specs()
+        nm = net._numerics
+        histograms = nm.histograms if nm is not None else False
+        layers = net._layer_names()
+
+        def local_step(params, opt_shards, state, x, y, rng):
+            loss, new_state, grads, act_stats = self._local_grads(
+                params, state, x, y, rng, want_stats=True)
+            local_norms = numerics.layer_norms_vector(grads, layers)
+            divergence = (jax.lax.pmax(local_norms, "data")
+                          - jax.lax.pmin(local_norms, "data"))
+            gshard = layout.scatter_mean(grads, "data")
+            # full mean grads are diag-only outputs (per-layer norms);
+            # the update itself consumes only the scattered shards
+            grads = jax.tree.map(
+                lambda g: jax.lax.pmean(g, "data"), grads)
+            act_stats = numerics.reduce_act_stats(act_stats, "data")
+            pshard = layout.shard(layout.flatten(params),
+                                  jax.lax.axis_index("data"))
+            pshard, opt_shards, ushard = self._apply_update(
+                pshard, opt_shards, gshard, constrain=False)
+            params = net._apply_constraints(
+                layout.gather(pshard, "data"))
+            updates = layout.gather(ushard, "data")
+            diag = numerics.build_diag(params, grads, updates,
+                                       act_stats, layers,
+                                       histograms=histograms)
+            diag["replica_divergence"] = divergence
+            pnorms = numerics.layer_norms_vector(params, layers)
+            diag["param_replica_divergence"] = (
+                jax.lax.pmax(pnorms, "data")
+                - jax.lax.pmin(pnorms, "data"))
+            loss = jax.lax.pmean(loss, "data")
+            return params, opt_shards, new_state, loss, diag
+
+        pspec = P()
+        dspec = P("data")
+        smapped = shard_map(
+            local_step, mesh=mesh,
+            in_specs=(pspec, ospec, pspec, dspec, dspec, pspec),
+            out_specs=(pspec, ospec, pspec, pspec, pspec),
+            check_vma=False)
+        return sentry.jit(
+            smapped, name="ParallelWrapper.sync_sharded_diag_step",
+            donate_argnums=(0, 1, 2))
+
+    def _build_encoded_step(self):
+        mesh = self.mesh
         acc = self.accumulator
 
         def local_step(params, opt_state, state, acc_state, x, y, rng):
             # strip per-device leading axis from the residual state
-            acc_state = jax.tree.map(lambda a: a[0], acc_state)
+            acc_state = _replica_view(acc_state)
             # per-device grads on the local shard
-            (loss, new_state), grads = jax.value_and_grad(
-                self._loss, has_aux=True)(params, state, x, y, rng)
+            loss, new_state, grads, _ = self._local_grads(
+                params, state, x, y, rng)
             grads, acc_state = acc.exchange(grads, acc_state, "data")
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            params = net._apply_constraints(params)
+            params, opt_state, _ = self._apply_update(params, opt_state,
+                                                      grads)
             loss = jax.lax.pmean(loss, "data")
-            acc_state = jax.tree.map(lambda a: a[None], acc_state)
-            return params, opt_state, new_state, acc_state, loss
+            return (params, opt_state, new_state, _stacked(acc_state),
+                    loss)
 
         pspec = P()          # replicated params
         dspec = P("data")    # sharded batch / per-device residuals
@@ -253,29 +606,23 @@ class ParallelWrapper:
                           donate_argnums=(0, 1, 2, 3))
 
     def _build_async_step(self):
-        net = self.net
         mesh = self.mesh
-        optimizer = net._optimizer
         acc = self.accumulator
 
         def local_step(params, opt_state, state, acc_state, x, y, rng):
             # per-replica params/opt + per-replica residual/inflight
-            params = jax.tree.map(lambda a: a[0], params)
-            opt_state = jax.tree.map(lambda a: a[0], opt_state)
-            acc_state = jax.tree.map(lambda a: a[0], acc_state)
-            (loss, new_state), grads = jax.value_and_grad(
-                self._loss, has_aux=True)(params, state, x, y, rng)
+            params = _replica_view(params)
+            opt_state = _replica_view(opt_state)
+            acc_state = _replica_view(acc_state)
+            loss, new_state, grads, _ = self._local_grads(
+                params, state, x, y, rng)
             grads, acc_state = acc.exchange_async(grads, acc_state,
                                                   "data")
-            updates, opt_state = optimizer.update(grads, opt_state,
-                                                  params)
-            params = optax.apply_updates(params, updates)
-            params = net._apply_constraints(params)
+            params, opt_state, _ = self._apply_update(params, opt_state,
+                                                      grads)
             loss = jax.lax.pmean(loss, "data")
-            lead = lambda a: a[None]
-            return (jax.tree.map(lead, params),
-                    jax.tree.map(lead, opt_state), new_state,
-                    jax.tree.map(lead, acc_state), loss)
+            return (_stacked(params), _stacked(opt_state), new_state,
+                    _stacked(acc_state), loss)
 
         pdev = P("data")
         repl = P()
@@ -285,12 +632,10 @@ class ParallelWrapper:
             out_specs=(pdev, pdev, repl, pdev, repl),
             check_vma=False)
         return sentry.jit(smapped, name="ParallelWrapper.async_step",
-                          donate_argnums=(0, 1, 3))
+                          donate_argnums=(0, 1, 2, 3))
 
     def _build_averaging_step(self):
-        net = self.net
         mesh = self.mesh
-        optimizer = net._optimizer
         k = self.averaging_frequency
         avg_upd = self.average_updaters
 
@@ -302,14 +647,12 @@ class ParallelWrapper:
                 if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
 
         def local_step(params, opt_state, state, x, y, rng, it):
-            # strip the leading per-device axis added by the stacking
-            params = jax.tree.map(lambda a: a[0], params)
-            opt_state = jax.tree.map(lambda a: a[0], opt_state)
-            (loss, new_state), grads = jax.value_and_grad(
-                self._loss, has_aux=True)(params, state, x, y, rng)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            params = net._apply_constraints(params)
+            params = _replica_view(params)
+            opt_state = _replica_view(opt_state)
+            loss, new_state, grads, _ = self._local_grads(
+                params, state, x, y, rng)
+            params, opt_state, _ = self._apply_update(params, opt_state,
+                                                      grads)
             # every k-th iteration: replica averaging (reference
             # ParameterAveraging semantics; averageUpdaters=true also
             # averages the optimizer moments)
@@ -320,9 +663,8 @@ class ParallelWrapper:
                             pmean_floats(po[1]) if avg_upd else po[1]),
                 lambda po: po, (params, opt_state))
             loss = jax.lax.pmean(loss, "data")
-            params = jax.tree.map(lambda a: a[None], params)
-            opt_state = jax.tree.map(lambda a: a[None], opt_state)
-            return params, opt_state, new_state, loss
+            return (_stacked(params), _stacked(opt_state), new_state,
+                    loss)
 
         pdev = P("data")   # leading device axis
         repl = P()
@@ -332,15 +674,23 @@ class ParallelWrapper:
             out_specs=(pdev, pdev, repl, repl),
             check_vma=False)
         return sentry.jit(smapped, name="ParallelWrapper.averaging_step",
-                          donate_argnums=(0, 1))
+                          donate_argnums=(0, 1, 2))
 
     # -------------------------------------------------------------------
     def _prepare(self):
         net = self.net
         if self.mode == self.SYNC:
-            self._step = self._build_sync_step()
+            if self.sharded_update:
+                self._check_sharded_update_supported()
+                self._step = self._build_sync_sharded_step()
+                self._step_builder = "_build_sync_sharded_step"
+                self._ensure_sharded_state()
+            else:
+                self._step = self._build_sync_step()
+                self._step_builder = "_build_sync_step"
         elif self.mode == self.ENCODED:
             self._step = self._build_encoded_step()
+            self._step_builder = "_build_encoded_step"
             if self._dp_state is None:
                 # per-device residual state: leading axis over devices
                 one = self.accumulator.init_state(net.params)
@@ -353,6 +703,7 @@ class ParallelWrapper:
                 }
         elif self.mode == self.AVERAGING:
             self._step = self._build_averaging_step()
+            self._step_builder = "_build_averaging_step"
             if self._dp_state is None:
                 self._dp_state = (
                     jax.tree.map(lambda a: jnp.broadcast_to(
@@ -362,6 +713,7 @@ class ParallelWrapper:
                 )
         elif self.mode == self.ASYNC:
             self._step = self._build_async_step()
+            self._step_builder = "_build_async_step"
             if self._dp_state is None:
                 stack = lambda a: jnp.broadcast_to(
                     a[None], (self.n,) + a.shape)
@@ -374,50 +726,78 @@ class ParallelWrapper:
                 )
         else:
             raise ValueError(f"unknown mode {self.mode!r}")
+        self._export_opt_state_bytes()
+
+    def _export_opt_state_bytes(self):
+        """Publish the per-device optimizer-state footprint of the
+        active layout (the headline HBM number sharded_update moves)."""
+        if self.mode == self.SYNC and self.sharded_update:
+            layout, nbytes = "sharded", per_device_bytes(
+                self._dp_state, self.n)
+        elif self.mode in (self.AVERAGING, self.ASYNC):
+            # per-replica stacks: each device holds one full copy
+            layout, nbytes = "replicated", per_device_bytes(
+                self._dp_state[1], self.n)
+        else:
+            layout, nbytes = "replicated", per_device_bytes(
+                self.net.opt_state)
+        obs.metrics.OPT_STATE_BYTES.labels(layout=layout).set(nbytes)
+
+    def _diag_builder_name(self):
+        return ("_build_sync_sharded_diag_step" if self.sharded_update
+                else "_build_sync_diag_step")
+
+    def _ensure_diag_step(self, nm):
+        """(Re)build the SYNC diagnostic step for the attached
+        monitor: the monitor's config (histogram sketches on/off) is
+        traced into the program."""
+        if self._diag_step is None or self._diag_step_monitor is not nm:
+            self._diag_step = getattr(self, self._diag_builder_name())()
+            self._diag_step_monitor = nm
+        return self._diag_step
 
     def warmup(self, specs):
-        """AOT-compile the SPMD train step for every declared batch
-        shape before the first real batch (see ``perf.warmup``): the
-        first step of a fresh worker process otherwise stalls the whole
-        mesh on its compile. Spec features/labels carry the GLOBAL
-        batch dim (what ``fit`` feeds the step after trimming)."""
+        """AOT-compile the SPMD train step (and, with a numerics
+        monitor attached, its diagnostic sibling) for every declared
+        batch shape before the first real batch (see ``perf.warmup``):
+        the first step of a fresh worker process otherwise stalls the
+        whole mesh on its compile. Spec features/labels carry the
+        GLOBAL batch dim (what ``fit`` feeds the step after trimming).
+
+        Feeds come from the module-level ``WARMUP_FEEDS`` table — one
+        entry per step builder, enforced by
+        ``tools/lint_instrumentation.py`` rule 4 so a new step variant
+        cannot ship without a warmup path."""
         from deeplearning4j_tpu.perf.warmup import (_feature_sds,
-                                                    _label_sds)
+                                                    _label_sds,
+                                                    sharded_sds)
         net = self.net
-        if self._step is None:
-            self._prepare()
+        self._ensure_ready()
         # fit feeds batch-sharded global arrays (make_global_batch /
         # the SYNC in_shardings), and jit's dispatch cache keys on
         # input sharding — lower from the SAME sharding or the first
         # real step recompiles invisibly (sentry signatures ignore
         # sharding by design)
         dshard = NamedSharding(self.mesh, P("data"))
-        as_sharded = lambda t: jax.tree.map(
-            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype,
-                                           sharding=dshard), t)
         rng = jax.random.fold_in(jax.random.PRNGKey(net.conf.seed), 0)
+        entries = [(self._step, self._step_builder)]
+        nm = getattr(net, "_numerics", None)
+        if nm is not None and self.mode == self.SYNC:
+            # the cadence-gated diagnostic step is a second compiled
+            # program over the same signature — warm it too or the
+            # first diagnostic iteration stalls on its compile
+            entries.append((self._ensure_diag_step(nm),
+                            self._diag_builder_name()))
         compiled, seconds = 0, 0.0
         for spec in specs:
             if not spec.train:
                 continue
-            x = as_sharded(_feature_sds(spec, net.conf))
-            y = as_sharded(_label_sds(spec, net.conf))
-            if self.mode == self.SYNC:
-                dt = self._step.warmup(net.params, net.opt_state,
-                                       net.state, x, y, rng)
-            elif self.mode == self.ENCODED:
-                dt = self._step.warmup(net.params, net.opt_state,
-                                       net.state, self._dp_state, x, y,
-                                       rng)
-            elif self.mode == self.ASYNC:
-                p, o, a = self._dp_state
-                dt = self._step.warmup(p, o, net.state, a, x, y, rng)
-            else:  # AVERAGING
-                p, o = self._dp_state
-                dt = self._step.warmup(p, o, net.state, x, y, rng,
-                                       jnp.asarray(0, jnp.int32))
-            compiled += dt > 0
-            seconds += dt
+            x = sharded_sds(_feature_sds(spec, net.conf), dshard)
+            y = sharded_sds(_label_sds(spec, net.conf), dshard)
+            for step, builder in entries:
+                dt = step.warmup(*WARMUP_FEEDS[builder](self, x, y, rng))
+                compiled += dt > 0
+                seconds += dt
         return {"compiled": compiled, "seconds": seconds}
 
     def fit(self, iterator, epochs: int = 1):
@@ -432,8 +812,7 @@ class ParallelWrapper:
         raises instead of desyncing the cluster.
         """
         net = self.net
-        if self._step is None:
-            self._prepare()
+        self._ensure_ready()
         from deeplearning4j_tpu.data.iterators import AsyncDataSetIterator
         from deeplearning4j_tpu.parallel.master import make_global_batch
         multi = jax.process_count() > 1
@@ -527,20 +906,27 @@ class ParallelWrapper:
                         "implemented for SYNC mode only; %r trains "
                         "without in-step diagnostics", self.mode)
                 if diag_due and self.mode == self.SYNC:
-                    if self._diag_step is None or \
-                            self._diag_step_monitor is not nm:
-                        # (re)build: the monitor's config (histogram
-                        # sketches on/off) is traced into the program
-                        self._diag_step = self._build_sync_diag_step()
-                        self._diag_step_monitor = nm
-                    (net.params, net.opt_state, net.state, loss,
-                     diag) = self._diag_step(
-                        net.params, net.opt_state, net.state, x, y,
-                        rng)
+                    self._ensure_diag_step(nm)
+                    if self.sharded_update:
+                        (net.params, self._dp_state, net.state, loss,
+                         diag) = self._diag_step(
+                            net.params, self._dp_state, net.state, x,
+                            y, rng)
+                    else:
+                        (net.params, net.opt_state, net.state, loss,
+                         diag) = self._diag_step(
+                            net.params, net.opt_state, net.state, x, y,
+                            rng)
                 elif self.mode == self.SYNC:
-                    net.params, net.opt_state, net.state, loss = \
-                        self._step(net.params, net.opt_state, net.state,
-                                   x, y, rng)
+                    if self.sharded_update:
+                        (net.params, self._dp_state, net.state,
+                         loss) = self._step(
+                            net.params, self._dp_state, net.state, x,
+                            y, rng)
+                    else:
+                        net.params, net.opt_state, net.state, loss = \
+                            self._step(net.params, net.opt_state,
+                                       net.state, x, y, rng)
                 elif self.mode == self.ENCODED:
                     (net.params, net.opt_state, net.state,
                      self._dp_state, loss) = self._step(
@@ -596,3 +982,30 @@ class ParallelWrapper:
                 if jnp.issubdtype(a.dtype, jnp.floating) else a[0], o)
         else:
             self.net.opt_state = jax.tree.map(lambda a: a[0], o)
+
+
+#: warmup feed per step builder: (wrapper, x, y, rng) -> the exact
+#: argument tuple ``fit`` will pass the compiled step. ``warmup()``
+#: iterates this table, and ``tools/lint_instrumentation.py`` rule 4
+#: asserts its keys cover every ``_build_*_step`` method on
+#: ParallelWrapper — a new step variant without a feed here fails
+#: tier-1 instead of silently cold-tracing on its first real batch.
+WARMUP_FEEDS = {
+    "_build_sync_step": lambda w, x, y, rng: (
+        w.net.params, w.net.opt_state, w.net.state, x, y, rng),
+    "_build_sync_diag_step": lambda w, x, y, rng: (
+        w.net.params, w.net.opt_state, w.net.state, x, y, rng),
+    "_build_sync_sharded_step": lambda w, x, y, rng: (
+        w.net.params, w._dp_state, w.net.state, x, y, rng),
+    "_build_sync_sharded_diag_step": lambda w, x, y, rng: (
+        w.net.params, w._dp_state, w.net.state, x, y, rng),
+    "_build_encoded_step": lambda w, x, y, rng: (
+        w.net.params, w.net.opt_state, w.net.state, w._dp_state, x, y,
+        rng),
+    "_build_async_step": lambda w, x, y, rng: (
+        w._dp_state[0], w._dp_state[1], w.net.state, w._dp_state[2],
+        x, y, rng),
+    "_build_averaging_step": lambda w, x, y, rng: (
+        w._dp_state[0], w._dp_state[1], w.net.state, x, y, rng,
+        jnp.asarray(0, jnp.int32)),
+}

@@ -1,0 +1,309 @@
+"""ZeRO-style sharded weight update — flat shard layout + accounting.
+
+Reference: "Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training" (arxiv 2004.13336). The replicated
+data-parallel step all-reduces gradients and then has every replica
+redo the SAME optimizer math over the SAME full parameter set, holding
+N copies of the optimizer moments. The sharded update replaces that
+with: reduce-scatter the gradients (each replica receives the mean of
+its 1/N slice), apply the optimizer to the local slice only — against
+optimizer state that lives permanently as 1/N shards — and all-gather
+the updated parameters for the next forward. Wire volume is identical
+to the all-reduce it replaces (a ring all-reduce IS a reduce-scatter +
+all-gather); optimizer-state HBM and update FLOPs drop by N.
+
+:class:`FlatShardLayout` is the layout half: every parameter leaf
+viewed as a flat vector, zero-padded to a multiple of the replica
+count so ``lax.psum_scatter``/``lax.all_gather`` tile evenly. The
+layout keeps the parameter pytree structure (one flat leaf per
+original leaf), so per-layer optimizer partitioning
+(``optax.multi_transform`` keyed by layer name) keeps working on
+shards unchanged. Elementwise optimizer transforms (every stock
+updater: Adam/AdamW/SGD/momentum/RMSProp/...) are exact on shards;
+cross-element gradient normalization (per-layer / global-norm
+clipping) is not expressible shard-locally and is rejected up front by
+``ParallelWrapper``.
+
+``zero_dp_report`` is the measurement half: the before/after row
+(step time, per-device optimizer-state bytes, estimated peak-HBM
+delta) recorded by ``bench.py``, ``tools/perf_dossier.py`` and the
+8-device MULTICHIP gate.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import Any, Dict, List, Optional
+
+from deeplearning4j_tpu.parallel._compat import (all_gather, psum_scatter,
+                                                 supports_psum_scatter)
+
+
+class FlatShardLayout:
+    """Per-leaf flat shard layout over ``n_shards`` replicas.
+
+    Host-side metadata is fixed at construction from a donor params
+    pytree; the ``flatten``/``shard``/``scatter_mean``/``gather``
+    methods are traced inside the SPMD step. All methods preserve the
+    donor treedef, so optimizer label trees and per-layer diagnostics
+    keep addressing leaves the same way.
+    """
+
+    def __init__(self, params, n_shards: int):
+        import jax
+        import numpy as np
+
+        self.n = int(n_shards)
+        leaves, self.treedef = jax.tree_util.tree_flatten(params)
+        self.shapes = [tuple(l.shape) for l in leaves]
+        self.dtypes = [l.dtype for l in leaves]
+        self.sizes = [int(np.prod(s)) if s else 1 for s in self.shapes]
+        self.padded = [((s + self.n - 1) // self.n) * self.n
+                       for s in self.sizes]
+
+    # -- traced pieces ------------------------------------------------------
+    def flatten(self, tree):
+        """Params-like tree -> same-structure tree of flat zero-padded
+        ``(padded,)`` leaves."""
+        import jax
+        import jax.numpy as jnp
+
+        leaves = jax.tree_util.tree_leaves(tree)
+        flat = [jnp.pad(jnp.ravel(l), (0, p - s))
+                for l, s, p in zip(leaves, self.sizes, self.padded)]
+        return jax.tree_util.tree_unflatten(self.treedef, flat)
+
+    def unflatten(self, flat_tree):
+        """Inverse of :meth:`flatten` (drops the zero pad)."""
+        import jax
+
+        flats = jax.tree_util.tree_leaves(flat_tree)
+        leaves = [f[:s].reshape(shape) for f, s, shape in
+                  zip(flats, self.sizes, self.shapes)]
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    def shard(self, flat_tree, index):
+        """This replica's ``(padded/n,)`` slice of every flat leaf."""
+        import jax
+        from jax import lax
+
+        flats = jax.tree_util.tree_leaves(flat_tree)
+        out = [lax.dynamic_slice(f, (index * (p // self.n),),
+                                 (p // self.n,))
+               for f, p in zip(flats, self.padded)]
+        return jax.tree_util.tree_unflatten(self.treedef, out)
+
+    def scatter_mean(self, tree, axis_name: str):
+        """Reduce-scatter a (grads-like) tree: each replica receives
+        the cross-replica MEAN of its flat slice — the sharded
+        equivalent of the replicated path's gradient ``pmean``
+        (bit-identical on power-of-two meshes: scatter-sum and
+        all-reduce-sum accumulate in the same order, and the ``/n`` is
+        an exact power-of-two scale)."""
+        import jax
+
+        flat = self.flatten(tree)
+        return jax.tree.map(
+            lambda f: psum_scatter(f, axis_name, tiled=True) / self.n,
+            flat)
+
+    def gather(self, shard_tree, axis_name: str):
+        """All-gather per-replica shards back into the original-shape
+        tree (every replica receives identical full leaves — the ZeRO
+        lockstep invariant the param-divergence fence asserts)."""
+        import jax
+
+        full = jax.tree.map(
+            lambda s: all_gather(s, axis_name, tiled=True), shard_tree)
+        return self.unflatten(full)
+
+    # -- host-side helpers --------------------------------------------------
+    def shard_structs(self):
+        """Abstract per-replica shard tree (warmup donors)."""
+        import jax
+
+        return jax.tree_util.tree_unflatten(
+            self.treedef,
+            [jax.ShapeDtypeStruct((p // self.n,), d)
+             for p, d in zip(self.padded, self.dtypes)])
+
+
+def sharded_leaf(leaf, n_shards: int) -> bool:
+    """Is this optimizer-state leaf carried as 1/N shards under the
+    flat layout? Moment trees mirror the flat param leaves — vectors
+    padded to a multiple of the shard count; scalars (step counts,
+    schedule state) stay replicated."""
+    return leaf.ndim >= 1 and leaf.shape[0] % n_shards == 0
+
+
+def per_device_bytes(tree, n_shards: int = 1) -> int:
+    """Bytes of a pytree resident on ONE device: with ``n_shards > 1``
+    the sharded leaves count at 1/N (their global array is laid out
+    ``P('data')`` across the mesh), replicated scalars at full size."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        size = int(np.prod(leaf.shape)) if leaf.shape else 1
+        nb = size * leaf.dtype.itemsize
+        if n_shards > 1 and sharded_leaf(leaf, n_shards):
+            nb //= n_shards
+        total += nb
+    return int(total)
+
+
+# ---------------------------------------------------------------------------
+# before/after measurement row (bench.py / perf_dossier / MULTICHIP gate)
+# ---------------------------------------------------------------------------
+
+def zero_dp_report(n_devices: Optional[int] = None, steps: int = 10,
+                   hidden: int = 256, features: int = 64,
+                   classes: int = 8) -> Dict[str, Any]:
+    """Replicated vs sharded-update SYNC row on the live device set:
+    per-step wall time, per-device optimizer-state bytes, and an
+    estimated peak-HBM (params + grads + moments) per device, plus a
+    trajectory cross-check between the two modes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu import obs
+    from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn import updaters as upd
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+
+    n = int(n_devices or len(jax.devices()))
+    if len(jax.devices()) < n or n < 2:
+        return {"skipped": True,
+                "reason": f"needs {n} devices, have {len(jax.devices())}"}
+    if not supports_psum_scatter():
+        return {"skipped": True, "reason": "no lax.psum_scatter"}
+
+    def mk_net():
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater(upd.Adam(learning_rate=1e-3)).list()
+                .layer(DenseLayer(n_out=hidden, activation="relu"))
+                .layer(DenseLayer(n_out=hidden, activation="relu"))
+                .layer(OutputLayer(n_out=classes, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(features))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    batch = 8 * n
+    x = rng.normal(size=(batch, features)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[
+        rng.integers(0, classes, batch)]
+
+    def drive(sharded: bool) -> Dict[str, Any]:
+        net = mk_net()
+        w = ParallelWrapper(net, workers=n, sharded_update=sharded)
+        it = ListDataSetIterator(DataSet(x, y), batch_size=batch)
+        w.fit(it, epochs=2)               # build + warm the step
+        t0 = obs.now()
+        w.fit(it, epochs=steps)
+        dt = (obs.now() - t0) / steps
+        if sharded:
+            opt_bytes = per_device_bytes(w._dp_state, n)
+        else:
+            opt_bytes = per_device_bytes(net.opt_state)
+        p_bytes = per_device_bytes(net.params)
+        return {"step_ms": round(dt * 1e3, 3),
+                "opt_state_bytes_per_device": opt_bytes,
+                # steady-state HBM model: master params + one gradient
+                # tree + resident optimizer state, per device
+                "est_peak_hbm_bytes_per_device":
+                    2 * p_bytes + opt_bytes,
+                "params": net.params}
+
+    rep = drive(False)
+    sh = drive(True)
+    # the two trajectories are identical in exact arithmetic; XLA
+    # compiles the two programs with different fusion/FMA choices so
+    # agreement is to float rounding, not bitwise
+    rel = 0.0
+    for a, b in zip(jax.tree_util.tree_leaves(rep["params"]),
+                    jax.tree_util.tree_leaves(sh["params"])):
+        a, b = np.asarray(a), np.asarray(b)
+        rel = max(rel, float(np.max(np.abs(a - b) /
+                                    (np.abs(a) + 1e-6))))
+    rep.pop("params")
+    sh.pop("params")
+    return {
+        "n_devices": n,
+        "platform": jax.devices()[0].platform,
+        "model": f"mlp {features}-{hidden}-{hidden}-{classes} adam",
+        "replicated": rep,
+        "sharded": sh,
+        "opt_state_ratio": round(
+            sh["opt_state_bytes_per_device"]
+            / max(1, rep["opt_state_bytes_per_device"]), 4),
+        "step_time_ratio": round(
+            sh["step_ms"] / rep["step_ms"], 3) if rep["step_ms"] > 0
+            else None,
+        "max_param_rel_diff": rel,
+    }
+
+
+def subprocess_report(timeout: int = 420,
+                      n_devices: int = 8) -> Dict[str, Any]:
+    """Run :func:`zero_dp_report` in a fresh process on ``n_devices``
+    forced CPU host devices — callable from single-device bench runs
+    (bench.py, perf_dossier) without touching their backend. Returns
+    the report dict, or ``{"skipped": True, ...}`` on any failure."""
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags = (flags + " --xla_force_host_platform_device_count="
+                 f"{n_devices}").strip()
+    env["XLA_FLAGS"] = flags
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu.parallel.zero"],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))))
+    except (subprocess.TimeoutExpired, OSError) as e:
+        return {"skipped": True, "reason": f"zero-dp child: {e}"}
+    parsed = None
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except ValueError:
+                pass
+    if proc.returncode != 0 or parsed is None:
+        tail = (proc.stderr or proc.stdout or "").strip()
+        return {"skipped": True,
+                "reason": "zero-dp child rc=%d: %s"
+                          % (proc.returncode, tail.splitlines()[-1]
+                             if tail else "no output")}
+    return parsed
+
+
+def _main() -> None:
+    # sitecustomize forces the axon TPU platform and overrides
+    # JAX_PLATFORMS; pin CPU before any device query (the
+    # dryrun_multichip dance) so the measurement never waits on the
+    # TPU tunnel
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+    print(json.dumps(zero_dp_report()))
+
+
+if __name__ == "__main__":
+    _main()

@@ -57,6 +57,19 @@ def _label_dtype(spec: WarmupSpec) -> str:
     return spec.labels_dtype or spec.dtype
 
 
+def sharded_sds(tree, sharding):
+    """Rewrite a (tree of) ShapeDtypeStruct(s) to carry an explicit
+    sharding — warmup must lower from the SAME sharding the live path
+    feeds (batch-sharded global batches, ZeRO optimizer shards), or
+    jit's sharding-keyed dispatch cache misses and the first real step
+    recompiles invisibly."""
+    import jax
+
+    return jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                       sharding=sharding), tree)
+
+
 def _feature_sds(spec: WarmupSpec, conf):
     """Spec features -> the network's feed structure."""
     graph_inputs = getattr(conf, "inputs", None)

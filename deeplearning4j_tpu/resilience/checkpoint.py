@@ -32,7 +32,11 @@ that window for every checkpoint producer and consumer:
 The orbax/tensorstore sharded path gets the same posture via
 ``ShardedCheckpointer.restore_latest_valid`` (``serialization.py``),
 which quarantines unrestorable step dirs to the same ``corrupt/``
-location.
+location. ZeRO sharded-update training
+(``ParallelWrapper(sharded_update=True)``) checkpoints through
+``ShardedCheckpointer.save_wrapper``/``restore_wrapper``: each device
+saves and restores only its 1/N optimizer shard, onto the same
+topology, without ever materializing the replicated state.
 """
 from __future__ import annotations
 

@@ -97,8 +97,27 @@ class ModelSerializer:
             _writestr_det(zf, "configuration.json", net.conf.to_json())
             _save_npz(zf, "params.npz", net.params)
             _save_npz(zf, "state.npz", net.state)
-            if save_updater and net.opt_state is not None:
-                _save_npz(zf, "updater.npz", net.opt_state)
+            if save_updater:
+                opt_state = net.opt_state
+                # a ZeRO sharded-update wrapper (parallel/wrapper.py)
+                # carries the LIVE optimizer moments as 1/N shards;
+                # net.opt_state is the stale init copy. Fold the
+                # shards into the replicated layout for the zip —
+                # export is the one place that materialization is the
+                # point — so listener/trainer checkpoints taken during
+                # sharded training stay resume-exact.
+                wref = getattr(net, "_zero_wrapper", None)
+                w = wref() if wref is not None else None
+                if w is not None and w.sharded_update and \
+                        w._dp_state is not None and \
+                        opt_state is getattr(w, "_evicted_opt", None):
+                    # identity check = ownership: anything else (a
+                    # later replicated wrapper, direct net.fit, a
+                    # restore) reassigns net.opt_state and thereby
+                    # reclaims it from the sharded wrapper
+                    opt_state = w.gather_opt_state()
+                if opt_state is not None:
+                    _save_npz(zf, "updater.npz", opt_state)
             if normalizer is not None:
                 _writestr_det(zf, "normalizer.json",
                               json.dumps(normalizer.state_dict()))
@@ -255,6 +274,23 @@ class ShardedCheckpointer:
             net.epoch = int(tree["meta"]["epoch"])
             return net
         return tree
+
+    def save_wrapper(self, step: int, wrapper, *, wait: bool = False):
+        """Checkpoint a ``ParallelWrapper``'s full training state —
+        including the ZeRO sharded optimizer shards, which each device
+        writes as its own 1/N (tensorstore layout): the replicated
+        optimizer state is never materialized, not even to save."""
+        return self.save(step, tree=wrapper.checkpoint_tree(),
+                         wait=wait)
+
+    def restore_wrapper(self, wrapper, step: Optional[int] = None):
+        """Restore a ``save_wrapper`` checkpoint into ``wrapper`` on
+        the SAME topology: the wrapper's live state tree (with its
+        shardings) is the restore target, so ZeRO optimizer shards
+        land directly back on their devices."""
+        tree = self.restore(step, target=wrapper.checkpoint_target())
+        wrapper.load_checkpoint_tree(tree)
+        return wrapper
 
     def restore_latest_valid(self, net=None, *, target=None):
         """Restore the newest step that actually restores, walking

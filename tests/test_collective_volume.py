@@ -75,6 +75,57 @@ def test_dp_broken_sharding_is_caught(cv):
     assert sum(ar) < 1e6   # ~0: the gradient sync is gone
 
 
+def test_zero_dp_reduce_scatter_allgather_and_footprint(cv):
+    """ZeRO-DP sharded weight update (ISSUE 6): the compiled sharded
+    SYNC step moves gradients by reduce-scatter and params by
+    all-gather — at the per-shard/full-tensor byte volumes the flat
+    layout implies — and the resident optimizer state drops to ~1/N
+    of the replicated footprint per device."""
+    from deeplearning4j_tpu.parallel._compat import supports_psum_scatter
+    if not supports_psum_scatter():
+        pytest.skip("this jax cannot express psum_scatter")
+    jitted, args, acct = cv.dp_sharded_wrapper()
+    colls = cv.collectives_of(jitted.lower(*args).compile())
+    rs = [(nb, w) for k, nb, w in colls if k == "reduce-scatter"]
+    ag = [(nb, w) for k, nb, w in colls if k == "all-gather"]
+    assert rs, "sharded step lost its gradient reduce-scatter"
+    assert ag, "sharded step lost its param all-gather"
+    n = 8
+    # reduce-scatter results are the per-device grad shards: total
+    # ≈ grad_bytes/n (pad slack allowed); all-gather results are the
+    # full flat params: total ≈ param_bytes (plus the small loss mean)
+    got_rs = sum(nb for nb, _ in rs)
+    assert acct["grad_bytes"] / n * 0.95 < got_rs \
+        < acct["grad_bytes"] / n * 1.2, (got_rs, acct)
+    got_ag = sum(nb for nb, _ in ag)
+    assert acct["param_bytes"] * 0.95 < got_ag \
+        < acct["param_bytes"] * 1.2, (got_ag, acct)
+    # optimizer-state residency: ~1/N of replicated (adam: 2 moment
+    # trees + scalar counts)
+    ratio = acct["opt_bytes_per_device"] \
+        / acct["opt_bytes_replicated_per_device"]
+    assert 1 / n * 0.8 < ratio < 1 / n * 1.6, acct
+    # and no dense gradient allreduce remains (scatter replaced it)
+    ar = [nb for k, nb, _ in colls if k == "all-reduce"]
+    assert sum(ar) < acct["grad_bytes"] * 0.05, ar
+
+
+def test_zero_dp_replicated_baseline_has_no_scatter(cv):
+    """Canary for the gate above: the SAME wrapper step with
+    ``sharded_update=False`` emits NO reduce-scatter/all-gather — the
+    gradient sync is one fused all-reduce and the optimizer state
+    stays replicated (ratio 1)."""
+    jitted, args, acct = cv.dp_sharded_wrapper(sharded_update=False)
+    colls = cv.collectives_of(jitted.lower(*args).compile())
+    other = [k for k, _, _ in colls
+             if k in ("reduce-scatter", "all-gather")]
+    assert not other, other
+    ar = [nb for k, nb, _ in colls if k == "all-reduce"]
+    assert sum(ar) > acct["grad_bytes"] * 0.95
+    assert acct["opt_bytes_per_device"] \
+        == acct["opt_bytes_replicated_per_device"]
+
+
 def test_tp_mlp_activation_allreduce_only(cv):
     """TP col→row MLP: activations (not params) allreduce — volume is
     activation-sized (≪ param bytes), and no collective-permute."""

@@ -34,6 +34,42 @@ def test_lint_catches_uninstrumented_hot_path(tmp_path):
                for p in problems)
 
 
+def test_lint_catches_step_variant_without_warmup_feed(tmp_path):
+    """Rule 4: a ParallelWrapper step builder missing from
+    WARMUP_FEEDS (or a stale feed, or a warmup() that ignores the
+    table) fails the lint — new step signatures can't silently
+    cold-trace their first real batch."""
+    pdir = tmp_path / "parallel"
+    pdir.mkdir()
+    (pdir / "wrapper.py").write_text(
+        "class ParallelWrapper:\n"
+        "    def _build_sync_step(self):\n"
+        "        pass\n"
+        "    def _build_fancy_new_step(self):\n"
+        "        pass\n"
+        "    def warmup(self, specs):\n"
+        "        return WARMUP_FEEDS\n"
+        "WARMUP_FEEDS = {\n"
+        "    '_build_sync_step': None,\n"
+        "    '_build_removed_step': None,\n"
+        "}\n")
+    problems = lint_instrumentation.run(tmp_path)
+    assert any("_build_fancy_new_step" in p and "WARMUP_FEEDS" in p
+               for p in problems)
+    assert any("_build_removed_step" in p and "stale" in p
+               for p in problems)
+    # dead table: warmup() that never reads WARMUP_FEEDS
+    (pdir / "wrapper.py").write_text(
+        "class ParallelWrapper:\n"
+        "    def _build_sync_step(self):\n"
+        "        pass\n"
+        "    def warmup(self, specs):\n"
+        "        return None\n"
+        "WARMUP_FEEDS = {'_build_sync_step': None}\n")
+    problems = lint_instrumentation.run(tmp_path)
+    assert any("never reads WARMUP_FEEDS" in p for p in problems)
+
+
 def test_lint_catches_listener_side_device_reductions(tmp_path):
     """Rule 3: jnp / jax.tree.map reductions in listener/stats paths
     (the old StatsListener._prev_params pattern) are flagged; the

@@ -92,6 +92,16 @@ can produce one. The orbax sharded path behaves the same:
 `ShardedCheckpointer.restore_latest_valid()` quarantines unrestorable
 step dirs.
 
+**Sharded optimizer checkpoints (ZeRO).** Training with
+`ParallelWrapper(..., sharded_update=True)` carries the optimizer
+state as 1/N shards per replica; checkpoint it with
+`ShardedCheckpointer.save_wrapper(step, wrapper)` and restore with
+`restore_wrapper(wrapper)` onto the SAME mesh topology — each device
+writes/reads only its shard and the replicated layout is never
+materialized. For zip/`ModelSerializer` export, fold first with
+`wrapper.gather_opt_state()` (replicated-layout copy: export only,
+never in the training loop).
+
 **Retries.** `FaultTolerantTrainer` classifies errors
 (`resilience.policy.classify`): transient (OSError/ConnectionError/
 TimeoutError/bare RuntimeError) → restore newest valid checkpoint and

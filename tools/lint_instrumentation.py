@@ -1,7 +1,7 @@
 """Instrumentation lint — the telemetry spine's CI fence (tier-1 via
 ``tests/test_lint_instrumentation.py``).
 
-Three AST rules over ``deeplearning4j_tpu/``:
+Four AST rules over ``deeplearning4j_tpu/``:
 
 1. **Every ``sentry.jit``-wrapped hot path emits obs telemetry.** A
    module that builds jitted entry points with ``sentry.jit(...)`` is
@@ -32,6 +32,14 @@ Three AST rules over ``deeplearning4j_tpu/``:
    scalars. ``jax.tree.leaves`` + numpy stays legal (the explicit
    opt-in host histograms).
 
+4. **Every ``ParallelWrapper`` step variant has a warmup feed.** The
+   wrapper's ``warmup()`` iterates the module-level ``WARMUP_FEEDS``
+   table; a ``_build_*_step`` method without a table entry is a step
+   signature ``perf/warmup.py`` can never AOT-compile — its first
+   real batch cold-traces and stalls the whole mesh. The rule keeps
+   the builder set and the feed table in lockstep (both directions:
+   no missing feeds, no stale feeds).
+
 Exit status 0 = clean; 1 = violations (printed one per line).
 """
 from __future__ import annotations
@@ -61,6 +69,10 @@ _OBS_EMITTERS = {"record_step", "record_etl", "record_worker_step",
 # device reductions over params/grads are banned (obs/numerics.py is
 # the sanctioned in-step home, outside this set by construction)
 LISTENER_STATS_PATHS = {"train/stats.py", "train/listeners.py"}
+
+# rule 4 target: the SPMD wrapper whose step builders must each have a
+# WARMUP_FEEDS entry
+WRAPPER_PATH = "parallel/wrapper.py"
 
 
 def _calls(tree: ast.AST):
@@ -124,6 +136,60 @@ def lint_file(path: Path, rel: str) -> List[str]:
                     "numerics observatory (obs/numerics.py, the "
                     "allowlisted home); consume net.last_numerics / "
                     "obs.numerics.tree_norms scalars instead")
+
+    if rel == WRAPPER_PATH:
+        problems.extend(_lint_wrapper_warmup(tree, rel))
+    return problems
+
+
+def _lint_wrapper_warmup(tree: ast.AST, rel: str) -> List[str]:
+    """Rule 4: every ``_build_*_step`` method on ParallelWrapper has a
+    ``WARMUP_FEEDS`` entry (and no entry is stale), and ``warmup()``
+    actually reads the table."""
+    builders = set()
+    warmup_reads_table = False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and \
+                node.name == "ParallelWrapper":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.FunctionDef):
+                    if sub.name.startswith("_build_") and \
+                            sub.name.endswith("_step"):
+                        builders.add(sub.name)
+                    if sub.name == "warmup":
+                        warmup_reads_table = any(
+                            isinstance(n, ast.Name)
+                            and n.id == "WARMUP_FEEDS"
+                            for n in ast.walk(sub))
+    feeds = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "WARMUP_FEEDS"
+                for t in node.targets):
+            if isinstance(node.value, ast.Dict):
+                feeds = {k.value for k in node.value.keys
+                         if isinstance(k, ast.Constant)
+                         and isinstance(k.value, str)}
+    problems = []
+    if not builders:
+        return problems
+    if feeds is None:
+        return [f"{rel}: no WARMUP_FEEDS dict literal — step variants "
+                "have no warmup feeds and will cold-trace their first "
+                "real batch"]
+    for b in sorted(builders - feeds):
+        problems.append(
+            f"{rel}: step builder {b} has no WARMUP_FEEDS entry — its "
+            "step signature cannot be AOT-warmed and the first real "
+            "batch stalls the mesh on a cold trace")
+    for b in sorted(feeds - builders):
+        problems.append(
+            f"{rel}: WARMUP_FEEDS entry {b!r} names no step builder — "
+            "stale feed (renamed/removed variant?)")
+    if not warmup_reads_table:
+        problems.append(
+            f"{rel}: warmup() never reads WARMUP_FEEDS — the feed "
+            "table is dead and step variants cold-trace")
     return problems
 
 

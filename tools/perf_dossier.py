@@ -690,6 +690,14 @@ def main(names):
     except Exception as e:
         print(f"numerics_observatory: FAILED {type(e).__name__}: {e}")
         failed.append("numerics_observatory")
+    # ZeRO-DP sharded weight update (parallel/zero.py): before/after
+    # row — replicated vs sharded SYNC step time, per-device
+    # optimizer-state bytes, est. peak HBM. Own forced-CPU
+    # 8-virtual-device subprocess (the real-chip box is single-chip;
+    # multi-chip step time lands with the MULTICHIP gate).
+    from deeplearning4j_tpu.parallel import zero
+    payload.append({"config": "zero_dp_sharded_update",
+                    **zero.subprocess_report(), "smoke": SMOKE})
     if out_path:
         Path(out_path).write_text(json.dumps(payload, indent=1))
     if SMOKE:

@@ -109,6 +109,23 @@ _register("DL4J_TPU_FAULT_PLAN", "", str,
           "preempt) or 'site:error=OSError:p=0.5:seed=3;...' rule "
           "syntax — see docs/OPS.md failure & recovery runbook")
 
+# -- elastic fleets (resilience/elastic.py) --------------------------------
+_register("DL4J_TPU_HOST_LEASE_SECS", 15.0, float,
+          "membership lease window: a host whose lease file is older "
+          "than this is evicted from the fleet at the next agreement "
+          "round; the collective watchdog defaults to 2x this window")
+_register("DL4J_TPU_ELASTIC_DIR", None, str,
+          "shared directory for the elastic membership coordinator "
+          "(leases, proposals, committed mesh-epoch record); unset = "
+          "elastic layer off")
+_register("DL4J_TPU_HOST_ID", None, str,
+          "this host's stable identity in the elastic fleet (lease "
+          "file name, deterministic leader ordering)")
+_register("DL4J_TPU_ELASTIC_PORT_BASE", 31300, int,
+          "base port for generation-salted coordination services: "
+          "mesh epoch g binds base+(g mod 1000) so a stale generation "
+          "can never capture the new generation's workers")
+
 # -- UI / examples ---------------------------------------------------------
 _register("DL4J_TPU_UI_PORT", 9000, int,
           "training dashboard HTTP port (DL4JSystemProperties UI port)")

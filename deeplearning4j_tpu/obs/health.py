@@ -30,6 +30,16 @@ def heartbeat(worker: str, t: Optional[float] = None) -> None:
         _beats[str(worker)] = _trace.now() if t is None else t
 
 
+def observe_age(worker: str, age_s: float) -> None:
+    """Record a beat whose AGE is known instead of its timestamp —
+    how the elastic membership coordinator mirrors cross-process lease
+    files (wall-clock deadlines) into this monotonic registry: a peer
+    whose lease is ``age_s`` stale shows the same staleness on
+    ``/healthz`` and ``dl4j_tpu_worker_stale``, so a dying host is
+    named by the scrape surface before the fleet even re-forms."""
+    heartbeat(worker, _trace.now() - max(0.0, float(age_s)))
+
+
 def retire(worker: str) -> None:
     """Forget ``worker``'s heartbeat — called when a worker loop exits
     NORMALLY (``ParallelWrapper.fit`` completing its epochs). Without

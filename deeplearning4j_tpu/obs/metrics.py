@@ -350,6 +350,17 @@ PREEMPTIONS = REGISTRY.counter(
     "dl4j_tpu_preemptions_total",
     "SIGTERM preemption notices honored (checkpoint-and-exit)")
 
+# elastic multi-host training (resilience/elastic.py): the committed
+# membership generation every step is stamped with, and the hosts the
+# coordinator has evicted (missed lease / SIGTERM departure)
+MESH_EPOCH = REGISTRY.gauge(
+    "dl4j_tpu_mesh_epoch",
+    "committed mesh-membership generation this host trains under")
+HOSTS_EVICTED = REGISTRY.counter(
+    "dl4j_tpu_hosts_evicted_total",
+    "hosts forcibly evicted from the fleet after a missed lease "
+    "(graceful SIGTERM departures count preemptions_total instead)")
+
 # parallel training (parallel/wrapper.py): the optimizer-state HBM
 # footprint the ZeRO sharded update divides by N — layout is
 # "replicated" (every device holds full moments) or "sharded" (1/N)

@@ -53,11 +53,38 @@ def batch_sharded(mesh: Mesh, axis: str = "data") -> NamedSharding:
     return NamedSharding(mesh, P(axis))
 
 
+def enable_cpu_collectives() -> bool:
+    """Multi-process collectives on the CPU backend need the gloo
+    transport (the default XLA:CPU backend refuses cross-process
+    computations outright). Must run before backends initialize; a jax
+    without the option (or a non-CPU platform) is a no-op. Returns
+    whether the option was applied."""
+    import os
+    platforms = str(os.environ.get("JAX_PLATFORMS", "")).lower()
+    try:
+        if jax.config.jax_platforms and \
+                "cpu" not in str(jax.config.jax_platforms).lower():
+            return False
+    except AttributeError:
+        if platforms and "cpu" not in platforms:
+            return False
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        return True
+    except Exception:               # pragma: no cover - old/new jax
+        return False
+
+
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
                            process_id: Optional[int] = None) -> None:
     """Multi-host bring-up (reference: SharedTrainingMaster's Spark+Aeron
     bootstrap → jax coordination service). No-op when single-process.
+
+    This is also the re-formation entry point for elastic fleets
+    (``resilience/elastic.py``): a surviving host's fresh process
+    image calls back in here with the NEW world size and the new
+    generation's epoch-salted coordinator port.
 
     Example launcher (replaces spark-submit):
         DL4J_TPU_COORD=host0:1234 DL4J_TPU_NPROC=4 DL4J_TPU_PROC_ID=$i \
@@ -68,10 +95,93 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         "DL4J_TPU_COORD")
     if coordinator_address is None:
         return  # single process
+    enable_cpu_collectives()
+    if num_processes is None:
+        num_processes = int(os.environ["DL4J_TPU_NPROC"])
+    if process_id is None:          # NOT `or`: rank 0 is falsy
+        process_id = int(os.environ["DL4J_TPU_PROC_ID"])
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
-        num_processes=num_processes or int(os.environ["DL4J_TPU_NPROC"]),
-        process_id=process_id or int(os.environ["DL4J_TPU_PROC_ID"]))
+        num_processes=num_processes,
+        process_id=process_id)
+
+
+def initialize_distributed_elastic(coordinator_address: str,
+                                   num_processes: int,
+                                   process_id: int,
+                                   on_fault=None) -> bool:
+    """Distributed bring-up for a PREEMPTIBLE fleet: same coordination
+    service, but the runtime client is built with (a) a custom
+    missed-heartbeat/fault callback instead of the stock one — the
+    stock callback TERMINATES the process the moment the service
+    reports any peer dead, which on a spot fleet is routine, not fatal
+    (the elastic layer's bounded-timeout collectives surface the
+    failure as an exception the re-formation path handles) — and (b)
+    ``shutdown_on_destruction=False``, so a surviving process never
+    blocks in (or aborts on) the exit-time shutdown barrier its dead
+    peers can no longer join.
+
+    Reaches into the runtime's distributed state (the public
+    ``initialize`` does not expose either knob); any mismatch with
+    this runtime's internals falls back to the stock bring-up and
+    returns False — training still works there, but host loss then
+    kills the whole fleet the old way."""
+    import logging
+    logger = logging.getLogger("deeplearning4j_tpu")
+    enable_cpu_collectives()
+    if num_processes <= 1:
+        return True
+    from jax._src import distributed as _dist
+    state = _dist.global_state
+    if getattr(state, "client", None) is not None:
+        # caller bug, not a compat problem: distributed is already up
+        # and a second bring-up can only corrupt it — surface loudly
+        raise RuntimeError(
+            "distributed runtime already initialized; elastic "
+            "re-formation replaces the process image instead of "
+            "re-initializing in place")
+    try:
+        from jaxlib import xla_extension as _xe
+        port = coordinator_address.rsplit(":", 1)[1]
+        cb = on_fault or (lambda status: logger.warning(
+            "elastic: coordination fault (peer died?): %s", status))
+        if process_id == 0 and state.service is None:
+            state.service = _xe.get_distributed_runtime_service(
+                "[::]:" + port, num_processes,
+                heartbeat_interval=10, max_missing_heartbeats=10)
+        state.client = _xe.get_distributed_runtime_client(
+            coordinator_address, process_id, init_timeout=120,
+            heartbeat_interval=10, max_missing_heartbeats=10,
+            missed_heartbeat_callback=cb,
+            shutdown_on_destruction=False, use_compression=True)
+        state.client.connect()
+        state.process_id = process_id
+        state.num_processes = num_processes
+        try:
+            state.initialize_preemption_sync_manager()
+        except Exception:           # pragma: no cover - best effort
+            pass
+        return True
+    except Exception as e:          # internals moved: stock bring-up
+        logger.warning(
+            "elastic distributed bring-up unavailable on this runtime "
+            "(%s); falling back to jax.distributed.initialize — host "
+            "loss will NOT be survivable in-fleet", e)
+        # undo any partial mutation or the stock initialize (which
+        # refuses to run twice) fails too: rank 0's service may
+        # already hold the coordinator port
+        if getattr(state, "client", None) is not None:
+            state.client = None
+        if getattr(state, "service", None) is not None:
+            try:
+                state.service.shutdown()
+            except Exception:       # pragma: no cover - best effort
+                pass
+            state.service = None
+        initialize_distributed(coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+        return False
 
 
 # ---------------------------------------------------------------------------

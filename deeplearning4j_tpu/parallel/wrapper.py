@@ -150,6 +150,12 @@ class ParallelWrapper:
         self._diag_step = None      # numerics diagnostic step (SYNC)
         self._diag_step_monitor = None   # monitor it was built for
         self._diag_unsupported_warned = False
+        #: optional ``resilience.elastic.ElasticContext`` — when set,
+        #: every step is stamped with the mesh epoch (stragglers from
+        #: an old generation raise instead of corrupting collectives)
+        #: and the blocking loss sync runs under the collective
+        #: watchdog; ``None`` costs one branch per step
+        self.elastic = None
 
     # -- builder parity (reference ParallelWrapper.Builder) -------------
     class Builder:
@@ -413,6 +419,89 @@ class ParallelWrapper:
             self._dp_state = tree["opt"]
         else:
             net.opt_state = tree["opt"]
+        net.iteration = int(tree["meta"]["iteration"])
+        net.epoch = int(tree["meta"]["epoch"])
+        return self
+
+    def load_gathered_tree(self, tree, src_layout: str = "zero-flat"):
+        """Install a GATHERED checkpoint tree written at a different
+        world size — the re-scatter half of resharded restore
+        (``ShardedCheckpointer.restore_wrapper(reshard=True)``).
+
+        ``tree`` holds fully-replicated leaves on this wrapper's mesh:
+        params/state in their natural shapes, the optimizer state in
+        the SOURCE layout (``zero-flat`` leaves padded for the source
+        world size — which size is irrelevant here: re-padding is a
+        pure function of the leaf and the target — or plain
+        ``replicated``). Flat leaves are
+        re-padded through ``zero.repad_flat_leaves`` onto THIS
+        wrapper's ``FlatShardLayout`` (bit-exact on real content) and
+        materialized directly as 1/N shards, exactly like
+        ``_init_sharded_opt``; ``net.opt_state`` keeps a host-side
+        replicated copy so zip export and later replicated fits see
+        the restored moments."""
+        import weakref
+        from deeplearning4j_tpu.parallel.zero import (repad_flat_leaves,
+                                                      sharded_leaf)
+        net = self.net
+        net.params = tree["params"]
+        net.state = tree["state"]
+        src_leaves = [np.asarray(l)
+                      for l in jax.tree_util.tree_leaves(tree["opt"])]
+        # replicated-layout reference: the per-leaf original shapes the
+        # flat leaves unflatten back into (positionally aligned — the
+        # flat and replicated optimizer trees share one treedef)
+        rep_ref = jax.eval_shape(net._optimizer.init, net.params)
+        rep_ref_leaves = jax.tree_util.tree_leaves(rep_ref)
+        rep_def = jax.tree_util.tree_structure(rep_ref)
+        if src_layout == "zero-flat":
+            # route the flat→original conversion through
+            # repad_flat_leaves (true-size 1-D refs, then reshape) so
+            # ONE implementation owns the strict zero-tail invariant
+            flat_refs = [
+                want if tuple(cur.shape) == tuple(want.shape)
+                else jax.ShapeDtypeStruct(
+                    (int(np.prod(want.shape)) if want.shape else 1,),
+                    want.dtype)
+                for cur, want in zip(src_leaves, rep_ref_leaves)]
+            rep_leaves = [
+                np.asarray(l).reshape(tuple(want.shape))
+                for l, want in zip(
+                    repad_flat_leaves(src_leaves, flat_refs),
+                    rep_ref_leaves)]
+        else:
+            rep_leaves = src_leaves
+        replicated_opt = jax.tree_util.tree_unflatten(rep_def,
+                                                      rep_leaves)
+        if not self.sharded_update:
+            repl = NamedSharding(self.mesh, P())
+            net.opt_state = jax.tree.map(
+                lambda l: jax.device_put(l, repl), replicated_opt)
+        else:
+            self._check_sharded_update_supported()
+            ref = jax.eval_shape(self._opt_shard_init_fn(), net.params)
+            ref_leaves = jax.tree_util.tree_leaves(ref)
+            ref_def = jax.tree_util.tree_structure(ref)
+            if src_layout == "zero-flat":
+                flat = repad_flat_leaves(src_leaves, ref_leaves)
+            else:
+                flat = repad_flat_leaves(
+                    [np.ravel(l) if l.ndim > 1 else l
+                     for l in src_leaves], ref_leaves)
+            out_sh = jax.tree.map(
+                lambda l: NamedSharding(
+                    self.mesh,
+                    P("data") if sharded_leaf(l, self.n) else P()),
+                ref)
+            self._dp_state = jax.jit(
+                lambda ls: jax.tree_util.tree_unflatten(ref_def, ls),
+                out_shardings=out_sh)(flat)
+            # host copy in the replicated layout — the same eviction
+            # contract _ensure_sharded_state establishes, so
+            # ModelSerializer's zip export keeps folding live shards
+            net.opt_state = jax.tree.map(np.asarray, replicated_opt)
+            self._evicted_opt = net.opt_state
+            net._zero_wrapper = weakref.ref(self)
         net.iteration = int(tree["meta"]["iteration"])
         net.epoch = int(tree["meta"]["epoch"])
         return self
@@ -800,6 +889,15 @@ class ParallelWrapper:
                 seconds += dt
         return {"compiled": compiled, "seconds": seconds}
 
+    def _guarded(self, fn):
+        """Run a step dispatch under the elastic collective watchdog
+        when a context is installed (the collective may block INSIDE
+        the dispatch, not only at the loss sync — e.g. gloo CPU runs
+        the program synchronously); plain call otherwise."""
+        if self.elastic is None:
+            return fn()
+        return self.elastic.run(fn)
+
     def fit(self, iterator, epochs: int = 1):
         """Reference: ParallelWrapper.fit(DataSetIterator).
 
@@ -862,6 +960,10 @@ class ParallelWrapper:
                     break
                 obs.record_etl("ParallelWrapper.fit", te0, obs.now())
                 faults.inject("worker_step")  # site: worker loop body
+                if self.elastic is not None:
+                    # mesh-epoch stamp + lease renewal + the
+                    # host_death drill site (resilience/elastic.py)
+                    self.elastic.pre_step(net.iteration)
                 if n_steps is not None and step_i >= n_steps:
                     break               # stay in lockstep across hosts
                 t0 = obs.now()
@@ -909,45 +1011,56 @@ class ParallelWrapper:
                     self._ensure_diag_step(nm)
                     if self.sharded_update:
                         (net.params, self._dp_state, net.state, loss,
-                         diag) = self._diag_step(
-                            net.params, self._dp_state, net.state, x,
-                            y, rng)
+                         diag) = self._guarded(
+                            lambda: self._diag_step(
+                                net.params, self._dp_state, net.state,
+                                x, y, rng))
                     else:
                         (net.params, net.opt_state, net.state, loss,
-                         diag) = self._diag_step(
-                            net.params, net.opt_state, net.state, x, y,
-                            rng)
+                         diag) = self._guarded(
+                            lambda: self._diag_step(
+                                net.params, net.opt_state, net.state,
+                                x, y, rng))
                 elif self.mode == self.SYNC:
                     if self.sharded_update:
                         (net.params, self._dp_state, net.state,
-                         loss) = self._step(
-                            net.params, self._dp_state, net.state, x,
-                            y, rng)
+                         loss) = self._guarded(
+                            lambda: self._step(
+                                net.params, self._dp_state, net.state,
+                                x, y, rng))
                     else:
                         net.params, net.opt_state, net.state, loss = \
-                            self._step(net.params, net.opt_state,
-                                       net.state, x, y, rng)
+                            self._guarded(
+                                lambda: self._step(
+                                    net.params, net.opt_state,
+                                    net.state, x, y, rng))
                 elif self.mode == self.ENCODED:
                     (net.params, net.opt_state, net.state,
-                     self._dp_state, loss) = self._step(
-                        net.params, net.opt_state, net.state,
-                        self._dp_state, x, y, rng)
+                     self._dp_state, loss) = self._guarded(
+                        lambda: self._step(
+                            net.params, net.opt_state, net.state,
+                            self._dp_state, x, y, rng))
                 elif self.mode == self.ASYNC:
                     p, o, a = self._dp_state
-                    p, o, net.state, a, loss = self._step(
-                        p, o, net.state, a, x, y, rng)
+                    p, o, net.state, a, loss = self._guarded(
+                        lambda: self._step(p, o, net.state, a, x, y,
+                                           rng))
                     self._dp_state = (p, o, a)
                 else:  # AVERAGING
                     p, o = self._dp_state
-                    p, o, net.state, loss = self._step(
-                        p, o, net.state, x, y, rng,
-                        jnp.asarray(net.iteration, jnp.int32))
+                    p, o, net.state, loss = self._guarded(
+                        lambda: self._step(
+                            p, o, net.state, x, y, rng,
+                            jnp.asarray(net.iteration, jnp.int32)))
                     self._dp_state = (p, o)
                 t2 = obs.now()
                 # the float() blocks on the step AND its averaging /
                 # all-reduce collective — this wait is the visible
-                # collective-sync wall time
-                net.score_ = float(loss)
+                # collective-sync wall time; under an elastic context
+                # it runs on the watchdog so a dead peer raises
+                # within the lease window instead of hanging forever
+                net.score_ = float(loss) if self.elastic is None \
+                    else self.elastic.sync(loss)
                 obs.record_worker_step(worker, t0, t1, t2, obs.now())
                 net.iteration += 1
                 if diag is not None:

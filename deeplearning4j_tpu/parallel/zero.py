@@ -130,6 +130,62 @@ class FlatShardLayout:
              for p, d in zip(self.padded, self.dtypes)])
 
 
+class LayoutMismatch(ValueError):
+    """A checkpoint's flat leaves do not belong to the target
+    parameter layout (non-zero data where the zero pad must be, or an
+    un-re-paddable shape). Raised by :func:`repad_flat_leaves`;
+    restore chains treat it as FAIL-FAST configuration error, never as
+    corruption — quarantining would walk the fallback chain and move
+    aside every (perfectly valid) checkpoint of the mismatched net."""
+
+
+def repad_flat_leaves(src_leaves, ref_leaves, *, strict: bool = True):
+    """Re-pad flat-layout leaves written under ONE shard count onto
+    the padded sizes of ANOTHER — the re-scatter half of resharded
+    restore (``ShardedCheckpointer.restore_wrapper(reshard=True)``).
+
+    A flat leaf padded for N devices and the same leaf padded for M
+    devices differ only in the zero tail (``ceil(s/N)*N`` vs
+    ``ceil(s/M)*M`` beyond the true size ``s``), and the zero pad is
+    an *invariant of training*: padded gradient lanes are identically
+    0, so every elementwise optimizer keeps moments and params exactly
+    0 there. Truncate-or-extend with zeros is therefore bit-exact on
+    the real content. ``strict`` verifies the invariant — any
+    truncated tail must be all-zero — so a mismatched layout (wrong
+    net for this checkpoint) fails loudly instead of silently
+    dropping state. Scalar/replicated leaves (optimizer step counts)
+    pass through unchanged. Host-side (numpy): runs once per restore,
+    before device placement."""
+    import numpy as np
+
+    out = []
+    for i, (cur, want) in enumerate(zip(src_leaves, ref_leaves)):
+        cur = np.asarray(cur)
+        wshape = tuple(want.shape)
+        if tuple(cur.shape) == wshape:
+            out.append(cur)
+            continue
+        if cur.ndim != 1 or len(wshape) != 1:
+            raise LayoutMismatch(
+                f"resharded restore: leaf {i} has shape {cur.shape} "
+                f"but the target layout wants {wshape} — only flat "
+                "(1-D padded) leaves can be re-padded")
+        n = int(wshape[0])
+        if cur.size > n:
+            tail = cur[n:]
+            if strict and np.any(tail != 0):
+                raise LayoutMismatch(
+                    f"resharded restore: leaf {i} carries non-zero "
+                    f"data beyond the target padded size {n} "
+                    f"({cur.size} > {n}) — the checkpoint does not "
+                    "match this parameter layout")
+            cur = cur[:n]
+        elif cur.size < n:
+            cur = np.pad(cur, (0, n - cur.size))
+        out.append(cur.astype(want.dtype))
+    return out
+
+
 def sharded_leaf(leaf, n_shards: int) -> bool:
     """Is this optimizer-state leaf carried as 1/N shards under the
     flat layout? Moment trees mirror the flat param leaves — vectors

@@ -20,15 +20,24 @@ PyGraph (PAPERS.md) takes for CUDA-graph capture.
   (transient vs deterministic), :class:`RetryPolicy` exponential
   backoff with seeded jitter, SIGTERM :class:`PreemptionHandler` for
   checkpoint-and-exit-cleanly.
+- :mod:`~deeplearning4j_tpu.resilience.elastic` — the fleet-level
+  layer (ARCHITECTURE.md §13): membership coordinator with
+  generation-numbered mesh epochs (lease files +
+  ``DL4J_TPU_HOST_LEASE_SECS``), bounded-timeout collectives so the
+  peers of a dead host raise instead of hanging, exec-based mesh
+  re-formation at the surviving world size, and reshard-on-restore
+  through ``ShardedCheckpointer``/``FlatShardLayout``.
 
 Consumers: ``ModelSerializer``/``ShardedCheckpointer``
 (``serialization.py``), ``FaultTolerantTrainer``
-(``train/fault_tolerance.py``), ``ParallelInference`` load-shedding
+(``train/fault_tolerance.py``), ``ParallelWrapper`` elastic hooks
+(``parallel/wrapper.py``), ``ParallelInference`` load-shedding
 (``parallel/inference.py``), and ``tools/chaos.py``.
 """
 from deeplearning4j_tpu.resilience import checkpoint as checkpoint
 from deeplearning4j_tpu.resilience import faults as faults
 from deeplearning4j_tpu.resilience import policy as policy
+from deeplearning4j_tpu.resilience import elastic as elastic
 from deeplearning4j_tpu.resilience.checkpoint import (
     newest_valid_checkpoint, quarantine, verify_checkpoint,
     write_manifest)
@@ -40,7 +49,7 @@ from deeplearning4j_tpu.resilience.policy import (Preempted,
                                                   RetryPolicy, classify)
 
 __all__ = [
-    "checkpoint", "faults", "policy",
+    "checkpoint", "elastic", "faults", "policy",
     "newest_valid_checkpoint", "quarantine", "verify_checkpoint",
     "write_manifest", "FaultPlan", "FaultRule", "InjectedFault",
     "NAMED_PLANS", "Preempted", "PreemptionHandler", "RetryPolicy",

@@ -1,0 +1,858 @@
+"""Elastic multi-host training — membership, mesh epochs, re-formation.
+
+PR 3 made ONE process survive faults and preemption; the ZeRO sharded
+update (parallel/zero.py) then spread optimizer state over N replicas.
+This module makes the FLEET survive: losing a host no longer strands
+every peer in a dead collective and every shard on a topology that no
+longer exists (ROADMAP open item 3 — training that rides
+spot/preemptible pools).
+
+Three cooperating pieces (ARCHITECTURE.md §13):
+
+- **Membership coordinator** (:class:`MembershipCoordinator`):
+  generation-numbered *mesh epochs* over a shared directory (the same
+  medium the checkpoints already live on). Hosts hold *leases* —
+  atomic JSON files renewed like heartbeats (and mirrored into the
+  PR 2 ``obs/health.py`` registry, so ``/healthz`` names dead peers).
+  A missed lease (``DL4J_TPU_HOST_LEASE_SECS``) or a SIGTERM
+  :meth:`~MembershipCoordinator.leave` evicts the host; survivors run
+  the propose→ack→commit round of :meth:`agree_membership` so
+  *everyone agrees on the new membership before any collective runs
+  again*. Every commit bumps the epoch (``dl4j_tpu_mesh_epoch``) and
+  is stamped onto every subsequent step: a straggler from an old
+  generation raises :class:`StaleMeshEpoch` instead of silently
+  joining (and corrupting) the new generation's allreduce.
+
+- **Bounded-timeout collectives** (:func:`bounded_sync` /
+  :class:`ElasticContext`): the blocking host↔device sync of every
+  ``ParallelWrapper`` step runs under a watchdog, so the peers of a
+  dead host raise :class:`CollectiveTimeoutError` within the lease
+  window instead of hanging forever (the runtime's own collective
+  error — e.g. a gloo connection reset — surfaces even faster).
+
+- **Re-formation by re-exec** (:meth:`ElasticTrainer.reform`): a
+  wedged collective runtime cannot be torn down in-process — on this
+  runtime family the coordination client *aborts the process* during
+  shutdown once a peer has died — so re-formation replaces the
+  process image (``os.execv``), the one teardown that always works.
+  The fresh image re-runs mesh bring-up (``parallel/mesh.py``) at the
+  agreed world size and *reshard-restores* the newest valid sharded
+  checkpoint (``ShardedCheckpointer.restore_wrapper`` gathers by
+  manifest and re-scatters through ``FlatShardLayout``), resuming the
+  uninterrupted trajectory at the surviving scale.
+
+The coordinator assumes a shared filesystem and crash-stop failures —
+the same assumptions the checkpoint pipeline already makes. Leases use
+the *wall* clock (``time.time``): lease deadlines must be comparable
+across processes, which monotonic clocks are not; hosts of one fleet
+are assumed NTP-close relative to the lease window.
+
+Drilled by ``tools/chaos.py --elastic`` on ``tests/mp_harness.py``:
+SIGKILL one host mid-epoch → survivors detect within the lease
+window, re-form at the reduced world size, reshard-restore, and match
+the same-scale uninterrupted baseline bit-for-bit.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.resilience import checkpoint as _ckpt
+from deeplearning4j_tpu.resilience import faults as _faults
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+#: env passed through ``os.execv`` so the fresh image knows it is a
+#: re-formation (join waits for lease expiry instead of a fixed count)
+#: and can carry the restart counter across the exec boundary
+_REFORM_ENV = "DL4J_TPU_ELASTIC_REFORM"
+_RESTARTS_ENV = "DL4J_TPU_ELASTIC_RESTARTS"
+
+
+class CollectiveTimeoutError(RuntimeError):
+    """A collective (or its host-side sync) outlived the watchdog —
+    the canonical signature of a dead/wedged peer. Classified
+    transient by ``resilience.policy`` (retrying IS the elastic
+    answer: re-form and go again)."""
+
+
+class StaleMeshEpoch(RuntimeError):
+    """This host's mesh generation is no longer the committed one —
+    it slept through a re-formation (GC pause, SIGSTOP, slow restore)
+    and must NOT touch the new generation's collectives."""
+
+
+class Evicted(RuntimeError):
+    """The committed membership no longer includes this host — its
+    lease lapsed and the survivors moved on. The only safe action is
+    to exit (rejoining means a fresh :meth:`MembershipCoordinator.join`
+    at the next epoch)."""
+
+
+def _read_json(path: Path) -> Optional[dict]:
+    """Tolerant read: a missing or torn file is ``None``, never an
+    exception — every coordinator file is written atomically, so a
+    torn read means 'concurrent writer', i.e. retry."""
+    try:
+        return json.loads(Path(path).read_text())
+    except (OSError, ValueError):
+        return None
+
+
+def _write_json(path: Path, obj: dict) -> None:
+    _ckpt.atomic_write_bytes(path, (json.dumps(obj) + "\n").encode())
+
+
+class _WatchdogThread:
+    """One reusable DAEMON worker thread running submitted callables
+    under a timeout — the per-step form of :func:`bounded_sync`
+    without a thread spawn per step. Daemon on purpose: a worker
+    wedged inside a dead collective must never block interpreter
+    exit (and after a timeout the caller re-forms by exec anyway)."""
+
+    def __init__(self, name: str = "dl4j-collective-watchdog"):
+        import queue
+        self._name = name
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+
+    def _loop(self):
+        while True:
+            item = self._q.get()
+            if item is None:        # close() sentinel
+                return
+            fn, box, done = item
+            try:
+                box["v"] = fn()
+            except BaseException as e:
+                box["e"] = e
+            finally:
+                done.set()
+
+    def run(self, fn: Callable[[], object], timeout_s: float,
+            what: str = "collective"):
+        if not timeout_s or timeout_s <= 0:
+            return fn()
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name=self._name)
+            self._thread.start()
+        box: dict = {}
+        done = threading.Event()
+        self._q.put((fn, box, done))
+        if not done.wait(timeout_s):
+            # the worker is stuck in the dead collective; abandon it —
+            # the next run() starts a fresh thread if needed (it won't
+            # be: the caller's answer to a timeout is re-formation)
+            self._thread = None
+            raise CollectiveTimeoutError(
+                f"{what} did not complete within {timeout_s:.1f}s — a "
+                "peer is dead or wedged; tear down and re-form the "
+                "mesh")
+        if "e" in box:
+            raise box["e"]
+        return box.get("v")
+
+    def close(self) -> None:
+        """Let the worker exit once idle (a worker stuck inside a dead
+        collective drains the sentinel whenever — or never — it
+        returns; it is a daemon either way)."""
+        if self._thread is not None and self._thread.is_alive():
+            self._q.put(None)
+        self._thread = None
+
+
+def bounded_sync(fn: Callable[[], object], timeout_s: float,
+                 what: str = "collective"):
+    """Run a blocking device sync under a watchdog: returns ``fn()``'s
+    value, re-raises its exception, or raises
+    :class:`CollectiveTimeoutError` after ``timeout_s``. The wedged
+    operation itself cannot be cancelled — the caller must treat a
+    timeout as fatal to the collective context (re-form, don't retry
+    in place). One-shot form of :class:`_WatchdogThread` (which the
+    per-step path holds long-lived to avoid a spawn per step); the
+    throwaway worker is told to exit so repeated calls don't
+    accumulate parked threads."""
+    w = _WatchdogThread()
+    try:
+        return w.run(fn, timeout_s, what)
+    finally:
+        w.close()
+
+
+class MembershipCoordinator:
+    """File-plane membership with generation-numbered mesh epochs.
+
+    Layout under ``directory``::
+
+        members/<host>.json        live lease (atomic, renewed)
+        members/evicted/...        expired leases, moved aside
+        proposals/<g>.json         leader's proposed membership
+        proposals/<g>.ack.<host>   member acknowledgements
+        epoch.json                 the committed mesh epoch record
+
+    The *leader* is simply the lexicographically-first live host —
+    deterministic from any coherent view, no election traffic. A
+    commit requires every proposed member's ack, so no survivor can
+    run a collective against a membership its peers never agreed to.
+    """
+
+    def __init__(self, directory, host_id: str, *,
+                 n_devices: Optional[int] = None,
+                 addr: Optional[str] = None,
+                 lease_secs: Optional[float] = None,
+                 port_base: Optional[int] = None,
+                 clock: Callable[[], float] = time.time):
+        from deeplearning4j_tpu import environment
+        self.dir = Path(directory)
+        self.host = str(host_id)
+        self.addr = addr or os.environ.get("DL4J_TPU_HOST_ADDR",
+                                           "127.0.0.1")
+        self.n_devices = n_devices
+        self.lease_secs = float(
+            lease_secs if lease_secs is not None
+            else environment.get_flag("DL4J_TPU_HOST_LEASE_SECS"))
+        self.port_base = int(
+            port_base if port_base is not None
+            else environment.get_flag("DL4J_TPU_ELASTIC_PORT_BASE"))
+        self.clock = clock
+        self._members = self.dir / "members"
+        self._proposals = self.dir / "proposals"
+        self._members.mkdir(parents=True, exist_ok=True)
+        self._proposals.mkdir(parents=True, exist_ok=True)
+        self._renew_thread: Optional[threading.Thread] = None
+        self._renew_stop = threading.Event()
+        self._last_renew = 0.0
+        # the auto-renew thread and the per-step maybe_renew share one
+        # pid-keyed tmp file — serialize them or one replace()s the
+        # tmp out from under the other
+        self._renew_lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls, **kw) -> "MembershipCoordinator":
+        """Coordinator from the standing flags: shared directory from
+        ``DL4J_TPU_ELASTIC_DIR`` (required), host identity from
+        ``DL4J_TPU_HOST_ID`` (default: hostname-pid — stable across
+        the exec-based re-formation, which preserves the pid)."""
+        import socket
+        from deeplearning4j_tpu import environment
+        d = environment.get_flag("DL4J_TPU_ELASTIC_DIR")
+        if not d:
+            raise ValueError(
+                "DL4J_TPU_ELASTIC_DIR is not set — the elastic "
+                "membership coordinator needs a shared directory")
+        host = environment.get_flag("DL4J_TPU_HOST_ID") or \
+            f"{socket.gethostname()}-{os.getpid()}"
+        return cls(d, host, **kw)
+
+    # -- leases ---------------------------------------------------------
+    def _lease_path(self, host: str) -> Path:
+        return self._members / f"{host}.json"
+
+    def renew(self) -> None:
+        """Refresh this host's lease (the cross-process heartbeat) and
+        mirror every known lease age into ``obs/health.py`` so
+        ``/healthz`` + ``dl4j_tpu_worker_stale`` name dead peers."""
+        _faults.inject("coordinator")
+        from deeplearning4j_tpu.obs import health
+        with self._renew_lock:
+            now = self.clock()
+            _write_json(self._lease_path(self.host), {
+                "host": self.host, "pid": os.getpid(),
+                "addr": self.addr, "n_devices": self.n_devices,
+                "t": now, "lease_secs": self.lease_secs})
+            self._last_renew = now
+        for host, lease in self._leases().items():
+            health.observe_age(f"host:{host}",
+                               max(0.0, now - lease.get("t", 0.0)))
+
+    def maybe_renew(self, every: Optional[float] = None) -> bool:
+        """Renew when more than ``every`` (default: a third of the
+        lease) has passed — the per-step hook stays cheap. Returns
+        whether a renewal actually happened (the epoch-stamp check
+        piggybacks on the same cadence: a host that never went a
+        renewal interval without stepping cannot have slept through a
+        re-formation)."""
+        every = self.lease_secs / 3.0 if every is None else every
+        if self.clock() - self._last_renew >= every:
+            self.renew()
+            return True
+        return False
+
+    def start_auto_renew(self) -> None:
+        """Background lease renewal — keeps the host live through long
+        compiles/restores. Liveness of the *process* is the right
+        signal: a wedged-but-alive straggler is fenced by the mesh
+        epoch stamp, not by lease expiry."""
+        if self._renew_thread is not None:
+            return
+        self._renew_stop.clear()
+
+        def run():
+            while not self._renew_stop.wait(self.lease_secs / 3.0):
+                try:
+                    self.renew()
+                except Exception:   # pragma: no cover - best effort
+                    logger.exception("lease auto-renew failed")
+
+        self._renew_thread = threading.Thread(
+            target=run, daemon=True, name="dl4j-lease-renew")
+        self._renew_thread.start()
+
+    def stop_auto_renew(self) -> None:
+        if self._renew_thread is None:
+            return
+        self._renew_stop.set()
+        self._renew_thread.join(timeout=2.0)
+        self._renew_thread = None
+
+    def leave(self) -> None:
+        """Graceful departure (the SIGTERM path): drop the lease NOW so
+        survivors evict this host at the next agreement instead of
+        waiting out the lease window."""
+        self.stop_auto_renew()
+        self._lease_path(self.host).unlink(missing_ok=True)
+
+    def _leases(self) -> Dict[str, dict]:
+        out = {}
+        for p in sorted(self._members.glob("*.json")):
+            lease = _read_json(p)
+            if lease and "host" in lease:
+                out[str(lease["host"])] = lease
+        return out
+
+    def live_members(self, now: Optional[float] = None) -> List[str]:
+        now = self.clock() if now is None else now
+        live = []
+        for host, lease in self._leases().items():
+            if now - lease.get("t", 0.0) <= lease.get(
+                    "lease_secs", self.lease_secs):
+                live.append(host)
+        return sorted(live)
+
+    def evict_expired(self, now: Optional[float] = None) -> List[str]:
+        """Move expired leases to ``members/evicted/`` (kept for
+        post-mortems, out of every live scan) and count them in
+        ``dl4j_tpu_hosts_evicted_total``."""
+        from deeplearning4j_tpu import obs
+        now = self.clock() if now is None else now
+        evicted = []
+        dest = self._members / "evicted"
+        for host, lease in self._leases().items():
+            age = now - lease.get("t", 0.0)
+            if age <= lease.get("lease_secs", self.lease_secs):
+                continue
+            dest.mkdir(parents=True, exist_ok=True)
+            try:
+                os.replace(self._lease_path(host),
+                           dest / f"{host}.{now:.0f}.json")
+            except OSError:
+                continue            # a peer moved it first — fine
+            evicted.append(host)
+            obs.metrics.HOSTS_EVICTED.inc()
+            logger.warning(
+                "elastic: evicted host %r (lease %.1fs overdue)",
+                host, age - self.lease_secs)
+        return evicted
+
+    # -- mesh epochs ----------------------------------------------------
+    def epoch_record(self) -> Optional[dict]:
+        """The committed mesh epoch: ``{"epoch", "members",
+        "coordinator", "addr", "port"}`` (None before first
+        formation)."""
+        return _read_json(self.dir / "epoch.json")
+
+    def committed_epoch(self) -> int:
+        rec = self.epoch_record()
+        return int(rec["epoch"]) if rec else 0
+
+    def check_epoch(self, epoch: int) -> None:
+        """Reject a straggler: raises :class:`StaleMeshEpoch` when the
+        committed generation has moved past ``epoch`` — this host must
+        not touch the new generation's collectives."""
+        cur = self.committed_epoch()
+        if cur != int(epoch):
+            raise StaleMeshEpoch(
+                f"host {self.host!r} runs mesh epoch {epoch} but the "
+                f"fleet committed epoch {cur} — this process slept "
+                "through a re-formation and must re-join, not compute")
+
+    def agree_membership(self, timeout_s: float = 60.0,
+                         poll_s: float = 0.05) -> dict:
+        """One agreement round: evict expired leases, leader proposes
+        the live set at generation ``committed+1``, every proposed
+        member acks, leader commits. Idempotent — when the committed
+        record already names exactly the live set, it is returned
+        as-is (the steady-state fast path). Every survivor returns the
+        SAME record; a host that finds itself excluded raises
+        :class:`Evicted`."""
+        _faults.inject("coordinator")
+        deadline = self.clock() + timeout_s
+        self.renew()
+        last_ack = None             # (g, members) last written
+        while True:
+            now = self.clock()
+            if now > deadline:
+                raise TimeoutError(
+                    f"membership agreement did not converge within "
+                    f"{timeout_s}s (live={self.live_members()})")
+            self.evict_expired(now)
+            live = self.live_members(now)
+            if self.host not in live:
+                # our own lease lapsed mid-agreement: re-joining at
+                # the NEXT epoch is the elastic semantic for a host
+                # that is demonstrably alive (the leader will include
+                # the fresh lease in its superseding proposal);
+                # :class:`Evicted` fires only when the fleet has
+                # ALREADY committed a membership without us (fast
+                # path above / :meth:`rank_of`)
+                self.renew()
+                live = self.live_members()
+            cur = self.epoch_record()
+            if cur and sorted(cur.get("members", [])) == live:
+                if self.host not in live:
+                    raise Evicted(
+                        f"host {self.host!r} is not in the committed "
+                        f"membership {live}")
+                return cur
+            g = (int(cur["epoch"]) if cur else 0) + 1
+            leader = live[0] if live else self.host
+            prop_path = self._proposals / f"{g}.json"
+            prop = _read_json(prop_path)
+            if leader == self.host and prop is not None and \
+                    sorted(prop.get("members", [])) != live:
+                # SUPERSEDE a stale proposal: a proposed member died
+                # before acking (its ack can never arrive), or the
+                # proposer itself is gone — without this overwrite,
+                # generation g could never commit and the fleet would
+                # be permanently unable to form
+                prop = None
+            if prop is None and leader == self.host:
+                prop = {"epoch": g, "members": live,
+                        "coordinator": leader,
+                        "addr": self._leases().get(leader, {}).get(
+                            "addr", self.addr),
+                        "port": self.port_base + (g % 1000)}
+                _write_json(prop_path, prop)
+            if prop is not None and self.host in prop["members"]:
+                # the ack names the member set it is FOR, so acks of a
+                # superseded proposal cannot count toward the new one;
+                # written only when (g, set) changes — not per poll
+                ack_key = (g, tuple(sorted(prop["members"])))
+                if ack_key != last_ack:
+                    _write_json(
+                        self._proposals / f"{g}.ack.{self.host}",
+                        {"host": self.host, "epoch": g,
+                         "members": sorted(prop["members"])})
+                    last_ack = ack_key
+            if prop is not None and leader == self.host:
+                # strip the "<g>.ack." prefix (NOT Path.suffix — host
+                # ids may legitimately contain dots, e.g. hostnames)
+                ack_prefix = f"{g}.ack."
+                acks = set()
+                for a in self._proposals.glob(f"{g}.ack.*"):
+                    data = _read_json(a)
+                    if data and sorted(data.get("members", [])) == \
+                            sorted(prop["members"]):
+                        acks.add(a.name[len(ack_prefix):])
+                if all(m in acks for m in prop["members"]):
+                    _write_json(self.dir / "epoch.json", prop)
+                    from deeplearning4j_tpu import obs
+                    obs.metrics.MESH_EPOCH.set(g)
+                    logger.warning(
+                        "elastic: committed mesh epoch %d members=%s",
+                        g, prop["members"])
+            time.sleep(poll_s)
+
+    def join(self, expected: Optional[int] = None,
+             timeout_s: float = 120.0,
+             settle_s: Optional[float] = None) -> dict:
+        """Initial formation / re-join. With ``expected`` the host
+        waits for that many live leases (fast, for coordinated
+        launches); without it the live set must hold STABLE for
+        ``settle_s`` (default: one lease window) — long enough for a
+        dead host's lease to expire so a post-failure re-formation
+        cannot re-commit the corpse. Then one :meth:`agree_membership`
+        round commits (or confirms) the epoch."""
+        settle = self.lease_secs if settle_s is None else settle_s
+        deadline = self.clock() + timeout_s
+        self.renew()
+        stable_since = self.clock()
+        prev = self.live_members()
+        while True:
+            now = self.clock()
+            if now > deadline:
+                raise TimeoutError(
+                    f"join did not converge within {timeout_s}s "
+                    f"(live={prev}, expected={expected})")
+            live = self.live_members(now)
+            if expected is not None:
+                if len(live) >= expected:
+                    break
+            else:
+                if live != prev:
+                    prev, stable_since = live, now
+                elif now - stable_since >= settle:
+                    break
+            time.sleep(min(0.05, self.lease_secs / 10))
+            # keep our lease fresh at the normal cadence — a full
+            # fsync'd write every 50ms poll would hammer the shared
+            # filesystem for nothing
+            self.maybe_renew()
+        rec = self.agree_membership(
+            timeout_s=max(5.0, deadline - self.clock()))
+        from deeplearning4j_tpu import obs
+        obs.metrics.MESH_EPOCH.set(int(rec["epoch"]))
+        return rec
+
+    def rank_of(self, rec: dict) -> int:
+        members = sorted(rec["members"])
+        if self.host not in members:
+            raise Evicted(f"host {self.host!r} not in {members}")
+        return members.index(self.host)
+
+
+class ElasticContext:
+    """Per-step elastic hooks installed on a ``ParallelWrapper``
+    (``wrapper.elastic = ElasticContext(...)``): stamp + verify the
+    mesh epoch before every dispatch, renew the lease, and run the
+    blocking loss sync under the collective watchdog. This is where
+    the ``host_death`` fault-injection site lives, so membership-change
+    paths are drillable like every other failure mode
+    (``DL4J_TPU_FAULT_PLAN=host-preempt``)."""
+
+    def __init__(self, coordinator: MembershipCoordinator, record: dict,
+                 collective_timeout_s: Optional[float] = None,
+                 compile_grace_s: float = 300.0):
+        self.coordinator = coordinator
+        self.record = record
+        self.epoch = int(record["epoch"])
+        # default: two lease windows — a dead peer's lease expires and
+        # is evictable by the time the watchdog fires
+        self.collective_timeout_s = (
+            2.0 * coordinator.lease_secs
+            if collective_timeout_s is None else collective_timeout_s)
+        # the FIRST dispatch of a fresh process image compiles the
+        # step (tens of seconds on real hardware) — it gets this much
+        # headroom before the watchdog calls it a dead peer
+        self.compile_grace_s = float(compile_grace_s)
+        self.last_step_wall: Optional[float] = None
+        self._watchdog = _WatchdogThread()
+        self._dispatched_once = False
+        self._last_epoch_check = 0.0
+
+    def pre_step(self, iteration: int) -> None:
+        from deeplearning4j_tpu import obs
+        _faults.inject("host_death")
+        now = self.coordinator.clock()
+        self.last_step_wall = now
+        self.coordinator.maybe_renew()
+        # epoch stamp on its OWN lease/3 cadence (NOT gated on
+        # maybe_renew's return — the auto-renew thread refreshes the
+        # lease at the same interval, which would starve the check):
+        # reading the committed record (a shared-FS hit) every single
+        # step buys nothing, since a host that never went a third of
+        # a lease without stepping cannot have slept through a
+        # re-formation
+        if now - self._last_epoch_check >= \
+                self.coordinator.lease_secs / 3.0:
+            self._last_epoch_check = now
+            self.coordinator.check_epoch(self.epoch)
+            obs.metrics.MESH_EPOCH.set(self.epoch)
+
+    def run(self, fn: Callable[[], object]):
+        """A step dispatch under the watchdog — a dead peer turns an
+        indefinite in-dispatch collective hang into a
+        :class:`CollectiveTimeoutError` within the window. One
+        long-lived daemon worker serves every step (no thread spawn
+        on the hot path). The first dispatch of this context runs
+        under ``compile_grace_s`` instead: a cold XLA compile is not
+        a dead peer."""
+        timeout = self.collective_timeout_s
+        if not self._dispatched_once:
+            timeout = max(timeout, self.compile_grace_s)
+        out = self._watchdog.run(fn, timeout,
+                                 what=f"step (mesh epoch "
+                                      f"{self.epoch})")
+        self._dispatched_once = True
+        return out
+
+    def sync(self, value):
+        """The step's blocking device sync (``float(loss)``) under the
+        watchdog — same contract as :meth:`run` for runtimes whose
+        dispatch is async and whose block lands on the host read."""
+        return self._watchdog.run(
+            lambda: float(value), self.collective_timeout_s,
+            what=f"step sync (mesh epoch {self.epoch})")
+
+
+def elastic_env(rec: dict) -> Dict[str, str]:
+    """The distributed bring-up env for a committed epoch record —
+    what ``parallel/mesh.py::initialize_distributed`` reads. The port
+    is epoch-salted so a stale generation's coordination service can
+    never capture the new generation's workers."""
+    members = sorted(rec["members"])
+    return {
+        "DL4J_TPU_COORD": f"{rec.get('addr', '127.0.0.1')}"
+                          f":{rec['port']}",
+        "DL4J_TPU_NPROC": str(len(members)),
+    }
+
+
+def reform_exec(restarts: int, argv: Optional[List[str]] = None) -> None:
+    """Re-formation by image replacement: the wedged collective
+    runtime cannot be shut down in-process (the coordination client
+    aborts the process once a peer died), so survivors ``exec`` a
+    fresh image that re-runs bring-up at the new world size. Never
+    returns."""
+    from deeplearning4j_tpu import obs
+    os.environ[_REFORM_ENV] = "1"
+    os.environ[_RESTARTS_ENV] = str(restarts)
+    obs.metrics.RESILIENCE_RESTARTS.inc()
+    argv = list(sys.argv if argv is None else argv)
+    logger.warning("elastic: re-forming by exec (restart %d): %s",
+                   restarts, [sys.executable] + argv)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execv(sys.executable, [sys.executable] + argv)
+
+
+def is_reform() -> bool:
+    """True in a process image produced by :func:`reform_exec`."""
+    return os.environ.get(_REFORM_ENV) == "1"
+
+
+def prior_restarts() -> int:
+    try:
+        return int(os.environ.get(_RESTARTS_ENV, "0"))
+    except ValueError:
+        return 0
+
+
+class ElasticTrainer:
+    """The per-host elastic training loop: bring up membership, form
+    the mesh at the agreed world size, reshard-restore the newest
+    valid checkpoint, train under bounded-timeout collectives, and
+    re-form (by exec) when a peer dies.
+
+    ``net_factory``: builds a fresh initialized net (the restore
+    template). Checkpoints go through
+    ``ShardedCheckpointer.save_wrapper`` every ``save_every``
+    iterations — each device writes only its 1/N optimizer shard, and
+    restore reshards onto whatever world size survived
+    (``restore_wrapper(..., reshard=True)``).
+    """
+
+    def __init__(self, net_factory: Callable[[], object], ckpt_dir, *,
+                 coordinator: MembershipCoordinator,
+                 sharded_update: bool = True,
+                 save_every: int = 2, keep_last: int = 20,
+                 collective_timeout_s: Optional[float] = None,
+                 max_reforms: int = 5):
+        self.net_factory = net_factory
+        self.ckpt_dir = Path(ckpt_dir)
+        self.coordinator = coordinator
+        self.sharded_update = sharded_update
+        self.save_every = save_every
+        self.keep_last = keep_last
+        self.collective_timeout_s = collective_timeout_s
+        self.max_reforms = max_reforms
+        self.wrapper = None
+        self.net = None
+        self.record: Optional[dict] = None
+        self.resumed_step: Optional[int] = None
+        self._ck = None
+
+    # -- bring-up -------------------------------------------------------
+    def bring_up(self, expected: Optional[int] = None):
+        """Join → agree → form the mesh → reshard-restore. Returns the
+        (wrapper, epoch record) pair ready to train. ``expected`` is
+        the launch-time host count; a re-exec'd image ignores it and
+        waits for the live set to settle instead (the dead host's
+        lease must expire before the new generation commits)."""
+        from deeplearning4j_tpu import obs
+        from deeplearning4j_tpu.parallel import mesh as _mesh
+        from deeplearning4j_tpu.serialization import ShardedCheckpointer
+
+        co = self.coordinator
+        restarts = prior_restarts()
+        if restarts:
+            # the restart counter crossed the exec boundary in env;
+            # fold it back into the fresh image's metrics registry
+            obs.metrics.RESILIENCE_RESTARTS.inc(restarts)
+        rec = co.join(expected=None if is_reform() else expected)
+        co.start_auto_renew()
+        members = sorted(rec["members"])
+        if len(members) > 1:
+            env = elastic_env(rec)
+            _mesh.initialize_distributed_elastic(
+                env["DL4J_TPU_COORD"],
+                num_processes=len(members),
+                process_id=co.rank_of(rec))
+        import jax
+        from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+        self.net = self.net_factory()
+        self.wrapper = ParallelWrapper(
+            self.net, sharded_update=self.sharded_update,
+            prefetch_buffer=0)
+        self.wrapper.elastic = ElasticContext(
+            co, rec, collective_timeout_s=self.collective_timeout_s)
+        self.record = rec
+        self._ck = ShardedCheckpointer(self.ckpt_dir,
+                                       keep_last=self.keep_last,
+                                       async_save=False)
+        if self._ck.all_steps():
+            self._ck.restore_latest_valid(wrapper=self.wrapper)
+            self.resumed_step = int(self.net.iteration)
+            logger.warning(
+                "elastic: host %s resumed step %d at world size %d "
+                "(mesh epoch %d, %d device(s))", co.host,
+                self.resumed_step, len(members), rec["epoch"],
+                len(jax.devices()))
+        return self.wrapper, rec
+
+    # -- checkpoint listener -------------------------------------------
+    class _SaveListener:
+        """Collective sharded save every k iterations — every host
+        calls ``save_wrapper`` at the same step (the fit loops run in
+        lockstep), so each device publishes exactly its shard."""
+
+        def __init__(self, trainer: "ElasticTrainer"):
+            self.t = trainer
+
+        def iteration_done(self, net, iteration, epoch):
+            t = self.t
+            if t.save_every and iteration % t.save_every == 0:
+                t._ck.save_wrapper(
+                    iteration, t.wrapper, wait=True,
+                    mesh_epoch=int(t.record["epoch"]))
+
+        def on_epoch_start(self, net):
+            pass
+
+        def on_epoch_end(self, net):
+            pass
+
+    # -- the loop -------------------------------------------------------
+    def fit(self, iterator, epochs: int, expected: Optional[int] = None):
+        """Train to ``epochs`` total epochs, surviving host loss. On a
+        peer failure (collective timeout/error, stale epoch) the host
+        re-forms via exec and THIS CALL NEVER RETURNS — the fresh
+        image must re-run the same script, whose ``fit`` resumes from
+        the reshard-restored checkpoint. Returns ``"done"`` on
+        completion, ``"preempted"`` after a clean SIGTERM departure."""
+        import jax
+        from deeplearning4j_tpu.resilience.policy import (
+            Preempted, PreemptionHandler)
+        if self.wrapper is None:
+            self.bring_up(expected=expected)
+        net = self.net
+        listener = self._SaveListener(self)
+        if listener not in net.listeners:
+            net.listeners.append(listener)
+        handler = None
+        try:
+            handler = PreemptionHandler().install()
+        except ValueError:          # not the main thread
+            handler = None
+
+        class _PreemptGate:
+            def iteration_done(self, _net, _it, _ep):
+                if handler is not None and handler.requested:
+                    raise Preempted()
+
+            def on_epoch_start(self, _net):
+                pass
+
+            def on_epoch_end(self, _net):
+                pass
+
+        gate = _PreemptGate()
+        net.listeners.append(gate)
+        try:
+            while net.epoch < epochs:
+                self.wrapper.fit(iterator, epochs=1)
+            # final save — unless the per-k listener already published
+            # this exact step (orbax refuses to overwrite a step)
+            if self.save_every and \
+                    net.iteration not in self._ck.all_steps():
+                self._ck.save_wrapper(net.iteration, self.wrapper,
+                                      wait=True,
+                                      mesh_epoch=int(
+                                          self.record["epoch"]))
+            return "done"
+        except Preempted:
+            # graceful departure: drop the lease so survivors evict us
+            # at the next agreement; a single-host world checkpoints
+            # first (no peers are needed for that save)
+            from deeplearning4j_tpu import obs
+            obs.metrics.PREEMPTIONS.inc()
+            if len(self.record["members"]) == 1 and self.save_every \
+                    and net.iteration not in self._ck.all_steps():
+                # skip when the per-k listener already published this
+                # exact step (orbax refuses to overwrite a step)
+                self._ck.save_wrapper(net.iteration, self.wrapper,
+                                      wait=True,
+                                      mesh_epoch=int(
+                                          self.record["epoch"]))
+            self.coordinator.leave()
+            return "preempted"
+        except Evicted:
+            raise
+        except (CollectiveTimeoutError, StaleMeshEpoch) as e:
+            # dead-peer / stale-straggler signals: re-forming (exec →
+            # join the new epoch) is the designed answer for both
+            self.reform(e)          # never returns
+        except Exception as e:
+            from deeplearning4j_tpu.resilience.policy import (
+                TRANSIENT, classify)
+            # XlaRuntimeError = the collective runtime itself failed
+            # (gloo reset, ICI fault): ALWAYS a re-formation matter,
+            # whatever keywords its message happens to carry
+            if type(e).__name__ == "XlaRuntimeError" or \
+                    classify(e) == TRANSIENT:
+                self.reform(e)      # never returns
+            # deterministic failures (shape bugs, NonFiniteError...)
+            # would recur identically after every reform — surface
+            # them instead of burning max_reforms fleet-wide
+            # exec/restore cycles on an error no re-formation can fix
+            raise
+        finally:
+            for l in (listener, gate):
+                if l in net.listeners:
+                    net.listeners.remove(l)
+            if handler is not None:
+                handler.uninstall()
+
+    def reform(self, cause: BaseException) -> None:
+        """Peer-failure answer: record the cause, stop renewing from
+        this doomed image, and exec a fresh one. Membership agreement
+        happens in the NEW image's :meth:`bring_up` — the old image
+        still hosts the wedged runtime, whose distributed client may
+        abort the process at any moment; the file plane work must not
+        race against that."""
+        restarts = prior_restarts() + 1
+        if restarts > self.max_reforms:
+            raise RuntimeError(
+                f"elastic: {restarts} re-formations exceed the budget "
+                f"({self.max_reforms}); last cause: {cause!r}") \
+                from cause
+        ctx = getattr(self.wrapper, "elastic", None)
+        detect_s = -1.0
+        if ctx is not None and ctx.last_step_wall is not None:
+            detect_s = self.coordinator.clock() - ctx.last_step_wall
+        # structured breadcrumb the chaos drill parses: the bounded-
+        # timeout raise happened, this long after the last dispatch
+        logger.warning(
+            "ELASTIC_REFORM host=%s epoch=%s cause=%s detect_s=%.2f",
+            self.coordinator.host,
+            self.record and self.record.get("epoch"),
+            type(cause).__name__, detect_s)
+        self.coordinator.stop_auto_renew()
+        reform_exec(restarts)

@@ -20,6 +20,13 @@ site                      where it fires
                           iterator yields
 ``worker_step``           ``ParallelWrapper.fit`` per-worker loop body
 ``serving``               ``ParallelInference`` dispatch worker, per batch
+``host_death``            ``elastic.ElasticContext.pre_step`` — every
+                          elastic step on every host (``error=exit`` is
+                          the in-process kill -9 analog, ``sigterm`` a
+                          preemption notice for ONE host of a fleet)
+``coordinator``           ``elastic.MembershipCoordinator`` lease renewal
+                          and agreement rounds (coordination-plane IO
+                          flakes)
 ========================  ===================================================
 
 Plans are env-gated (``DL4J_TPU_FAULT_PLAN``) and the **off path is one
@@ -95,7 +102,8 @@ def _error_class(name: str):
 #: rule sites are validated against this at parse time so a typo'd
 #: plan fails loudly instead of silently never firing
 KNOWN_SITES = frozenset({"ckpt_write", "ckpt_commit", "step",
-                         "iterator", "worker_step", "serving"})
+                         "iterator", "worker_step", "serving",
+                         "host_death", "coordinator"})
 
 #: the chaos vocabulary: plan names accepted by ``FaultPlan.parse``,
 #: ``tools/chaos.py --plan`` and ``DL4J_TPU_FAULT_PLAN`` itself
@@ -111,6 +119,12 @@ NAMED_PLANS = {
     "serving-crash": "serving:error=RuntimeError:nth=2:max=1",
     # self-delivered SIGTERM mid-fit (preemption notice)
     "preempt": "step:error=sigterm:nth=5:max=1",
+    # one host of an elastic fleet gets its preemption notice mid-run
+    # (elastic step site): graceful leave -> survivors evict + re-form
+    "host-preempt": "host_death:error=sigterm:nth=4:max=1",
+    # coordination-plane IO flakes: lease renewals / agreement rounds
+    # hit a flaky shared filesystem
+    "coord-flake": "coordinator:error=OSError:p=0.4:seed=9:max=2",
 }
 
 _EXIT_CODE = 17         # `error=exit` status — distinguishable from crashes

@@ -19,6 +19,7 @@ import io
 import json
 import logging
 import os
+import shutil
 import zipfile
 from pathlib import Path
 from typing import Any, Optional
@@ -275,29 +276,149 @@ class ShardedCheckpointer:
             return net
         return tree
 
-    def save_wrapper(self, step: int, wrapper, *, wait: bool = False):
+    # -- world manifests (elastic resharded restore) --------------------
+    def _world_manifest_path(self, step: int) -> Path:
+        return self.directory / f"world_{int(step)}.json"
+
+    def world_manifest(self, step: int) -> Optional[dict]:
+        """The sidecar written by :meth:`save_wrapper`: the world size
+        (shard count) and optimizer layout the step was written under
+        — what a restore onto a DIFFERENT world size gathers by."""
+        try:
+            return json.loads(self._world_manifest_path(step)
+                              .read_text())
+        except (OSError, ValueError):
+            return None
+
+    def save_wrapper(self, step: int, wrapper, *, wait: bool = False,
+                     mesh_epoch: Optional[int] = None):
         """Checkpoint a ``ParallelWrapper``'s full training state —
         including the ZeRO sharded optimizer shards, which each device
         writes as its own 1/N (tensorstore layout): the replicated
-        optimizer state is never materialized, not even to save."""
-        return self.save(step, tree=wrapper.checkpoint_tree(),
-                         wait=wait)
+        optimizer state is never materialized, not even to save. A
+        ``world_<step>.json`` sidecar records the shard count and
+        layout so a later restore onto M≠N devices knows how to
+        gather and re-scatter (elastic fleets: hosts may die between
+        save and restore). The manifest is published BEFORE the step
+        itself: a crash in between leaves a manifest naming a step
+        that never committed (harmless, pruned on the next save),
+        while the reverse order would leave a committed step whose
+        world size nobody can recover."""
+        if jax.process_index() == 0:
+            _ckpt.atomic_write_bytes(
+                self._world_manifest_path(step),
+                (json.dumps({
+                    "step": int(step), "n_shards": int(wrapper.n),
+                    "layout": ("zero-flat" if wrapper.sharded_update
+                               else "replicated"),
+                    "mesh_epoch": mesh_epoch}) + "\n").encode())
+        self.save(step, tree=wrapper.checkpoint_tree(), wait=wait)
+        if jax.process_index() == 0:
+            # prune manifests whose step dirs keep-last already dropped
+            steps = set(self.all_steps()) | {int(step)}
+            for p in self.directory.glob("world_*.json"):
+                try:
+                    s = int(p.stem.split("_", 1)[1])
+                except (IndexError, ValueError):
+                    continue
+                if s not in steps:
+                    p.unlink(missing_ok=True)
+        return self
 
-    def restore_wrapper(self, wrapper, step: Optional[int] = None):
-        """Restore a ``save_wrapper`` checkpoint into ``wrapper`` on
-        the SAME topology: the wrapper's live state tree (with its
+    def restore_wrapper(self, wrapper, step: Optional[int] = None, *,
+                        reshard: bool = True):
+        """Restore a ``save_wrapper`` checkpoint into ``wrapper``.
+
+        Same topology (checkpoint shard count == ``wrapper.n`` and
+        same layout): the wrapper's live state tree (with its
         shardings) is the restore target, so ZeRO optimizer shards
-        land directly back on their devices."""
-        tree = self.restore(step, target=wrapper.checkpoint_target())
-        wrapper.load_checkpoint_tree(tree)
+        land directly back on their devices.
+
+        Different topology (``reshard=True``, the default): the
+        elastic-restore path — *gather by manifest, re-scatter by
+        layout*. The ``world_<step>.json`` manifest names the source
+        shard count N; a fully-replicated restore target is built
+        analytically from the wrapper's own net (the padded flat
+        shapes are a pure function of (params, N)), orbax gathers the
+        saved shards into whole leaves, and
+        ``ParallelWrapper.load_gathered_tree`` re-pads them through
+        ``FlatShardLayout`` onto the surviving M devices — bit-exact
+        on the real content (the zero pad is a training invariant;
+        see ``parallel/zero.py::repad_flat_leaves``)."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoints under {self.directory}")
+        wm = self.world_manifest(step)
+        want_layout = ("zero-flat" if wrapper.sharded_update
+                       else "replicated")
+        n_src = int(wm["n_shards"]) if wm else int(wrapper.n)
+        src_layout = (wm or {}).get("layout", want_layout)
+        if n_src == wrapper.n and src_layout == want_layout:
+            tree = self.restore(step,
+                                target=wrapper.checkpoint_target())
+            wrapper.load_checkpoint_tree(tree)
+            return wrapper
+        if not reshard:
+            raise ValueError(
+                f"checkpoint step {step} was written at "
+                f"{n_src} shards ({src_layout}) but the wrapper runs "
+                f"{wrapper.n} ({want_layout}); pass reshard=True to "
+                "gather and re-scatter")
+        tree = self._restore_gathered(step, wrapper, n_src, src_layout)
+        wrapper.load_gathered_tree(tree, src_layout)
+        logger.warning(
+            "resharded restore: step %d (%d shards, %s) -> %d shards",
+            step, n_src, src_layout, wrapper.n)
         return wrapper
 
-    def restore_latest_valid(self, net=None, *, target=None):
+    def _restore_gathered(self, step: int, wrapper, n_src: int,
+                          src_layout: str):
+        """Gather-by-manifest: restore every leaf fully replicated on
+        the wrapper's (new) mesh. The target is built analytically —
+        params/state shapes from the live net, optimizer shapes from
+        ``optimizer.init`` over the SOURCE flat layout — because the
+        checkpoint's own sharding metadata names devices that no
+        longer exist."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from deeplearning4j_tpu.parallel.zero import FlatShardLayout
+        net = wrapper.net
+        repl = NamedSharding(wrapper.mesh, P())
+
+        def sds(leaf):
+            return jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype,
+                                        sharding=repl)
+
+        if src_layout == "zero-flat":
+            opt_ref = jax.eval_shape(
+                lambda p: net._optimizer.init(
+                    FlatShardLayout(p, n_src).flatten(p)), net.params)
+        else:
+            opt_ref = jax.eval_shape(net._optimizer.init, net.params)
+        target = {
+            "params": jax.tree.map(sds,
+                                   jax.eval_shape(lambda: net.params)),
+            "opt": jax.tree.map(sds, opt_ref),
+            "state": jax.tree.map(sds,
+                                  jax.eval_shape(lambda: net.state)),
+            "meta": {"iteration": 0, "epoch": 0},
+        }
+        return self.mngr.restore(
+            step, args=self._ocp.args.StandardRestore(target))
+
+    def restore_latest_valid(self, net=None, *, target=None,
+                             wrapper=None):
         """Restore the newest step that actually restores, walking
         newest→oldest; an unrestorable (corrupt/partial) step dir is
         quarantined to ``corrupt/`` and the scan falls back — the
         sharded-path analog of
-        ``resilience.checkpoint.newest_valid_checkpoint``."""
+        ``resilience.checkpoint.newest_valid_checkpoint``. With
+        ``wrapper=`` each candidate goes through
+        :meth:`restore_wrapper` instead, so the fallback chain keeps
+        its reshard-onto-M≠N capability: a corrupt newest written at
+        8 devices quarantines, and the next-newest valid one still
+        reshards onto the surviving 4."""
+        from deeplearning4j_tpu.parallel.zero import LayoutMismatch
         last_err: Optional[Exception] = None
         while True:
             steps = sorted(self.all_steps(), reverse=True)
@@ -307,8 +428,15 @@ class ShardedCheckpointer:
                 ) from last_err
             step = steps[0]
             try:
+                if wrapper is not None:
+                    return self.restore_wrapper(wrapper, step)
                 return self.restore(step, net=net, target=target)
             except (KeyboardInterrupt, SystemExit):
+                raise
+            except LayoutMismatch:
+                # configuration error (wrong net for this checkpoint
+                # dir), NOT corruption: fail fast — quarantining would
+                # walk the chain and move aside every valid step
                 raise
             except Exception as e:
                 last_err = e
@@ -329,8 +457,28 @@ class ShardedCheckpointer:
         # the manager caches its step list (and may hold handles into
         # the dir): close, move, re-open
         self.mngr.close()
-        moved = (step_dir.is_dir()
-                 and _rck.quarantine(step_dir, reason) is not None)
+        if step_dir.is_dir():
+            moved = _rck.quarantine(step_dir, reason) is not None
+            if not moved and not step_dir.is_dir():
+                # a concurrently-restoring peer won the move race —
+                # the step is out of the scan either way
+                moved = True
+        else:
+            # already moved aside (a peer, or a prior attempt): the
+            # goal — this step out of every scan — is achieved
+            moved = True
+        if moved:
+            # the world sidecar goes with its step (evidence stays
+            # paired; a later save at the same step number must not
+            # inherit a stale manifest)
+            wm = self._world_manifest_path(step)
+            if wm.is_file():
+                try:
+                    shutil.move(str(wm),
+                                str(step_dir.parent / _rck.CORRUPT_DIR
+                                    / wm.name))
+                except OSError:
+                    wm.unlink(missing_ok=True)
         self.mngr = self._ocp.CheckpointManager(
             self.directory,
             options=self._ocp.CheckpointManagerOptions(

@@ -193,6 +193,8 @@ class FaultTolerantTrainer:
         self._listener = CheckpointListener(
             self.dir, save_every_n_iterations=save_every_n_iterations,
             keep_last=keep_last)
+        self._keep_last = keep_last
+        self._sharded = None        # lazy ShardedCheckpointer
         self._tracker = _ProgressTracker(self)
         self._preemption: Optional[PreemptionHandler] = None
         self._skip = 0              # batches to drop in the next epoch
@@ -208,17 +210,117 @@ class FaultTolerantTrainer:
              "batch_in_epoch": self._batch_in_epoch,
              "time": time.time()}).encode())
 
+    # -- ZeRO sharded-update integration (PR 5 x PR 3 interplay) --------
+    def _sharded_wrapper(self):
+        """The ``train_with`` wrapper when it carries its optimizer
+        state as 1/N ZeRO shards — the case where the replicated zip
+        path would have to materialize N× the live footprint just to
+        stop cleanly."""
+        tw = self.train_with
+        return tw if tw is not None and \
+            getattr(tw, "sharded_update", False) else None
+
+    def _sharded_ck(self):
+        if self._sharded is None:
+            from deeplearning4j_tpu.serialization import \
+                ShardedCheckpointer
+            self._sharded = ShardedCheckpointer(
+                self.dir / "sharded", keep_last=self._keep_last,
+                async_save=False)
+        return self._sharded
+
+    def _newest_sharded_step(self) -> Optional[int]:
+        if not (self.dir / "sharded").is_dir():
+            return None
+        steps = self._sharded_ck().all_steps()
+        return max(steps) if steps else None
+
+    def _restore_sharded(self, min_iteration: int = -1) -> bool:
+        """Newest-valid sharded restore into the wrapper (quarantining
+        corrupt step dirs, resharding onto the wrapper's world size if
+        the checkpoint was written at a different one). Returns False
+        when nothing restorable remains — OR when the step the
+        fallback actually landed on is older than ``min_iteration``
+        (the valid zip the caller holds): the newest SHARDED step
+        being ahead of the zip says nothing until it verifies, so the
+        comparison must be re-made after the fallback resolves and the
+        caller must then restore its newer zip over this state."""
+        tw = self._sharded_wrapper()
+        try:
+            self._sharded_ck().restore_latest_valid(wrapper=tw)
+        except FileNotFoundError:
+            return False
+        if self.net.iteration < min_iteration:
+            return False
+        prog = read_progress(self.dir)
+        if prog.get("iteration") == self.net.iteration:
+            self.net.epoch = max(self.net.epoch,
+                                 prog.get("epoch", self.net.epoch))
+            self._skip = prog.get("batch_in_epoch", 0)
+        else:
+            self._skip = 0
+        self._batch_in_epoch = self._skip
+        self._tracker.reset_epoch_tracking()
+        return True
+
     def _checkpoint_now(self):
-        """Synchronous checkpoint + progress (preemption path)."""
+        """Synchronous checkpoint + progress (preemption path). A
+        ZeRO sharded-update wrapper publishes through
+        ``ShardedCheckpointer.save_wrapper`` — each device writes only
+        its 1/N optimizer shard — NOT the replicated zip path, whose
+        gather would materialize exactly the N copies the sharded
+        mode exists to avoid, in the narrow shutdown window a
+        preemption notice leaves."""
+        tw = self._sharded_wrapper()
+        if tw is not None:
+            ck = self._sharded_ck()
+            if self.net.iteration not in ck.all_steps():
+                # an existing step IS this iteration's state (e.g. a
+                # second preemption before any progress) — orbax
+                # refuses to overwrite, and there is nothing to add
+                ck.save_wrapper(self.net.iteration, tw, wait=True)
+            self._save_progress()
+            return
         self._listener._save(self.net, f"iter_{self.net.iteration}")
         self._listener.flush()
         self._save_progress()
 
+    @staticmethod
+    def _zip_iteration(ckpt_path) -> int:
+        """The iteration a zip checkpoint was cut at (its meta.json);
+        -1 for anything unreadable — the caller treats it as older
+        than any sharded step."""
+        import zipfile
+        try:
+            with zipfile.ZipFile(ckpt_path) as zf:
+                return int(json.loads(
+                    zf.read("meta.json").decode()).get("iteration", -1))
+        except Exception:
+            return -1
+
     def _restore(self, e) -> None:
         """Restore the newest valid checkpoint into ``self.net`` (in
         place) and set the mid-epoch skip; no checkpoint → continue
-        from in-memory params (the failed epoch restarts)."""
+        from in-memory params (the failed epoch restarts). When the
+        trainer drives a ZeRO sharded-update wrapper, the newest
+        checkpoint may be a SHARDED one (the preemption path writes
+        those): the newer of the two chains wins, and the sharded
+        restore reshards onto the current world size if it has to."""
         ckpt = newest_checkpoint(self.dir)
+        if self._sharded_wrapper() is not None:
+            sh_step = self._newest_sharded_step()
+            zip_iter = self._zip_iteration(ckpt) if ckpt is not None \
+                else -1
+            if sh_step is not None and sh_step >= zip_iter:
+                logger.warning(
+                    "training failure (%s); restoring sharded "
+                    "checkpoint step %d (restart %d/%d)", describe(e),
+                    sh_step, self.restarts, self.max_restarts)
+                # min_iteration: if the newest sharded steps turn out
+                # corrupt and the fallback lands BELOW the valid zip,
+                # fall through and let the zip restore win
+                if self._restore_sharded(min_iteration=zip_iter):
+                    return
         if ckpt is None:
             logger.warning(
                 "failure before first checkpoint (%s); "
@@ -284,6 +386,25 @@ class FaultTolerantTrainer:
                 self._preemption = PreemptionHandler().install()
             except ValueError:      # not the main thread: poll-only
                 self._preemption = None
+        # sharded-chain resume: a preemption (or elastic departure)
+        # under a ZeRO wrapper published 1/N shards, which the zip
+        # scan of resume_or_init cannot see — restore them here when
+        # they are newer than whatever the net already carries,
+        # resharding onto the current topology if the world size
+        # changed between the save and this restart
+        if self._sharded_wrapper() is not None:
+            sh_step = self._newest_sharded_step()
+            if sh_step is not None and sh_step > net.iteration:
+                logger.info("resuming from sharded checkpoint step %d",
+                            sh_step)
+                if not self._restore_sharded(
+                        min_iteration=net.iteration):
+                    # the sharded fallback landed on a step older than
+                    # the state the net already carried (a zip-restored
+                    # net, overwritten just now): put the newer zip
+                    # state back
+                    self._restore(RuntimeError(
+                        "sharded chain fell back below the zip state"))
         # cross-process mid-epoch resume: a net brought up by
         # resume_or_init after a preemption/crash carries counters that
         # match progress.json — honor its batch_in_epoch so the resumed

@@ -2,40 +2,75 @@
 launch N worker processes with a coordinator address, collect their
 output, and guarantee cleanup — a crashed or hung worker never leaks
 past the test (its peer would otherwise block in a collective forever
-and keep the coordinator port bound)."""
+and keep the coordinator port bound).
+
+``kill_after`` staggers host death deterministically from the parent:
+``{proc_id: seconds}`` SIGKILLs the given worker that long after
+launch — the elastic drills (and any future membership test) get a
+real kill -9 mid-run without hand-rolling Popen scaffolding per test.
+"""
 import os
 import subprocess
 import sys
+import threading
 
 
-def run_two_process_workers(script_path, port, extra_env=None,
-                            timeout=300):
-    """Launch 2 workers of ``script_path`` (each sees COORD/PROC_ID and
-    2 CPU devices), wait for both, and return their outputs. Kills
-    both processes on any failure path."""
+def run_workers(script_path, port, n=2, extra_env=None, timeout=300,
+                kill_after=None, devices_per_proc=2,
+                per_proc_env=None):
+    """Launch ``n`` workers of ``script_path`` (each sees
+    COORD/NPROC/PROC_ID and ``devices_per_proc`` forced CPU devices),
+    wait for all, and return ``(procs, outs)``. Kills every process on
+    any failure path.
+
+    ``kill_after={proc_id: seconds}``: a timer per entry SIGKILLs that
+    worker after the delay — the deterministic host-death hook for
+    elastic/membership drills. A killed worker's output is whatever it
+    flushed before dying; its returncode is ``-SIGKILL``.
+
+    ``per_proc_env={proc_id: {...}}``: per-worker overrides on top of
+    ``extra_env`` (e.g. a fault plan armed on ONE host of a fleet).
+    """
     procs = []
+    timers = []
     try:
-        for pid in range(2):
+        for pid in range(n):
             env = dict(os.environ,
-                       COORD=f"127.0.0.1:{port}", NPROC="2",
+                       COORD=f"127.0.0.1:{port}", NPROC=str(n),
                        PROC_ID=str(pid),
-                       XLA_FLAGS="--xla_force_host_platform_device_count=2",
+                       XLA_FLAGS="--xla_force_host_platform_device_"
+                                 f"count={devices_per_proc}",
                        JAX_PLATFORMS="cpu")
             env.update(extra_env or {})      # overrides win
+            env.update((per_proc_env or {}).get(pid, {}))
             procs.append(subprocess.Popen(
                 [sys.executable, str(script_path)], env=env,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True))
+        for pid, delay in (kill_after or {}).items():
+            t = threading.Timer(float(delay), procs[int(pid)].kill)
+            t.daemon = True
+            t.start()
+            timers.append(t)
         outs = []
         for p in procs:
             out, _ = p.communicate(timeout=timeout)
             outs.append(out)
         return procs, outs
     finally:
+        for t in timers:
+            t.cancel()
         for p in procs:
             if p.poll() is None:
                 p.kill()
                 p.communicate(timeout=30)
+
+
+def run_two_process_workers(script_path, port, extra_env=None,
+                            timeout=300):
+    """Back-compat wrapper: the original 2-worker launcher."""
+    return run_workers(script_path, port, n=2, extra_env=extra_env,
+                       timeout=timeout)
 
 
 def assert_all_done(procs, outs):

@@ -70,6 +70,75 @@ def test_lint_catches_step_variant_without_warmup_feed(tmp_path):
     assert any("never reads WARMUP_FEEDS" in p for p in problems)
 
 
+def _fault_tree(tmp_path, known_sites, plans, inject_calls,
+                test_text=""):
+    """Synthesize a package tree for rule 5: a resilience/faults.py
+    declaring ``known_sites``/``plans``, a module making the given
+    inject calls, and an optional tests dir."""
+    rdir = tmp_path / "pkg" / "resilience"
+    rdir.mkdir(parents=True)
+    sites = ", ".join(repr(s) for s in known_sites)
+    plan_lines = ", ".join(f"{k!r}: {v!r}" for k, v in plans.items())
+    (rdir / "faults.py").write_text(
+        f"KNOWN_SITES = frozenset({{{sites}}})\n"
+        f"NAMED_PLANS = {{{plan_lines}}}\n"
+        "def inject(site):\n    pass\n")
+    body = "from pkg.resilience import faults\n" + "".join(
+        f"faults.inject({s!r})\n" for s in inject_calls)
+    (tmp_path / "pkg" / "consumer.py").write_text(body)
+    tdir = tmp_path / "tests"
+    tdir.mkdir(exist_ok=True)
+    (tdir / "test_x.py").write_text(test_text)
+    return tmp_path / "pkg", tdir
+
+
+def test_lint_rule5_dead_and_undeclared_and_unplanned_sites(tmp_path):
+    """Rule 5: a KNOWN_SITES entry with no call site is dead; an
+    inject() of an undeclared site is untargetable; a declared+called
+    site with neither a named plan nor a test reference is
+    undrillable."""
+    pkg, tdir = _fault_tree(
+        tmp_path,
+        known_sites=["step", "ghost", "orphan"],
+        plans={"p1": "step:error=OSError:nth=1"},
+        inject_calls=["step", "rogue", "orphan"])
+    problems = lint_instrumentation.run(pkg, tdir)
+    assert any("ghost" in p and "dead site" in p for p in problems)
+    assert any("rogue" in p and "KNOWN_SITES" in p for p in problems)
+    assert any("orphan" in p and "no NAMED_PLANS rule" in p
+               for p in problems)
+    # 'step' is planned: not flagged
+    assert not any("'step'" in p for p in problems)
+
+
+def test_lint_rule5_test_reference_and_glob_plan_cover(tmp_path):
+    """A quoted site string in tests/ counts as coverage, and a glob
+    plan rule (ckpt_*) covers every site it matches."""
+    pkg, tdir = _fault_tree(
+        tmp_path,
+        known_sites=["ckpt_write", "ckpt_commit", "serving"],
+        plans={"io": "ckpt_*:error=OSError:p=0.5"},
+        inject_calls=["ckpt_write", "ckpt_commit", "serving"],
+        test_text='PLAN = "serving:error=RuntimeError:nth=2"\n'
+                  'SITE = "serving"\n')
+    problems = lint_instrumentation.run(pkg, tdir)
+    assert problems == []
+
+
+def test_lint_rule5_real_package_sites_all_live_and_drillable():
+    """The live package: every KNOWN_SITES entry (including the
+    elastic layer's host_death/coordinator) is threaded and covered —
+    asserted through the full run() already, but pin the vocabulary
+    parse here so a refactor that moves the tables fails loudly."""
+    declared, plan_pats = lint_instrumentation._parse_fault_vocabulary(
+        lint_instrumentation.PACKAGE / "resilience" / "faults.py")
+    assert {"host_death", "coordinator", "step",
+            "worker_step"} <= declared
+    injected = lint_instrumentation._inject_sites(
+        lint_instrumentation.PACKAGE)
+    assert declared == set(injected)
+
+
 def test_lint_catches_listener_side_device_reductions(tmp_path):
     """Rule 3: jnp / jax.tree.map reductions in listener/stats paths
     (the old StatsListener._prev_params pattern) are flagged; the

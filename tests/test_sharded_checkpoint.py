@@ -1,7 +1,9 @@
 """Orbax-backed sharded checkpointing on the 8-device CPU mesh:
 save/restore of a TP-sharded pytree preserves values AND shardings;
-keep-last-K; resume into a live network. (SURVEY §5 checkpoint/resume —
-the scale path next to the zip ModelSerializer.)
+keep-last-K; resume into a live network; elastic resharded restore
+(a ZeRO checkpoint written at N devices restored onto M≠N — the
+forced-8-CPU-device reshard fence of ISSUE 7). (SURVEY §5
+checkpoint/resume — the scale path next to the zip ModelSerializer.)
 """
 import jax
 import jax.numpy as jnp
@@ -9,10 +11,15 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.parallel._compat import supports_psum_scatter
 from deeplearning4j_tpu.serialization import ShardedCheckpointer
 
 pytestmark = pytest.mark.skipif(
     len(jax.devices()) < 8, reason="needs 8 virtual devices")
+
+needs_scatter = pytest.mark.skipif(
+    not supports_psum_scatter(),
+    reason="jax runtime has no psum_scatter/all_gather")
 
 
 def test_sharded_roundtrip_preserves_sharding(tmp_path):
@@ -109,6 +116,185 @@ def test_sharded_checkpoint_listener(tmp_path):
     restored = lst._ck.restore(6, net=MultiLayerNetwork(conf).init())
     np.testing.assert_allclose(np.asarray(restored.output(x)),
                                np.asarray(net.output(x)), rtol=1e-6)
+
+
+# =========================================================================
+# elastic resharded restore (ISSUE 7): save at N, restore at M != N
+# =========================================================================
+
+def _zero_wrapper(n, seed=3, feats=6, classes=3, hidden=13):
+    """A sharded-update wrapper over the first n of the 8 forced CPU
+    devices; hidden=13 makes most flat leaves pad differently under
+    8 vs 4 shards (the repad path is actually exercised)."""
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn import updaters as upd
+    from deeplearning4j_tpu.parallel.wrapper import ParallelWrapper
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(upd.Adam(learning_rate=5e-3)).list()
+            .layer(DenseLayer(n_out=hidden, activation="tanh"))
+            .layer(OutputLayer(n_out=classes, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(feats)).build())
+    net = MultiLayerNetwork(conf).init()
+    return net, ParallelWrapper(net, workers=n, sharded_update=True,
+                                prefetch_buffer=0)
+
+
+def _fit_steps(wrapper, steps=4, batch=16, feats=6, classes=3, seed=0):
+    from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+    rng = np.random.RandomState(seed)
+    x = rng.randn(batch * steps, feats).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[
+        rng.randint(0, classes, batch * steps)]
+    wrapper.fit(ListDataSetIterator(DataSet(x, y), batch_size=batch),
+                epochs=1)
+
+
+def _host_flat_opt(wrapper):
+    """The wrapper's live optimizer state as full host-side flat
+    leaves (np.asarray of a P('data') global array materializes the
+    whole leaf)."""
+    return [np.asarray(l)
+            for l in jax.tree_util.tree_leaves(wrapper._dp_state)]
+
+
+@needs_scatter
+def test_reshard_fence_8_to_4_and_back(tmp_path):
+    """Acceptance fence: opt/param state saved at N=8 restores onto
+    M=4 (and 4→8) with the gathered flat leaves bit-identical to the
+    source checkpoint, in this forced-8-CPU-device process."""
+    from deeplearning4j_tpu.parallel.zero import repad_flat_leaves
+    net8, w8 = _zero_wrapper(8)
+    _fit_steps(w8)
+    src_flat = _host_flat_opt(w8)
+    src_params = [np.asarray(l)
+                  for l in jax.tree_util.tree_leaves(net8.params)]
+    with ShardedCheckpointer(tmp_path / "ck", async_save=False) as ck:
+        ck.save_wrapper(net8.iteration, w8, wait=True)
+        assert ck.world_manifest(net8.iteration)["n_shards"] == 8
+
+        # N=8 -> M=4
+        net4, w4 = _zero_wrapper(4)
+        ck.restore_wrapper(w4)
+        assert net4.iteration == net8.iteration
+        assert net4.epoch == net8.epoch
+        for a, b in zip(jax.tree_util.tree_leaves(net4.params),
+                        src_params):
+            assert np.array_equal(np.asarray(a), b)
+        # gather M=4 shards, re-pad onto the source layout: bit-equal
+        flat4 = _host_flat_opt(w4)
+        back = repad_flat_leaves(flat4, src_flat)
+        for a, b in zip(back, src_flat):
+            assert a.dtype == b.dtype and np.array_equal(a, b)
+
+        # M=4 -> N=8 (continue training at 4, save, restore at 8)
+        _fit_steps(w4, seed=1)
+        ck.save_wrapper(net4.iteration, w4, wait=True)
+        assert ck.world_manifest(net4.iteration)["n_shards"] == 4
+        src4_flat = _host_flat_opt(w4)
+        net8b, w8b = _zero_wrapper(8)
+        ck.restore_wrapper(w8b, step=net4.iteration)
+        assert net8b.iteration == net4.iteration
+        flat8b = _host_flat_opt(w8b)
+        back4 = repad_flat_leaves(flat8b, src4_flat)
+        for a, b in zip(back4, src4_flat):
+            assert np.array_equal(a, b)
+        # and the resharded state actually trains (shards are live,
+        # not just storage): one more step must not diverge from the
+        # same step taken at the source scale... world size differs,
+        # so just assert it steps cleanly and stays finite
+        _fit_steps(w8b, steps=1, seed=2)
+        assert np.isfinite(net8b.score_)
+
+
+@needs_scatter
+def test_same_topology_restore_stays_fast_path(tmp_path):
+    """n_src == wrapper.n keeps the sharded-target restore (shards
+    land on their devices; nothing gathers): the restored opt leaves
+    carry P('data') shardings."""
+    net8, w8 = _zero_wrapper(8)
+    _fit_steps(w8)
+    with ShardedCheckpointer(tmp_path / "ck", async_save=False) as ck:
+        ck.save_wrapper(net8.iteration, w8, wait=True)
+        net8b, w8b = _zero_wrapper(8)
+        ck.restore_wrapper(w8b)
+    from deeplearning4j_tpu.parallel.zero import sharded_leaf
+    for leaf in jax.tree_util.tree_leaves(w8b._dp_state):
+        if sharded_leaf(leaf, 8):
+            assert len(leaf.sharding.device_set) == 8
+    for a, b in zip(_host_flat_opt(w8b), _host_flat_opt(w8)):
+        assert np.array_equal(a, b)
+
+
+@needs_scatter
+def test_reshard_refused_without_opt_in(tmp_path):
+    net8, w8 = _zero_wrapper(8)
+    _fit_steps(w8)
+    with ShardedCheckpointer(tmp_path / "ck", async_save=False) as ck:
+        ck.save_wrapper(net8.iteration, w8, wait=True)
+        _, w4 = _zero_wrapper(4)
+        with pytest.raises(ValueError, match="reshard"):
+            ck.restore_wrapper(w4, reshard=False)
+
+
+@needs_scatter
+def test_layout_mismatch_fails_fast_without_quarantine(tmp_path):
+    """Restoring a checkpoint dir written by a DIFFERENT net is a
+    configuration error: the strict zero-pad invariant raises
+    LayoutMismatch and restore_latest_valid must NOT walk the chain
+    quarantining every (valid) step."""
+    from deeplearning4j_tpu.parallel.zero import LayoutMismatch
+    net8, w8 = _zero_wrapper(8, hidden=13)
+    _fit_steps(w8)
+    with ShardedCheckpointer(tmp_path / "ck", async_save=False) as ck:
+        ck.save_wrapper(net8.iteration, w8, wait=True)
+        # same leaf COUNT, different layer width -> flat sizes clash
+        _, w4 = _zero_wrapper(4, hidden=9)
+        with pytest.raises(LayoutMismatch):
+            ck.restore_latest_valid(wrapper=w4)
+        assert ck.all_steps() == [net8.iteration]   # nothing moved
+        assert not (tmp_path / "ck" / "corrupt").exists()
+
+
+@needs_scatter
+def test_restore_degradation_order_quarantines_then_reshards(tmp_path):
+    """Satellite: newest checkpoint written at N=8 is CORRUPT →
+    restore_latest_valid onto M=4 quarantines it (with its world
+    manifest) and the next-newest valid step still reshards."""
+    from deeplearning4j_tpu.obs import metrics
+    net8, w8 = _zero_wrapper(8)
+    _fit_steps(w8)
+    good_step = net8.iteration
+    good_params = [np.asarray(l)
+                   for l in jax.tree_util.tree_leaves(net8.params)]
+    ck = ShardedCheckpointer(tmp_path / "ck", keep_last=5,
+                             async_save=False)
+    ck.save_wrapper(good_step, w8, wait=True)
+    _fit_steps(w8, seed=1)
+    bad_step = net8.iteration
+    ck.save_wrapper(bad_step, w8, wait=True)
+    # rot the newest step dir (truncate every tensorstore file)
+    for f in (tmp_path / "ck" / str(bad_step)).rglob("*"):
+        if f.is_file():
+            f.write_bytes(f.read_bytes()[:3])
+    q0 = metrics.CKPT_QUARANTINED._children[()].get()
+    net4, w4 = _zero_wrapper(4)
+    ck.restore_latest_valid(wrapper=w4)
+    assert net4.iteration == good_step      # fell back, resharded
+    for a, b in zip(jax.tree_util.tree_leaves(net4.params),
+                    good_params):
+        assert np.array_equal(np.asarray(a), b)
+    assert metrics.CKPT_QUARANTINED._children[()].get() == q0 + 1
+    assert (tmp_path / "ck" / "corrupt" / str(bad_step)).exists()
+    # the corrupt step's world manifest moved with it
+    assert not (tmp_path / "ck" / f"world_{bad_step}.json").exists()
+    assert (tmp_path / "ck" / "corrupt"
+            / f"world_{bad_step}.json").exists()
+    assert ck.all_steps() == [good_step]
+    ck.close()
 
 
 def test_listener_iter_and_epoch_saves_no_step_collision(tmp_path):

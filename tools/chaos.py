@@ -36,6 +36,7 @@ import os
 import subprocess
 import sys
 import tempfile
+import textwrap
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -193,6 +194,284 @@ def _serving_scenario(plan_name: str) -> dict:
             "wall_s": round(wall, 2), "ok": bool(ok)}
 
 
+# ---------------------------------------------------------------------------
+# elastic multi-host drill (resilience/elastic.py on tests/mp_harness.py)
+# ---------------------------------------------------------------------------
+
+# One elastic host: join the fleet, form the mesh at the agreed world
+# size, reshard-restore the newest valid sharded checkpoint, train
+# under bounded-timeout collectives, re-form by exec on peer death.
+# The victim host (PROC_ID == KILL_HOST) SIGKILLs itself at iteration
+# KILL_AT — a real kill -9 mid-epoch, deterministic where a wall-clock
+# kill is not (the parent's mp_harness kill_after stays armed as the
+# backstop for a pre-step wedge).
+ELASTIC_WORKER = textwrap.dedent("""
+    import os, signal, sys, warnings
+    sys.path.insert(0, %(repo)r)
+    warnings.filterwarnings("ignore")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import hashlib
+    import numpy as np
+
+    from deeplearning4j_tpu.data import DataSet, ListDataSetIterator
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn import updaters as upd
+    from deeplearning4j_tpu.resilience import elastic
+
+    host = "h%%s" %% os.environ["PROC_ID"]
+    EPOCHS = int(os.environ["EPOCHS"])
+    LEASE = float(os.environ["LEASE_S"])
+    BASELINE_STEP = int(os.environ.get("BASELINE_STEP", "0"))
+    SAVE_EVERY = int(os.environ.get("SAVE_EVERY", "2"))
+    KILL_AT = int(os.environ.get("KILL_AT", "0"))
+    victim = os.environ.get("KILL_HOST", "") == os.environ["PROC_ID"]
+
+    def factory():
+        conf = (NeuralNetConfiguration.builder().seed(23)
+                .updater(upd.Adam(learning_rate=2e-3)).list()
+                .layer(DenseLayer(n_out=18, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(10)).build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(5)          # same data on every host
+    x = rng.standard_normal((32, 10)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    it = ListDataSetIterator(DataSet(x, y), batch_size=8)  # 4/epoch
+
+    co = elastic.MembershipCoordinator(
+        os.environ["ELASTIC_DIR"], host, lease_secs=LEASE,
+        port_base=int(os.environ["PORT_BASE"]))
+    tr = elastic.ElasticTrainer(
+        factory, os.environ["CKPT_DIR"], coordinator=co,
+        save_every=SAVE_EVERY, keep_last=50)
+    wrapper, rec = tr.bring_up(expected=int(os.environ["NPROC"]))
+    net = tr.net
+    print("%%s WORLD=%%d EPOCH=%%d DEV=%%d" %% (
+        host, len(rec["members"]), rec["epoch"],
+        len(jax.devices())), flush=True)
+    if tr.resumed_step is not None:
+        print("%%s RESUMED step=%%d" %% (host, tr.resumed_step),
+              flush=True)
+    if BASELINE_STEP:
+        # same-scale uninterrupted baseline: pin the restore to the
+        # exact step the survivors resumed from
+        tr._ck.restore_wrapper(wrapper, step=BASELINE_STEP)
+        print("%%s PINNED step=%%d" %% (host, BASELINE_STEP),
+              flush=True)
+
+    if victim and KILL_AT:
+        class Killer:
+            def iteration_done(self, _net, iteration, _epoch):
+                if iteration >= KILL_AT:
+                    print("%%s SELF-SIGKILL at iter %%d" %% (
+                        host, iteration), flush=True)
+                    os.kill(os.getpid(), signal.SIGKILL)
+            def on_epoch_start(self, _net):
+                pass
+            def on_epoch_end(self, _net):
+                pass
+        net.listeners.append(Killer())
+
+    status = tr.fit(it, epochs=EPOCHS)       # execs on peer death
+    digest = hashlib.sha1()
+    for leaf in jax.tree_util.tree_leaves(net.params):
+        digest.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    print("%%s FINAL status=%%s iter=%%d epoch=%%d loss=%%.6f "
+          "checksum=%%s" %% (host, status, net.iteration, net.epoch,
+                             net.score_, digest.hexdigest()),
+          flush=True)
+    from deeplearning4j_tpu.obs import metrics as M
+    for line in M.exposition().splitlines():
+        if line.startswith(("dl4j_tpu_mesh_epoch",
+                            "dl4j_tpu_hosts_evicted_total",
+                            "dl4j_tpu_resilience_restarts_total",
+                            "dl4j_tpu_preemptions_total")):
+            print("%%s METRIC %%s" %% (host, line), flush=True)
+    print("proc %%s DONE" %% os.environ["PROC_ID"], flush=True)
+    # skip the interpreter's atexit distributed-shutdown barrier: a
+    # host that departs (preempted) or finishes while a peer is dead
+    # would wedge or abort inside it — the work is done, leave hard
+    sys.stdout.flush()
+    os._exit(0)
+""")
+
+
+def _elastic_scenario(hosts: int = 3, kill_host: int = 2,
+                      kill_at_iter: int = 9, epochs: int = 8,
+                      lease_s: float = 3.0, port: int = 0) -> dict:
+    """The multi-host chaos drill (acceptance fence of ISSUE 7):
+    SIGKILL one host of an ``hosts``-process fleet mid-epoch, assert
+    the survivors (a) raise out of the dead collective within the
+    lease window, (b) re-form the mesh at the reduced world size with
+    a bumped mesh epoch, (c) reshard-restore the newest valid sharded
+    checkpoint, and (d) reach a final state bit-identical to a
+    same-scale uninterrupted baseline resumed from the same step.
+    (Graceful SIGTERM departure is the sibling drill,
+    :func:`_elastic_preempt_scenario`.)"""
+    import re
+    import tempfile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tests"))
+    from mp_harness import run_workers
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = port or 30200 + (os.getpid() % 300)
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="chaos_elastic_") as d:
+        script = os.path.join(d, "elastic_worker.py")
+        with open(script, "w") as f:
+            f.write(ELASTIC_WORKER % {"repo": repo})
+        ckdir = os.path.join(d, "ckpt")
+        env = {"ELASTIC_DIR": os.path.join(d, "elastic"),
+               "CKPT_DIR": ckdir, "EPOCHS": str(epochs),
+               "LEASE_S": str(lease_s), "PORT_BASE": str(port + 50),
+               "KILL_HOST": str(kill_host), "SAVE_EVERY": "2",
+               "KILL_AT": str(kill_at_iter)}
+        # mp_harness kill_after is the BACKSTOP (a host wedged before
+        # its self-kill iteration still dies); the deterministic kill
+        # is the victim's in-worker SIGKILL at iteration KILL_AT
+        procs, outs = run_workers(
+            script, port, n=hosts, timeout=420,
+            kill_after={kill_host: 90.0},
+            extra_env=env)
+
+        survivors = [i for i in range(hosts) if i != kill_host]
+        victim_rc = procs[kill_host].returncode
+        ok = victim_rc == -9        # a real SIGKILL took the host
+        finals = {}
+        resumed = None
+        detect_s = None
+        mesh_epoch = None
+        world = None
+        evicted = 0
+        restarts = 0
+        for i in survivors:
+            out = outs[i]
+            ok = ok and procs[i].returncode == 0 and \
+                f"proc {i} DONE" in out
+            m = re.findall(r"WORLD=(\d+) EPOCH=(\d+)", out)
+            if m:
+                world, mesh_epoch = int(m[-1][0]), int(m[-1][1])
+            r = re.search(r"RESUMED step=(\d+)", out)
+            if r:
+                resumed = int(r.group(1))
+            dm = re.search(r"ELASTIC_REFORM .*detect_s=([\d.]+)", out)
+            if dm:
+                detect_s = float(dm.group(1))
+            fm = re.search(r"FINAL .*checksum=([0-9a-f]+)", out)
+            if fm:
+                finals[i] = fm.group(1)
+            em = re.search(
+                r"dl4j_tpu_hosts_evicted_total (\d+)", out)
+            if em:
+                evicted = max(evicted, int(em.group(1)))
+            rm = re.search(
+                r"dl4j_tpu_resilience_restarts_total (\d+)", out)
+            if rm:
+                restarts = max(restarts, int(rm.group(1)))
+        ok = (ok and len(finals) == len(survivors)
+              and len(set(finals.values())) == 1
+              and resumed is not None and resumed > 0
+              and world == hosts - 1 and mesh_epoch == 2
+              and detect_s is not None and detect_s <= 4 * lease_s
+              and evicted >= 1 and restarts >= 1)
+
+        # same-scale uninterrupted baseline: fresh fleet of the
+        # surviving size, pinned to the exact step the survivors
+        # resumed from, trained to the same epoch budget — the
+        # post-recovery trajectory must match it bit-for-bit
+        base_env = dict(env, ELASTIC_DIR=os.path.join(d, "el_base"),
+                        BASELINE_STEP=str(resumed or 0),
+                        SAVE_EVERY="0", KILL_AT="0", KILL_HOST="")
+        base_env["PORT_BASE"] = str(port + 150)
+        bprocs, bouts = run_workers(script, port + 100,
+                                    n=hosts - 1, timeout=420,
+                                    extra_env=base_env)
+        base_finals = set()
+        for i, out in enumerate(bouts):
+            ok = ok and bprocs[i].returncode == 0
+            fm = re.search(r"FINAL .*checksum=([0-9a-f]+)", out)
+            if fm:
+                base_finals.add(fm.group(1))
+        trajectory_match = (len(base_finals) == 1 and len(finals) > 0
+                            and base_finals == set(finals.values()))
+        ok = ok and trajectory_match
+        if not ok:                  # post-mortem material
+            tails = {f"drill_{i}": (outs[i] or "")[-1500:]
+                     for i in range(hosts)}
+            tails.update({f"base_{i}": (bouts[i] or "")[-1500:]
+                          for i in range(len(bouts))})
+            print(json.dumps({"output_tails": tails}, indent=1),
+                  file=sys.stderr)
+        return {"mode": "elastic", "hosts": hosts,
+                "killed": kill_host, "victim_rc": victim_rc,
+                "survivor_world": world, "mesh_epoch": mesh_epoch,
+                "resumed_step": resumed,
+                "detect_s": detect_s, "lease_s": lease_s,
+                "hosts_evicted": evicted, "restarts": restarts,
+                "trajectory_match": trajectory_match,
+                "wall_s": round(time.perf_counter() - t0, 2),
+                "ok": bool(ok)}
+
+
+def _elastic_preempt_scenario(hosts: int = 2,
+                              plan: str = "host-preempt",
+                              epochs: int = 8, lease_s: float = 3.0,
+                              port: int = 0) -> dict:
+    """host-preempt named-plan drill: host ``hosts-1`` trains under
+    ``DL4J_TPU_FAULT_PLAN=host-preempt`` (SIGTERM at its nth elastic
+    step), departs GRACEFULLY (lease dropped, no checkpoint torn),
+    and the survivors re-form and finish."""
+    import tempfile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tests"))
+    from mp_harness import run_workers
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = port or 30600 + (os.getpid() % 200)
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="chaos_preempt_") as d:
+        script = os.path.join(d, "elastic_worker.py")
+        with open(script, "w") as f:
+            f.write(ELASTIC_WORKER % {"repo": repo})
+        env = {"ELASTIC_DIR": os.path.join(d, "elastic"),
+               "CKPT_DIR": os.path.join(d, "ckpt"),
+               "EPOCHS": str(epochs), "LEASE_S": str(lease_s),
+               "PORT_BASE": str(port + 50), "SAVE_EVERY": "2",
+               "KILL_AT": "0", "KILL_HOST": ""}
+        procs, outs = run_workers(
+            script, port, n=hosts, timeout=420, extra_env=env,
+            per_proc_env={hosts - 1: {"DL4J_TPU_FAULT_PLAN": plan}})
+        victim_out = outs[hosts - 1] or ""
+        ok = (procs[hosts - 1].returncode == 0
+              and "status=preempted" in victim_out
+              and "fault injection: firing 'sigterm' at site "
+                  "'host_death'" in victim_out)
+        survivor_done = 0
+        for i in range(hosts - 1):
+            out = outs[i] or ""
+            if procs[i].returncode == 0 and "status=done" in out:
+                survivor_done += 1
+        ok = ok and survivor_done == hosts - 1
+        res = {"mode": "elastic-preempt", "plan": plan,
+               "hosts": hosts, "survivors_done": survivor_done,
+               "victim_preempted": "status=preempted" in victim_out,
+               "wall_s": round(time.perf_counter() - t0, 2),
+               "ok": bool(ok)}
+        if not ok:                  # post-mortem material
+            res["output_tails"] = {
+                i: (outs[i] or "")[-1500:] for i in range(hosts)}
+        return res
+
+
 def _example_scenario(example: str, plan: str, restarts: int) -> dict:
     """Slice-restart supervision: run the example under the plan env;
     a crash (injected fault escaping to the top) is answered by simply
@@ -250,6 +529,14 @@ def main() -> int:
                     help="max |chaos_loss - baseline_loss|")
     ap.add_argument("--restarts", type=int, default=3,
                     help="restart budget for --example supervision")
+    ap.add_argument("--elastic", action="store_true",
+                    help="multi-host drill: SIGKILL one host of a "
+                         "live fleet mid-epoch, assert re-formation + "
+                         "resharded restore + baseline-matching "
+                         "trajectory (with --plan host-preempt: the "
+                         "victim departs via SIGTERM instead)")
+    ap.add_argument("--hosts", type=int, default=3,
+                    help="fleet size for --elastic")
     ap.add_argument("--list", action="store_true",
                     help="list named plans and exit")
     args = ap.parse_args()
@@ -257,6 +544,17 @@ def main() -> int:
         for name, spec in NAMED_PLANS.items():
             print(f"{name:<16} {spec}")
         return 0
+    if args.elastic:
+        if args.plan:
+            results = [_elastic_preempt_scenario(hosts=args.hosts,
+                                                 plan=args.plan[0])]
+        else:
+            results = [_elastic_scenario(hosts=args.hosts,
+                                         kill_host=args.hosts - 1)]
+        print(json.dumps({"results": results,
+                          "ok": all(r["ok"] for r in results)},
+                         indent=1))
+        return 0 if all(r["ok"] for r in results) else 1
     if not args.plan:
         ap.error("--plan required (see --list)")
 
@@ -269,6 +567,10 @@ def main() -> int:
                 _example_scenario(args.example, spec, args.restarts))
         elif any(r.site.startswith("serving") for r in parsed.rules):
             results.append(_serving_scenario(plan))
+        elif any(r.site.startswith(("host_death", "coordinator"))
+                 for r in parsed.rules):
+            results.append(_elastic_preempt_scenario(
+                hosts=args.hosts, plan=plan))
         else:
             results.append(_train_scenario(plan, args.epochs, args.tol))
     print(json.dumps({"results": results,

@@ -196,6 +196,73 @@ poisoning real params.
 """
 
 
+# hand-maintained operations doc, re-emitted on every regeneration
+# (ISSUE 7 satellite: the elastic-fleet runbook lives in docs/OPS.md
+# next to the failure & recovery workflow)
+ELASTIC_OPS_SECTION = """
+## Elastic fleets & host preemption (resilience/elastic.py)
+
+Operating training on preemptible/spot capacity (ARCHITECTURE.md §13):
+
+**Bring-up.** Every host joins the fleet through a
+`MembershipCoordinator` over a shared directory: an atomically-written
+*lease* file per host, renewed like a heartbeat and mirrored into
+`obs/health.py` (a dying peer is named on `/healthz` before the fleet
+even reacts). `ElasticTrainer.bring_up()` waits for the expected
+hosts, runs the propose→ack→commit agreement round, and forms the
+mesh at the agreed world size — the committed *mesh epoch*
+(generation number, `dl4j_tpu_mesh_epoch`) stamps every subsequent
+step.
+
+**Lease timing.** `DL4J_TPU_HOST_LEASE_SECS` (default 15) is the
+eviction window: a host that misses it is moved aside
+(`members/evicted/`, `dl4j_tpu_hosts_evicted_total`) at the next
+agreement. The collective watchdog defaults to twice the lease — a
+peer's death turns an indefinite collective hang into a
+`CollectiveTimeoutError` within that window (a gloo/ICI connection
+reset surfaces even faster). Size the lease to tolerate your worst
+GC/compile pause: the background auto-renew thread keeps a busy host
+alive, and a *wedged* host is fenced by the epoch stamp
+(`StaleMeshEpoch`), not by lease expiry.
+
+**Host loss.** The survivors' failed step raises (no indefinite
+hang), and re-formation happens by *exec*: the wedged collective
+runtime cannot be torn down in-process, so each survivor replaces its
+process image, re-joins, agrees on the reduced membership (epoch+1,
+a new epoch-salted coordinator port — stragglers from the old
+generation are rejected, `dl4j_tpu_resilience_restarts_total` counts
+the reforms), and **reshard-restores** the newest valid checkpoint:
+`ShardedCheckpointer.restore_wrapper(reshard=True)` reads the
+`world_<step>.json` manifest, gathers the N-sharded optimizer state,
+and re-scatters it through `FlatShardLayout` onto the surviving M
+devices — bit-exact on the real content. A corrupt newest step
+quarantines and the next-newest valid one still reshards
+(`restore_latest_valid(wrapper=...)`).
+
+**Preemption.** SIGTERM on one host of a fleet = graceful departure:
+the host drops its lease (`leave()`), peers re-form without waiting
+out the window. SIGTERM on a *single-host* world checkpoints first
+(the PR 3 behavior). Under `FaultTolerantTrainer` with a ZeRO
+`sharded_update=True` wrapper, the preemption checkpoint publishes
+through `save_wrapper` (1/N shards + world manifest) — never the
+replicated zip path — and resume picks the newer of the sharded and
+zip chains.
+
+**Drills.** The standing fleet drill (also
+`tests/test_elastic.py`):
+
+    python tools/chaos.py --elastic                    # SIGKILL one of 3 hosts
+    python tools/chaos.py --elastic --plan host-preempt  # graceful SIGTERM departure
+
+asserts: bounded-timeout raise within the lease window, re-formation
+at the reduced world size, reshard-restore of the newest valid step,
+and a post-recovery trajectory bit-identical to the same-scale
+uninterrupted baseline. Site-level drills: `host_death` and
+`coordinator` fire under `DL4J_TPU_FAULT_PLAN` (named plans
+`host-preempt`, `coord-flake`) like every other failure mode.
+"""
+
+
 def main():
     import warnings
     warnings.filterwarnings("ignore")
@@ -346,7 +413,8 @@ def main():
         op_lines.append(entry)
     op_lines += ["", TELEMETRY_OPS_SECTION.strip(),
                  "", RESILIENCE_OPS_SECTION.strip(),
-                 "", NUMERICS_OPS_SECTION.strip()]
+                 "", NUMERICS_OPS_SECTION.strip(),
+                 "", ELASTIC_OPS_SECTION.strip()]
     ops_out = os.path.join(os.path.dirname(out), "OPS.md")
     with open(ops_out, "w") as f:
         f.write("\n".join(op_lines) + "\n")

@@ -40,6 +40,20 @@ Four AST rules over ``deeplearning4j_tpu/``:
    the builder set and the feed table in lockstep (both directions:
    no missing feeds, no stale feeds).
 
+5. **Every fault-injection site is declared, live, and drillable.**
+   ``resilience/faults.py`` failure modes only exist where a
+   ``faults.inject("<site>")`` call is threaded through a real code
+   path, and only stay honest while something exercises them. Three
+   checks keep the site table and the codebase in lockstep: every
+   literal ``inject`` site must appear in ``KNOWN_SITES`` (else the
+   plan parser rejects plans that target it), every ``KNOWN_SITES``
+   entry must have at least one call site (a dead site advertises a
+   drill that cannot fire), and every injected site must be covered
+   by a ``NAMED_PLANS`` rule or referenced from ``tests/`` (an
+   unplanned, untested site rots silently as code moves — exactly how
+   the elastic layer's ``host_death``/``coordinator`` sites would
+   otherwise age out).
+
 Exit status 0 = clean; 1 = violations (printed one per line).
 """
 from __future__ import annotations
@@ -47,7 +61,7 @@ from __future__ import annotations
 import ast
 import sys
 from pathlib import Path
-from typing import List
+from typing import List, Optional
 
 REPO = Path(__file__).resolve().parent.parent
 PACKAGE = REPO / "deeplearning4j_tpu"
@@ -59,6 +73,9 @@ TIME_TIME_ALLOWLIST = {
     "train/earlystopping.py",
     # cluster-event records carry epoch timestamps for cross-host logs
     "train/fault_tolerance.py",
+    # membership leases are CROSS-PROCESS deadlines: wall clock is the
+    # only clock whose readings are comparable between hosts
+    "resilience/elastic.py",
 }
 
 _OBS_EMITTERS = {"record_step", "record_etl", "record_worker_step",
@@ -73,6 +90,9 @@ LISTENER_STATS_PATHS = {"train/stats.py", "train/listeners.py"}
 # rule 4 target: the SPMD wrapper whose step builders must each have a
 # WARMUP_FEEDS entry
 WRAPPER_PATH = "parallel/wrapper.py"
+
+# rule 5 source of truth: the site table + named-plan vocabulary
+FAULTS_PATH = "resilience/faults.py"
 
 
 def _calls(tree: ast.AST):
@@ -193,11 +213,109 @@ def _lint_wrapper_warmup(tree: ast.AST, rel: str) -> List[str]:
     return problems
 
 
-def run(package_dir: Path = PACKAGE) -> List[str]:
+def _parse_fault_vocabulary(faults_path: Path):
+    """``(KNOWN_SITES literals, named-plan site patterns)`` straight
+    from the AST of ``resilience/faults.py`` — the lint never imports
+    the package."""
+    tree = ast.parse(faults_path.read_text())
+    declared: set = set()
+    plan_patterns: set = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        names = {t.id for t in node.targets
+                 if isinstance(t, ast.Name)}
+        if "KNOWN_SITES" in names:
+            for sub in ast.walk(node.value):
+                if isinstance(sub, ast.Constant) and \
+                        isinstance(sub.value, str):
+                    declared.add(sub.value)
+        if "NAMED_PLANS" in names and isinstance(node.value, ast.Dict):
+            for v in node.value.values:
+                spec = ""
+                # string literal or implicit concatenation folds to one
+                # Constant; anything fancier is skipped (plans are
+                # plain literals by construction)
+                if isinstance(v, ast.Constant) and \
+                        isinstance(v.value, str):
+                    spec = v.value
+                for chunk in spec.split(";"):
+                    chunk = chunk.strip()
+                    if chunk:
+                        plan_patterns.add(chunk.split(":")[0])
+    return declared, plan_patterns
+
+
+def _inject_sites(package_dir: Path):
+    """Every literal ``faults.inject("<site>")`` call site in the
+    package: ``{site: [rel:lineno, ...]}``."""
+    sites: dict = {}
+    for path in sorted(package_dir.rglob("*.py")):
+        rel = path.relative_to(package_dir).as_posix()
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue                # rule-agnostic: lint_file reports it
+        for c in _calls(tree):
+            ch = _attr_chain(c.func)
+            if not ch.endswith(".inject"):
+                continue
+            base = ch.rsplit(".", 2)[-2] if "." in ch else ""
+            if base not in ("faults", "_faults"):
+                continue
+            if c.args and isinstance(c.args[0], ast.Constant) and \
+                    isinstance(c.args[0].value, str):
+                sites.setdefault(c.args[0].value, []).append(
+                    f"{rel}:{c.lineno}")
+    return sites
+
+
+def _lint_fault_sites(package_dir: Path,
+                      tests_dir: Optional[Path]) -> List[str]:
+    """Rule 5: declared ⊆ injected ⊆ declared, and every injected site
+    is named by a plan or a test."""
+    import fnmatch
+    faults_path = package_dir / FAULTS_PATH
+    if not faults_path.is_file():
+        return []
+    declared, plan_patterns = _parse_fault_vocabulary(faults_path)
+    injected = _inject_sites(package_dir)
+    problems: List[str] = []
+    for site in sorted(set(injected) - declared):
+        problems.append(
+            f"{injected[site][0]}: faults.inject({site!r}) is not in "
+            f"{FAULTS_PATH} KNOWN_SITES — no fault plan can ever "
+            "target it (the parser rejects unknown literal sites)")
+    for site in sorted(declared - set(injected)):
+        problems.append(
+            f"{FAULTS_PATH}: KNOWN_SITES entry {site!r} has no "
+            "faults.inject() call site anywhere in the package — a "
+            "dead site advertising a drill that cannot fire")
+    test_text = ""
+    if tests_dir is not None and Path(tests_dir).is_dir():
+        test_text = "\n".join(
+            p.read_text() for p in sorted(Path(tests_dir).glob("*.py")))
+    for site in sorted(set(injected) & declared):
+        planned = any(fnmatch.fnmatchcase(site, pat)
+                      for pat in plan_patterns)
+        tested = f'"{site}"' in test_text or f"'{site}'" in test_text
+        if not planned and not tested:
+            problems.append(
+                f"{injected[site][0]}: fault site {site!r} is covered "
+                "by no NAMED_PLANS rule and referenced by no test — "
+                "an undrillable site rots as the code around it moves")
+    return problems
+
+
+def run(package_dir: Path = PACKAGE,
+        tests_dir: Optional[Path] = None) -> List[str]:
     problems: List[str] = []
     for path in sorted(package_dir.rglob("*.py")):
         rel = path.relative_to(package_dir).as_posix()
         problems.extend(lint_file(path, rel))
+    if tests_dir is None and package_dir == PACKAGE:
+        tests_dir = REPO / "tests"
+    problems.extend(_lint_fault_sites(package_dir, tests_dir))
     return problems
 
 

@@ -203,6 +203,13 @@ def bench_body():
         jax.random.fold_in(jax.random.PRNGKey(0), 0),
         k=4 if on_tpu else 2)
 
+    # fleet observability plane (obs/fleet.py): publish-cadence cost
+    # against this run's real step — the off path (no plane) must be
+    # ~0 (one branch, the PR 2 bar) and the on path < 1% of step time
+    # at the default 1 Hz cadence
+    fleet_rec = obs.fleet.measure_publish_overhead(
+        step_seconds=batch / images_per_sec)
+
     print(json.dumps({
         "metric": METRIC,
         "value": round(images_per_sec, 1),
@@ -218,6 +225,7 @@ def bench_body():
         "compile": compile_rec,
         "obs": obs_rec,
         "numerics": numerics_rec,
+        "fleet_obs": fleet_rec,
     }), flush=True)
 
 

@@ -126,6 +126,20 @@ _register("DL4J_TPU_ELASTIC_PORT_BASE", 31300, int,
           "mesh epoch g binds base+(g mod 1000) so a stale generation "
           "can never capture the new generation's workers")
 
+# -- fleet observability plane (obs/fleet.py) ------------------------------
+_register("DL4J_TPU_FLEET_PUBLISH_SECS", 1.0, float,
+          "telemetry-snapshot publish cadence: each elastic host "
+          "atomically writes <elastic_dir>/telemetry/<host>.json at "
+          "most this often (the fleet aggregator's sampling floor)")
+_register("DL4J_TPU_FLEET_RING", 50, int,
+          "flight-recorder ring size: last-N step records dumped as "
+          "the postmortem bundle when a run dies")
+_register("DL4J_TPU_FLEET_TELEMETRY", True, _bool,
+          "fleet observability plane for elastic training: '0' "
+          "disables snapshot publishing + the flight recorder "
+          "(non-elastic training never pays more than one branch "
+          "either way)")
+
 # -- UI / examples ---------------------------------------------------------
 _register("DL4J_TPU_UI_PORT", 9000, int,
           "training dashboard HTTP port (DL4JSystemProperties UI port)")

@@ -17,6 +17,10 @@ per-tool private formats) with one layer (ARCHITECTURE.md §9):
 - :mod:`~deeplearning4j_tpu.obs.numerics` — in-step per-layer
   gradient/activation health with NaN attribution (cadence-gated
   diagnostic steps; ARCHITECTURE.md §11).
+- :mod:`~deeplearning4j_tpu.obs.fleet` — cross-host telemetry
+  aggregation, collective-skew straggler attribution, and the crash
+  flight recorder riding the elastic file plane (ARCHITECTURE.md
+  §14).
 - :func:`report` — the merged JSON snapshot consumed by
   ``StatsListener`` records, ``bench.py``'s ``obs`` section,
   ``tools/perf_dossier.py``, and ``utils/crashreport.py``.
@@ -35,6 +39,7 @@ from deeplearning4j_tpu.obs import health as health
 from deeplearning4j_tpu.obs import metrics as metrics
 from deeplearning4j_tpu.obs import numerics as numerics
 from deeplearning4j_tpu.obs import trace as trace
+from deeplearning4j_tpu.obs import fleet as fleet
 from deeplearning4j_tpu.obs.trace import now as now, span as span
 
 
@@ -144,6 +149,6 @@ def snapshot() -> Dict[str, Any]:
     return metrics.snapshot()
 
 
-__all__ = ["trace", "metrics", "health", "numerics", "span", "now",
-           "record_step", "record_etl", "record_worker_step",
+__all__ = ["trace", "metrics", "health", "numerics", "fleet", "span",
+           "now", "record_step", "record_etl", "record_worker_step",
            "summary", "report", "overhead_report", "snapshot"]

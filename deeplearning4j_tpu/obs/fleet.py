@@ -1,0 +1,768 @@
+"""Fleet observability plane — cross-host telemetry aggregation,
+straggler attribution, and a crash flight recorder.
+
+Reference: DL4J's ``StatsListener`` + training UI aggregated
+per-worker ``ParallelWrapper`` stats into ONE fleet-visible view
+(SURVEY §5); our PR 2/4 spine is strictly per-process — ``/metrics``,
+spans, and numerics all stop at the process boundary, so after the
+elastic layer (PR 6) made training multi-host, "which host stalled
+the collective" and "what happened in the 50 steps before the
+eviction" were unanswerable. This module answers both by riding the
+PR 6 file plane (the shared elastic directory the leases already live
+on):
+
+- **Telemetry publishing** (:class:`FleetTelemetry`): each host
+  atomically publishes a compact, versioned snapshot — metrics
+  exposition, heartbeat ages, a numerics tail, mesh epoch, step, and
+  per-step barrier-entry/exit wall timestamps — into
+  ``<elastic_dir>/telemetry/<host>.json`` on a cadence
+  (``DL4J_TPU_FLEET_PUBLISH_SECS``). Publication is the same
+  tmp+fsync+``os.replace`` idiom as the lease files: a reader sees
+  old-or-new, never torn.
+
+- **Aggregation** (:func:`aggregate` → :class:`FleetView`): merge
+  every host's snapshot into ONE fleet-level Prometheus exposition —
+  each sample re-labelled with ``host=`` and ``mesh_epoch=`` via
+  ``metrics.parse_exposition`` — plus aggregator-computed families:
+  per-host collective skew (``dl4j_tpu_collective_skew_seconds``),
+  the named straggler (``dl4j_tpu_collective_straggler``), snapshot
+  ages, and the live host count. Served on the existing stdlib
+  server's ``/fleet`` path (``metrics.set_fleet_dir``) or rendered by
+  ``tools/tpu_watch.py --fleet-dir``.
+
+- **Straggler attribution** (:meth:`FleetView.skew_report`): the
+  elastic context stamps barrier entry/exit per step; the aggregator
+  turns "the allreduce is slow" into "host C enters 40ms late every
+  step". A host MISSING from the newest entered step is ranked by its
+  lease age — the authoritative liveness signal — so a corpse is
+  named the final-step straggler even when every survivor is wedged
+  at the same barrier.
+
+- **Crash flight recorder** (:meth:`FleetTelemetry.dump`): a bounded
+  black-box ring (last-N steps: barrier stamps, loss, numerics
+  scalars, mesh-epoch events) dumped as a *versioned* postmortem
+  bundle on ``NonFiniteError`` / ``StaleMeshEpoch`` /
+  ``CollectiveTimeoutError`` / SIGTERM preemption, carrying
+  ``obs.report()`` tail spans and the fleet skew view at the moment
+  of death. On eviction the surviving leader snapshots the dead
+  host's FINAL telemetry into a bundle too
+  (:func:`record_eviction`) — diagnostics survive the failure they
+  explain (PyGraph's robust-versioning bar, PAPERS.md 2503.19779:
+  every snapshot and bundle carries a schema version and readers skip
+  incompatible files instead of crashing).
+
+Clock: barrier stamps and snapshot ages use the *wall* clock
+(``time.time``) for the same reason leases do — they must be
+comparable across hosts; fleet hosts are assumed NTP-close relative
+to the skew scales of interest (the lease window bounds the error).
+
+Off-path contract (the PR 2/4 bar): with no fleet plane installed the
+training step pays ONE branch (``if ... is None``) and
+:func:`publishes` / :func:`dumps` stay 0 for the process lifetime —
+counter-asserted by ``tests/test_fleet_obs.py``.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.obs import metrics as _metrics
+from deeplearning4j_tpu.obs import trace as _trace
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+#: schema versions — bump on any incompatible layout change; readers
+#: SKIP (never crash on) files from another version
+SNAPSHOT_VERSION = 1
+BUNDLE_VERSION = 1
+
+#: barrier stamps kept per snapshot (per-step entry/exit pairs — the
+#: skew window the aggregator can attribute over)
+BARRIER_KEEP = 16
+
+_UNSET = object()   # memoization sentinel (skew_report may be None)
+
+# -- metric families ---------------------------------------------------------
+
+FLEET_PUBLISHES = _metrics.REGISTRY.counter(
+    "dl4j_tpu_fleet_snapshots_published_total",
+    "telemetry snapshots this host published into the fleet plane")
+FLIGHT_DUMPS = _metrics.REGISTRY.counter(
+    "dl4j_tpu_flight_recorder_dumps_total",
+    "flight-recorder postmortem bundles written", ("cause",))
+
+#: families the AGGREGATOR computes (they exist only in the merged
+#: fleet exposition, never in a single process's registry) — declared
+#: here AND in ``metrics.FAMILIES`` so ``lint_instrumentation`` rule 6
+#: keeps emit sites, tpu_watch, and OPS.md in lockstep
+AGGREGATE_FAMILIES = {
+    "dl4j_tpu_collective_skew_seconds": "gauge",
+    "dl4j_tpu_collective_straggler": "gauge",
+    "dl4j_tpu_fleet_hosts": "gauge",
+    "dl4j_tpu_fleet_snapshot_age_seconds": "gauge",
+}
+
+# -- off-path fence counters (tests assert both stay 0 with no plane) --------
+
+_lock = threading.Lock()
+_counters = {"publishes": 0, "dumps": 0}
+_bundle_seq = 0
+
+
+def publishes() -> int:
+    """Snapshots published since the last reset — stays 0 for the
+    process lifetime when no fleet plane is installed (the off-path
+    zero-overhead assertion)."""
+    return _counters["publishes"]
+
+
+def dumps() -> int:
+    """Postmortem bundles written since the last reset."""
+    return _counters["dumps"]
+
+
+def reset_counters() -> None:
+    """Tests only."""
+    with _lock:
+        _counters["publishes"] = 0
+        _counters["dumps"] = 0
+
+
+def _atomic_write_json(path: Path, obj: dict) -> None:
+    """Atomic JSON publication via the resilience layer's hardened
+    writer (tmp+fsync+``os.replace``+directory fsync, tmp cleaned on
+    failure) — the postmortem bundle must be durable through the very
+    crash it explains. Imported lazily: ``obs`` loads before
+    ``resilience`` at package import, so a module-level import here
+    would cycle."""
+    from deeplearning4j_tpu.resilience.checkpoint import \
+        atomic_write_bytes
+    atomic_write_bytes(Path(path), (json.dumps(obj) + "\n").encode())
+
+
+def _read_json(path: Path) -> Optional[dict]:
+    """Tolerant read: missing/torn → None (writers are atomic, so a
+    failed parse means a concurrent writer — retry next sample)."""
+    try:
+        return json.loads(Path(path).read_text())
+    except (OSError, ValueError):
+        return None
+
+
+def _numerics_tail() -> Dict[str, Any]:
+    """Compact numerics-observatory tail for the snapshot: the
+    per-layer grad-norm gauges and any nonzero non-finite counters —
+    scalar values already on host (no device traffic)."""
+    from deeplearning4j_tpu.obs import numerics as _num
+    tail: Dict[str, Any] = {}
+    grads = _num.GRAD_NORM.snapshot()
+    if grads:
+        tail["grad_norm"] = {k: round(float(v), 6)
+                             for k, v in grads.items()}
+    nf = {k: int(v) for k, v in _num.NONFINITE.snapshot().items() if v}
+    if nf:
+        tail["nonfinite"] = nf
+    return tail
+
+
+class FleetTelemetry:
+    """Per-host half of the plane: the publisher + the flight
+    recorder. ``directory`` is the shared elastic dir (snapshots go
+    under ``telemetry/``, bundles under ``postmortem/``)."""
+
+    def __init__(self, directory, host: str, *,
+                 every_s: Optional[float] = None,
+                 ring: Optional[int] = None,
+                 clock: Callable[[], float] = time.time):
+        from deeplearning4j_tpu import environment
+        self.dir = Path(directory)
+        self.host = str(host)
+        self.every_s = float(
+            every_s if every_s is not None
+            else environment.get_flag("DL4J_TPU_FLEET_PUBLISH_SECS"))
+        self.clock = clock
+        n = int(ring if ring is not None
+                else environment.get_flag("DL4J_TPU_FLEET_RING"))
+        self._ring: deque = deque(maxlen=max(1, n))
+        self._barriers: deque = deque(maxlen=BARRIER_KEEP)
+        self._pending: Dict[int, float] = {}
+        self._last_publish = float("-inf")
+        self._io_lock = threading.Lock()
+        self.step = -1
+        self.mesh_epoch = 0
+
+    @property
+    def telemetry_path(self) -> Path:
+        return self.dir / "telemetry" / f"{self.host}.json"
+
+    # -- recording ------------------------------------------------------
+    def note_enter(self, step: int, t: Optional[float] = None) -> None:
+        """Barrier-entry stamp: this host is about to dispatch ``step``
+        (the collective's rendezvous point — a late entry here IS the
+        skew the aggregator attributes)."""
+        self._pending[int(step)] = self.clock() if t is None else t
+
+    def record_step(self, step: int, *, mesh_epoch: Optional[int] = None,
+                    t_enter: Optional[float] = None,
+                    t_exit: Optional[float] = None,
+                    loss: Optional[float] = None,
+                    extra: Optional[Dict[str, Any]] = None) -> None:
+        """One completed step: barrier-exit stamp + flight-recorder
+        ring entry + cadence-gated publish."""
+        step = int(step)
+        t_exit = self.clock() if t_exit is None else t_exit
+        if t_enter is None:
+            t_enter = self._pending.pop(step, t_exit)
+        else:
+            self._pending.pop(step, None)
+        self.step = step
+        if mesh_epoch is not None:
+            self.mesh_epoch = int(mesh_epoch)
+        self._barriers.append((step, t_enter, t_exit))
+        rec: Dict[str, Any] = {"step": step, "t_enter": t_enter,
+                               "t_exit": t_exit,
+                               "mesh_epoch": self.mesh_epoch}
+        if loss is not None:
+            rec["loss"] = float(loss)
+        if extra:
+            rec.update(extra)
+        self._ring.append(rec)
+        self.maybe_publish()
+
+    def event(self, kind: str, **info: Any) -> None:
+        """A membership-plane event (mesh-epoch commit, eviction
+        observed, preemption notice) — ringed and published
+        immediately: these are exactly the breadcrumbs a postmortem
+        needs and they are rare enough to skip the cadence."""
+        rec = {"event": str(kind), "t_wall": self.clock(), **info}
+        if "epoch" in info:
+            self.mesh_epoch = int(info["epoch"])
+        self._ring.append(rec)
+        self.publish(force=True)
+
+    # -- publishing -----------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """The compact host snapshot: everything a fleet aggregator
+        needs to merge this process into the fleet view."""
+        from deeplearning4j_tpu.obs import health as _health
+        return {
+            "version": SNAPSHOT_VERSION,
+            "host": self.host,
+            "pid": os.getpid(),
+            "t_wall": self.clock(),
+            "step": self.step,
+            "mesh_epoch": self.mesh_epoch,
+            "barriers": [list(b) for b in self._barriers] + [
+                [s, t, None] for s, t in sorted(self._pending.items())],
+            "health": {w: round(s["age_s"], 3)
+                       for w, s in _health.check().items()},
+            "numerics": _numerics_tail(),
+            "exposition": _metrics.exposition(),
+        }
+
+    def maybe_publish(self) -> bool:
+        """Publish when more than ``every_s`` has passed — the
+        per-step hook stays a clock read + compare (the cadence gate
+        lives in :meth:`publish`, once)."""
+        return self.publish()
+
+    def publish(self, force: bool = False) -> bool:
+        if not force and \
+                self.clock() - self._last_publish < self.every_s:
+            return False
+        snap = self.snapshot()
+        with self._io_lock:
+            path = self.telemetry_path
+            path.parent.mkdir(parents=True, exist_ok=True)
+            _atomic_write_json(path, snap)
+            self._last_publish = self.clock()
+        with _lock:
+            _counters["publishes"] += 1
+        FLEET_PUBLISHES.inc()
+        return True
+
+    # -- the flight recorder --------------------------------------------
+    def dump(self, cause, extra: Optional[Dict[str, Any]] = None,
+             republish: bool = True) -> Optional[str]:
+        """Write the versioned postmortem bundle: the step ring, the
+        obs report tail (spans + metric families + health), and the
+        fleet skew view at the moment of death. ``cause`` is an
+        exception or a string. ``republish=False`` skips the final
+        snapshot publish — the EVICTED path must not resurrect the
+        telemetry file the leader's eviction bundle just retired (a
+        lease-less snapshot reads as a corpse forever). Best-effort by
+        construction — a dump must never turn one failure into two."""
+        global _bundle_seq
+        from deeplearning4j_tpu import obs
+        t = self.clock()
+        if republish:
+            try:
+                self.publish(force=True)  # final telemetry for peers
+            except Exception:            # pragma: no cover - disk gone
+                logger.exception("fleet: final publish failed")
+        cause_name = (type(cause).__name__
+                      if isinstance(cause, BaseException) else str(cause))
+        bundle: Dict[str, Any] = {
+            "version": BUNDLE_VERSION,
+            "host": self.host,
+            "pid": os.getpid(),
+            "t_wall": t,
+            "cause": cause_name,
+            "message": str(cause),
+            "step": self.step,
+            "mesh_epoch": self.mesh_epoch,
+            "ring": list(self._ring),
+        }
+        if isinstance(cause, BaseException):
+            for attr in ("layer", "kind", "iteration"):
+                v = getattr(cause, attr, None)
+                if v is not None:
+                    bundle.setdefault("origin", {})[attr] = v
+        if extra:
+            bundle.update(extra)
+        try:
+            bundle["report"] = obs.report(spans=50)
+        except Exception:                # pragma: no cover
+            logger.exception("fleet: obs.report failed in dump")
+        try:
+            # aggregate in THIS publisher's clock domain — mixing an
+            # injected clock's stamps with wall time would mark every
+            # lease/snapshot astronomically stale
+            view = aggregate(self.dir, now=t)
+            bundle["fleet"] = {"hosts": view.table(),
+                               "skew": view.skew_report()}
+        except Exception:                # pragma: no cover
+            logger.exception("fleet: skew aggregation failed in dump")
+        with _lock:
+            _bundle_seq += 1
+            seq = _bundle_seq
+        path = (self.dir / "postmortem" /
+                f"{self.host}.{cause_name}.{os.getpid()}.{seq}.json")
+        try:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            _atomic_write_json(path, bundle)
+        except Exception:                # pragma: no cover - disk gone
+            logger.exception("fleet: postmortem write failed")
+            return None
+        with _lock:
+            _counters["dumps"] += 1
+        FLIGHT_DUMPS.labels(cause=cause_name).inc()
+        logger.warning("FLIGHT_RECORDER host=%s cause=%s step=%d -> %s",
+                       self.host, cause_name, self.step, path)
+        return str(path)
+
+
+def record_eviction(directory, dead_host: str, *, by: str,
+                    now: Optional[float] = None,
+                    cause: str = "Evicted") -> Optional[str]:
+    """Surviving-leader half of the flight recorder: snapshot the dead
+    host's FINAL telemetry into a postmortem bundle (named for the
+    corpse, recorded by the evictor) and retire its live snapshot so
+    the fleet view stops counting it. No-op when the dead host never
+    published (fleet plane off). Called by
+    ``MembershipCoordinator.evict_expired`` — only the winner of the
+    lease ``os.replace`` race calls it, so exactly one bundle. A
+    graceful departure takes the same path with ``cause="Departed"``
+    (``record_departure``), recorded by the departing host itself —
+    without the retirement, a long-gone peer's stale snapshot would
+    read lease-less, i.e. dead, and be named straggler forever."""
+    d = Path(directory)
+    live = d / "telemetry" / f"{dead_host}.json"
+    snap = _read_json(live)
+    if snap is None:
+        return None
+    now = time.time() if now is None else now
+    bundle = {
+        "version": BUNDLE_VERSION,
+        "host": str(dead_host),
+        "cause": str(cause),
+        "recorded_by": str(by),
+        "t_wall": now,
+        "step": snap.get("step"),
+        "mesh_epoch": snap.get("mesh_epoch"),
+        "final_telemetry": snap,
+    }
+    try:
+        # the ADJUDICATED skew view: computed at eviction time, while
+        # the corpse's snapshot is still live but its lease is gone —
+        # survivor dumps race an instant transport error and can
+        # misattribute; this one cannot (the lease verdict is in)
+        view = aggregate(d, now=now)
+        bundle["fleet"] = {"hosts": view.table(),
+                           "skew": view.skew_report()}
+    except Exception:                    # pragma: no cover
+        logger.exception("fleet: eviction skew aggregation failed")
+    path = d / "postmortem" / \
+        f"{dead_host}.{str(cause).lower()}.{int(now)}.json"
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        _atomic_write_json(path, bundle)
+        live.unlink(missing_ok=True)
+    except OSError:                      # pragma: no cover
+        logger.exception("fleet: eviction bundle write failed")
+        return None
+    with _lock:
+        _counters["dumps"] += 1
+    FLIGHT_DUMPS.labels(cause=str(cause)).inc()
+    logger.warning("FLIGHT_RECORDER host=%s cause=%s by=%s -> %s",
+                   dead_host, cause, by, path)
+    return str(path)
+
+
+def record_departure(directory, host: str,
+                     now: Optional[float] = None) -> Optional[str]:
+    """Graceful-departure retirement: the LEAVING host moves its own
+    final telemetry into a ``<host>.departed.<ts>.json`` bundle so
+    the fleet view stops counting it (a lingering snapshot with no
+    lease reads as a corpse and would be named straggler forever)."""
+    return record_eviction(directory, host, by=host, now=now,
+                           cause="Departed")
+
+
+# -- aggregation -------------------------------------------------------------
+
+def read_snapshots(directory) -> Dict[str, dict]:
+    """Every parseable, version-compatible snapshot under
+    ``<directory>/telemetry/`` (or ``directory`` itself when pointed
+    straight at a telemetry dir). Incompatible versions are skipped,
+    not fatal — a mixed-version fleet mid-rollout must still
+    aggregate what it can."""
+    d = Path(directory)
+    if not (d / "telemetry").is_dir() and d.name == "telemetry":
+        tdir = d
+    else:
+        tdir = d / "telemetry"
+    out: Dict[str, dict] = {}
+    if not tdir.is_dir():
+        return out
+    for p in sorted(tdir.glob("*.json")):
+        snap = _read_json(p)
+        if not snap or "host" not in snap:
+            continue
+        if snap.get("version") != SNAPSHOT_VERSION:
+            logger.warning("fleet: skipping %s (snapshot version %r, "
+                           "want %d)", p.name, snap.get("version"),
+                           SNAPSHOT_VERSION)
+            continue
+        out[str(snap["host"])] = snap
+    return out
+
+
+def _read_leases(directory, now: float) -> Dict[str, Dict[str, float]]:
+    """Lease evidence from the elastic members/ dir — the
+    authoritative liveness signal straggler attribution anchors on:
+    ``{host: {"age_s", "lease_secs"}}``. Read directly (tolerantly)
+    so the aggregator needs no coordinator instance."""
+    out: Dict[str, Dict[str, float]] = {}
+    mdir = Path(directory) / "members"
+    if not mdir.is_dir():
+        return out
+    for p in mdir.glob("*.json"):
+        lease = _read_json(p)
+        if lease and "host" in lease:
+            out[str(lease["host"])] = {
+                "age_s": now - float(lease.get("t", 0.0)),
+                "lease_secs": float(lease.get("lease_secs", 0.0)),
+            }
+    return out
+
+
+class FleetView:
+    """One merged view over every host's snapshot: the per-host table,
+    the collective-skew report, and the fleet-level exposition."""
+
+    def __init__(self, snapshots: Dict[str, dict], *,
+                 directory=None, now: Optional[float] = None):
+        self.snapshots = snapshots
+        self.dir = Path(directory) if directory is not None else None
+        # "now" for age math: never run ahead of the snapshots' own
+        # clock domain (tests drive fake clocks), never behind it
+        t_max = max([s.get("t_wall", 0.0)
+                     for s in snapshots.values()] or [0.0])
+        self.now = max(t_max, time.time() if now is None else now)
+        self.leases = (_read_leases(self.dir, self.now)
+                       if self.dir is not None else {})
+        # whether a membership plane exists at all: when it does, a
+        # host with NO live lease file is presumed dead (evicted,
+        # expired-and-moved, or gracefully departed) — the strongest
+        # lateness signal there is
+        self._has_lease_plane = (
+            self.dir is not None and (self.dir / "members").is_dir())
+        # a view is a point-in-time read — memoize the derived
+        # products so exposition() (which needs both) and its callers
+        # (which often also want them) compute each once
+        self._table: Optional[Dict[str, Dict[str, Any]]] = None
+        self._skew: Any = _UNSET
+
+    def _dead_hosts(self) -> List[str]:
+        """Hosts whose LEASE evidence says they are gone: no live
+        lease file (while a membership plane exists) or a lease older
+        than its own window. Snapshot staleness alone is NOT death —
+        at a slow publish cadence every healthy peer looks stale."""
+        if not self._has_lease_plane:
+            return []
+        dead = []
+        for h in self.snapshots:
+            lease = self.leases.get(h)
+            if lease is None:
+                dead.append(h)
+            elif lease["lease_secs"] > 0 and \
+                    lease["age_s"] > lease["lease_secs"]:
+                dead.append(h)
+        return sorted(dead)
+
+    def table(self) -> Dict[str, Dict[str, Any]]:
+        """{host: {step, mesh_epoch, age_s}} — the tpu_watch table."""
+        if self._table is None:
+            self._table = {
+                h: {"step": s.get("step"),
+                    "mesh_epoch": s.get("mesh_epoch"),
+                    "age_s": round(self.now - s.get("t_wall", 0.0), 3)}
+                for h, s in sorted(self.snapshots.items())}
+        return self._table
+
+    def evicted(self) -> List[str]:
+        """Hosts with an eviction bundle under ``postmortem/``."""
+        if self.dir is None:
+            return []
+        pdir = self.dir / "postmortem"
+        if not pdir.is_dir():
+            return []
+        return sorted({p.name.split(".evicted.")[0]
+                       for p in pdir.glob("*.evicted.*.json")})
+
+    # -- straggler attribution -----------------------------------------
+    def _enters(self) -> Dict[int, Dict[str, float]]:
+        """{step: {host: barrier_enter}} across every snapshot."""
+        out: Dict[int, Dict[str, float]] = {}
+        for host, snap in self.snapshots.items():
+            for b in snap.get("barriers", []):
+                try:
+                    step, t_enter = int(b[0]), float(b[1])
+                except (TypeError, ValueError, IndexError):
+                    continue
+                out.setdefault(step, {})[host] = t_enter
+        return out
+
+    def skew_report(self) -> Optional[Dict[str, Any]]:
+        """Per-step collective skew + the named straggler.
+
+        For each step, skew = spread of barrier-entry stamps across
+        the hosts that entered it; ``last_in`` is the latest entrant.
+
+        Attribution anchors on LEASE evidence, never on snapshot
+        staleness: with hosts publishing on a cadence, every healthy
+        peer's snapshot lags the newest one by up to the cadence, so
+        "missing from the newest step" is normal, not a verdict.
+
+        - When some host is lease-dead (no live lease while a
+          membership plane exists, or its lease outlived its own
+          window), THAT is the straggler — the stalest-evidence corpse
+          first. A SIGKILLed host is named even though it never
+          stamped the final step (every survivor wedges at the same
+          barrier, so entry times cannot tell corpse from
+          victim-of-corpse). With an INSTANT transport error the
+          leases are still fresh at dump time, which is why the
+          eviction-time bundle — written after the lease verdict — is
+          the adjudicated naming and survivor dumps are best-effort.
+        - With every lease live, the anchor is the newest step COMMON
+          to every live host's published window (falling back to the
+          newest step anywhere when windows don't overlap), and the
+          straggler is simply the last entrant there."""
+        if self._skew is not _UNSET:
+            return self._skew
+        self._skew = self._skew_report()
+        return self._skew
+
+    def _skew_report(self) -> Optional[Dict[str, Any]]:
+        enters = self._enters()
+        if not enters or not self.snapshots:
+            return None
+        dead = self._dead_hosts()
+        live = [h for h in self.snapshots if h not in dead]
+        live_steps = [
+            {s for s, ts in enters.items() if h in ts} for h in live]
+        common = set.intersection(*live_steps) \
+            if live_steps and all(live_steps) else set()
+        if common:
+            step = max(common)
+        else:
+            # disjoint windows (steps much faster than the cadence):
+            # anchor on the newest step with >= 2 entrants — a
+            # single-entrant anchor has no cross-host spread to read
+            multi = [s for s, ts in enters.items() if len(ts) >= 2]
+            step = max(multi) if multi else max(enters)
+        at_step = enters[step]
+        min_enter = min(at_step.values())
+        skew = {h: round(t - min_enter, 6)
+                for h, t in at_step.items()}
+        # only lease-dead hosts are "missing" — their lateness is a
+        # lower bound, not a stamp
+        missing = [h for h in dead if h not in at_step]
+        est = round(max(0.0, self.now - min_enter), 6)
+        for h in missing:
+            skew[h] = est
+
+        def lateness(h):
+            snap_age = self.now - self.snapshots[h].get("t_wall", 0.0)
+            lease = self.leases.get(h)
+            if lease is None:       # no lease at all: deadest evidence
+                return (1, 0.0, snap_age)
+            return (0, lease["age_s"], snap_age)
+
+        if dead:
+            straggler = max(dead, key=lateness)
+        elif len(at_step) >= 2:
+            straggler = max(at_step, key=at_step.get)
+        else:
+            # one entrant and nobody dead: there is no straggler to
+            # name — naming the lone (often the FASTEST) publisher
+            # would be pure noise
+            straggler = None
+        series = []
+        for s in sorted(enters)[-BARRIER_KEEP:]:
+            ts = enters[s]
+            if len(ts) < 2:
+                continue
+            lo, hi = min(ts.values()), max(ts.values())
+            series.append([s, round(hi - lo, 6),
+                           max(ts, key=ts.get)])
+        return {"step": step, "skew_s": skew, "missing": missing,
+                "dead": dead, "straggler": straggler,
+                "max_skew_s": max(skew.values()) if skew else 0.0,
+                "series": series}
+
+    # -- fleet-level exposition ----------------------------------------
+    def exposition(self) -> str:
+        """Fleet-level Prometheus text: every host's samples
+        re-labelled with ``host=`` / ``mesh_epoch=``, grouped per
+        family with TYPE from the ``metrics.FAMILIES`` registry, plus
+        the aggregator-computed skew/straggler/age/host-count
+        families."""
+        fam_kind = dict(_metrics.FAMILIES)
+        by_family: Dict[str, List[str]] = {}
+
+        def base_family(name: str) -> str:
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix) and \
+                        fam_kind.get(name[:-len(suffix)]) == "histogram":
+                    return name[:-len(suffix)]
+            return name
+
+        for host, snap in sorted(self.snapshots.items()):
+            epoch = str(snap.get("mesh_epoch", 0))
+            try:
+                fams = _metrics.parse_exposition(
+                    snap.get("exposition", ""))
+            except ValueError:
+                logger.warning("fleet: unparseable exposition from "
+                               "host %r — skipped", host)
+                continue
+            for (name, labels), value in fams.items():
+                merged = dict(labels)
+                merged["host"] = host
+                merged["mesh_epoch"] = epoch
+                by_family.setdefault(base_family(name), []).append(
+                    f"{name}{_metrics._label_str(merged)} {value}")
+        agg: Dict[str, List[str]] = {
+            "dl4j_tpu_fleet_hosts":
+                [f"dl4j_tpu_fleet_hosts {len(self.snapshots)}"],
+            "dl4j_tpu_fleet_snapshot_age_seconds": [
+                f"dl4j_tpu_fleet_snapshot_age_seconds"
+                f"{_metrics._label_str({'host': h})} {v['age_s']}"
+                for h, v in self.table().items()],
+        }
+        rep = self.skew_report()
+        if rep:
+            agg["dl4j_tpu_collective_skew_seconds"] = [
+                f"dl4j_tpu_collective_skew_seconds"
+                f"{_metrics._label_str({'host': h})} {v}"
+                for h, v in sorted(rep["skew_s"].items())]
+            agg["dl4j_tpu_collective_straggler"] = [
+                f"dl4j_tpu_collective_straggler"
+                f"{_metrics._label_str({'host': h})} "
+                f"{int(h == rep['straggler'])}"
+                for h in sorted(self.snapshots)]
+        by_family.update(agg)
+        lines: List[str] = []
+        for fam in sorted(by_family):
+            kind = fam_kind.get(fam) or AGGREGATE_FAMILIES.get(fam)
+            if kind:
+                lines.append(f"# TYPE {fam} {kind}")
+            lines.extend(by_family[fam])
+        return "\n".join(lines) + "\n"
+
+
+def aggregate(directory, now: Optional[float] = None) -> FleetView:
+    """Read every snapshot under ``directory`` (the shared elastic
+    dir) and return the merged :class:`FleetView`."""
+    return FleetView(read_snapshots(directory), directory=directory,
+                     now=now)
+
+
+# -- bench/dossier harness ---------------------------------------------------
+
+def measure_publish_overhead(step_seconds: Optional[float] = None,
+                             iters: int = 2000,
+                             every_s: float = 1.0) -> Dict[str, Any]:
+    """Measure the fleet plane's per-step costs: the OFF path (the one
+    ``is None`` branch every non-fleet step pays), the ON-path
+    ``record_step`` (ring append + cadence check; publishes amortized
+    at ``every_s``), and one full snapshot publish — the ``fleet_obs``
+    section of ``bench.py`` / the dossier. Probe counters are scrubbed
+    so the synthetic samples never reach the off-path fences."""
+    import tempfile
+
+    pubs0, dumps0 = _counters["publishes"], _counters["dumps"]
+    fam0 = FLEET_PUBLISHES._children[()].value
+    ft = None
+    t0 = _trace.now()
+    for i in range(iters):
+        if ft is not None:           # the exact branch the step pays
+            ft.record_step(i)
+    off = (_trace.now() - t0) / iters
+    with tempfile.TemporaryDirectory(prefix="fleet_bench_") as d:
+        ft = FleetTelemetry(d, "bench-probe", every_s=every_s)
+        base = time.time()
+        t1 = _trace.now()
+        for i in range(iters):
+            ft.record_step(i, mesh_epoch=1, t_enter=base,
+                           t_exit=base, loss=0.0)
+        on = (_trace.now() - t1) / iters
+        t2 = _trace.now()
+        ft.publish(force=True)
+        publish_s = _trace.now() - t2
+        published = _counters["publishes"] - pubs0
+    with _lock:                      # scrub the probe's counters
+        _counters["publishes"] = pubs0
+        _counters["dumps"] = dumps0
+    with FLEET_PUBLISHES._lock:
+        FLEET_PUBLISHES._children[()].value = fam0
+    out: Dict[str, Any] = {
+        "off_path_cost_us": round(off * 1e6, 3),
+        "on_path_record_us": round(on * 1e6, 3),
+        "publish_ms": round(publish_s * 1e3, 3),
+        "publish_interval_s": every_s,
+        "publishes": published,
+    }
+    if step_seconds:
+        # at cadence: one publish per every_s, record cost per step
+        per_step = on + publish_s * step_seconds / max(every_s, 1e-9)
+        out["step_ms"] = round(step_seconds * 1e3, 3)
+        out["overhead_pct_of_step"] = round(
+            100.0 * per_step / step_seconds, 4)
+        out["off_path_pct_of_step"] = round(
+            100.0 * off / step_seconds, 4)
+    return out
+
+
+__all__ = ["FleetTelemetry", "FleetView", "aggregate",
+           "read_snapshots", "record_eviction", "publishes", "dumps",
+           "reset_counters", "measure_publish_overhead",
+           "SNAPSHOT_VERSION", "BUNDLE_VERSION", "AGGREGATE_FAMILIES"]

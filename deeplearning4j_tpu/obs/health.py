@@ -22,6 +22,13 @@ from deeplearning4j_tpu.obs import trace as _trace
 
 _lock = threading.Lock()
 _beats: Dict[str, float] = {}
+#: per-worker staleness threshold overrides — how host LEASES keep
+#: their own window (DL4J_TPU_HOST_LEASE_SECS) inside this one table:
+#: a host the coordinator would evict at 15s must not read "ok" on
+#: /healthz until the generic 30s worker default (no divergent
+#: staleness verdicts between the membership plane and the scrape
+#: surface)
+_stale_after: Dict[str, float] = {}
 
 
 def heartbeat(worker: str, t: Optional[float] = None) -> None:
@@ -30,14 +37,20 @@ def heartbeat(worker: str, t: Optional[float] = None) -> None:
         _beats[str(worker)] = _trace.now() if t is None else t
 
 
-def observe_age(worker: str, age_s: float) -> None:
+def observe_age(worker: str, age_s: float,
+                stale_after: Optional[float] = None) -> None:
     """Record a beat whose AGE is known instead of its timestamp —
     how the elastic membership coordinator mirrors cross-process lease
     files (wall-clock deadlines) into this monotonic registry: a peer
     whose lease is ``age_s`` stale shows the same staleness on
     ``/healthz`` and ``dl4j_tpu_worker_stale``, so a dying host is
-    named by the scrape surface before the fleet even re-forms."""
+    named by the scrape surface before the fleet even re-forms.
+    ``stale_after`` pins THIS worker's staleness threshold (the lease
+    window for hosts) so both planes render one verdict."""
     heartbeat(worker, _trace.now() - max(0.0, float(age_s)))
+    if stale_after is not None:
+        with _lock:
+            _stale_after[str(worker)] = float(stale_after)
 
 
 def retire(worker: str) -> None:
@@ -48,18 +61,24 @@ def retire(worker: str) -> None:
     retires, so the stale alarm still fires for real wedges."""
     with _lock:
         _beats.pop(str(worker), None)
+        _stale_after.pop(str(worker), None)
 
 
 def check(stale_after: Optional[float] = None,
           now: Optional[float] = None) -> Dict[str, Dict]:
-    """``{worker: {"age_s", "stale"}}`` for every known worker."""
+    """``{worker: {"age_s", "stale"}}`` for every known worker. A
+    per-worker threshold recorded via :func:`observe_age` wins over
+    the default (it is that worker's authoritative liveness window —
+    e.g. a host's lease)."""
     if stale_after is None:
         from deeplearning4j_tpu import environment
         stale_after = environment.get_flag("DL4J_TPU_STALE_WORKER_SECS")
     now = _trace.now() if now is None else now
     with _lock:
         beats = dict(_beats)
-    return {w: {"age_s": now - t, "stale": (now - t) > stale_after}
+        overrides = dict(_stale_after)
+    return {w: {"age_s": now - t,
+                "stale": (now - t) > overrides.get(w, stale_after)}
             for w, t in beats.items()}
 
 
@@ -72,3 +91,4 @@ def stale_workers(stale_after: Optional[float] = None,
 def reset() -> None:
     with _lock:
         _beats.clear()
+        _stale_after.clear()

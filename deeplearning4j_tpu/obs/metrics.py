@@ -45,6 +45,66 @@ LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256)
 
+#: THE metric-family registry: every ``dl4j_tpu_*`` family name in the
+#: package — registered families, pull-time collector families, and
+#: the fleet aggregator's computed families — declared ONCE here.
+#: ``tools/lint_instrumentation.py`` rule 6 keeps this table, the emit
+#: sites, ``tools/tpu_watch.py``, and ``docs/OPS.md`` in lockstep so a
+#: family can't drift into three spellings across producers and
+#: consumers. Add the name here FIRST when introducing a family.
+FAMILIES = {
+    # fit/serve hot paths (this module)
+    "dl4j_tpu_step_latency_seconds": "histogram",
+    "dl4j_tpu_steps_total": "counter",
+    "dl4j_tpu_h2d_seconds_total": "counter",
+    "dl4j_tpu_device_sync_seconds_total": "counter",
+    "dl4j_tpu_fit_etl_seconds_total": "counter",
+    "dl4j_tpu_prefetch_wait_seconds_total": "counter",
+    "dl4j_tpu_prefetch_depth": "gauge",
+    "dl4j_tpu_worker_step_latency_seconds": "histogram",
+    "dl4j_tpu_worker_collective_sync_seconds_total": "counter",
+    "dl4j_tpu_inference_requests_total": "counter",
+    "dl4j_tpu_inference_request_latency_seconds": "histogram",
+    "dl4j_tpu_inference_queue_depth": "gauge",
+    "dl4j_tpu_inference_batch_size": "histogram",
+    # resilience + elastic membership
+    "dl4j_tpu_resilience_restarts_total": "counter",
+    "dl4j_tpu_inference_requests_shed_total": "counter",
+    "dl4j_tpu_checkpoints_quarantined_total": "counter",
+    "dl4j_tpu_faults_injected_total": "counter",
+    "dl4j_tpu_preemptions_total": "counter",
+    "dl4j_tpu_mesh_epoch": "gauge",
+    "dl4j_tpu_hosts_evicted_total": "counter",
+    # parallel training
+    "dl4j_tpu_opt_state_bytes_per_device": "gauge",
+    # perf collector (retrace sentry + persistent compile cache)
+    "dl4j_tpu_retrace_traces_total": "counter",
+    "dl4j_tpu_retrace_unplanned_shapes": "gauge",
+    "dl4j_tpu_retrace_compiles_total": "counter",
+    "dl4j_tpu_aot_hits_total": "counter",
+    "dl4j_tpu_compile_time_seconds_total": "counter",
+    "dl4j_tpu_compile_cache_requests_total": "counter",
+    "dl4j_tpu_compile_cache_hits_total": "counter",
+    # worker/host health collector
+    "dl4j_tpu_worker_heartbeat_age_seconds": "gauge",
+    "dl4j_tpu_worker_stale": "gauge",
+    # numerics observatory (obs/numerics.py)
+    "dl4j_tpu_numerics_grad_norm": "gauge",
+    "dl4j_tpu_numerics_update_ratio": "gauge",
+    "dl4j_tpu_numerics_activation_absmax": "gauge",
+    "dl4j_tpu_numerics_replica_divergence": "gauge",
+    "dl4j_tpu_numerics_param_replica_divergence": "gauge",
+    "dl4j_tpu_numerics_nonfinite_total": "counter",
+    "dl4j_tpu_numerics_diag_steps_total": "counter",
+    # fleet observability plane (obs/fleet.py)
+    "dl4j_tpu_fleet_snapshots_published_total": "counter",
+    "dl4j_tpu_flight_recorder_dumps_total": "counter",
+    "dl4j_tpu_collective_skew_seconds": "gauge",
+    "dl4j_tpu_collective_straggler": "gauge",
+    "dl4j_tpu_fleet_hosts": "gauge",
+    "dl4j_tpu_fleet_snapshot_age_seconds": "gauge",
+}
+
 
 def _escape(v: str) -> str:
     return str(v).replace("\\", r"\\").replace("\n", r"\n") \
@@ -485,10 +545,26 @@ def parse_exposition(text: str) -> Dict[Tuple[str, Tuple], float]:
 
 # -- /metrics + /healthz server ----------------------------------------------
 
+#: shared elastic dir the ``/fleet`` path aggregates over (None = 404)
+_fleet_dir: Optional[str] = None
+
+
+def set_fleet_dir(directory) -> None:
+    """Point the standing server's ``/fleet`` path at a fleet plane's
+    shared directory: the endpoint then serves the MERGED fleet
+    exposition (every host's families with ``host=``/``mesh_epoch=``
+    labels plus collective-skew attribution) next to the per-process
+    ``/metrics`` — one server, both altitudes."""
+    global _fleet_dir
+    _fleet_dir = None if directory is None else str(directory)
+
+
 class MetricsServer:
     """Stdlib HTTP endpoint: ``/metrics`` (Prometheus text),
     ``/healthz`` (JSON liveness: 200 when no worker is stale, 503
-    otherwise). Pattern shared with ``train.stats.UIServer``."""
+    otherwise), ``/fleet`` (merged fleet exposition when
+    :func:`set_fleet_dir` configured one). Pattern shared with
+    ``train.stats.UIServer``."""
 
     def __init__(self, port: int = 0, registry: MetricsRegistry = None):
         self.port = port
@@ -503,7 +579,14 @@ class MetricsServer:
         stale = sorted(w for w, s in chk.items() if s["stale"])
         return {
             "status": "stale_workers" if stale else "ok",
+            # ONE staleness table: worker heartbeats and elastic host
+            # leases (mirrored in via health.observe_age with their
+            # own lease window) — stale_hosts is the host: subset with
+            # the prefix stripped, so a 503 names dying PEERS next to
+            # wedged local workers with no divergent verdicts
             "stale_workers": stale,
+            "stale_hosts": [w[len("host:"):] for w in stale
+                            if w.startswith("host:")],
             "workers": {w: round(s["age_s"], 3)
                         for w, s in chk.items()},
             "uptime_s": round(_trace.now() - self._t_start, 3),
@@ -527,9 +610,28 @@ class MetricsServer:
                     body = json.dumps(h).encode()
                     code = 200 if h["status"] == "ok" else 503
                     ctype = "application/json"
+                elif path == "/fleet":
+                    if _fleet_dir is None:
+                        body = b"no fleet dir configured "\
+                               b"(obs.metrics.set_fleet_dir)\n"
+                        code, ctype = 404, "text/plain"
+                    else:
+                        try:
+                            from deeplearning4j_tpu.obs import fleet
+                            body = fleet.aggregate(_fleet_dir)\
+                                .exposition().encode()
+                            code, ctype = 200, \
+                                "text/plain; version=0.0.4; " \
+                                "charset=utf-8"
+                        except Exception as e:
+                            # a shared-FS hiccup must answer 500, not
+                            # drop the socket mid-request
+                            body = f"fleet aggregation failed: " \
+                                   f"{e!r}\n".encode()
+                            code, ctype = 500, "text/plain"
                 else:
                     body = (b"deeplearning4j_tpu telemetry: "
-                            b"/metrics /healthz\n")
+                            b"/metrics /healthz /fleet\n")
                     code, ctype = 200, "text/plain"
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)

@@ -960,12 +960,15 @@ class ParallelWrapper:
                     break
                 obs.record_etl("ParallelWrapper.fit", te0, obs.now())
                 faults.inject("worker_step")  # site: worker loop body
-                if self.elastic is not None:
-                    # mesh-epoch stamp + lease renewal + the
-                    # host_death drill site (resilience/elastic.py)
-                    self.elastic.pre_step(net.iteration)
                 if n_steps is not None and step_i >= n_steps:
                     break               # stay in lockstep across hosts
+                if self.elastic is not None:
+                    # mesh-epoch stamp + lease renewal + the
+                    # host_death drill site (resilience/elastic.py) —
+                    # AFTER the lockstep break, so a surplus local
+                    # batch never stamps a phantom barrier entry for
+                    # a step the fleet will never dispatch
+                    self.elastic.pre_step(net.iteration)
                 t0 = obs.now()
                 x, y = ds.features, ds.labels
                 bsz = jax.tree.leaves(x)[0].shape[0]
@@ -1061,7 +1064,18 @@ class ParallelWrapper:
                 # within the lease window instead of hanging forever
                 net.score_ = float(loss) if self.elastic is None \
                     else self.elastic.sync(loss)
-                obs.record_worker_step(worker, t0, t1, t2, obs.now())
+                # stamp the step end BEFORE the fleet hook: the
+                # cadence-gated snapshot publish fsyncs to the shared
+                # dir, and that I/O must not masquerade as
+                # collective-sync wall time in the very metrics the
+                # straggler hunt reads
+                t3 = obs.now()
+                if self.elastic is not None:
+                    # fleet plane: barrier-exit stamp + flight-recorder
+                    # ring + cadence-gated telemetry publish (a no-op
+                    # branch when no FleetTelemetry is installed)
+                    self.elastic.post_step(net.iteration, net.score_)
+                obs.record_worker_step(worker, t0, t1, t2, t3)
                 net.iteration += 1
                 if diag is not None:
                     # publishes per-layer gauges incl. the replica-

@@ -269,8 +269,15 @@ class MembershipCoordinator:
                 "t": now, "lease_secs": self.lease_secs})
             self._last_renew = now
         for host, lease in self._leases().items():
-            health.observe_age(f"host:{host}",
-                               max(0.0, now - lease.get("t", 0.0)))
+            # each lease carries its OWN staleness window into the
+            # health table, so /healthz and the eviction logic render
+            # one verdict (a host 20s silent under a 15s lease must
+            # not read "ok" against the generic 30s worker default)
+            health.observe_age(
+                f"host:{host}",
+                max(0.0, now - lease.get("t", 0.0)),
+                stale_after=float(lease.get("lease_secs",
+                                            self.lease_secs)))
 
     def maybe_renew(self, every: Optional[float] = None) -> bool:
         """Renew when more than ``every`` (default: a third of the
@@ -315,8 +322,20 @@ class MembershipCoordinator:
     def leave(self) -> None:
         """Graceful departure (the SIGTERM path): drop the lease NOW so
         survivors evict this host at the next agreement instead of
-        waiting out the lease window."""
+        waiting out the lease window. The fleet-plane snapshot is
+        retired first (into a ``departed`` bundle) — a stale snapshot
+        with no lease would read as a corpse to the skew attribution
+        forever."""
         self.stop_auto_renew()
+        try:
+            from deeplearning4j_tpu import obs
+            # now= keeps the bundle in THIS coordinator's clock domain
+            # (an injected clock mixed with wall time reads every
+            # lease as astronomically stale)
+            obs.fleet.record_departure(self.dir, self.host,
+                                       now=self.clock())
+        except Exception:           # pragma: no cover - best effort
+            logger.exception("elastic: departure bundle failed")
         self._lease_path(self.host).unlink(missing_ok=True)
 
     def _leases(self) -> Dict[str, dict]:
@@ -356,6 +375,15 @@ class MembershipCoordinator:
                 continue            # a peer moved it first — fine
             evicted.append(host)
             obs.metrics.HOSTS_EVICTED.inc()
+            # flight recorder, leader half: exactly one peer wins the
+            # os.replace above, and that peer snapshots the corpse's
+            # FINAL telemetry into a postmortem bundle (no-op when the
+            # fleet plane never published for it)
+            try:
+                obs.fleet.record_eviction(self.dir, host,
+                                          by=self.host, now=now)
+            except Exception:       # pragma: no cover - best effort
+                logger.exception("elastic: eviction bundle failed")
             logger.warning(
                 "elastic: evicted host %r (lease %.1fs overdue)",
                 host, age - self.lease_secs)
@@ -529,10 +557,16 @@ class ElasticContext:
 
     def __init__(self, coordinator: MembershipCoordinator, record: dict,
                  collective_timeout_s: Optional[float] = None,
-                 compile_grace_s: float = 300.0):
+                 compile_grace_s: float = 300.0,
+                 fleet=None):
         self.coordinator = coordinator
         self.record = record
         self.epoch = int(record["epoch"])
+        #: optional ``obs.fleet.FleetTelemetry`` — when set, every
+        #: step stamps barrier entry/exit into the published snapshot
+        #: (the aggregator's skew-attribution source); None costs one
+        #: branch per step
+        self.fleet = fleet
         # default: two lease windows — a dead peer's lease expires and
         # is evictable by the time the watchdog fires
         self.collective_timeout_s = (
@@ -565,6 +599,22 @@ class ElasticContext:
             self._last_epoch_check = now
             self.coordinator.check_epoch(self.epoch)
             obs.metrics.MESH_EPOCH.set(self.epoch)
+        if self.fleet is not None:
+            # barrier-ENTRY stamp (wall clock, cross-host comparable):
+            # a host that stamps this late every step IS the straggler
+            # the fleet aggregator names
+            self.fleet.note_enter(iteration, t=now)
+
+    def post_step(self, iteration: int, loss: float) -> None:
+        """Barrier-EXIT stamp + flight-recorder ring entry +
+        cadence-gated snapshot publish, called by the wrapper once the
+        loss sync lands. The off path (no fleet plane) is this one
+        branch."""
+        if self.fleet is None:
+            return
+        self.fleet.record_step(iteration, mesh_epoch=self.epoch,
+                               loss=loss,
+                               t_exit=self.coordinator.clock())
 
     def run(self, fn: Callable[[], object]):
         """A step dispatch under the watchdog — a dead peer turns an
@@ -654,7 +704,9 @@ class ElasticTrainer:
                  sharded_update: bool = True,
                  save_every: int = 2, keep_last: int = 20,
                  collective_timeout_s: Optional[float] = None,
-                 max_reforms: int = 5):
+                 max_reforms: int = 5,
+                 fleet_telemetry: Optional[bool] = None):
+        from deeplearning4j_tpu import environment
         self.net_factory = net_factory
         self.ckpt_dir = Path(ckpt_dir)
         self.coordinator = coordinator
@@ -663,6 +715,10 @@ class ElasticTrainer:
         self.keep_last = keep_last
         self.collective_timeout_s = collective_timeout_s
         self.max_reforms = max_reforms
+        self.fleet_telemetry = bool(
+            environment.get_flag("DL4J_TPU_FLEET_TELEMETRY")
+            if fleet_telemetry is None else fleet_telemetry)
+        self.fleet = None
         self.wrapper = None
         self.net = None
         self.record: Optional[dict] = None
@@ -701,8 +757,19 @@ class ElasticTrainer:
         self.wrapper = ParallelWrapper(
             self.net, sharded_update=self.sharded_update,
             prefetch_buffer=0)
+        if self.fleet_telemetry:
+            # the fleet observability plane rides the same shared dir
+            # as the leases: snapshots under telemetry/, postmortem
+            # bundles under postmortem/ (obs/fleet.py)
+            self.fleet = obs.fleet.FleetTelemetry(
+                co.dir, co.host, clock=co.clock)
+            self.fleet.event("mesh_epoch_commit", epoch=rec["epoch"],
+                             members=sorted(rec["members"]),
+                             restarts=restarts)
+            obs.metrics.set_fleet_dir(co.dir)
         self.wrapper.elastic = ElasticContext(
-            co, rec, collective_timeout_s=self.collective_timeout_s)
+            co, rec, collective_timeout_s=self.collective_timeout_s,
+            fleet=self.fleet)
         self.record = rec
         self._ck = ShardedCheckpointer(self.ckpt_dir,
                                        keep_last=self.keep_last,
@@ -786,6 +853,18 @@ class ElasticTrainer:
                                       wait=True,
                                       mesh_epoch=int(
                                           self.record["epoch"]))
+            if self.fleet is not None:
+                # final telemetry: a run shorter than the publish
+                # cadence must still leave its last step in the fleet
+                # view (the same reason the dump paths force-publish).
+                # Best-effort — a telemetry write failure on a
+                # FINISHED run must not classify transient and burn
+                # reform() exec cycles on a job that already succeeded
+                try:
+                    self.fleet.publish(force=True)
+                except Exception:   # pragma: no cover - disk gone
+                    logger.exception("elastic: final telemetry "
+                                     "publish failed")
             return "done"
         except Preempted:
             # graceful departure: drop the lease so survivors evict us
@@ -801,9 +880,14 @@ class ElasticTrainer:
                                       wait=True,
                                       mesh_epoch=int(
                                           self.record["epoch"]))
+            self._flight_dump("preemption")
             self.coordinator.leave()
             return "preempted"
-        except Evicted:
+        except Evicted as e:
+            # no republish: the leader's eviction bundle already
+            # retired this host's snapshot — rewriting it would leave
+            # a lease-less "corpse" in the fleet view forever
+            self._flight_dump(e, republish=False)
             raise
         except (CollectiveTimeoutError, StaleMeshEpoch) as e:
             # dead-peer / stale-straggler signals: re-forming (exec →
@@ -820,8 +904,10 @@ class ElasticTrainer:
                 self.reform(e)      # never returns
             # deterministic failures (shape bugs, NonFiniteError...)
             # would recur identically after every reform — surface
-            # them instead of burning max_reforms fleet-wide
+            # them, with the flight recorder carrying the last-N
+            # steps, instead of burning max_reforms fleet-wide
             # exec/restore cycles on an error no re-formation can fix
+            self._flight_dump(e)
             raise
         finally:
             for l in (listener, gate):
@@ -830,13 +916,25 @@ class ElasticTrainer:
             if handler is not None:
                 handler.uninstall()
 
+    def _flight_dump(self, cause, republish: bool = True) -> None:
+        """Best-effort flight-recorder bundle — the black box must
+        never turn one failure into two."""
+        if self.fleet is None:
+            return
+        try:
+            self.fleet.dump(cause, republish=republish)
+        except Exception:           # pragma: no cover - disk gone
+            logger.exception("elastic: flight-recorder dump failed")
+
     def reform(self, cause: BaseException) -> None:
-        """Peer-failure answer: record the cause, stop renewing from
-        this doomed image, and exec a fresh one. Membership agreement
-        happens in the NEW image's :meth:`bring_up` — the old image
-        still hosts the wedged runtime, whose distributed client may
-        abort the process at any moment; the file plane work must not
-        race against that."""
+        """Peer-failure answer: record the cause (flight-recorder
+        bundle first — the postmortem must survive the exec), stop
+        renewing from this doomed image, and exec a fresh one.
+        Membership agreement happens in the NEW image's
+        :meth:`bring_up` — the old image still hosts the wedged
+        runtime, whose distributed client may abort the process at
+        any moment; the file plane work must not race against that."""
+        self._flight_dump(cause)
         restarts = prior_restarts() + 1
         if restarts > self.max_reforms:
             raise RuntimeError(

@@ -403,7 +403,11 @@ def test_elastic_drill_sigkill_reform_reshard_baseline():
     reshard-restore the newest valid checkpoint (6 shards → 4), and
     the post-recovery trajectory is bit-identical to the same-scale
     uninterrupted baseline; mesh-epoch/eviction/restart metrics are
-    exported."""
+    exported. ISSUE 12 rides the same drill: a flight-recorder bundle
+    must exist whose skew series names the killed host as the
+    final-step straggler, the leader's eviction bundle must carry the
+    corpse's final telemetry, and the surviving epoch's fleet
+    exposition must carry mesh_epoch=2 labels."""
     sys.path.insert(0, str(REPO / "tools"))
     import chaos
     res = chaos._elastic_scenario(hosts=3, kill_host=2,
@@ -415,6 +419,12 @@ def test_elastic_drill_sigkill_reform_reshard_baseline():
     assert res["detect_s"] <= 4 * res["lease_s"]
     assert res["trajectory_match"] is True
     assert res["hosts_evicted"] >= 1 and res["restarts"] >= 1
+    # fleet observability plane (obs/fleet.py, ISSUE 12)
+    assert res["flight_bundles"] >= 2          # survivor dump + evict
+    assert res["straggler_final"] == "h2"      # the corpse, named
+    assert res["evict_bundle_named_dead"] is True
+    assert res["dead_last_step"] and res["dead_last_step"] > 0
+    assert res["fleet_epoch2"] is True
 
 
 @pytest.mark.slow

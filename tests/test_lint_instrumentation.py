@@ -139,6 +139,110 @@ def test_lint_rule5_real_package_sites_all_live_and_drillable():
     assert declared == set(injected)
 
 
+def _metrics_tree(tmp_path, families, body="", watch=None, ops=None):
+    """Synthesize a package tree for rule 6: an obs/metrics.py with a
+    FAMILIES dict + registrations, an optional extra module, and
+    optional tools/tpu_watch.py + docs/OPS.md consumers."""
+    obs_dir = tmp_path / "pkg" / "obs"
+    obs_dir.mkdir(parents=True)
+    fams = ", ".join(f"{k!r}: {v!r}" for k, v in families.items())
+    (obs_dir / "metrics.py").write_text(
+        f"FAMILIES = {{{fams}}}\n"
+        "class MetricsRegistry:\n    pass\n"
+        "REGISTRY = MetricsRegistry()\n" + body)
+    tools_dir = docs_dir = None
+    if watch is not None:
+        tools_dir = tmp_path / "tools"
+        tools_dir.mkdir()
+        (tools_dir / "tpu_watch.py").write_text(watch)
+    if ops is not None:
+        docs_dir = tmp_path / "docs"
+        docs_dir.mkdir()
+        (docs_dir / "OPS.md").write_text(ops)
+    return tmp_path / "pkg", tools_dir, docs_dir
+
+
+def test_lint_rule6_undeclared_dead_and_kind_mismatch(tmp_path):
+    """Rule 6: a registration of an undeclared family is drift; a
+    FAMILIES entry with no emit site is dead; a kind mismatch between
+    declaration and emit site is flagged."""
+    pkg, _t, _d = _metrics_tree(
+        tmp_path,
+        families={"dl4j_tpu_a_total": "counter",
+                  "dl4j_tpu_ghost_total": "counter",
+                  "dl4j_tpu_b_depth": "gauge"},
+        body='A = REGISTRY.counter("dl4j_tpu_a_total", "doc")\n'
+             'B = REGISTRY.counter("dl4j_tpu_b_depth", "doc")\n'
+             'R = REGISTRY.gauge("dl4j_tpu_rogue", "doc")\n')
+    problems = lint_instrumentation.run(pkg, tmp_path / "tests")
+    assert any("dl4j_tpu_rogue" in p and "not declared" in p
+               for p in problems)
+    assert any("dl4j_tpu_ghost_total" in p and "no emit site" in p
+               for p in problems)
+    assert any("dl4j_tpu_b_depth" in p and "counter" in p
+               for p in problems)
+    assert not any("'dl4j_tpu_a_total'" in p for p in problems)
+
+
+def test_lint_rule6_collector_tuples_and_aggregate_tables_count(
+        tmp_path):
+    """Pull-time collector tuples and AGGREGATE_FAMILIES dict entries
+    are emit sites — they keep their declarations alive."""
+    pkg, _t, _d = _metrics_tree(
+        tmp_path,
+        families={"dl4j_tpu_col_total": "counter",
+                  "dl4j_tpu_agg_skew": "gauge"},
+        body='def _collector():\n'
+             '    yield ("dl4j_tpu_col_total", "counter", "d", [])\n'
+             'AGGREGATE_FAMILIES = {"dl4j_tpu_agg_skew": "gauge"}\n')
+    problems = lint_instrumentation.run(pkg, tmp_path / "tests")
+    assert problems == []
+
+
+def test_lint_rule6_consumer_tokens_must_resolve(tmp_path):
+    """Every dl4j_tpu_* token in tpu_watch/OPS.md must name a declared
+    family — exactly, via a histogram sample suffix, or as a prefix
+    filter; an unresolvable token is a dashboard watching nothing."""
+    pkg, tools_dir, docs_dir = _metrics_tree(
+        tmp_path,
+        families={"dl4j_tpu_lat_seconds": "histogram",
+                  "dl4j_tpu_numerics_x": "gauge"},
+        body='H = REGISTRY.histogram("dl4j_tpu_lat_seconds", "d")\n'
+             'G = REGISTRY.gauge("dl4j_tpu_numerics_x", "d")\n',
+        watch='KEYS = ("dl4j_tpu_lat_seconds_count",\n'
+              '        "dl4j_tpu_numerics_")\n'
+              'BAD = "dl4j_tpu_never_emitted_total"\n',
+        ops="Watch `dl4j_tpu_lat_seconds` and the\n"
+            "`dl4j_tpu_retired_family` counter.\n")
+    problems = lint_instrumentation.run(pkg, tmp_path / "tests",
+                                        tools_dir, docs_dir)
+    assert any("tpu_watch" in p and "dl4j_tpu_never_emitted_total" in p
+               for p in problems)
+    assert any("OPS.md" in p and "dl4j_tpu_retired_family" in p
+               for p in problems)
+    # suffix + prefix + exact tokens all resolved
+    assert not any("dl4j_tpu_lat_seconds" in p and "matches no" in p
+                   for p in problems)
+    assert not any("dl4j_tpu_numerics_" in p for p in problems)
+
+
+def test_lint_rule6_real_package_families_all_declared():
+    """The live package: the FAMILIES table parses and covers the
+    standing families (pin the vocabulary so a refactor that moves
+    the table fails loudly)."""
+    fams = lint_instrumentation._parse_families(
+        lint_instrumentation.PACKAGE / "obs" / "metrics.py")
+    assert fams and fams["dl4j_tpu_step_latency_seconds"] == \
+        "histogram"
+    assert {"dl4j_tpu_collective_skew_seconds",
+            "dl4j_tpu_fleet_snapshots_published_total",
+            "dl4j_tpu_flight_recorder_dumps_total",
+            "dl4j_tpu_mesh_epoch"} <= set(fams)
+    sites = lint_instrumentation._family_emit_sites(
+        lint_instrumentation.PACKAGE)
+    assert set(sites) == set(fams)
+
+
 def test_lint_catches_listener_side_device_reductions(tmp_path):
     """Rule 3: jnp / jax.tree.map reductions in listener/stats paths
     (the old StatsListener._prev_params pattern) are flagged; the

@@ -333,7 +333,10 @@ def _elastic_scenario(hosts: int = 3, kill_host: int = 2,
                "CKPT_DIR": ckdir, "EPOCHS": str(epochs),
                "LEASE_S": str(lease_s), "PORT_BASE": str(port + 50),
                "KILL_HOST": str(kill_host), "SAVE_EVERY": "2",
-               "KILL_AT": str(kill_at_iter)}
+               "KILL_AT": str(kill_at_iter),
+               # fleet plane at a fast cadence so the victim's final
+               # telemetry is fresh when the leader snapshots it
+               "DL4J_TPU_FLEET_PUBLISH_SECS": "0.05"}
         # mp_harness kill_after is the BACKSTOP (a host wedged before
         # its self-kill iteration still dies); the deterministic kill
         # is the victim's in-worker SIGKILL at iteration KILL_AT
@@ -383,6 +386,58 @@ def _elastic_scenario(hosts: int = 3, kill_host: int = 2,
               and detect_s is not None and detect_s <= 4 * lease_s
               and evicted >= 1 and restarts >= 1)
 
+        # fleet observability plane (obs/fleet.py): the drill doubles
+        # as the acceptance fence for the flight recorder + fleet
+        # exposition — (a) a survivor's postmortem bundle must exist
+        # whose skew series names the killed host as the final-step
+        # straggler, (b) the surviving leader's eviction bundle must
+        # carry the corpse's final telemetry (host + last step), and
+        # (c) the post-reform fleet exposition must carry
+        # mesh_epoch="2" labels
+        import glob
+
+        from deeplearning4j_tpu.obs import fleet as obs_fleet
+        from deeplearning4j_tpu.obs import metrics as obs_metrics
+        victim = f"h{kill_host}"
+        pm = sorted(glob.glob(os.path.join(env["ELASTIC_DIR"],
+                                           "postmortem", "*.json")))
+        straggler_final = None
+        survivor_bundles = 0
+        evicted_named = False
+        dead_last_step = None
+        for b in pm:
+            try:
+                with open(b) as f:
+                    rec = json.load(f)
+            except ValueError:
+                continue
+            if rec.get("cause") == "Evicted" and \
+                    rec.get("host") == victim:
+                evicted_named = True
+                dead_last_step = (rec.get("final_telemetry")
+                                  or {}).get("step")
+                # the ADJUDICATED final-step straggler: the eviction
+                # bundle's skew view is computed after the lease
+                # verdict, so it names the corpse deterministically
+                # (survivor dumps race instant transport errors and
+                # are best-effort testimony)
+                straggler_final = ((rec.get("fleet") or {})
+                                   .get("skew") or {}).get("straggler")
+            elif rec.get("host") != victim and \
+                    ((rec.get("fleet") or {}).get("skew") or {}
+                     ).get("straggler"):
+                survivor_bundles += 1
+        view = obs_fleet.aggregate(env["ELASTIC_DIR"])
+        fams = obs_metrics.parse_exposition(view.exposition())
+        expo_epochs = sorted({dict(labels).get("mesh_epoch")
+                              for _n, labels in fams
+                              if "mesh_epoch" in dict(labels)})
+        fleet_epoch2 = "2" in expo_epochs
+        ok = (ok and straggler_final == victim and evicted_named
+              and survivor_bundles >= 1
+              and dead_last_step is not None and dead_last_step > 0
+              and fleet_epoch2)
+
         # same-scale uninterrupted baseline: fresh fleet of the
         # surviving size, pinned to the exact step the survivors
         # resumed from, trained to the same epoch budget — the
@@ -417,6 +472,13 @@ def _elastic_scenario(hosts: int = 3, kill_host: int = 2,
                 "detect_s": detect_s, "lease_s": lease_s,
                 "hosts_evicted": evicted, "restarts": restarts,
                 "trajectory_match": trajectory_match,
+                "flight_bundles": len(pm),
+                "survivor_bundles": survivor_bundles,
+                "straggler_final": straggler_final,
+                "evict_bundle_named_dead": evicted_named,
+                "dead_last_step": dead_last_step,
+                "fleet_mesh_epochs": expo_epochs,
+                "fleet_epoch2": fleet_epoch2,
                 "wall_s": round(time.perf_counter() - t0, 2),
                 "ok": bool(ok)}
 

@@ -263,6 +263,72 @@ uninterrupted baseline. Site-level drills: `host_death` and
 """
 
 
+# hand-maintained operations doc, re-emitted on every regeneration
+# (ISSUE 12 satellite: the fleet observability & straggler-hunting
+# runbook lives in docs/OPS.md next to the elastic-fleet workflow)
+FLEET_OPS_SECTION = """
+## Fleet observability & straggler hunting (obs/fleet.py)
+
+Operating a multi-host fleet with the fleet plane (ARCHITECTURE.md
+§14):
+
+**What publishes.** Every elastic host (`ElasticTrainer`) atomically
+writes a versioned snapshot — its `/metrics` exposition, heartbeat
+ages, a numerics tail, mesh epoch, step, and per-step barrier
+entry/exit stamps — into `<elastic_dir>/telemetry/<host>.json` at the
+`DL4J_TPU_FLEET_PUBLISH_SECS` cadence (default 1 Hz). Non-elastic
+training pays one branch and publishes nothing
+(`dl4j_tpu_fleet_snapshots_published_total` stays 0).
+
+**Read the fleet view.** Aggregate from anywhere that sees the shared
+dir:
+
+    python tools/tpu_watch.py --interval 30 --fleet-dir <elastic_dir>
+
+emits one `fleet` line per sample: the per-host step/epoch/age table,
+a collective-skew sparkline with the straggler named, and
+NONFINITE/EVICTED alarms. In code, `obs.fleet.aggregate(dir)` merges
+every snapshot into one Prometheus exposition where each sample
+carries `host=` and `mesh_epoch=` labels; the standing `/metrics`
+server also serves it on `/fleet` after
+`obs.metrics.set_fleet_dir(dir)` (done automatically by
+`ElasticTrainer.bring_up`).
+
+**Hunt stragglers.** `dl4j_tpu_collective_skew_seconds{host=}` is how
+late each host entered the anchor collective relative to the first-in
+peer; `dl4j_tpu_collective_straggler{host=}` is 1 for the last-in
+host. A host that is 40ms late EVERY step is a sick chip or a starved
+input pipeline — compare its `fit_etl` share before blaming the ICI.
+Attribution anchors on lease evidence, never snapshot staleness: with
+every lease live it uses the newest step COMMON to all hosts'
+published windows (a snapshot lagging by the publish cadence is
+normal, not a verdict); a lease-dead host (expired or no lease at
+all) is the straggler, so a corpse is named even while every survivor
+is wedged at the same barrier. `/healthz` tells the same story from
+one table: `stale_hosts` (lease ages, each under its OWN lease
+window) next to `stale_workers`.
+
+**Post-mortems.** On `NonFiniteError`, `StaleMeshEpoch`,
+`CollectiveTimeoutError`, SIGTERM preemption, or eviction, the flight
+recorder dumps a versioned bundle into `<elastic_dir>/postmortem/`:
+the last `DL4J_TPU_FLEET_RING` step records (barrier stamps, loss,
+mesh-epoch events), the obs span/metric tail, and the fleet skew view
+at the moment of death (`dl4j_tpu_flight_recorder_dumps_total{cause=}`).
+When a host is evicted, the surviving leader snapshots the corpse's
+FINAL telemetry into `<host>.evicted.<ts>.json` — the dead host's
+last step survives the death. Start there: the eviction bundle's
+`fleet.skew.straggler` is the ADJUDICATED naming (computed after the
+lease verdict); survivor crash dumps race instant transport errors
+and are best-effort testimony.
+
+**Drill it.** `python tools/chaos.py --elastic` SIGKILLs one host of
+a live fleet and asserts the whole chain: survivor bundles exist with
+skew views, the eviction bundle names the corpse as the final-step
+straggler and carries its last step, and the post-reform fleet
+exposition carries the bumped `mesh_epoch=` labels.
+"""
+
+
 def main():
     import warnings
     warnings.filterwarnings("ignore")
@@ -414,7 +480,8 @@ def main():
     op_lines += ["", TELEMETRY_OPS_SECTION.strip(),
                  "", RESILIENCE_OPS_SECTION.strip(),
                  "", NUMERICS_OPS_SECTION.strip(),
-                 "", ELASTIC_OPS_SECTION.strip()]
+                 "", ELASTIC_OPS_SECTION.strip(),
+                 "", FLEET_OPS_SECTION.strip()]
     ops_out = os.path.join(os.path.dirname(out), "OPS.md")
     with open(ops_out, "w") as f:
         f.write("\n".join(op_lines) + "\n")

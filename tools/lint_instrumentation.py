@@ -1,7 +1,7 @@
 """Instrumentation lint — the telemetry spine's CI fence (tier-1 via
 ``tests/test_lint_instrumentation.py``).
 
-Four AST rules over ``deeplearning4j_tpu/``:
+Six AST rules over ``deeplearning4j_tpu/``:
 
 1. **Every ``sentry.jit``-wrapped hot path emits obs telemetry.** A
    module that builds jitted entry points with ``sentry.jit(...)`` is
@@ -54,6 +54,21 @@ Four AST rules over ``deeplearning4j_tpu/``:
    the elastic layer's ``host_death``/``coordinator`` sites would
    otherwise age out).
 
+6. **Every metric family name is declared in the one FAMILIES
+   table.** ``obs/metrics.py::FAMILIES`` is the single registry of
+   ``dl4j_tpu_*`` family names (and kinds). Three checks kill
+   stringly-typed family drift between producers and consumers:
+   every emit site in the package (a ``REGISTRY.counter/gauge/
+   histogram`` registration, a pull-time collector tuple, or a fleet
+   ``AGGREGATE_FAMILIES`` entry) must name a declared family with the
+   declared kind; every declared family must have an emit site (no
+   dead declarations advertising metrics that never exist); and every
+   ``dl4j_tpu_*`` token in ``tools/tpu_watch.py`` and ``docs/OPS.md``
+   must resolve to a declared family (exactly, via a histogram
+   ``_bucket``/``_sum``/``_count`` suffix, or as a prefix filter
+   matching at least one family) — a dashboard or runbook can't watch
+   a family the code stopped (or never started) emitting.
+
 Exit status 0 = clean; 1 = violations (printed one per line).
 """
 from __future__ import annotations
@@ -93,6 +108,17 @@ WRAPPER_PATH = "parallel/wrapper.py"
 
 # rule 5 source of truth: the site table + named-plan vocabulary
 FAULTS_PATH = "resilience/faults.py"
+
+# rule 6 source of truth: the metric-family registry table
+METRICS_PATH = "obs/metrics.py"
+
+# rule 6: non-family dl4j_tpu_* tokens that legitimately appear in the
+# watched docs/tools (file-name stems, not metric families) — keep
+# short and justified:
+FAMILY_TOKEN_ALLOWLIST = {
+    # the span tracer's default output file, dl4j_tpu_trace_<pid>.jsonl
+    "dl4j_tpu_trace_",
+}
 
 
 def _calls(tree: ast.AST):
@@ -307,15 +333,171 @@ def _lint_fault_sites(package_dir: Path,
     return problems
 
 
+def _parse_families(metrics_path: Path) -> Optional[dict]:
+    """``{family: kind}`` from the FAMILIES dict literal in
+    ``obs/metrics.py`` — AST only, the lint never imports the
+    package. None when the file/table is absent (synthetic trees)."""
+    if not metrics_path.is_file():
+        return None
+    tree = ast.parse(metrics_path.read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "FAMILIES"
+                for t in node.targets):
+            if isinstance(node.value, ast.Dict):
+                out = {}
+                for k, v in zip(node.value.keys, node.value.values):
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str) and \
+                            isinstance(v, ast.Constant) and \
+                            isinstance(v.value, str):
+                        out[k.value] = v.value
+                return out
+    return None
+
+
+_FAMILY_KINDS = ("counter", "gauge", "histogram")
+
+
+def _family_emit_sites(package_dir: Path) -> dict:
+    """Every place the package EMITS a metric family:
+    ``{name: [(kind, "rel:lineno"), ...]}`` — registration calls
+    (``REGISTRY.counter/gauge/histogram("name", ...)``), pull-time
+    collector tuples (``("name", "kind", doc, samples)``), and
+    aggregator family tables (dict literals named
+    ``AGGREGATE_FAMILIES``)."""
+    sites: dict = {}
+
+    def add(name, kind, where):
+        sites.setdefault(name, []).append((kind, where))
+
+    for path in sorted(package_dir.rglob("*.py")):
+        rel = path.relative_to(package_dir).as_posix()
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue                # rule-agnostic: lint_file reports it
+        for c in _calls(tree):
+            ch = _attr_chain(c.func)
+            parts = ch.split(".")
+            if parts[-1] in _FAMILY_KINDS and "REGISTRY" in parts and \
+                    c.args and isinstance(c.args[0], ast.Constant) and \
+                    isinstance(c.args[0].value, str):
+                add(c.args[0].value, parts[-1], f"{rel}:{c.lineno}")
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Tuple) and len(node.elts) >= 3 \
+                    and isinstance(node.elts[0], ast.Constant) \
+                    and isinstance(node.elts[0].value, str) \
+                    and node.elts[0].value.startswith("dl4j_tpu_") \
+                    and isinstance(node.elts[1], ast.Constant) \
+                    and node.elts[1].value in _FAMILY_KINDS:
+                add(node.elts[0].value, node.elts[1].value,
+                    f"{rel}:{node.lineno}")
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name)
+                    and t.id == "AGGREGATE_FAMILIES"
+                    for t in node.targets) and \
+                    isinstance(node.value, ast.Dict):
+                for k, v in zip(node.value.keys, node.value.values):
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str):
+                        kind = v.value if isinstance(v, ast.Constant) \
+                            else ""
+                        add(k.value, kind, f"{rel}:{node.lineno}")
+    return sites
+
+
+_FAMILY_TOKEN_RE = None
+
+
+def _family_tokens(text: str) -> List[str]:
+    global _FAMILY_TOKEN_RE
+    if _FAMILY_TOKEN_RE is None:
+        import re
+        _FAMILY_TOKEN_RE = re.compile(r"dl4j_tpu_\w*")
+    return _FAMILY_TOKEN_RE.findall(text)
+
+
+def _resolve_family(token: str, families: dict) -> bool:
+    """A consumer token resolves when it is a declared family, a
+    histogram sample (``_bucket``/``_sum``/``_count``), or a prefix
+    filter matching at least one declared family."""
+    if token in families:
+        return True
+    for suffix in ("_bucket", "_sum", "_count"):
+        if token.endswith(suffix) and \
+                families.get(token[:-len(suffix)]) == "histogram":
+            return True
+    return any(f.startswith(token) for f in families)
+
+
+def _lint_metric_families(package_dir: Path,
+                          tools_dir: Optional[Path],
+                          docs_dir: Optional[Path]) -> List[str]:
+    """Rule 6: emitted ⊆ declared ⊆ emitted (kinds matching), and
+    every dl4j_tpu_* token tpu_watch/OPS.md consumes resolves."""
+    families = _parse_families(package_dir / METRICS_PATH)
+    if families is None:
+        return []                   # no registry table (synthetic tree)
+    problems: List[str] = []
+    sites = _family_emit_sites(package_dir)
+    for name in sorted(sites):
+        for kind, where in sites[name]:
+            if name not in families:
+                problems.append(
+                    f"{where}: metric family {name!r} is not declared "
+                    f"in {METRICS_PATH} FAMILIES — stringly-typed "
+                    "family drift (declare it there first)")
+            elif kind and families[name] != kind:
+                problems.append(
+                    f"{where}: metric family {name!r} emitted as "
+                    f"{kind} but declared {families[name]!r} in "
+                    f"{METRICS_PATH} FAMILIES")
+    for name in sorted(set(families) - set(sites)):
+        problems.append(
+            f"{METRICS_PATH}: FAMILIES entry {name!r} has no emit "
+            "site anywhere in the package — a dead declaration "
+            "advertising a metric that never exists")
+    consumers = []
+    if tools_dir is not None and (Path(tools_dir)
+                                  / "tpu_watch.py").is_file():
+        consumers.append(("tools/tpu_watch.py",
+                          (Path(tools_dir) / "tpu_watch.py")
+                          .read_text()))
+    if docs_dir is not None and (Path(docs_dir) / "OPS.md").is_file():
+        consumers.append(("docs/OPS.md",
+                          (Path(docs_dir) / "OPS.md").read_text()))
+    for label, text in consumers:
+        for token in sorted(set(_family_tokens(text))):
+            if token in FAMILY_TOKEN_ALLOWLIST:
+                continue
+            if not _resolve_family(token, families):
+                problems.append(
+                    f"{label}: references {token!r} which matches no "
+                    f"family in {METRICS_PATH} FAMILIES — the "
+                    "dashboard/runbook is watching a metric the code "
+                    "does not emit")
+    return problems
+
+
 def run(package_dir: Path = PACKAGE,
-        tests_dir: Optional[Path] = None) -> List[str]:
+        tests_dir: Optional[Path] = None,
+        tools_dir: Optional[Path] = None,
+        docs_dir: Optional[Path] = None) -> List[str]:
     problems: List[str] = []
     for path in sorted(package_dir.rglob("*.py")):
         rel = path.relative_to(package_dir).as_posix()
         problems.extend(lint_file(path, rel))
-    if tests_dir is None and package_dir == PACKAGE:
-        tests_dir = REPO / "tests"
+    if package_dir == PACKAGE:
+        if tests_dir is None:
+            tests_dir = REPO / "tests"
+        if tools_dir is None:
+            tools_dir = REPO / "tools"
+        if docs_dir is None:
+            docs_dir = REPO / "docs"
     problems.extend(_lint_fault_sites(package_dir, tests_dir))
+    problems.extend(_lint_metric_families(package_dir, tools_dir,
+                                          docs_dir))
     return problems
 
 

@@ -690,6 +690,13 @@ def main(names):
     except Exception as e:
         print(f"numerics_observatory: FAILED {type(e).__name__}: {e}")
         failed.append("numerics_observatory")
+    # fleet observability plane (obs/fleet.py): snapshot-publish cost
+    # vs the median measured step — off path ~0 (one branch), on path
+    # bounded at the default 1 Hz cadence (acceptance: < 1% of step)
+    payload.append({"config": "fleet_obs_plane",
+                    **obs.fleet.measure_publish_overhead(
+                        step_seconds=steps[len(steps) // 2]),
+                    "smoke": SMOKE})
     # ZeRO-DP sharded weight update (parallel/zero.py): before/after
     # row — replicated vs sharded SYNC step time, per-device
     # optimizer-state bytes, est. peak HBM. Own forced-CPU

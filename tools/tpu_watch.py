@@ -14,6 +14,14 @@ approach: the watcher reads the SAME ``/metrics`` exposition and trace
 JSONL every other consumer uses (``docs/OPS.md`` "Telemetry
 operations").
 
+Fleet (obs/fleet.py): pass ``--fleet-dir <elastic_dir>`` to tail an
+elastic fleet's telemetry snapshots incrementally (same model as the
+trace-JSONL tail: the snapshots are small atomic files, the skew
+history accumulates across samples). Each interval emits a ``fleet``
+view: the per-host step/epoch/age table, a collective-skew sparkline
+with the straggler named, and NONFINITE / EVICTED alarms from the
+merged exposition and the postmortem bundles.
+
 VERDICT r3 Next #1: the perf dossier must land the instant the tunnel
 answers, and if it never does the round must carry "a timestamped retry
 log proving the tunnel never came up". This script is that loop:
@@ -142,7 +150,52 @@ def _numerics_view(fams) -> dict:
     return view
 
 
-def _scrape_telemetry(metrics_url, healthz_url, trace_jsonl) -> None:
+# fleet view state: per-sample max collective skew feeds the sparkline
+# (bounded, like the grad-norm history)
+_SKEW_HISTORY: list = []
+
+
+def _fleet_view(fleet_dir) -> dict:
+    """One sample of an elastic fleet's merged telemetry: the per-host
+    table, the skew sparkline + named straggler, and the alarms."""
+    from deeplearning4j_tpu.obs import fleet as obs_fleet
+    from deeplearning4j_tpu.obs import metrics as obs_metrics
+
+    view = obs_fleet.aggregate(fleet_dir)
+    out: dict = {"hosts": view.table()}
+    rep = view.skew_report()
+    if rep:
+        _SKEW_HISTORY.append(rep["max_skew_s"])
+        del _SKEW_HISTORY[:-64]
+        out["skew"] = {
+            "step": rep["step"],
+            "max_skew_s": rep["max_skew_s"],
+            "straggler": rep["straggler"],
+            "sparkline": _sparkline(_SKEW_HISTORY),
+            # per-step [step, skew_s, last_in_host] — who entered the
+            # collective last, step by step
+            "series": rep["series"][-8:],
+        }
+    alarms: dict = {}
+    fams = obs_metrics.parse_exposition(view.exposition())
+    nonfinite = {
+        f"{dict(labels).get('host', '')}:"
+        f"{dict(labels).get('layer', '')}/"
+        f"{dict(labels).get('kind', '')}": int(v)
+        for (name, labels), v in fams.items()
+        if name == "dl4j_tpu_numerics_nonfinite_total" and v > 0}
+    if nonfinite:
+        alarms["NONFINITE"] = nonfinite
+    evicted = view.evicted()
+    if evicted:
+        alarms["EVICTED"] = evicted
+    if alarms:
+        out["alarms"] = alarms
+    return out
+
+
+def _scrape_telemetry(metrics_url, healthz_url, trace_jsonl,
+                      fleet_dir=None) -> None:
     """One sample of a live run's telemetry, appended to the log.
     Scrape failures are logged, never fatal — the run may simply not
     have started its endpoint yet."""
@@ -193,6 +246,12 @@ def _scrape_telemetry(metrics_url, healthz_url, trace_jsonl) -> None:
                  top_spans_ms={k: round(v / 1e3, 3) for k, v in top})
         except Exception as e:
             _log(event="trace", path=trace_jsonl, error=repr(e))
+    if fleet_dir:
+        try:
+            _log(event="fleet", dir=str(fleet_dir),
+                 **_fleet_view(fleet_dir))
+        except Exception as e:
+            _log(event="fleet", dir=str(fleet_dir), error=repr(e))
 
 
 def main() -> int:
@@ -208,6 +267,11 @@ def main() -> int:
                     help="/healthz endpoint to sample each interval")
     ap.add_argument("--trace-jsonl", default=None,
                     help="obs trace JSONL to summarize each interval")
+    ap.add_argument("--fleet-dir", default=None,
+                    help="elastic fleet dir (DL4J_TPU_ELASTIC_DIR) to "
+                         "aggregate each interval: per-host table, "
+                         "collective-skew sparkline + straggler, "
+                         "NONFINITE/EVICTED alarms")
     args = ap.parse_args()
 
     sys.path.insert(0, str(REPO))
@@ -216,9 +280,10 @@ def main() -> int:
     attempt = 0
     while True:
         attempt += 1
-        if args.metrics_url or args.healthz_url or args.trace_jsonl:
+        if args.metrics_url or args.healthz_url or args.trace_jsonl \
+                or args.fleet_dir:
             _scrape_telemetry(args.metrics_url, args.healthz_url,
-                              args.trace_jsonl)
+                              args.trace_jsonl, args.fleet_dir)
         ok, info = probe_backend(timeout=args.probe_timeout)
         _log(event="probe", attempt=attempt, ok=ok, info=info)
         if ok:

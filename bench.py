@@ -94,6 +94,12 @@ def _run_bench_child():
     # never blanks the headline number.
     from deeplearning4j_tpu.parallel import zero
     parsed["zero_dp"] = zero.subprocess_report()
+    # continuous-batching serving gateway (serving/): the smoke trace
+    # row — continuous vs request-at-a-time tokens/sec, p99 TTFT,
+    # shed rate, retraces-after-warmup. Own forced-CPU subprocess for
+    # the same reason as zero_dp.
+    from deeplearning4j_tpu.serving import loadgen
+    parsed["serving"] = loadgen.subprocess_report()
     print(json.dumps(parsed))
 
 

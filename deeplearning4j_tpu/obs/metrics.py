@@ -96,6 +96,16 @@ FAMILIES = {
     "dl4j_tpu_numerics_param_replica_divergence": "gauge",
     "dl4j_tpu_numerics_nonfinite_total": "counter",
     "dl4j_tpu_numerics_diag_steps_total": "counter",
+    # continuous-batching serving gateway (serving/)
+    "dl4j_tpu_serving_requests_total": "counter",
+    "dl4j_tpu_serving_requests_shed_total": "counter",
+    "dl4j_tpu_serving_tokens_total": "counter",
+    "dl4j_tpu_serving_ttft_seconds": "histogram",
+    "dl4j_tpu_serving_step_seconds": "histogram",
+    "dl4j_tpu_serving_prefill_seconds": "histogram",
+    "dl4j_tpu_serving_active_slots": "gauge",
+    "dl4j_tpu_serving_queue_depth": "gauge",
+    "dl4j_tpu_serving_kv_pages_free": "gauge",
     # fleet observability plane (obs/fleet.py)
     "dl4j_tpu_fleet_snapshots_published_total": "counter",
     "dl4j_tpu_flight_recorder_dumps_total": "counter",
@@ -420,6 +430,40 @@ HOSTS_EVICTED = REGISTRY.counter(
     "dl4j_tpu_hosts_evicted_total",
     "hosts forcibly evicted from the fleet after a missed lease "
     "(graceful SIGTERM departures count preemptions_total instead)")
+
+# continuous-batching serving gateway (serving/): in-flight batched
+# decode over the paged KV cache — TTFT is the serving SLO metric
+# (queue wait + prefill), step_seconds is the per-token latency every
+# active slot pays per decode iteration, kv_pages_free is the
+# admission-control currency
+SERVING_REQS = REGISTRY.counter(
+    "dl4j_tpu_serving_requests_total",
+    "gateway requests submitted (per tenant)", ("tenant",))
+SERVING_SHED = REGISTRY.counter(
+    "dl4j_tpu_serving_requests_shed_total",
+    "gateway requests shed instead of served", ("reason",))
+SERVING_TOKENS = REGISTRY.counter(
+    "dl4j_tpu_serving_tokens_total",
+    "tokens streamed by the continuous-batching gateway")
+SERVING_TTFT = REGISTRY.histogram(
+    "dl4j_tpu_serving_ttft_seconds",
+    "submit -> first streamed token (queue wait + paged prefill)")
+SERVING_STEP = REGISTRY.histogram(
+    "dl4j_tpu_serving_step_seconds",
+    "one fixed-shape continuous-batching decode iteration (== the "
+    "per-token latency of every active slot)")
+SERVING_PREFILL = REGISTRY.histogram(
+    "dl4j_tpu_serving_prefill_seconds",
+    "prompt prefill-into-pages wall time per admission")
+SERVING_SLOTS = REGISTRY.gauge(
+    "dl4j_tpu_serving_active_slots",
+    "decode slots occupied by in-flight sequences")
+SERVING_QUEUE = REGISTRY.gauge(
+    "dl4j_tpu_serving_queue_depth",
+    "requests queued awaiting admission (all tenants)")
+SERVING_PAGES_FREE = REGISTRY.gauge(
+    "dl4j_tpu_serving_kv_pages_free",
+    "free pages in the paged KV-cache pool")
 
 # parallel training (parallel/wrapper.py): the optimizer-state HBM
 # footprint the ZeRO sharded update divides by N — layout is

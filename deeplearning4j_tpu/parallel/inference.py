@@ -167,8 +167,11 @@ class ParallelInference:
             obs.metrics.INFER_REQS.inc()
             obs.metrics.INFER_LATENCY.observe(obs.now() - t0)
             return out
+        # `is not None`, not truthiness: an explicit timeout of 0 means
+        # "already expired" (shed immediately), not "no deadline"
         ob = _Observable(
-            x, deadline=obs.now() + timeout if timeout else None)
+            x, deadline=obs.now() + timeout if timeout is not None
+            else None)
         self._submit(ob)
         return ob.get(timeout)
 
@@ -179,7 +182,8 @@ class ParallelInference:
         queue before the worker drops it."""
         ob = _Observable(
             np.asarray(x),
-            deadline=obs.now() + deadline_s if deadline_s else None)
+            deadline=obs.now() + deadline_s if deadline_s is not None
+            else None)
         self._submit(ob)
         return ob
 

@@ -1,0 +1,28 @@
+"""Continuous-batching serving gateway (ARCHITECTURE.md §15).
+
+The serving subsystem the north star's "heavy traffic from millions of
+users" needs: in-flight batching for ``CausalTransformerLM.generate``
+over a paged/block KV cache, behind a front end that keeps
+``ParallelInference``'s shed/deadline/drain posture.
+
+- :mod:`~deeplearning4j_tpu.serving.kv_pager` — fixed pool of
+  block-token KV pages, per-sequence page table, free-list allocation,
+  int8 page storage (the ``zoo.gpt._quant_kv`` codes);
+- :mod:`~deeplearning4j_tpu.serving.scheduler` — ONE fixed-shape
+  jitted decode step over every slot + per-bucket prefill-into-pages;
+  zero retraces after ``warmup()``;
+- :mod:`~deeplearning4j_tpu.serving.gateway` — ``submit()`` returning
+  a streaming :class:`TokenStream`, admission control keyed on free
+  pages, per-tenant round-robin fairness, graceful ``shutdown()``;
+- :mod:`~deeplearning4j_tpu.serving.loadgen` — the open/closed-loop
+  synthetic trace driver (``tools/serving_trace.py`` CLI; bench/
+  dossier rows).
+"""
+from deeplearning4j_tpu.serving.gateway import (SequenceAborted,
+                                                ServingGateway,
+                                                TokenStream)
+from deeplearning4j_tpu.serving.kv_pager import KVPager, PageTableError
+from deeplearning4j_tpu.serving.scheduler import DecodeScheduler
+
+__all__ = ["ServingGateway", "TokenStream", "SequenceAborted",
+           "KVPager", "PageTableError", "DecodeScheduler"]

@@ -1,0 +1,172 @@
+"""Paged/block KV cache — the serving gateway's memory plane.
+
+The dense decode path (``zoo/gpt.py::_decode_gen``) builds one KV
+cache of ``[B, Hkv, 2D, tb + n_new]`` per layer *per generate() call*:
+cache memory is O(batch x max_len) whether or not the sequences use
+it, and a new sequence can only join by retracing a new batch shape.
+This module replaces that with the vLLM-style paged layout the
+compiler-first O(1)-per-token caching design calls for (PAPERS.md:
+arxiv 2603.09555): a FIXED pool of ``block``-token pages, a
+per-sequence page table, and free-list allocation — cache memory is
+O(active tokens) (rounded up to page granularity), sequences of any
+length share one pool, and the pool's shape never changes, so the
+decode step compiles exactly once.
+
+Layout (one layer-stacked array pair, the tuple the jitted step
+carries as its donated pool argument):
+
+- ``codes``  ``[L, P, Hkv, 2D, block]`` — page ``p`` of layer ``l``
+  holds ``block`` consecutive positions of the k (rows ``0:D``) and v
+  (rows ``D:2D``) halves, the exact minor-dim tiling the dense cache
+  uses (``zoo/gpt.py::_token_logits`` layout note). dtype is ``int8``
+  under ``cache_quant="int8"`` (codes from ``zoo.gpt._quant_kv``, the
+  same quantiser the dense path uses — the pager-correctness fence
+  demands token identity), else the model's compute dtype.
+- ``scales`` ``[L, P, Hkv, 2, block]`` f32 — per-(page, head, k/v
+  half, position) dequant scales; present only under int8.
+
+Page 0 is the reserved **trash page**: inactive slots' writes and
+unallocated page-table entries route there, so a fixed-shape step can
+always scatter/gather without corrupting live sequences (reads of
+trash positions are masked by each slot's length).
+
+The pager itself is host-side bookkeeping: free list, page->owner
+map, and the invariants the tests fence (no page owned twice,
+allocation conservation). The device arrays live here too so the
+scheduler can thread them through its jitted step and write the
+updated pool back.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.obs import metrics as _metrics
+
+
+class PageTableError(RuntimeError):
+    """A pager invariant broke (page owned twice, free-list leak) —
+    raised by :meth:`KVPager.check_invariants`, the churn tests' fence."""
+
+
+class KVPager:
+    """Fixed pool of KV pages with free-list allocation.
+
+    ``n_pages`` counts the trash page: usable capacity is
+    ``n_pages - 1`` pages of ``block`` tokens each.
+    """
+
+    def __init__(self, *, n_layers: int, n_kv_heads: int, head_dim: int,
+                 n_pages: int, block: int, cache_quant: Optional[str],
+                 dtype: str = "float32"):
+        import jax.numpy as jnp
+        if block < 1 or block & (block - 1):
+            raise ValueError(f"block={block} must be a power of two "
+                             "(pages must tile the power-of-two "
+                             "prompt buckets exactly)")
+        if n_pages < 2:
+            raise ValueError(f"n_pages={n_pages}: need at least one "
+                             "usable page beyond the trash page")
+        if cache_quant not in (None, "int8"):
+            raise ValueError(f"cache_quant={cache_quant!r} "
+                             "(None | 'int8')")
+        self.n_layers = n_layers
+        self.n_kv_heads = n_kv_heads
+        self.head_dim = head_dim
+        self.n_pages = n_pages
+        self.block = block
+        self.cache_quant = cache_quant
+        shape = (n_layers, n_pages, n_kv_heads, 2 * head_dim, block)
+        if cache_quant == "int8":
+            self._pool: Tuple = (
+                jnp.zeros(shape, jnp.int8),
+                jnp.zeros((n_layers, n_pages, n_kv_heads, 2, block),
+                          jnp.float32))
+        else:
+            self._pool = (jnp.zeros(shape, jnp.dtype(dtype)),)
+        # host bookkeeping: LIFO free list (hot pages stay hot) and the
+        # page -> owner map the invariant checks walk
+        self._free: List[int] = list(range(n_pages - 1, 0, -1))
+        self._owner: Dict[int, object] = {}
+        self._pages_of: Dict[int, List[int]] = {}
+        self._gauge()
+
+    # -- device pool -----------------------------------------------------
+    @property
+    def pool(self) -> Tuple:
+        """The layer-stacked device arrays the jitted step reads and
+        rewrites: ``(codes,)`` or ``(codes, scales)``."""
+        return self._pool
+
+    @pool.setter
+    def pool(self, new: Tuple) -> None:
+        self._pool = tuple(new)
+
+    def pool_bytes(self) -> int:
+        return sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                   for a in self._pool)
+
+    # -- allocation ------------------------------------------------------
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to hold ``n_tokens`` cache positions."""
+        return -(-int(n_tokens) // self.block)
+
+    def alloc(self, n: int, owner) -> Optional[List[int]]:
+        """Take ``n`` pages for ``owner`` (any hashable-by-id object —
+        the gateway uses the request stream). Returns the page ids in
+        position order, or None when the pool can't satisfy the
+        request — admission control's signal to keep the request
+        queued rather than wedge a slot mid-flight."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._owner[p] = owner
+        self._pages_of.setdefault(id(owner), []).extend(pages)
+        self._gauge()
+        return pages
+
+    def release(self, owner) -> int:
+        """Return every page ``owner`` holds to the free list."""
+        pages = self._pages_of.pop(id(owner), [])
+        for p in pages:
+            self._owner.pop(p, None)
+            self._free.append(p)
+        self._gauge()
+        return len(pages)
+
+    def owned(self, owner) -> List[int]:
+        return list(self._pages_of.get(id(owner), []))
+
+    def _gauge(self) -> None:
+        _metrics.SERVING_PAGES_FREE.set(len(self._free))
+
+    # -- invariants (tests/test_serving.py churn fence) ------------------
+    def check_invariants(self) -> None:
+        """No page owned twice, no owned page on the free list, trash
+        page never allocated, and conservation: free + owned ==
+        n_pages - 1. Raises :class:`PageTableError` on any breach."""
+        free = set(self._free)
+        if len(free) != len(self._free):
+            raise PageTableError("duplicate pages on the free list")
+        owned: Dict[int, int] = {}
+        for oid, pages in self._pages_of.items():
+            for p in pages:
+                if p in owned:
+                    raise PageTableError(
+                        f"page {p} owned by two live sequences "
+                        f"({owned[p]:#x} and {oid:#x})")
+                owned[p] = oid
+        if 0 in owned or 0 in free:
+            raise PageTableError("trash page 0 entered circulation")
+        if free & set(owned):
+            raise PageTableError(
+                f"pages both free and owned: {sorted(free & set(owned))}")
+        if len(free) + len(owned) != self.n_pages - 1:
+            raise PageTableError(
+                f"page leak: {len(free)} free + {len(owned)} owned "
+                f"!= {self.n_pages - 1} usable")

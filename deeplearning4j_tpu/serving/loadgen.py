@@ -1,0 +1,308 @@
+"""Synthetic serving-trace driver — open/closed-loop multi-tenant load.
+
+The measurement half of the gateway: generates sustained multi-tenant
+traffic against a :class:`~deeplearning4j_tpu.serving.gateway.
+ServingGateway`, reports the serving SLO quartet — p50/p99 TTFT,
+per-token latency, aggregate tokens/sec, shed rate — and compares
+against the request-at-a-time baseline (sequential B=1
+``generate()`` calls, exactly what ``ParallelInference``-style serving
+would do per request). Everything the driver measures client-side also
+flows through the ``dl4j_tpu_serving_*`` families, so a live run shows
+the same numbers on ``/metrics``.
+
+Two load models (the standard serving-bench dichotomy):
+
+- **open loop**: arrivals are a seeded Poisson process at ``rate``
+  req/s regardless of completions — measures behavior under a traffic
+  level you don't control (overload shows up as shed rate + TTFT
+  tail);
+- **closed loop**: ``clients`` concurrent callers each submit, wait,
+  and immediately resubmit — measures sustainable throughput at a
+  fixed concurrency;
+- **burst**: every request submitted up front from ONE thread, then
+  collected — the saturation-throughput measurement (occupancy stays
+  maxed, no client-thread scheduling noise; later requests' TTFT
+  includes their real queue wait).
+
+``smoke_report()`` is the CPU wiring config consumed by ``bench.py``'s
+``serving`` section and ``tools/perf_dossier.py``'s
+``continuous_batching`` row (via :func:`subprocess_report`, the
+forced-CPU-subprocess idiom of ``parallel/zero.py``);
+``tools/serving_trace.py`` is the shell CLI over :func:`run_trace`.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def _pct(vals, q):
+    return float(np.percentile(np.asarray(vals), q)) if vals else None
+
+
+def gen_requests(*, n_requests: int, tenants=("tenant0", "tenant1"),
+                 prompt_lens=(8, 48), max_new: int = 32,
+                 vocab_size: int = 256, seed: int = 0):
+    """Deterministic synthetic request list: per-request tenant,
+    prompt (uniform length in ``prompt_lens`` bounds), token budget."""
+    rng = np.random.default_rng(seed)
+    lo, hi = prompt_lens
+    out = []
+    for i in range(n_requests):
+        t0 = int(rng.integers(lo, hi + 1))
+        out.append({
+            "tenant": tenants[i % len(tenants)],
+            "prompt": rng.integers(
+                0, vocab_size, t0).astype(np.int32),
+            "max_new": max_new,
+        })
+    return out
+
+
+def run_trace(gateway, requests, *, mode: str = "closed",
+              rate: float = 50.0, clients: int = 8,
+              deadline_s: Optional[float] = None,
+              timeout_s: float = 120.0, seed: int = 0
+              ) -> Dict[str, Any]:
+    """Drive ``requests`` through ``gateway`` under the given load
+    model and gather the SLO stats. Returns the stats dict."""
+    from deeplearning4j_tpu.obs import metrics as M
+    from deeplearning4j_tpu.parallel.inference import QueueFullError
+
+    lock = threading.Lock()
+    streams: list = []
+    shed = [0]
+    submit_errors = [0]
+    # the step histogram is process-cumulative: snapshot so THIS
+    # trace's per-token number isn't polluted by earlier gateways
+    step0 = dict(M.SERVING_STEP.snapshot().get("", {}))
+    t_bench0 = time.perf_counter()
+
+    def submit(r):
+        try:
+            st = gateway.submit(r["prompt"], max_new=r["max_new"],
+                                tenant=r["tenant"],
+                                deadline_s=deadline_s)
+            with lock:
+                streams.append(st)
+            return st
+        except QueueFullError:
+            with lock:
+                shed[0] += 1
+            return None
+        except Exception:
+            # any other submit rejection (misconfigured trace vs pool
+            # limits, shutdown race) must not kill a client thread or
+            # abort the trace mid-run — it is COUNTED, so the report
+            # can't read as a clean run
+            with lock:
+                submit_errors[0] += 1
+            return None
+
+    if mode == "burst":
+        for req in requests:
+            submit(req)
+        for st in list(streams):
+            try:
+                st.result(timeout=timeout_s)
+            except Exception:
+                pass
+    elif mode == "open":
+        # seeded Poisson arrivals: exponential inter-arrival gaps at
+        # `rate` req/s, submissions never wait on completions
+        r = random.Random(seed)
+        for req in requests:
+            submit(req)
+            time.sleep(r.expovariate(rate))
+        for st in list(streams):
+            try:
+                st.result(timeout=timeout_s)
+            except Exception:
+                pass
+    elif mode == "closed":
+        # `clients` concurrent callers, back-to-back submissions
+        work = list(requests)
+
+        def client():
+            while True:
+                with lock:
+                    if not work:
+                        return
+                    req = work.pop()
+                st = submit(req)
+                if st is not None:
+                    try:
+                        st.result(timeout=timeout_s)
+                    except Exception:
+                        pass
+        threads = [threading.Thread(target=client)
+                   for _ in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout_s + 30)
+    else:
+        raise ValueError(f"mode={mode!r} (open | closed | burst)")
+    wall = time.perf_counter() - t_bench0
+
+    ttfts, completed, failed, tokens = [], 0, 0, 0
+    for st in streams:
+        tokens += st.n_generated()
+        if st.ttft_s is not None:
+            ttfts.append(st.ttft_s)
+        if st.error() is not None:
+            failed += 1
+        elif st.done():
+            completed += 1
+    # per-token latency from the gateway's own step histogram (THIS
+    # trace's delta); client-side we report tokens/sec and TTFT
+    step1 = M.SERVING_STEP.snapshot().get("", {})
+    d_count = step1.get("count", 0) - step0.get("count", 0)
+    d_sum = step1.get("sum", 0.0) - step0.get("sum", 0.0)
+    per_token_ms = 1e3 * d_sum / d_count if d_count else None
+    return {
+        "mode": mode,
+        "requests": len(requests),
+        "submitted": len(streams),
+        "shed_at_submit": shed[0],
+        "submit_errors": submit_errors[0],
+        "completed": completed,
+        "failed": failed,
+        "tokens": tokens,
+        "wall_s": round(wall, 3),
+        "tokens_per_sec": round(tokens / wall, 2) if wall > 0 else None,
+        "ttft_p50_ms": (round(1e3 * _pct(ttfts, 50), 3)
+                        if ttfts else None),
+        "ttft_p99_ms": (round(1e3 * _pct(ttfts, 99), 3)
+                        if ttfts else None),
+        "per_token_mean_ms": (round(per_token_ms, 3)
+                              if per_token_ms else None),
+        "shed_rate": round(shed[0] / max(1, len(requests)), 4),
+    }
+
+
+def baseline_tokens_per_sec(model, net, requests,
+                            repeat: int = 1) -> float:
+    """Request-at-a-time baseline: each request is one B=1
+    ``generate()`` call, sequential — the dynamic-batch serving story
+    this gateway replaces. Call once before timing to compile."""
+    t0 = time.perf_counter()
+    tokens = 0
+    for _ in range(repeat):
+        for r in requests:
+            model.generate(net, r["prompt"][None], n_new=r["max_new"])
+            tokens += r["max_new"]
+    return tokens / (time.perf_counter() - t0)
+
+
+def smoke_report(n_requests: int = 32, max_new: int = 32,
+                 max_slots: int = 16) -> Dict[str, Any]:
+    """CPU smoke config: a small weight-read-bound LM (h=256 — decode
+    is weight-bound there even on CPU, so in-flight batching has a
+    real read to amortize, exactly the regime TPU serving lives in),
+    closed-loop multi-tenant trace, continuous vs request-at-a-time
+    tokens/sec, retrace count after warmup. The acceptance row:
+    speedup >= 1.5x, zero retraces."""
+    from deeplearning4j_tpu.perf import sentry
+    from deeplearning4j_tpu.serving.gateway import ServingGateway
+    from deeplearning4j_tpu.zoo import CausalTransformerLM
+
+    model = CausalTransformerLM(vocab_size=512, hidden=256,
+                                n_layers=4, n_heads=4, n_kv_heads=2,
+                                max_len=128, seed=3)
+    net = model.init()
+    requests = gen_requests(n_requests=n_requests, max_new=max_new,
+                            prompt_lens=(4, 28),
+                            vocab_size=model.vocab_size, seed=1)
+    # baseline compiles its buckets on a first pass (excluded from
+    # the timed run — both sides are measured warm)
+    baseline_tokens_per_sec(model, net, requests)
+    base_tps = baseline_tokens_per_sec(model, net, requests)
+
+    gw = ServingGateway(model, net, max_slots=max_slots, block=16,
+                        max_context=64, queue_limit=n_requests + 8,
+                        default_max_new=max_new)
+    warm = gw.warmup(prompt_lens=range(1, 29))
+    traces_before = sentry.total_traces()
+    # burst arrivals: the saturation-throughput row (client-thread
+    # scheduling noise would bill the gateway for wakeups the
+    # single-threaded baseline never pays)
+    stats = run_trace(gw, requests, mode="burst")
+    retraces = sentry.total_traces() - traces_before
+    gw.shutdown()
+    cont_tps = stats["tokens_per_sec"] or 0.0
+    return {
+        "model": "causal-LM v512 L4 h256 (CPU smoke)",
+        "n_requests": n_requests,
+        "max_new": max_new,
+        "max_slots": max_slots,
+        "continuous_tokens_per_sec": round(cont_tps, 2),
+        "request_at_a_time_tokens_per_sec": round(base_tps, 2),
+        "speedup": round(cont_tps / base_tps, 3) if base_tps else None,
+        "ttft_p50_ms": stats["ttft_p50_ms"],
+        "ttft_p99_ms": stats["ttft_p99_ms"],
+        "per_token_mean_ms": stats["per_token_mean_ms"],
+        "shed_rate": stats["shed_rate"],
+        "completed": stats["completed"],
+        "failed": stats["failed"],
+        "retraces_after_warmup": retraces,
+        "warmup": warm,
+    }
+
+
+def subprocess_report(timeout: int = 420) -> Dict[str, Any]:
+    """Run :func:`smoke_report` in a fresh forced-CPU process (the
+    ``parallel/zero.py`` idiom): callable from bench/dossier runs
+    without touching their backend; any failure returns a structured
+    skip instead of sinking the headline metric."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # a host partitioned into virtual devices (the SPMD test suite's
+    # --xla_force_host_platform_device_count=8) throttles the
+    # single-device serving loop ~30%; the smoke row is a ONE-device
+    # measurement, so strip the forcing for the child
+    flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count"))
+    env["XLA_FLAGS"] = flags
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "deeplearning4j_tpu.serving.loadgen"],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))))
+    except (subprocess.TimeoutExpired, OSError) as e:
+        return {"skipped": True, "reason": f"serving child: {e}"}
+    parsed = None
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except ValueError:
+                pass
+    if proc.returncode != 0 or parsed is None:
+        tail = (proc.stderr or proc.stdout or "").strip()
+        return {"skipped": True,
+                "reason": "serving child rc=%d: %s"
+                          % (proc.returncode, tail.splitlines()[-1]
+                             if tail else "no output")}
+    return parsed
+
+
+def _main() -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(smoke_report()), flush=True)
+
+
+if __name__ == "__main__":
+    _main()

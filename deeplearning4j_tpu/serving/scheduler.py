@@ -1,0 +1,546 @@
+"""Continuous-batching decode scheduler — ONE fixed-shape jitted step.
+
+The request-at-a-time path (``CausalTransformerLM.generate``) traces
+one executable per (batch, prompt-bucket, n_new) triple and a request
+can only ride a batch formed at submit time. This scheduler instead
+runs ONE jitted step over ``(max_slots,)`` rows against the paged KV
+pool (``kv_pager.py``): every iteration it steps every active slot one
+token, new sequences are admitted *into the running loop* by
+prefilling into free pages (at the same power-of-two buckets
+``generate()`` uses — ``zoo.gpt.prompt_bucket`` is shared so the two
+can never drift), and finished sequences release their pages without
+anything changing shape. Shapes never vary, so after
+:meth:`DecodeScheduler.warmup` the PR 1 retrace sentry sees zero new
+traces no matter how traffic arrives (the low-latency JIT-graph-capture
+decode contract, PAPERS.md: arxiv 2604.23467).
+
+Attention math deliberately mirrors ``zoo/gpt.py::_token_logits``
+value-for-value (same ``_quant_kv`` codes/scales, same scale factoring
+out of the einsums, same ``-1e9`` mask): padded/trash positions
+contribute exact zeros after softmax, so paged greedy decode is
+TOKEN-IDENTICAL to dense ``generate()`` — the pager-correctness fence
+in ``tests/test_serving.py`` asserts it for both the float and the
+int8-KV cache paths.
+
+The scheduler is single-threaded host logic (the gateway's worker
+drives it); requests are duck-typed: ``.prompt`` (1-D int32),
+``.max_new``, ``.temperature``, ``.eos_id``, and ``push(tok)`` /
+``finish()`` / ``fail(exc)`` callbacks (``gateway.TokenStream``).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu import obs
+from deeplearning4j_tpu.serving.kv_pager import KVPager
+from deeplearning4j_tpu.zoo.gpt import _quant_kv, _rms, prompt_bucket
+
+#: every ``_build_*`` jitted entry point in this module must have an
+#: entry here describing its warmup feed, and :meth:`warmup` must
+#: iterate the table — ``tools/lint_instrumentation.py`` rule 7 keeps
+#: the builder set and this table in lockstep (the PR 5 WARMUP_FEEDS
+#: contract: an unfed builder cold-traces on the first live request)
+WARMUP_FEEDS = {
+    "_build_step_fn":
+        "(params, pool, page_table[S,MP]i32, lengths[S]i32, "
+        "active[S]bool, prev[S]i32, temps[S]f32, top_p f32, ctr i32) "
+        "— one signature total, warmed once",
+    "_build_admit_fn":
+        "(params, pool, page_ids[tb/block]i32, prompt[1,tb]i32, "
+        "t0 i32, temp f32, top_p f32, ctr i32) — one signature per "
+        "power-of-two prompt bucket (prompt_bucket), each warmed",
+}
+
+
+def _rotary_rows(x, theta: float, pos):
+    """RoPE at one position PER ROW: ``x`` [S, H, D], ``pos`` [S] i32.
+    Bit-identical per row to ``rotary_embedding(x[:, None],
+    offset=pos_scalar)[:, 0]`` (same f32 angle math, same half-split
+    pairing) — the continuous batch just carries a different position
+    per slot."""
+    import jax.numpy as jnp
+    half = x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]  # [S, D/2]
+    cos = jnp.cos(ang)[:, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[:, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], axis=-1)
+
+
+class _Slot:
+    """Host state of one occupied decode slot."""
+
+    __slots__ = ("req", "length", "remaining")
+
+    def __init__(self, req, length: int, remaining: int):
+        self.req = req
+        self.length = length        # cache positions written so far
+        self.remaining = remaining  # tokens still to generate
+
+
+class DecodeScheduler:
+    """In-flight batched decode over a shared paged KV pool.
+
+    ``max_context`` bounds prompt+generation per sequence (must be a
+    multiple of ``block`` and at most ``model.max_len``); ``n_pages``
+    sizes the pool (default: enough for every slot at full context —
+    pass less to exercise admission control). Sampling config is
+    gateway-level and static (``sample``/``top_k``/``top_p`` are trace
+    keys exactly as in ``generate()``); per-request ``temperature``
+    rides as a traced [S] vector so it never retraces.
+    """
+
+    def __init__(self, model, net, *, max_slots: int = 8,
+                 block: int = 16, n_pages: Optional[int] = None,
+                 max_context: Optional[int] = None,
+                 sample: bool = False, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None, seed: int = 0):
+        self.model = model
+        self.net = net
+        self.max_slots = int(max_slots)
+        self.block = int(block)
+        mc = int(max_context or model.max_len)
+        if mc > model.max_len:
+            raise ValueError(f"max_context={mc} exceeds model "
+                             f"max_len={model.max_len}")
+        if mc % self.block:
+            raise ValueError(f"max_context={mc} must be a multiple of "
+                             f"block={self.block} so pages tile every "
+                             "prompt bucket exactly")
+        if min(16, mc) % self.block:
+            raise ValueError(f"block={self.block} must divide the "
+                             "smallest prompt bucket (16)")
+        self.max_context = mc
+        self.max_pages_per_seq = mc // self.block
+        self.sample = bool(sample)
+        self.top_k = top_k
+        self.top_p = top_p
+        self.seed = int(seed)
+        hd = model.hidden // model.n_heads
+        self.pager = KVPager(
+            n_layers=model.n_layers, n_kv_heads=model.n_kv_heads,
+            head_dim=hd, block=self.block,
+            n_pages=(int(n_pages) if n_pages
+                     else 1 + self.max_slots * self.max_pages_per_seq),
+            cache_quant=model.cache_quant,
+            dtype=model.compute_dtype or "float32")
+        # per-slot host state, mirrored into the small int arrays the
+        # fixed-shape step consumes each iteration
+        self._slots: List[Optional[_Slot]] = [None] * self.max_slots
+        self._page_table = np.zeros(
+            (self.max_slots, self.max_pages_per_seq), np.int32)
+        self._lengths = np.zeros(self.max_slots, np.int32)
+        self._prev = np.zeros(self.max_slots, np.int32)
+        self._temps = np.ones(self.max_slots, np.float32)
+        # device-side feed cache: in steady state the step feeds back
+        # its own outputs (prev=nxt, lengths carried in-program) and
+        # the static arrays stay resident — zero h2d per token; any
+        # admit/retire/shed marks the feed dirty for a one-shot rebuild
+        self._dev_feed: Optional[dict] = None
+        self._feed_dirty = True
+        self._ctr = 0               # rng fold counter (step + admit)
+        self.steps = 0
+        self.tokens_out = 0
+        self._step_fn = self._build_step_fn()
+        self._admit_fns: Dict[int, object] = {}
+
+    # -- jitted entry points (lint rule 7: sentry.jit, WARMUP_FEEDS) -----
+    def _build_step_fn(self):
+        """One decode iteration for every slot: token ids [S] -> next
+        token ids [S], pool updated in place (each slot writes its
+        position's KV into its own page; inactive slots write the
+        trash page). Fixed shapes throughout — THE serving hot path."""
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.perf import sentry
+
+        model = self.model
+        L = model.n_layers
+
+        # pool is threaded through and returned so the caller rebinds
+        # the pager's arrays (donation-friendly on accelerators)
+        def step(params, pool, page_table, lengths, active, prev,
+                 temps, top_p, ctr):
+            x = params["layer_0"]["W"][prev]            # [S, F]
+            for i in range(L):
+                x, pool = self._paged_block_step(
+                    params[f"layer_{i + 1}"], i, x, pool, page_table,
+                    lengths, active)
+            x = _rms(x, params[f"layer_{L + 1}"]["gamma"])
+            logits = model._head_logits(params, x)
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(self.seed), ctr)
+            nxt = model._pick(
+                logits, temps[:, None], top_p, key, sample=self.sample,
+                top_k=self.top_k, nucleus=self.top_p is not None)
+            nxt = jnp.where(active, nxt, jnp.zeros_like(nxt))
+            # carry lengths forward ON DEVICE: steady-state steps feed
+            # back (nxt, lengths+active) without any host->device
+            # upload — only admissions/retirements dirty the feed
+            return nxt, pool, lengths + active.astype(lengths.dtype)
+
+        return sentry.jit(step, name="serving.decode_step")
+
+    def _paged_block_step(self, pblk, li, x, pool, pt, pos, active):
+        """One transformer block at one position per slot, reading and
+        writing the paged pool. Mirrors ``_token_logits.block_step``
+        value-for-value (the identity fence's contract); only the
+        cache addressing differs: write goes to page
+        ``pt[s, pos//block]`` offset ``pos%block``, the context is the
+        slot's page-table gather reshaped back to position order."""
+        import jax
+        import jax.numpy as jnp
+
+        model = self.model
+        S = self.max_slots
+        hd = model.hidden // model.n_heads
+        n_kv = model.n_kv_heads
+        block = self.block
+        h = _rms(x, pblk["ln1"]["gamma"])
+        mha = pblk["mha"]
+        q = (h @ mha["Wq"]).reshape(S, model.n_heads, hd)
+        k = (h @ mha["Wk"]).reshape(S, n_kv, hd)
+        v = (h @ mha["Wv"]).reshape(S, n_kv, hd)
+        q = _rotary_rows(q, model.rope_theta, pos)
+        k = _rotary_rows(k, model.rope_theta, pos)
+        kv = jnp.concatenate([k, v], axis=2)            # [S, Kv, 2D]
+        # inactive slots scatter into the reserved trash page — the
+        # step's shape never depends on how many slots are live
+        pids = jnp.where(active, pt[jnp.arange(S), pos // block], 0)
+        offs = pos % block
+        if model.cache_quant:
+            codes, scales = pool
+            q8, s_new = _quant_kv(kv.reshape(S, n_kv, 2, hd), 3)
+            codes = codes.at[li, pids, :, :, offs].set(
+                q8.reshape(S, n_kv, 2 * hd))
+            scales = scales.at[li, pids, :, :, offs].set(s_new)
+            pool = (codes, scales)
+            dt = x.dtype
+            gath = codes[li, pt]    # [S, MP, Kv, 2D, block]
+            ctx = gath.transpose(0, 2, 3, 1, 4).reshape(
+                S, n_kv, 2 * hd, -1)
+            sc = scales[li, pt].transpose(0, 2, 3, 1, 4).reshape(
+                S, n_kv, 2, -1)
+            ck = ctx[:, :, :hd, :].astype(dt)
+            cv = ctx[:, :, hd:, :].astype(dt)
+            k_scale = sc[:, :, 0, None, :]
+            v_scale = sc[:, :, 1, None, :]
+        else:
+            (kvpool,) = pool
+            kvpool = kvpool.at[li, pids, :, :, offs].set(
+                kv.astype(kvpool.dtype))
+            pool = (kvpool,)
+            ctx = kvpool[li, pt].transpose(0, 2, 3, 1, 4).reshape(
+                S, n_kv, 2 * hd, -1)
+            ck, cv = ctx[:, :, :hd, :], ctx[:, :, hd:, :]
+            k_scale = v_scale = None
+        groups = model.n_heads // n_kv
+        qg = q.reshape(S, n_kv, groups, hd)
+        s = jnp.einsum("bkgd,bkdt->bkgt", qg, ck) / jnp.sqrt(
+            jnp.asarray(hd, x.dtype))
+        if k_scale is not None:
+            s = (s * k_scale).astype(x.dtype)
+        # per-slot causal mask; positions past a slot's pages resolve
+        # to trash-page junk but always sit beyond its length, so the
+        # mask keeps them at exact-zero softmax weight
+        live = (jnp.arange(ck.shape[3])[None, None, None, :]
+                <= pos[:, None, None, None])
+        s = jnp.where(live, s, -1e9)
+        w = jax.nn.softmax(s, axis=-1)
+        if v_scale is not None:
+            w = (w * v_scale).astype(x.dtype)
+        a = jnp.einsum("bkgt,bkdt->bkgd", w, cv).reshape(S, -1)
+        x = x + a @ mha["Wo"] + mha["bo"]
+        h = _rms(x, pblk["ln2"]["gamma"])
+        h = jax.nn.silu(h @ pblk["Wg"]) * (h @ pblk["Wu"])
+        return x + h @ pblk["Wd"], pool
+
+    def _build_admit_fn(self, tb: int):
+        """Prefill-into-pages for prompt bucket ``tb``: ONE batched
+        causal forward over the padded prompt (the same
+        ``_prefill_forward`` + ``_pick`` the dense path runs — flash
+        dispatch, logits head on one row), its per-layer caches
+        scattered into this sequence's pages, first generated token
+        returned. One executable per power-of-two bucket, exactly the
+        ``generate()`` compile set."""
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.perf import sentry
+
+        model = self.model
+        n_chunks = tb // self.block
+        block = self.block
+
+        def admit(params, pool, page_ids, prompt_pad, t0, temp, top_p,
+                  ctr):
+            logits0, caches = model._prefill_forward(
+                params, prompt_pad, tb, t0)
+            if model.cache_quant:
+                codes, scales = pool
+                w8 = jnp.stack([c[0][0] for c in caches])
+                sc = jnp.stack([c[1][0] for c in caches])
+                # [L, Kv, 2D, tb] -> [L, n_chunks, Kv, 2D, block]:
+                # page p covers positions p*block..(p+1)*block-1
+                codes = codes.at[:, page_ids].set(
+                    w8.reshape(w8.shape[0], w8.shape[1], w8.shape[2],
+                               n_chunks, block)
+                    .transpose(0, 3, 1, 2, 4))
+                scales = scales.at[:, page_ids].set(
+                    sc.reshape(sc.shape[0], sc.shape[1], 2, n_chunks,
+                               block).transpose(0, 3, 1, 2, 4))
+                pool = (codes, scales)
+            else:
+                (kvpool,) = pool
+                kv = jnp.stack([c[0] for c in caches])
+                pool = (kvpool.at[:, page_ids].set(
+                    kv.reshape(kv.shape[0], kv.shape[1], kv.shape[2],
+                               n_chunks, block)
+                    .transpose(0, 3, 1, 2, 4).astype(kvpool.dtype)),)
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(self.seed), ctr)
+            _, sub = jax.random.split(key)
+            g0 = model._pick(logits0, temp, top_p, sub,
+                             sample=self.sample, top_k=self.top_k,
+                             nucleus=self.top_p is not None)
+            return pool, g0
+        return sentry.jit(admit, name="serving.prefill")
+
+    def _admit_fn(self, tb: int):
+        fn = self._admit_fns.get(tb)
+        if fn is None:
+            fn = self._admit_fns[tb] = self._build_admit_fn(tb)
+        return fn
+
+    # -- host-side scheduling -------------------------------------------
+    def pages_needed(self, t0: int, max_new: int) -> int:
+        """Pages a (prompt, budget) pair needs for its WHOLE life:
+        the prefilled bucket plus every decode write (positions
+        ``t0 .. t0+max_new-2``) — reserved up front so an admitted
+        sequence can never stall mid-flight on an empty free list."""
+        tb = prompt_bucket(t0, self.max_context)
+        return self.pager.pages_for(max(tb, t0 + max_new - 1))
+
+    def free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def can_admit(self, t0: int, max_new: int) -> bool:
+        return (self.free_slot() is not None
+                and self.pages_needed(t0, max_new)
+                <= self.pager.free_pages())
+
+    def active_count(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    def admit(self, req) -> bool:
+        """Prefill ``req`` into free pages and occupy a slot; emits the
+        first generated token (the TTFT token). Returns False when
+        capacity is lacking — the caller keeps it queued."""
+        import jax.numpy as jnp
+
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        t0, max_new = prompt.shape[0], int(req.max_new)
+        slot = self.free_slot()
+        if slot is None:
+            return False
+        tb = prompt_bucket(t0, self.max_context)
+        # resolve (possibly build) the bucket executable BEFORE taking
+        # pages: everything after the reservation is under the
+        # release-on-failure try below
+        fn = self._admit_fn(tb)
+        pages = self.pager.alloc(self.pages_needed(t0, max_new), req)
+        if pages is None:
+            return False
+        ts0 = obs.now()
+        row = self._page_table[slot]
+        row[:] = 0
+        row[:len(pages)] = pages
+        pad = np.zeros((1, tb), np.int32)
+        pad[0, :t0] = prompt
+        self._ctr += 1
+        # `is not None`, never truthiness (the falsy-deadline lesson):
+        # the gateway rejects temperature <= 0 at submit
+        temp = getattr(req, "temperature", None)
+        ts1 = obs.now()
+        try:
+            pool, g0 = fn(
+                self.model._decode_params(self.net), self.pager.pool,
+                jnp.asarray(np.asarray(pages[:tb // self.block],
+                                       np.int32)),
+                jnp.asarray(pad), jnp.asarray(t0, jnp.int32),
+                jnp.asarray(1.0 if temp is None else temp,
+                            jnp.float32),
+                jnp.asarray(1.0 if self.top_p is None else self.top_p,
+                            jnp.float32),
+                jnp.asarray(self._ctr, jnp.int32))
+            self.pager.pool = pool
+            ts2 = obs.now()
+            first = int(np.asarray(g0)[0])  # blocking device sync
+        except BaseException:
+            # a failed prefill must not leak the reservation (the
+            # slot was never occupied; its table row resets)
+            self._page_table[slot] = 0
+            self._feed_dirty = True
+            self.pager.release(req)
+            raise
+        ts3 = obs.now()
+        obs.record_step("serving.prefill", ts0, ts1, ts2, ts3,
+                        args={"bucket": tb, "t0": t0, "slot": slot})
+        obs.metrics.SERVING_PREFILL.observe(ts3 - ts0)
+        self._slots[slot] = _Slot(req, length=t0,
+                                  remaining=max_new - 1)
+        self._lengths[slot] = t0
+        self._prev[slot] = first
+        self._temps[slot] = 1.0 if temp is None else temp
+        self._feed_dirty = True
+        obs.metrics.SERVING_SLOTS.set(self.active_count())
+        req.push(first)
+        obs.metrics.SERVING_TOKENS.inc()
+        self.tokens_out += 1
+        if self._slots[slot].remaining <= 0 or first == getattr(
+                req, "eos_id", None):
+            self._retire(slot)
+        return True
+
+    def step(self) -> int:
+        """One continuous-batching iteration: step every active slot
+        one token, deliver, retire finished sequences (their pages go
+        back to the free list). Returns tokens produced (0 = idle)."""
+        import jax.numpy as jnp
+
+        act = [i for i, s in enumerate(self._slots) if s is not None]
+        if not act:
+            return 0
+        ts0 = obs.now()
+        self._ctr += 1
+        if self._feed_dirty or self._dev_feed is None:
+            active = np.zeros(self.max_slots, bool)
+            active[act] = True
+            self._dev_feed = {
+                "pt": jnp.asarray(self._page_table),
+                "lengths": jnp.asarray(self._lengths),
+                "active": jnp.asarray(active),
+                "prev": jnp.asarray(self._prev),
+                "temps": jnp.asarray(self._temps),
+                "top_p": jnp.asarray(
+                    1.0 if self.top_p is None else self.top_p,
+                    jnp.float32),
+            }
+            self._feed_dirty = False
+        f = self._dev_feed
+        ts1 = obs.now()
+        nxt, pool, len_next = self._step_fn(
+            self.model._decode_params(self.net), self.pager.pool,
+            f["pt"], f["lengths"], f["active"], f["prev"], f["temps"],
+            f["top_p"], jnp.asarray(self._ctr, jnp.int32))
+        self.pager.pool = pool
+        # feed the step's own outputs back: no h2d on the clean path
+        f["prev"], f["lengths"] = nxt, len_next
+        ts2 = obs.now()
+        toks = np.asarray(nxt)          # blocking device sync
+        ts3 = obs.now()
+        self.steps += 1
+        for i in act:
+            s = self._slots[i]
+            tok = int(toks[i])
+            self._lengths[i] += 1
+            self._prev[i] = tok
+            s.length += 1
+            s.remaining -= 1
+            s.req.push(tok)
+            if s.remaining <= 0 or tok == getattr(s.req, "eos_id",
+                                                  None):
+                self._retire(i)
+        obs.record_step("serving.decode_step", ts0, ts1, ts2, ts3,
+                        args={"active": len(act)})
+        obs.metrics.SERVING_STEP.observe(ts3 - ts0)
+        obs.metrics.SERVING_TOKENS.inc(len(act))
+        self.tokens_out += len(act)
+        return len(act)
+
+    def _retire(self, slot: int) -> None:
+        s = self._slots[slot]
+        self._slots[slot] = None
+        self._page_table[slot] = 0
+        self._feed_dirty = True
+        self.pager.release(s.req)
+        obs.metrics.SERVING_SLOTS.set(self.active_count())
+        s.req.finish()
+
+    def shed_all(self, make_error) -> int:
+        """Error out every in-flight sequence and release its pages —
+        the fault path's guarantee: a poisoned step never leaves a
+        wedged slot or a leaked page. ``make_error`` is a ZERO-ARG
+        factory called once per stream: a shared exception instance
+        would leak the first stream's tokens-so-far into every other
+        client's structured error."""
+        n = 0
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            self._slots[i] = None
+            self._page_table[i] = 0
+            self.pager.release(s.req)
+            s.req.fail(make_error())
+            n += 1
+        self._feed_dirty = True
+        obs.metrics.SERVING_SLOTS.set(0)
+        return n
+
+    def evict(self, req) -> bool:
+        """Cancel one in-flight sequence (client went away): free its
+        slot and pages without erroring the stream."""
+        for i, s in enumerate(self._slots):
+            if s is not None and s.req is req:
+                self._slots[i] = None
+                self._page_table[i] = 0
+                self._feed_dirty = True
+                self.pager.release(req)
+                obs.metrics.SERVING_SLOTS.set(self.active_count())
+                req.finish()
+                return True
+        return False
+
+    # -- AOT warmup ------------------------------------------------------
+    def warmup(self, prompt_lens=None) -> Dict[str, float]:
+        """AOT-compile the decode step (one signature) and the prefill
+        executable of every reachable prompt bucket BEFORE traffic —
+        after this the sentry sees zero new traces from any admission
+        order (the acceptance fence). Iterates :data:`WARMUP_FEEDS`'
+        builder table so lint rule 7 can hold the two in lockstep."""
+        import jax
+        import jax.numpy as jnp
+
+        assert set(WARMUP_FEEDS) == {"_build_step_fn",
+                                     "_build_admit_fn"}
+        if prompt_lens is None:
+            prompt_lens = range(1, self.max_context)
+        buckets = sorted({prompt_bucket(t, self.max_context)
+                          for t in prompt_lens})
+        params = self.model._decode_params(self.net)
+        pool_sds = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                         for a in self.pager.pool)
+        i32 = jnp.int32
+        sds = jax.ShapeDtypeStruct
+        S, MP = self.max_slots, self.max_pages_per_seq
+        seconds = self._step_fn.warmup(
+            params, pool_sds, sds((S, MP), i32), sds((S,), i32),
+            sds((S,), jnp.bool_), sds((S,), i32),
+            sds((S,), jnp.float32), sds((), jnp.float32),
+            sds((), i32))
+        compiled = seconds > 0
+        for tb in buckets:
+            dt = self._admit_fn(tb).warmup(
+                params, pool_sds, sds((tb // self.block,), i32),
+                sds((1, tb), i32), sds((), i32), sds((), jnp.float32),
+                sds((), jnp.float32), sds((), i32))
+            compiled += dt > 0
+            seconds += dt
+        return {"compiled": int(compiled), "seconds": seconds,
+                "buckets": list(buckets)}

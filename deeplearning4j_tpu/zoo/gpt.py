@@ -41,6 +41,17 @@ def _rms(x, gamma):
         jnp.mean(jnp.square(x), -1, keepdims=True) + RMSNORM_EPS) * gamma
 
 
+def prompt_bucket(t0: int, max_len: Optional[int] = None) -> int:
+    """THE prompt-length bucket table: power-of-two (min 16), clamped
+    to ``max_len`` when given. ``generate()``/``warmup_decode`` and the
+    serving gateway's prefill (``serving/scheduler.py``) MUST share
+    this one derivation — a gateway bucketing prompts even slightly
+    differently from the decode path it warms would guarantee a
+    retrace on the first live request."""
+    tb = max(16, 1 << (max(int(t0), 1) - 1).bit_length())
+    return tb if max_len is None else min(tb, max_len)
+
+
 def _quant_kv(kvr, channel_axis: int):
     """int8 KV quantisation shared by prefill and the decode step:
     per-slice abs-max scales over ``channel_axis`` (the D channels of
@@ -269,8 +280,10 @@ class CausalTransformerLM(ZooModel):
     def _bucket(t0: int) -> int:
         """Power-of-two prompt-length bucket (min 16): bounds decode
         compiles at O(log max_len) per n_new instead of one per prompt
-        length."""
-        return max(16, 1 << (t0 - 1).bit_length())
+        length. Delegates to the module-level :func:`prompt_bucket` —
+        the one table generate(), warmup_decode() and the serving
+        gateway all share."""
+        return prompt_bucket(t0)
 
     def _prep_decode(self, prompt, n_new: int):
         """Shared generate/generate_beam prologue: coerce, guard,
@@ -282,7 +295,7 @@ class CausalTransformerLM(ZooModel):
         if t0 + n_new > self.max_len:
             raise ValueError(f"prompt+new ({t0 + n_new}) exceeds "
                              f"max_len={self.max_len}")
-        tb = min(self._bucket(t0), self.max_len)
+        tb = prompt_bucket(t0, self.max_len)
         pad = np.zeros((b, tb - t0), np.int32)
         prompt_pad = jnp.asarray(np.concatenate([prompt_np, pad], 1))
         return prompt_np, prompt_pad, b, t0, tb
@@ -315,7 +328,7 @@ class CausalTransformerLM(ZooModel):
             # generate() snaps it — including the max_len-clamped top
             # bucket, which is the slowest compile of the lot
             prompt_lens = range(1, self.max_len - n_new + 1)
-        buckets = sorted({min(self._bucket(t0), self.max_len)
+        buckets = sorted({prompt_bucket(t0, self.max_len)
                           for t0 in prompt_lens})
         rng = jax.random.fold_in(jax.random.PRNGKey(0), 0)
         params = self._decode_params(net)

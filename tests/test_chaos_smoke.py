@@ -54,3 +54,14 @@ def test_chaos_serving_plan_sheds_and_survives():
     assert res["errored_by_fault"] > 0
     assert res["completed"] > 0
     assert res["worker_survived"] is True
+    # the same plan drills the continuous-batching gateway: in-flight
+    # sequences shed with a structured error (tokens-so-far attached),
+    # the paged pool comes back whole, and the same worker serves a
+    # post-fault wave — never a wedged slot or leaked page
+    gw = out["results"][1]
+    assert gw["mode"] == "serving-gateway"
+    assert gw["faults_fired"] >= 1
+    assert gw["aborted"] > 0 and gw["tokens_salvaged"] > 0
+    assert gw["completed"] + gw["aborted"] == gw["requests"]
+    assert gw["post_fault_completed"] == 3
+    assert gw["pages_conserved"] is True

@@ -265,3 +265,71 @@ def test_lint_catches_listener_side_device_reductions(tmp_path):
                for p in problems)
     assert any("train/stats.py" in p and "jnp." in p for p in problems)
     assert not any("train/listeners.py" in p for p in problems)
+
+
+def test_lint_rule7_serving_jits_sentried_and_fed(tmp_path):
+    """Rule 7: a raw jax.jit in serving/, a sentry.jit outside a
+    _build_* builder, a builder without a WARMUP_FEEDS entry, a stale
+    feed, and a warmup() that ignores the table are all flagged."""
+    sdir = tmp_path / "serving"
+    sdir.mkdir()
+    (sdir / "bad.py").write_text(
+        "import jax\n"
+        "from deeplearning4j_tpu.perf import sentry\n"
+        "from deeplearning4j_tpu import obs\n"
+        "raw = jax.jit(lambda x: x)\n"
+        "stray = sentry.jit(lambda x: x)\n"
+        "obs.record_step('e', 0.0, 0.0, 0.0, 0.0)\n"
+        "class S:\n"
+        "    def _build_step_fn(self):\n"
+        "        return sentry.jit(lambda x: x)\n"
+        "    def _build_orphan_fn(self):\n"
+        "        return sentry.jit(lambda x: x)\n"
+        "    def warmup(self):\n"
+        "        return None\n"
+        "WARMUP_FEEDS = {'_build_step_fn': 'feed',\n"
+        "                '_build_removed_fn': 'stale'}\n")
+    problems = lint_instrumentation.run(tmp_path)
+    assert any("bad.py:4" in p and "raw jax.jit" in p
+               for p in problems)
+    assert any("bad.py:5" in p and "outside a _build_" in p
+               for p in problems)
+    assert any("_build_orphan_fn" in p and "WARMUP_FEEDS" in p
+               for p in problems)
+    assert any("_build_removed_fn" in p and "stale" in p
+               for p in problems)
+    assert any("no warmup() reads WARMUP_FEEDS" in p
+               for p in problems)
+
+
+def test_lint_rule7_clean_serving_module_passes(tmp_path):
+    sdir = tmp_path / "serving"
+    sdir.mkdir()
+    (sdir / "good.py").write_text(
+        "from deeplearning4j_tpu.perf import sentry\n"
+        "from deeplearning4j_tpu import obs\n"
+        "WARMUP_FEEDS = {'_build_step_fn': 'feed'}\n"
+        "class S:\n"
+        "    def _build_step_fn(self):\n"
+        "        def step(x):\n"
+        "            return x\n"
+        "        return sentry.jit(step)\n"
+        "    def warmup(self):\n"
+        "        assert WARMUP_FEEDS\n"
+        "        obs.record_step('e', 0.0, 0.0, 0.0, 0.0)\n"
+        "        return 0\n")
+    assert not lint_instrumentation.run(tmp_path)
+
+
+def test_lint_rule7_missing_feed_table(tmp_path):
+    sdir = tmp_path / "serving"
+    sdir.mkdir()
+    (sdir / "nofeeds.py").write_text(
+        "from deeplearning4j_tpu.perf import sentry\n"
+        "from deeplearning4j_tpu import obs\n"
+        "obs.record_step('e', 0.0, 0.0, 0.0, 0.0)\n"
+        "class S:\n"
+        "    def _build_step_fn(self):\n"
+        "        return sentry.jit(lambda x: x)\n")
+    problems = lint_instrumentation.run(tmp_path)
+    assert any("no WARMUP_FEEDS dict literal" in p for p in problems)

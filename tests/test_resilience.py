@@ -467,6 +467,43 @@ def test_deadline_expired_requests_skipped_not_computed():
     pi.shutdown()
 
 
+def test_zero_deadline_means_expired_not_disabled():
+    """Falsy-deadline regression (ISSUE 13 satellite): an EXPLICIT
+    deadline of 0/0.0 means "already expired" — the worker must shed
+    it, never compute it. The old ``if deadline_s`` truthiness test
+    silently read 0 as "no deadline"."""
+    from deeplearning4j_tpu.parallel.inference import (
+        DeadlineExpiredError)
+    net = _mlp()
+    pi, release = _blocked_pi(net, queue_limit=8)
+    x = np.zeros(8, np.float32)
+    s0 = _counter(metrics.REQS_SHED, reason="deadline")
+    blocker = pi.output_async(x)                      # parks the worker
+    time.sleep(0.05)
+    doomed = pi.output_async(x, deadline_s=0.0)       # already expired
+    release.set()
+    with pytest.raises(DeadlineExpiredError):
+        doomed.get(10.0)
+    assert np.asarray(blocker.get(10.0)).shape[-1] == 3
+    assert _counter(metrics.REQS_SHED, reason="deadline") == s0 + 1
+    # output()'s timeout doubles as the deadline: timeout=0 must also
+    # mean expired (sheds in the worker; the caller's get times out)
+    pi2, release2 = _blocked_pi(net, queue_limit=8)
+    b2 = pi2.output_async(x)
+    time.sleep(0.05)
+    with pytest.raises(TimeoutError):
+        pi2.output(x, timeout=0)
+    release2.set()
+    assert np.asarray(b2.get(10.0)).shape[-1] == 3
+    for _ in range(400):    # worker sheds it on its NEXT loop pass
+        if _counter(metrics.REQS_SHED, reason="deadline") == s0 + 2:
+            break
+        time.sleep(0.005)
+    assert _counter(metrics.REQS_SHED, reason="deadline") == s0 + 2
+    pi.shutdown()
+    pi2.shutdown()
+
+
 def test_shutdown_flushes_queue_immediately():
     """Satellite acceptance: queued observables must not wait out their
     full timeout — shutdown errors them out immediately."""

@@ -1,0 +1,583 @@
+"""Continuous-batching serving gateway (serving/ — ISSUE 13).
+
+The three fences this file owns:
+
+- **pager correctness**: paged decode (float AND int8 pages) is
+  TOKEN-IDENTICAL to dense ``generate()`` for the same prompts/seed —
+  continuous batching must never change what a request returns;
+- **pager invariants**: no page owned by two live sequences, free-list
+  conservation under admit/evict churn, trash page out of circulation;
+- **serving semantics**: fixed-shape zero-retrace decode after
+  warmup, admission control on free pages, queue-full/deadline
+  shedding, graceful drain, tenant fairness, fault-shed without a
+  wedged slot or leaked page, and the continuous-vs-request-at-a-time
+  throughput acceptance.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.inference import (DeadlineExpiredError,
+                                                   QueueFullError,
+                                                   ServingShutdownError)
+from deeplearning4j_tpu.serving import (DecodeScheduler, KVPager,
+                                        PageTableError, SequenceAborted,
+                                        ServingGateway)
+from deeplearning4j_tpu.zoo import GPTNano
+from deeplearning4j_tpu.zoo.gpt import CausalTransformerLM, prompt_bucket
+
+
+def _tiny_model(**kw):
+    """2-layer/32-hidden LM: fast compiles for the scheduling tests
+    (the identity fences use GPTNano to cover GQA + 4 layers)."""
+    kw.setdefault("vocab_size", 64)
+    return CausalTransformerLM(hidden=32, n_layers=2, n_heads=2,
+                               n_kv_heads=1,
+                               max_len=kw.pop("max_len", 64),
+                               seed=kw.pop("seed", 9), **kw)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = _tiny_model()
+    return model, model.init()
+
+
+class _Req:
+    """Minimal duck-typed request for driving DecodeScheduler
+    directly (no gateway thread — deterministic churn tests)."""
+
+    def __init__(self, prompt, max_new, temperature=None, eos_id=None):
+        self.prompt = np.asarray(prompt, np.int32)
+        self.max_new = max_new
+        self.temperature = temperature
+        self.eos_id = eos_id
+        self.tokens = []
+        self.done = False
+        self.error = None
+
+    def push(self, tok):
+        self.tokens.append(int(tok))
+
+    def finish(self):
+        self.done = True
+
+    def fail(self, e):
+        self.error = e
+        self.done = True
+
+
+# =========================================================================
+# pager-correctness fence: paged decode == dense generate(), token for
+# token (float and int8 pages), across staggered admissions
+# =========================================================================
+
+@pytest.mark.parametrize("cache_quant", [None, "int8"])
+def test_paged_decode_token_identical_to_dense(cache_quant):
+    model = GPTNano(vocab_size=64, max_len=64, seed=7,
+                    cache_quant=cache_quant)
+    net = model.init()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 64, t).astype(np.int32)
+               for t in (5, 17, 9, 30, 3, 22)]
+    budgets = [10, 4, 16, 8, 12, 6]
+    dense = [np.asarray(model.generate(net, p[None], n_new=n))[0]
+             for p, n in zip(prompts, budgets)]
+    # 3 slots for 6 requests: admissions stagger mid-decode, every
+    # slot serves sequences at different positions/buckets — the
+    # continuous batch must still reproduce every dense output exactly
+    gw = ServingGateway(model, net, max_slots=3, block=8,
+                        max_context=64)
+    gw.warmup(prompt_lens=range(1, 31))
+    streams = [gw.submit(p, max_new=n)
+               for p, n in zip(prompts, budgets)]
+    for st, d in zip(streams, dense):
+        got = st.result(timeout=120)
+        np.testing.assert_array_equal(got, d)
+    gw._sched.pager.check_invariants()
+    assert gw._sched.pager.free_pages() == gw._sched.pager.n_pages - 1
+    gw.shutdown()
+
+
+# =========================================================================
+# pager invariants
+# =========================================================================
+
+def test_pager_alloc_release_conservation():
+    pager = KVPager(n_layers=2, n_kv_heads=1, head_dim=16, n_pages=9,
+                    block=8, cache_quant=None)
+    a, b = object(), object()
+    pa = pager.alloc(3, a)
+    pb = pager.alloc(4, b)
+    assert len(pa) == 3 and len(pb) == 4
+    assert 0 not in pa + pb                  # trash page reserved
+    assert not set(pa) & set(pb)             # disjoint owners
+    assert pager.free_pages() == 1
+    assert pager.alloc(2, object()) is None  # exhausted -> refused
+    assert pager.free_pages() == 1           # refusal takes nothing
+    pager.check_invariants()
+    assert pager.release(a) == 3
+    assert pager.free_pages() == 4
+    assert pager.release(b) == 4
+    assert pager.free_pages() == 8           # full conservation
+    pager.check_invariants()
+
+
+def test_pager_detects_double_ownership():
+    pager = KVPager(n_layers=1, n_kv_heads=1, head_dim=8, n_pages=5,
+                    block=8, cache_quant=None)
+    a, b = object(), object()
+    pa = pager.alloc(2, a)
+    pager.alloc(1, b)
+    # corrupt the table the way a scheduler bug would
+    pager._pages_of[id(b)].append(pa[0])
+    with pytest.raises(PageTableError, match="two live sequences"):
+        pager.check_invariants()
+
+
+def test_pager_invariants_under_admit_evict_churn(tiny):
+    """Seeded random admit/step/evict churn with the invariant check
+    after EVERY transition: no shared pages, no leaks, full free-list
+    conservation once drained."""
+    model, net = tiny
+    sched = DecodeScheduler(model, net, max_slots=3, block=8,
+                            max_context=32, n_pages=10)
+    sched.warmup(prompt_lens=range(1, 17))
+    rng = np.random.default_rng(4)
+    live = []
+    for it in range(120):
+        op = rng.integers(0, 3)
+        if op == 0:
+            r = _Req(rng.integers(0, 64, int(rng.integers(1, 17))),
+                     int(rng.integers(1, 9)))
+            if sched.can_admit(r.prompt.size, r.max_new):
+                assert sched.admit(r)
+                if not r.done:
+                    live.append(r)
+        elif op == 1:
+            sched.step()
+        elif live:
+            sched.evict(live.pop(int(rng.integers(0, len(live)))))
+        live = [r for r in live if not r.done]
+        sched.pager.check_invariants()
+    while any(s is not None for s in sched._slots):
+        sched.step()
+        sched.pager.check_invariants()
+    assert sched.pager.free_pages() == sched.pager.n_pages - 1
+
+
+def test_int8_pages_roundtrip_token_for_token(tiny):
+    """Satellite: int8 page storage must reproduce the dense int8-KV
+    decode path token-for-token on a fixed seed (the quantiser is
+    shared — ``_quant_kv`` — so codes and scales are bit-equal)."""
+    model = _tiny_model(cache_quant="int8", seed=11)
+    net = model.init()
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, 64, 13).astype(np.int32)
+    dense = np.asarray(model.generate(net, prompt[None], n_new=14))[0]
+    sched = DecodeScheduler(model, net, max_slots=2, block=8,
+                            max_context=64)
+    sched.warmup(prompt_lens=(13,))
+    r = _Req(prompt, 14)
+    assert sched.admit(r)
+    while not r.done:
+        sched.step()
+    np.testing.assert_array_equal(
+        np.concatenate([prompt, np.asarray(r.tokens, np.int32)]),
+        dense)
+
+
+# =========================================================================
+# fixed-shape contract: zero retraces after warmup
+# =========================================================================
+
+def test_zero_retraces_after_warmup(tiny):
+    from deeplearning4j_tpu.perf import sentry
+    model, net = tiny
+    gw = ServingGateway(model, net, max_slots=3, block=8,
+                        max_context=32, default_max_new=6)
+    gw.warmup(prompt_lens=range(1, 25))
+    before = sentry.total_traces()
+    rng = np.random.default_rng(1)
+    with sentry.strict():
+        streams = [gw.submit(rng.integers(0, 64, int(t)), max_new=6)
+                   for t in rng.integers(1, 25, 12)]
+        for st in streams:
+            st.result(timeout=120)
+    assert sentry.total_traces() == before, \
+        "continuous-batching traffic retraced after warmup"
+    gw.shutdown()
+
+
+def test_gateway_and_generate_share_bucket_table():
+    """Satellite: the gateway's prefill buckets come from the same
+    module-level helper generate()/warmup_decode use — drift here
+    would be a guaranteed retrace on the first live request."""
+    model = _tiny_model()
+    assert model._bucket(5) == prompt_bucket(5) == 16
+    assert prompt_bucket(17) == 32
+    assert prompt_bucket(40, 48) == 48          # max_len clamp
+    net = model.init()
+    sched = DecodeScheduler(model, net, max_slots=2, block=16,
+                            max_context=64)
+    warm = sched.warmup(prompt_lens=range(1, 33))
+    want = sorted({prompt_bucket(t, 64) for t in range(1, 33)})
+    assert warm["buckets"] == want
+
+
+# =========================================================================
+# gateway serving semantics (shed / deadline / drain / fairness)
+# =========================================================================
+
+def test_queue_full_sheds_fast(tiny):
+    from deeplearning4j_tpu.obs import metrics
+    model, net = tiny
+    # worker never started: the queue fills deterministically
+    gw = ServingGateway(model, net, max_slots=2, block=8,
+                        max_context=32, queue_limit=3,
+                        default_max_new=4, start=False)
+    p = np.zeros(4, np.int32)
+    for _ in range(3):
+        gw.submit(p)
+    t0 = time.perf_counter()
+    with pytest.raises(QueueFullError):
+        gw.submit(p)
+    assert time.perf_counter() - t0 < 0.5       # shed, not blocked
+    shed = metrics.SERVING_SHED.labels(reason="queue_full")
+    assert shed.get() >= 1
+
+
+def test_deadline_sheds_unadmitted_requests(tiny):
+    model, net = tiny
+    gw = ServingGateway(model, net, max_slots=1, block=8,
+                        max_context=64, default_max_new=4)
+    gw.warmup(prompt_lens=(4,))
+    blocker = gw.submit(np.zeros(4, np.int32), max_new=40)
+    # explicit 0 deadline = already expired (the `is not None`
+    # falsy-deadline contract): must shed, never serve
+    doomed = gw.submit(np.zeros(4, np.int32), deadline_s=0.0)
+    with pytest.raises(DeadlineExpiredError):
+        doomed.result(timeout=30)
+    assert blocker.result(timeout=120).shape == (44,)
+    gw.shutdown()
+
+
+def test_shutdown_drains_inflight_and_flushes_queue(tiny):
+    model, net = tiny
+    gw = ServingGateway(model, net, max_slots=1, block=8,
+                        max_context=64, default_max_new=24)
+    gw.warmup(prompt_lens=(4,))
+    running = gw.submit(np.zeros(4, np.int32))
+    # wait until it is admitted (first token streamed)
+    for _ in range(500):
+        if running.n_generated():
+            break
+        time.sleep(0.01)
+    queued = [gw.submit(np.zeros(4, np.int32)) for _ in range(2)]
+    dropped = gw.shutdown(drain=True)
+    assert dropped == 2
+    assert running.result(timeout=30).shape == (28,)  # drained to end
+    for st in queued:
+        with pytest.raises(ServingShutdownError):
+            st.result(timeout=5)
+    with pytest.raises(ServingShutdownError):
+        gw.submit(np.zeros(4, np.int32))
+    assert gw._sched.pager.free_pages() == gw._sched.pager.n_pages - 1
+
+
+def test_tenant_round_robin_fairness(tiny):
+    """One chatty tenant must not starve another: with one slot, a
+    flood from tenant A and a late pair from tenant B interleave, so
+    both B requests serve before A's tail."""
+    model, net = tiny
+    gw = ServingGateway(model, net, max_slots=1, block=8,
+                        max_context=32, default_max_new=8,
+                        queue_limit=32, start=False)
+    a = [gw.submit(np.zeros(3, np.int32), tenant="A")
+         for _ in range(6)]
+    b = [gw.submit(np.zeros(3, np.int32), tenant="B")
+         for _ in range(2)]
+    gw.warmup(prompt_lens=(3,))
+    gw._worker = threading.Thread(target=gw._loop, daemon=True)
+    gw._worker.start()
+    for st in a + b:
+        st.result(timeout=120)
+    # admission order == TTFT order with one slot
+    t_first = {st: st.t_first for st in a + b}
+    assert max(t_first[st] for st in b) < max(t_first[st] for st in a[3:])
+    gw.shutdown()
+
+
+def test_admission_control_on_free_pages(tiny):
+    """Pool smaller than the offered load: admission defers until
+    pages free up, every request still completes, nothing leaks."""
+    model, net = tiny
+    # 7 usable pages; each request needs ceil(max(16, 3+11)/8)=2 pages
+    # -> at most 3 in flight despite 4 slots
+    gw = ServingGateway(model, net, max_slots=4, block=8,
+                        max_context=32, n_pages=8, default_max_new=12,
+                        queue_limit=32)
+    gw.warmup(prompt_lens=(3,))
+    streams = [gw.submit(np.zeros(3, np.int32)) for _ in range(10)]
+    for st in streams:
+        assert st.result(timeout=120).shape == (15,)
+    gw._sched.pager.check_invariants()
+    assert gw._sched.pager.free_pages() == 7
+    gw.shutdown()
+
+
+def test_oversized_request_fails_loudly(tiny):
+    model, net = tiny
+    gw = ServingGateway(model, net, max_slots=2, block=8,
+                        max_context=32, n_pages=3, start=False)
+    with pytest.raises(ValueError, match="pages"):
+        gw.submit(np.zeros(20, np.int32), max_new=12)
+    with pytest.raises(ValueError, match="max_context"):
+        gw.submit(np.zeros(30, np.int32), max_new=8)
+    with pytest.raises(ValueError, match="empty"):
+        gw.submit(np.zeros(0, np.int32))
+
+
+def test_streaming_tokens_and_eos(tiny):
+    model, net = tiny
+    sched = DecodeScheduler(model, net, max_slots=2, block=8,
+                            max_context=32)
+    sched.warmup(prompt_lens=(5,))
+    probe = _Req(np.arange(5), 6)
+    sched.admit(probe)
+    while not probe.done:
+        sched.step()
+    assert len(probe.tokens) == 6
+    # eos: same prompt with eos_id = the 3rd token it will produce
+    # stops there and frees the pages
+    eos = probe.tokens[2]
+    if eos not in probe.tokens[:2]:         # unambiguous cut point
+        r = _Req(np.arange(5), 6, eos_id=eos)
+        sched.admit(r)
+        while not r.done:
+            sched.step()
+        assert r.tokens == probe.tokens[:3]
+    sched.pager.check_invariants()
+    assert sched.pager.free_pages() == sched.pager.n_pages - 1
+
+    # gateway streaming surface: tokens() yields the same sequence
+    # result() returns
+    gw = ServingGateway(model, net, max_slots=2, block=8,
+                        max_context=32, default_max_new=6)
+    gw.warmup(prompt_lens=(5,))
+    st = gw.submit(np.arange(5, dtype=np.int32))
+    toks = list(st.tokens(timeout=60))
+    np.testing.assert_array_equal(
+        st.result(timeout=5), np.concatenate([np.arange(5), toks]))
+    assert toks == probe.tokens
+    gw.shutdown()
+
+
+def test_cancel_queued_and_live_sequences(tiny):
+    """The cancel path is a slot/page-freeing path like retire and
+    shed: cancelling one QUEUED stream and one MID-GENERATION stream
+    must finish both without error, release every page, and leave the
+    remaining traffic serving."""
+    model, net = tiny
+    gw = ServingGateway(model, net, max_slots=1, block=8,
+                        max_context=32, default_max_new=16)
+    gw.warmup(prompt_lens=(4,))
+    live = gw.submit(np.zeros(4, np.int32))
+    for _ in range(500):                      # wait until admitted
+        if live.n_generated():
+            break
+        time.sleep(0.005)
+    queued = gw.submit(np.zeros(4, np.int32))
+    survivor = gw.submit(np.zeros(4, np.int32), max_new=4)
+    assert gw.cancel(queued)                  # unqueued immediately
+    assert gw.cancel(live)                    # evicted by the worker
+    assert queued.result(timeout=10).shape == (4,)   # no tokens, no error
+    partial = live.result(timeout=30)
+    assert live.error() is None and partial.shape[0] < 20
+    assert survivor.result(timeout=60).shape == (8,)
+    gw._sched.pager.check_invariants()
+    assert gw._sched.pager.free_pages() == gw._sched.pager.n_pages - 1
+    gw.shutdown()
+
+
+def test_sampled_decoding_serves_without_retraces(tiny):
+    from deeplearning4j_tpu.perf import sentry
+    model, net = tiny
+    gw = ServingGateway(model, net, max_slots=2, block=8,
+                        max_context=32, default_max_new=6,
+                        sample=True, top_k=8, top_p=0.9, seed=3)
+    gw.warmup(prompt_lens=(4, 20))
+    before = sentry.total_traces()
+    outs = []
+    for t in (4, 17):
+        st = gw.submit(np.zeros(t, np.int32), temperature=0.8)
+        outs.append(st.result(timeout=120))
+    assert sentry.total_traces() == before
+    for t, o in zip((4, 17), outs):
+        gen = o[t:]
+        assert gen.shape == (6,)
+        assert ((gen >= 0) & (gen < model.vocab_size)).all()
+    gw.shutdown()
+
+
+# =========================================================================
+# fault path: shed-not-wedge, no leaked pages (chaos.py drills the
+# same site end-to-end)
+# =========================================================================
+
+def test_injected_fault_sheds_inflight_and_recovers(tiny):
+    from deeplearning4j_tpu.obs import metrics
+    from deeplearning4j_tpu.resilience import faults
+    model, net = tiny
+    gw = ServingGateway(model, net, max_slots=2, block=8,
+                        max_context=64, default_max_new=30,
+                        queue_limit=16)
+    gw.warmup(prompt_lens=(4,))
+    shed0 = metrics.SERVING_SHED.labels(reason="fault").get()
+    with faults.active("serving:error=RuntimeError:nth=3:max=1"):
+        # two different prompts -> different token streams: each
+        # victim's structured error must carry ITS OWN tokens (a
+        # shared exception instance leaked the first stream's tokens
+        # into every other client's error)
+        victims = [gw.submit(np.full(4, i, np.int32))
+                   for i in range(2)]
+        errors = 0
+        for st in victims:
+            try:
+                st.result(timeout=60)
+            except SequenceAborted as e:
+                errors += 1
+                assert e.tokens, "structured error carries the " \
+                                 "tokens streamed before the fault"
+                assert e.tokens == st._tokens, \
+                    "cross-request token leakage in shed error"
+        assert errors == 2
+        assert victims[0]._tokens != victims[1]._tokens
+        fired = sum(s["fires"] for s in faults.stats().values())
+    assert fired == 1
+    assert metrics.SERVING_SHED.labels(reason="fault").get() \
+        == shed0 + 2
+    # never a wedged slot or leaked page: pool is whole and the SAME
+    # worker serves the next request
+    gw._sched.pager.check_invariants()
+    assert gw._sched.pager.free_pages() == gw._sched.pager.n_pages - 1
+    post = gw.submit(np.zeros(4, np.int32), max_new=4)
+    assert post.result(timeout=60).shape == (8,)
+    gw.shutdown()
+
+
+def test_starved_large_request_ages_into_admission(tiny):
+    """Anti-starvation aging: a page-hungry request must not wait
+    forever while smaller arrivals keep taking every freed page —
+    past ``starvation_patience`` the oldest head blocks younger
+    admissions until the pool accumulates its need."""
+    model, net = tiny
+    gw = ServingGateway(model, net, max_slots=2, block=8,
+                        max_context=32, n_pages=5, queue_limit=32,
+                        default_max_new=12, starvation_patience=0.2)
+    gw.warmup(prompt_lens=(3, 4))
+    small = lambda: gw.submit(np.zeros(3, np.int32), tenant="small",
+                              max_new=12)          # 2 pages
+    others = [small() for _ in range(2)]           # pool now full
+    big = gw.submit(np.zeros(4, np.int32), tenant="big",
+                    max_new=18)                    # needs 3 pages
+    others += [small() for _ in range(8)]          # sustained smalls
+    assert big.result(timeout=120).shape == (22,)
+    for st in others:
+        st.result(timeout=120)
+    # aging moved it ahead of the small-request tail
+    assert big.t_first < max(st.t_first for st in others[-4:])
+    gw._sched.pager.check_invariants()
+    gw.shutdown()
+
+
+def test_admission_fault_sheds_request_not_worker(tiny):
+    """A device error during PREFILL (not just the step) must shed
+    that one request with a structured error, release its page
+    reservation, and leave the worker serving — the admission path is
+    outside the step's try block and killed the worker before."""
+    model, net = tiny
+    gw = ServingGateway(model, net, max_slots=2, block=8,
+                        max_context=32, default_max_new=4)
+    gw.warmup(prompt_lens=(4,))
+    sched = gw._sched
+    real_admit_fn = sched._admit_fn
+    calls = [0]
+
+    def poisoned(tb):
+        calls[0] += 1
+        if calls[0] == 1:
+            raise RuntimeError("synthetic prefill device error")
+        return real_admit_fn(tb)
+
+    sched._admit_fn = poisoned
+    victim = gw.submit(np.zeros(4, np.int32))
+    with pytest.raises(SequenceAborted, match="admission fault"):
+        victim.result(timeout=30)
+    # reservation released, worker alive, next request serves
+    ok = gw.submit(np.zeros(4, np.int32))
+    assert ok.result(timeout=60).shape == (8,)
+    sched.pager.check_invariants()
+    assert sched.pager.free_pages() == sched.pager.n_pages - 1
+    gw.shutdown()
+
+
+def test_zero_temperature_rejected_loudly(tiny):
+    """temperature=0.0 must raise, not silently sample at 1.0 (the
+    falsy-zero bug class the deadline satellite fixed)."""
+    model, net = tiny
+    gw = ServingGateway(model, net, max_slots=2, block=8,
+                        max_context=32, sample=True, top_k=4,
+                        start=False)
+    with pytest.raises(ValueError, match="temperature"):
+        gw.submit(np.zeros(4, np.int32), temperature=0.0)
+
+
+# =========================================================================
+# acceptance: throughput vs request-at-a-time + SLO export
+# =========================================================================
+
+def test_continuous_batching_beats_request_at_a_time(tiny):
+    """The ISSUE 13 acceptance row: under the synthetic multi-tenant
+    closed-loop trace the gateway sustains >= 1.5x the sequential B=1
+    generate() baseline with zero retraces after warmup. Runs via
+    ``loadgen.subprocess_report`` — a one-device measurement (the
+    bench/dossier environment), outside this suite's 8-virtual-device
+    partitioning which throttles the device loop. The serving-family
+    /metrics export is asserted in-process on a small trace."""
+    from deeplearning4j_tpu.obs import metrics
+    from deeplearning4j_tpu.serving import loadgen
+
+    rep = loadgen.subprocess_report()
+    if not rep.get("skipped") and (rep.get("speedup") or 0) < 1.5:
+        # throughput measurements on a busy 1-core CI box jitter (the
+        # bench protocol medians 3 estimates for the same reason):
+        # one fresh-process retry before calling the regression real
+        rep = {**loadgen.subprocess_report(),
+               "first_attempt_speedup": rep.get("speedup")}
+    assert not rep.get("skipped"), rep
+    assert rep["retraces_after_warmup"] == 0
+    assert rep["completed"] == rep["n_requests"] and rep["failed"] == 0
+    assert rep["ttft_p99_ms"] is not None
+    assert rep["speedup"] >= 1.5, rep
+
+    # in-process: the SLO families flow through /metrics (the earlier
+    # gateway tests produced traffic in this registry)
+    model, net = tiny
+    gw = ServingGateway(model, net, max_slots=2, block=8,
+                        max_context=32, default_max_new=4)
+    gw.warmup(prompt_lens=(4,))
+    stats = loadgen.run_trace(
+        gw, loadgen.gen_requests(n_requests=4, max_new=4,
+                                 prompt_lens=(2, 8), vocab_size=64),
+        mode="open", rate=200.0)
+    gw.shutdown()
+    assert stats["completed"] == 4
+    fams = metrics.parse_exposition(metrics.exposition())
+    names = {n for n, _ in fams}
+    assert "dl4j_tpu_serving_ttft_seconds_count" in names
+    assert "dl4j_tpu_serving_tokens_total" in names
+    assert "dl4j_tpu_serving_kv_pages_free" in names
+    assert "dl4j_tpu_serving_step_seconds_count" in names

@@ -194,6 +194,62 @@ def _serving_scenario(plan_name: str) -> dict:
             "wall_s": round(wall, 2), "ok": bool(ok)}
 
 
+def _gateway_scenario(plan_name: str) -> dict:
+    """Continuous-batching gateway under an injected serving fault
+    (ISSUE 13 satellite): the fault takes one decode iteration
+    mid-trace — every in-flight sequence must shed with a structured
+    ``SequenceAborted`` (tokens-so-far attached) or complete, the
+    paged pool must come back whole (no leaked page, invariants
+    clean), and the SAME worker must serve a post-fault wave — never
+    a wedged slot."""
+    from deeplearning4j_tpu.obs import metrics
+    from deeplearning4j_tpu.resilience import faults
+    from deeplearning4j_tpu.serving import SequenceAborted, ServingGateway
+    from deeplearning4j_tpu.zoo import GPTNano
+
+    model = GPTNano(vocab_size=64, max_len=64, seed=7)
+    net = model.init()
+    gw = ServingGateway(model, net, max_slots=4, block=8,
+                        max_context=64, queue_limit=32,
+                        default_max_new=24)
+    gw.warmup(prompt_lens=(6,))
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(0, 64, (8, 6)).astype(np.int32)
+    completed, aborted, tokens_salvaged = 0, 0, 0
+    t0 = time.perf_counter()
+    with faults.active(plan_name):
+        wave = [gw.submit(p) for p in prompts]
+        for ob in wave:
+            try:
+                ob.result(timeout=60)
+                completed += 1
+            except SequenceAborted as e:
+                aborted += 1
+                tokens_salvaged += len(e.tokens)
+        fired = sum(s["fires"] for s in faults.stats().values())
+    # the worker survived: a post-fault wave round-trips on the same
+    # gateway, and the pool is conserved
+    post = [gw.submit(p, max_new=8) for p in prompts[:3]]
+    post_ok = sum(ob.result(timeout=60).shape == (14,) for ob in post)
+    gw._sched.pager.check_invariants()
+    pages_whole = (gw._sched.pager.free_pages()
+                   == gw._sched.pager.n_pages - 1)
+    shed_fault = metrics.SERVING_SHED.labels(reason="fault").get()
+    gw.shutdown()
+    wall = time.perf_counter() - t0
+    ok = (fired > 0 and aborted > 0 and completed + aborted == 8
+          and tokens_salvaged > 0 and post_ok == 3 and pages_whole
+          and wall < 60.0)
+    return {"mode": "serving-gateway", "plan": plan_name,
+            "requests": 8, "completed": completed, "aborted": aborted,
+            "tokens_salvaged": tokens_salvaged,
+            "post_fault_completed": post_ok,
+            "pages_conserved": pages_whole,
+            "shed_fault_metric": shed_fault, "faults_fired": fired,
+            "worker_survived": True,
+            "wall_s": round(wall, 2), "ok": bool(ok)}
+
+
 # ---------------------------------------------------------------------------
 # elastic multi-host drill (resilience/elastic.py on tests/mp_harness.py)
 # ---------------------------------------------------------------------------
@@ -628,7 +684,12 @@ def main() -> int:
             results.append(
                 _example_scenario(args.example, spec, args.restarts))
         elif any(r.site.startswith("serving") for r in parsed.rules):
+            # serving plans drill BOTH front ends: the batched
+            # ParallelInference queue and the continuous-batching
+            # gateway (each parses the plan fresh -> independent rule
+            # state, the nth/max counters start over)
             results.append(_serving_scenario(plan))
+            results.append(_gateway_scenario(plan))
         elif any(r.site.startswith(("host_death", "coordinator"))
                  for r in parsed.rules):
             results.append(_elastic_preempt_scenario(

@@ -329,6 +329,75 @@ exposition carries the bumped `mesh_epoch=` labels.
 """
 
 
+# hand-maintained operations doc, re-emitted on every regeneration
+# (ISSUE 13 satellite: the serving-under-load runbook lives in
+# docs/OPS.md next to the failure & recovery workflow)
+SERVING_OPS_SECTION = """
+## Serving under load (serving/)
+
+Operating the continuous-batching gateway (ARCHITECTURE.md §15):
+
+**Bring-up.** Build the gateway over a trained LM and warm it BEFORE
+taking traffic:
+
+    gw = ServingGateway(model, net, max_slots=16, block=16,
+                        max_context=2048, queue_limit=256)
+    gw.warmup()          # decode step + every prefill bucket, AOT
+
+After `warmup()` the retrace sentry must stay flat no matter how
+traffic arrives — shapes are fixed at `(max_slots, block)` and
+prompts snap to the same power-of-two buckets `generate()` uses
+(`zoo.gpt.prompt_bucket`, one shared table). A climbing
+`dl4j_tpu_retrace_unplanned_shapes{function="serving.decode_step"}`
+means someone changed the step signature without re-warming.
+
+**Size the pool.** The paged KV cache is the admission currency: each
+request reserves `ceil(max(prompt_bucket, prompt+max_new-1)/block)`
+pages for its WHOLE life, so an admitted sequence never stalls
+mid-flight. Watch `dl4j_tpu_serving_kv_pages_free` against
+`dl4j_tpu_serving_queue_depth`: pages pinned at 0 with a standing
+queue means the pool (`n_pages`) is the bottleneck, not the slots.
+Pool bytes = `n_pages x n_layers x Hkv x 2D x block` (x1 int8, x4
+f32) — int8 pages (`cache_quant="int8"`) halve the read traffic AND
+double the sequences a pool holds.
+
+**Watch the SLOs.** `dl4j_tpu_serving_ttft_seconds` (submit -> first
+token: queue wait + prefill) is the admission-health histogram —
+a fattening p99 with free pages means slot pressure; with
+`dl4j_tpu_serving_kv_pages_free` at 0 it means pool pressure.
+`dl4j_tpu_serving_step_seconds` IS the per-token latency every
+in-flight sequence pays per iteration. Shed posture mirrors
+ParallelInference:
+`dl4j_tpu_serving_requests_shed_total{reason=queue_full|deadline|shutdown|fault}`
+— alert on its rate vs `dl4j_tpu_serving_requests_total`.
+`tools/tpu_watch.py --metrics-url ...` renders a `serving` view per
+sample (occupancy, TTFT p50/p99, token-throughput sparkline, SHED
+alarms).
+
+**Load-test.** The standing trace driver:
+
+    python tools/serving_trace.py --mode open --rate 200 --requests 256
+    python tools/serving_trace.py --mode closed --clients 32 --baseline
+
+(open loop = arrivals you don't control, overload shows up as shed
+rate + TTFT tail; closed loop = sustainable throughput at fixed
+concurrency; `--baseline` adds the request-at-a-time `generate()`
+comparison). `bench.py`'s `serving` section and the dossier's
+`continuous_batching` row run the same driver's smoke config.
+
+**Fault posture.** An exception inside a decode iteration (including
+the `serving` fault site under `DL4J_TPU_FAULT_PLAN`) sheds every
+in-flight sequence with a structured `SequenceAborted` carrying the
+tokens already streamed, releases their pages, and keeps serving —
+never a wedged slot or leaked page. Drill it:
+
+    python tools/chaos.py --plan serving-crash
+
+asserts both front ends (batched queue + gateway) shed-and-survive,
+with page conservation checked.
+"""
+
+
 def main():
     import warnings
     warnings.filterwarnings("ignore")
@@ -481,7 +550,8 @@ def main():
                  "", RESILIENCE_OPS_SECTION.strip(),
                  "", NUMERICS_OPS_SECTION.strip(),
                  "", ELASTIC_OPS_SECTION.strip(),
-                 "", FLEET_OPS_SECTION.strip()]
+                 "", FLEET_OPS_SECTION.strip(),
+                 "", SERVING_OPS_SECTION.strip()]
     ops_out = os.path.join(os.path.dirname(out), "OPS.md")
     with open(ops_out, "w") as f:
         f.write("\n".join(op_lines) + "\n")

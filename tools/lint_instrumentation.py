@@ -1,7 +1,7 @@
 """Instrumentation lint — the telemetry spine's CI fence (tier-1 via
 ``tests/test_lint_instrumentation.py``).
 
-Six AST rules over ``deeplearning4j_tpu/``:
+Seven AST rules over ``deeplearning4j_tpu/``:
 
 1. **Every ``sentry.jit``-wrapped hot path emits obs telemetry.** A
    module that builds jitted entry points with ``sentry.jit(...)`` is
@@ -69,6 +69,16 @@ Six AST rules over ``deeplearning4j_tpu/``:
    matching at least one family) — a dashboard or runbook can't watch
    a family the code stopped (or never started) emitting.
 
+7. **Every jitted entry point in ``serving/`` is sentried and has a
+   warmup feed.** The serving gateway's whole contract is zero
+   retraces after ``warmup()`` — a raw ``jax.jit`` there bypasses the
+   retrace sentry's accounting, and a sentried entry point outside a
+   ``_build_*`` builder (or a builder without a ``WARMUP_FEEDS``
+   entry) is a compile the warmup can never reach: the first live
+   request pays it mid-traffic. Same shape as rule 4 (the
+   ``ParallelWrapper`` feed-table rule): builders ⊆ feeds ⊆ builders,
+   and ``warmup`` must actually read the table.
+
 Exit status 0 = clean; 1 = violations (printed one per line).
 """
 from __future__ import annotations
@@ -111,6 +121,10 @@ FAULTS_PATH = "resilience/faults.py"
 
 # rule 6 source of truth: the metric-family registry table
 METRICS_PATH = "obs/metrics.py"
+
+# rule 7 target: the serving gateway package whose jitted entry
+# points must all be sentried, builder-scoped, and warmup-fed
+SERVING_DIR = "serving"
 
 # rule 6: non-family dl4j_tpu_* tokens that legitimately appear in the
 # watched docs/tools (file-name stems, not metric families) — keep
@@ -480,6 +494,103 @@ def _lint_metric_families(package_dir: Path,
     return problems
 
 
+def _sentry_jit_calls(tree: ast.AST):
+    for c in _calls(tree):
+        ch = _attr_chain(c.func)
+        if ch == "sentry.jit" or ch.endswith(".sentry.jit"):
+            yield c
+
+
+def _lint_serving_jits(package_dir: Path) -> List[str]:
+    """Rule 7: in ``serving/``, (a) no raw ``jax.jit`` (the sentry
+    must see every serving entry point), (b) every ``sentry.jit`` call
+    lives inside a ``_build_*`` builder, (c) builders and the
+    module-level ``WARMUP_FEEDS`` table match both ways, and (d) a
+    ``warmup`` function reads the table."""
+    serving = package_dir / SERVING_DIR
+    if not serving.is_dir():
+        return []
+    problems: List[str] = []
+    for path in sorted(serving.glob("*.py")):
+        rel = f"{SERVING_DIR}/{path.name}"
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue                # rule-agnostic: lint_file reports it
+        for c in _calls(tree):
+            ch = _attr_chain(c.func)
+            if ch == "jax.jit" or ch.endswith(".jax.jit"):
+                problems.append(
+                    f"{rel}:{c.lineno}: raw jax.jit in serving/ — "
+                    "every serving entry point must go through "
+                    "sentry.jit (retrace accounting + AOT warmup); a "
+                    "bare jit here is invisible to the zero-retrace "
+                    "fence")
+        jit_calls = list(_sentry_jit_calls(tree))
+        if not jit_calls:
+            continue
+        # innermost enclosing FunctionDef per sentry.jit call
+        builders = set()
+        covered = set()
+        warmup_reads_table = False
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            inside = [c for c in jit_calls
+                      if any(c is sub for sub in ast.walk(node))]
+            if node.name == "warmup":
+                warmup_reads_table = warmup_reads_table or any(
+                    isinstance(n, ast.Name) and n.id == "WARMUP_FEEDS"
+                    for n in ast.walk(node))
+            if not inside:
+                continue
+            # walking outer defs first would mark calls covered by a
+            # non-builder wrapper; only _build_* functions count
+            if node.name.startswith("_build_"):
+                builders.add(node.name)
+                covered.update(id(c) for c in inside)
+        for c in jit_calls:
+            if id(c) not in covered:
+                problems.append(
+                    f"{rel}:{c.lineno}: sentry.jit outside a "
+                    "_build_* builder — the WARMUP_FEEDS table can't "
+                    "govern it, so warmup() can never AOT-compile "
+                    "this entry point and the first live request "
+                    "cold-traces")
+        feeds = None
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "WARMUP_FEEDS"
+                    for t in node.targets):
+                if isinstance(node.value, ast.Dict):
+                    feeds = {k.value for k in node.value.keys
+                             if isinstance(k, ast.Constant)
+                             and isinstance(k.value, str)}
+        if not builders:
+            continue
+        if feeds is None:
+            problems.append(
+                f"{rel}: builds sentried serving entry points but has "
+                "no WARMUP_FEEDS dict literal — nothing declares the "
+                "warmup feeds and the first live request cold-traces")
+            continue
+        for b in sorted(builders - feeds):
+            problems.append(
+                f"{rel}: serving builder {b} has no WARMUP_FEEDS "
+                "entry — its entry point cannot be AOT-warmed and the "
+                "first live request stalls on a cold trace")
+        for b in sorted(feeds - builders):
+            problems.append(
+                f"{rel}: WARMUP_FEEDS entry {b!r} names no _build_* "
+                "builder — stale feed (renamed/removed entry point?)")
+        if not warmup_reads_table:
+            problems.append(
+                f"{rel}: no warmup() reads WARMUP_FEEDS — the feed "
+                "table is dead and serving entry points cold-trace")
+    return problems
+
+
 def run(package_dir: Path = PACKAGE,
         tests_dir: Optional[Path] = None,
         tools_dir: Optional[Path] = None,
@@ -498,6 +609,7 @@ def run(package_dir: Path = PACKAGE,
     problems.extend(_lint_fault_sites(package_dir, tests_dir))
     problems.extend(_lint_metric_families(package_dir, tools_dir,
                                           docs_dir))
+    problems.extend(_lint_serving_jits(package_dir))
     return problems
 
 

@@ -705,6 +705,13 @@ def main(names):
     from deeplearning4j_tpu.parallel import zero
     payload.append({"config": "zero_dp_sharded_update",
                     **zero.subprocess_report(), "smoke": SMOKE})
+    # continuous-batching serving gateway (serving/): tokens/sec and
+    # p99 TTFT under the synthetic multi-tenant trace, continuous vs
+    # request-at-a-time baseline, zero-retrace proof. Forced-CPU
+    # subprocess (the smoke row is a one-device measurement).
+    from deeplearning4j_tpu.serving import loadgen
+    payload.append({"config": "continuous_batching",
+                    **loadgen.subprocess_report(), "smoke": SMOKE})
     if out_path:
         Path(out_path).write_text(json.dumps(payload, indent=1))
     if SMOKE:

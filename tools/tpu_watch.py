@@ -95,7 +95,7 @@ _METRIC_KEYS = ("dl4j_tpu_step_latency_seconds_count",
                 "dl4j_tpu_retrace_", "dl4j_tpu_compile_",
                 "dl4j_tpu_worker_stale",
                 "dl4j_tpu_inference_requests_total",
-                "dl4j_tpu_numerics_")
+                "dl4j_tpu_numerics_", "dl4j_tpu_serving_")
 
 # numerics view state: total-grad-norm history across samples feeds the
 # sparkline (bounded — one char per retained sample)
@@ -147,6 +147,65 @@ def _numerics_view(fams) -> dict:
               if v > 0}
     if alarms:
         view["NONFINITE_ALARM"] = alarms
+    return view
+
+
+# serving view state: tokens_total across samples feeds a throughput
+# sparkline (deltas between scrapes)
+_TOKENS_HISTORY: list = []
+_LAST_TOKENS: list = [None]
+
+
+def _hist_quantile(fams, name, q):
+    """Quantile estimate from one scrape's cumulative histogram
+    buckets (upper-bound of the first bucket whose cumulative count
+    reaches the quantile)."""
+    buckets = sorted(
+        ((float("inf") if dict(labels)["le"] == "+Inf"
+          else float(dict(labels)["le"])), v)
+        for (n, labels), v in fams.items()
+        if n == name + "_bucket")
+    total = fams.get((name + "_count", ()), 0)
+    if not buckets or not total:
+        return None
+    target = q * total
+    for le, cum in buckets:
+        if cum >= target:
+            return None if le == float("inf") else le
+    return None
+
+
+def _serving_view(fams) -> dict:
+    """Render the continuous-batching gateway families from one
+    /metrics scrape: occupancy (slots/queue/pages), TTFT p50/p99 from
+    the histogram, shed totals by reason, and a token-throughput
+    sparkline across samples."""
+    def val(name, default=None):
+        return fams.get((name, ()), default)
+
+    tokens = val("dl4j_tpu_serving_tokens_total")
+    if tokens is None:
+        return {}
+    view = {
+        "active_slots": val("dl4j_tpu_serving_active_slots"),
+        "queue_depth": val("dl4j_tpu_serving_queue_depth"),
+        "kv_pages_free": val("dl4j_tpu_serving_kv_pages_free"),
+        "tokens_total": int(tokens),
+    }
+    if _LAST_TOKENS[0] is not None:
+        _TOKENS_HISTORY.append(max(0.0, tokens - _LAST_TOKENS[0]))
+        del _TOKENS_HISTORY[:-64]
+        view["tokens_sparkline"] = _sparkline(_TOKENS_HISTORY)
+    _LAST_TOKENS[0] = tokens
+    for q, key in ((0.5, "ttft_p50_s"), (0.99, "ttft_p99_s")):
+        est = _hist_quantile(fams, "dl4j_tpu_serving_ttft_seconds", q)
+        if est is not None:
+            view[key] = est
+    shed = {dict(labels).get("reason", ""): int(v)
+            for (n, labels), v in fams.items()
+            if n == "dl4j_tpu_serving_requests_shed_total" and v > 0}
+    if shed:
+        view["SHED"] = shed
     return view
 
 
@@ -215,6 +274,9 @@ def _scrape_telemetry(metrics_url, healthz_url, trace_jsonl,
             view = _numerics_view(fams)
             if view:
                 _log(event="numerics", url=metrics_url, **view)
+            sview = _serving_view(fams)
+            if sview:
+                _log(event="serving", url=metrics_url, **sview)
         except Exception as e:
             _log(event="metrics", url=metrics_url, error=repr(e))
     if healthz_url:

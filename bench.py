@@ -216,6 +216,12 @@ def bench_body():
     fleet_rec = obs.fleet.measure_publish_overhead(
         step_seconds=batch / images_per_sec)
 
+    # device-time observatory (obs/devtime.py): the fit-loop hook's
+    # off-path cost against this run's real step (DL4J_TPU_DEVTIME
+    # unset must be one branch — the PR 2 bar), plus capture counters
+    devtime_rec = obs.devtime.measure_capture_overhead(
+        step_seconds=batch / images_per_sec)
+
     print(json.dumps({
         "metric": METRIC,
         "value": round(images_per_sec, 1),
@@ -232,6 +238,7 @@ def bench_body():
         "obs": obs_rec,
         "numerics": numerics_rec,
         "fleet_obs": fleet_rec,
+        "devtime": devtime_rec,
     }), flush=True)
 
 

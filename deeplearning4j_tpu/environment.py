@@ -126,6 +126,25 @@ _register("DL4J_TPU_ELASTIC_PORT_BASE", 31300, int,
           "mesh epoch g binds base+(g mod 1000) so a stale generation "
           "can never capture the new generation's workers")
 
+# -- device-time observatory (obs/devtime.py) ------------------------------
+_register("DL4J_TPU_DEVTIME", "", str,
+          "device-time observatory (obs/devtime.py): '' off (the fit "
+          "loops pay one branch); truthy installs the cadence monitor "
+          "— every DL4J_TPU_DEVTIME_EVERY-th step opens a short "
+          "jax.profiler.trace window, attributes device time to the "
+          "named_scope'd layers, and publishes dl4j_tpu_devtime_* "
+          "gauges + the hot-path gap report")
+_register("DL4J_TPU_DEVTIME_EVERY", 100, int,
+          "capture-window cadence in fit iterations (the capture "
+          "costs ~a profiler session + an xplane parse — keep sparse)")
+_register("DL4J_TPU_DEVTIME_STEPS", 3, int,
+          "fit steps each capture window stays open for")
+_register("DL4J_TPU_PEAK_TFLOPS", 197.0, float,
+          "roofline compute peak in TFLOP/s (default: v5e bf16 MXU) — "
+          "the denominator of devtime's per-scope utilization")
+_register("DL4J_TPU_PEAK_HBM_GBS", 819.0, float,
+          "roofline memory peak in GB/s (default: v5e HBM)")
+
 # -- fleet observability plane (obs/fleet.py) ------------------------------
 _register("DL4J_TPU_FLEET_PUBLISH_SECS", 1.0, float,
           "telemetry-snapshot publish cadence: each elastic host "
@@ -182,6 +201,13 @@ def apply_startup_flags() -> None:
     if get_flag("DL4J_TPU_METRICS_PORT"):
         from deeplearning4j_tpu.obs import metrics as obs_metrics
         obs_metrics.start_server()
+    # device-time observatory: the raw-env gate skips INSTALLING the
+    # cadence monitor (the module itself rides the obs package
+    # import) — unset leaves the fit-loop hooks on the one-branch
+    # monitor-is-None path
+    if os.environ.get("DL4J_TPU_DEVTIME", "").strip():
+        from deeplearning4j_tpu.obs import devtime as obs_devtime
+        obs_devtime.configure_from_env()
     # fault injection: gate on the raw env so the unset path never
     # imports the resilience package at startup
     if os.environ.get("DL4J_TPU_FAULT_PLAN", "").strip():

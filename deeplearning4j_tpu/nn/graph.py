@@ -270,11 +270,16 @@ class ComputationGraph:
             xs = [acts[i] for i in node.inputs]
             m = next((masks.get(i) for i in node.inputs
                       if masks.get(i) is not None), None)
+            # device-time attribution (obs/devtime.py): trace-time HLO
+            # metadata only — the compiled program is byte-identical
+            nscope = obs.devtime.scope(
+                f"{node.name}.{type(node.obj).__name__}")
             if node.kind == "vertex":
-                if node.obj.needs_mask:
-                    acts[node.name] = node.obj.apply(xs, mask=m)
-                else:
-                    acts[node.name] = node.obj.apply(xs)
+                with nscope:
+                    if node.obj.needs_mask:
+                        acts[node.name] = node.obj.apply(xs, mask=m)
+                    else:
+                        acts[node.name] = node.obj.apply(xs)
                 masks[node.name] = node.obj.propagate_mask(m)
                 continue
             layer = node.obj
@@ -284,21 +289,26 @@ class ComputationGraph:
                 sub = None
             if (pre_output and node.name in out_set
                     and isinstance(layer, OutputLayer)):
-                x = xs[0]
-                if x.ndim > 2 and not hasattr(layer, "loss_rnn"):
-                    x = x.reshape(x.shape[0], -1) if x.ndim == 2 else x
-                z = x @ params[node.name]["W"]
-                if layer.has_bias:
-                    z = z + params[node.name]["b"]
+                with nscope:
+                    x = xs[0]
+                    if x.ndim > 2 and not hasattr(layer, "loss_rnn"):
+                        # flatten to [B, features] like the
+                        # MultiLayerNetwork fused path (the old inner
+                        # `if x.ndim == 2` made this a dead no-op)
+                        x = x.reshape(x.shape[0], -1)
+                    z = x @ params[node.name]["W"]
+                    if layer.has_bias:
+                        z = z + params[node.name]["b"]
                 acts[node.name] = z
                 new_state[node.name] = state.get(node.name, {})
                 masks[node.name] = m
                 if stats_out is not None:
                     stats_out[node.name] = obs.numerics.act_summary(z)
                 continue
-            y, s = layer.apply(params.get(node.name, {}),
-                               state.get(node.name, {}), xs[0],
-                               train=train, rng=sub, mask=m)
+            with nscope:
+                y, s = layer.apply(params.get(node.name, {}),
+                                   state.get(node.name, {}), xs[0],
+                                   train=train, rng=sub, mask=m)
             acts[node.name] = y
             new_state[node.name] = (state.get(node.name, {})
                                     if isinstance(layer,
@@ -377,10 +387,12 @@ class ComputationGraph:
             kw = {"from_logits": True} if fused else {}
             lm = lmasks.get(name) if lmasks else None
             logits = acts[name]
-            if cd is not None and losses_mod.wants_f32_logits(fn,
-                                                              fused):
-                logits = logits.astype(jnp.float32)
-            total = total + fn(y, logits, mask=lm, **kw)
+            # devtime scope: names each output's loss device share
+            with obs.devtime.scope(f"loss.{loss_name}"):
+                if cd is not None and losses_mod.wants_f32_logits(
+                        fn, fused):
+                    logits = logits.astype(jnp.float32)
+                total = total + fn(y, logits, mask=lm, **kw)
         return total, new_state
 
     # ------------------------------------------------------------------
@@ -391,10 +403,14 @@ class ComputationGraph:
         (loss, new_state), grads = jax.value_and_grad(
             self._loss_fn, has_aux=True)(params, state, inputs,
                                          labels, masks, lmasks, rng)
-        updates, opt_state = self._optimizer.update(grads, opt_state,
-                                                    params)
-        params = optax.apply_updates(params, updates)
-        params = self._apply_constraints(params)
+        # devtime scope: names the optimizer's device share next to
+        # the per-node forward/backward scopes
+        with obs.devtime.scope("optimizer.update"):
+            updates, opt_state = self._optimizer.update(grads,
+                                                        opt_state,
+                                                        params)
+            params = optax.apply_updates(params, updates)
+            params = self._apply_constraints(params)
         return params, opt_state, new_state, loss
 
     def _make_train_step(self):
@@ -695,6 +711,9 @@ class ComputationGraph:
         if nm is not None and nm.due(self.iteration):
             return self._fit_batch_diag(inputs, labels, masks, lmasks,
                                         t0)
+        # devtime capture window (obs/devtime.py): off path is one
+        # module-global branch inside the hook
+        obs.devtime.step_started(self.iteration)
         rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed),
                                  self.iteration)
         t1 = obs.now()
@@ -703,6 +722,7 @@ class ComputationGraph:
                                 inputs, labels, masks, lmasks, rng)
         t2 = obs.now()
         self.score_ = float(loss)     # blocking device sync
+        obs.devtime.step_ended(self._train_step_fn)
         obs.record_step("ComputationGraph.fit", t0, t1, t2, obs.now())
         self.iteration += 1
         if nm is not None:

@@ -187,22 +187,32 @@ class MultiLayerNetwork:
             kwargs = {}
             if isinstance(layer, BaseRecurrentLayer) and rnn_init:
                 kwargs["initial_state"] = rnn_init.get(name)
+            # device-time attribution (obs/devtime.py): the scope is
+            # trace-time HLO metadata only — the compiled step is
+            # byte-identical; jax carries it into the backward ops as
+            # transpose(jvp(<scope>)), so gradients attribute too
+            lscope = obs.devtime.scope(f"{name}.{type(layer).__name__}")
             if (pre_output_last and i == n - 1
                     and isinstance(layer, (OutputLayer,))):
                 # pre-activation logits for fused loss
-                z = x.reshape(x.shape[0], -1) if (
-                    not isinstance(layer, RnnOutputLayer) and x.ndim > 2
-                ) else x
-                z = z @ params[name]["W"]
-                if layer.has_bias:
-                    z = z + params[name]["b"]
+                with lscope:
+                    z = x.reshape(x.shape[0], -1) if (
+                        not isinstance(layer, RnnOutputLayer)
+                        and x.ndim > 2
+                    ) else x
+                    z = z @ params[name]["W"]
+                    if layer.has_bias:
+                        z = z + params[name]["b"]
                 x = z
                 new_state[name] = state.get(name, {})
                 if stats_out is not None:
                     stats_out[name] = obs.numerics.act_summary(x)
                 continue
-            x, s = layer.apply(params.get(name, {}), state.get(name, {}),
-                               x, train=train, rng=sub, mask=mask, **kwargs)
+            with lscope:
+                x, s = layer.apply(params.get(name, {}),
+                                   state.get(name, {}),
+                                   x, train=train, rng=sub, mask=mask,
+                                   **kwargs)
             if isinstance(layer, BaseRecurrentLayer):
                 rnn_states[name] = s
                 new_state[name] = state.get(name, {})
@@ -290,12 +300,15 @@ class MultiLayerNetwork:
             params, state, x, train=True, rng=rng, mask=mask,
             pre_output_last=fused, stats_out=act_stats)
         loss_fn = losses_mod.get(loss_name)
-        if cd is not None and losses_mod.wants_f32_logits(loss_fn,
-                                                          fused):
-            out = out.astype(jnp.float32)
-        kw = {"from_logits": True} if fused else {}
-        data_loss = loss_fn(y, out, mask=lmask, **kw)
-        return data_loss + self._reg_score(master), new_state
+        # devtime scope: names the loss+regularization device share
+        with obs.devtime.scope(f"loss.{loss_name}"):
+            if cd is not None and losses_mod.wants_f32_logits(loss_fn,
+                                                              fused):
+                out = out.astype(jnp.float32)
+            kw = {"from_logits": True} if fused else {}
+            data_loss = loss_fn(y, out, mask=lmask, **kw)
+            total = data_loss + self._reg_score(master)
+        return total, new_state
 
     # ------------------------------------------------------------------
     # fit
@@ -306,10 +319,14 @@ class MultiLayerNetwork:
         (loss, new_state), grads = jax.value_and_grad(
             self._loss_fn, has_aux=True)(
                 params, state, x, y, mask, lmask, rng)
-        updates, opt_state = self._optimizer.update(grads, opt_state,
-                                                    params)
-        params = optax.apply_updates(params, updates)
-        params = self._apply_constraints(params)
+        # devtime scope: names the optimizer's device share next to
+        # the per-layer forward/backward scopes
+        with obs.devtime.scope("optimizer.update"):
+            updates, opt_state = self._optimizer.update(grads,
+                                                        opt_state,
+                                                        params)
+            params = optax.apply_updates(params, updates)
+            params = self._apply_constraints(params)
         return params, opt_state, new_state, loss
 
     def _make_train_step(self):
@@ -460,6 +477,7 @@ class MultiLayerNetwork:
         self._refresh_ambient_trace()
         if self._train_loop_fn is None:
             self._train_loop_fn = self._make_train_loop()
+        obs.devtime.step_started(self.iteration)
         xs = jnp.stack([jnp.asarray(np.asarray(x)) for x, _ in group])
         ys = jnp.stack([jnp.asarray(np.asarray(y)) for _, y in group])
         base = jax.random.PRNGKey(self.conf.seed)
@@ -484,6 +502,7 @@ class MultiLayerNetwork:
         t2 = obs.now()
         losses = np.asarray(losses)   # blocking device sync
         t3 = obs.now()
+        obs.devtime.step_ended(self._train_loop_fn)
         obs.record_step("MultiLayerNetwork.fit", t0, t1, t2, t3,
                         args={"steps": len(group)})
         tl0 = obs.now()
@@ -582,6 +601,9 @@ class MultiLayerNetwork:
             return self._fit_batch_diag(x, y, fmask, lmask, t0)
         if self._train_step_fn is None:
             self._train_step_fn = self._make_train_step()
+        # devtime capture window (obs/devtime.py): off path is one
+        # module-global branch inside the hook
+        obs.devtime.step_started(self.iteration)
         rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed),
                                  self.iteration)
         t1 = obs.now()
@@ -591,6 +613,7 @@ class MultiLayerNetwork:
                                     self.state, x, y, fmask, lmask, rng)
             t2 = obs.now()
             self.score_ = float(loss)   # blocking device sync
+            obs.devtime.step_ended(self._train_step_fn)
         except Exception as e:       # HBM OOM → diagnostic dump
             from deeplearning4j_tpu.utils import crashreport
             if crashreport.is_oom(e):

@@ -21,6 +21,11 @@ per-tool private formats) with one layer (ARCHITECTURE.md §9):
   aggregation, collective-skew straggler attribution, and the crash
   flight recorder riding the elastic file plane (ARCHITECTURE.md
   §14).
+- :mod:`~deeplearning4j_tpu.obs.devtime` — per-layer DEVICE-time
+  attribution: short ``jax.profiler.trace`` windows joined with the
+  ``named_scope``-annotated programs' HLO into per-scope device-time
+  totals, roofline utilization, and the Pallas-gap report
+  (ARCHITECTURE.md §16).
 - :func:`report` — the merged JSON snapshot consumed by
   ``StatsListener`` records, ``bench.py``'s ``obs`` section,
   ``tools/perf_dossier.py``, and ``utils/crashreport.py``.
@@ -35,6 +40,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
+from deeplearning4j_tpu.obs import devtime as devtime
 from deeplearning4j_tpu.obs import health as health
 from deeplearning4j_tpu.obs import metrics as metrics
 from deeplearning4j_tpu.obs import numerics as numerics
@@ -149,6 +155,7 @@ def snapshot() -> Dict[str, Any]:
     return metrics.snapshot()
 
 
-__all__ = ["trace", "metrics", "health", "numerics", "fleet", "span",
-           "now", "record_step", "record_etl", "record_worker_step",
-           "summary", "report", "overhead_report", "snapshot"]
+__all__ = ["trace", "metrics", "health", "numerics", "fleet",
+           "devtime", "span", "now", "record_step", "record_etl",
+           "record_worker_step", "summary", "report",
+           "overhead_report", "snapshot"]

@@ -106,6 +106,16 @@ FAMILIES = {
     "dl4j_tpu_serving_active_slots": "gauge",
     "dl4j_tpu_serving_queue_depth": "gauge",
     "dl4j_tpu_serving_kv_pages_free": "gauge",
+    "dl4j_tpu_serving_kv_page_occupancy": "gauge",
+    "dl4j_tpu_serving_kv_pages_reserved": "gauge",
+    # device-time observatory (obs/devtime.py)
+    "dl4j_tpu_devtime_captures_total": "counter",
+    "dl4j_tpu_devtime_capture_seconds_total": "counter",
+    "dl4j_tpu_devtime_scope_seconds": "gauge",
+    "dl4j_tpu_devtime_scope_share": "gauge",
+    "dl4j_tpu_devtime_scope_utilization": "gauge",
+    "dl4j_tpu_devtime_scope_pallas_candidate": "gauge",
+    "dl4j_tpu_devtime_pallas_candidates": "gauge",
     # fleet observability plane (obs/fleet.py)
     "dl4j_tpu_fleet_snapshots_published_total": "counter",
     "dl4j_tpu_flight_recorder_dumps_total": "counter",
@@ -464,6 +474,47 @@ SERVING_QUEUE = REGISTRY.gauge(
 SERVING_PAGES_FREE = REGISTRY.gauge(
     "dl4j_tpu_serving_kv_pages_free",
     "free pages in the paged KV-cache pool")
+SERVING_KV_OCCUPANCY = REGISTRY.gauge(
+    "dl4j_tpu_serving_kv_page_occupancy",
+    "fraction of usable KV pages currently reserved by live "
+    "sequences (1.0 = admission-control full)")
+SERVING_KV_RESERVED = REGISTRY.gauge(
+    "dl4j_tpu_serving_kv_pages_reserved",
+    "KV pages reserved per tenant (whole-life reservations, the "
+    "admission-control currency)", ("tenant",))
+
+# device-time observatory (obs/devtime.py): short profiler windows
+# attributed to the named_scope'd layers — the instrument that names
+# the Pallas gaps (ARCHITECTURE.md §16)
+DEVTIME_CAPTURES = REGISTRY.counter(
+    "dl4j_tpu_devtime_captures_total",
+    "completed device-time capture-and-attribute pipelines")
+DEVTIME_CAPTURE_SECONDS = REGISTRY.counter(
+    "dl4j_tpu_devtime_capture_seconds_total",
+    "wall time spent inside capture windows (profiler session + "
+    "xplane parse + attribution) — the capture-cost budget meter")
+DEVTIME_SCOPE_SECONDS = REGISTRY.gauge(
+    "dl4j_tpu_devtime_scope_seconds",
+    "device seconds per scope over the LAST capture window",
+    ("scope",))
+DEVTIME_SCOPE_SHARE = REGISTRY.gauge(
+    "dl4j_tpu_devtime_scope_share",
+    "share of measured device time per scope (last capture)",
+    ("scope",))
+DEVTIME_SCOPE_UTILIZATION = REGISTRY.gauge(
+    "dl4j_tpu_devtime_scope_utilization",
+    "achieved-vs-roofline utilization of the binding resource per "
+    "scope (last capture; DL4J_TPU_PEAK_TFLOPS/_PEAK_HBM_GBS peaks)",
+    ("scope",))
+DEVTIME_SCOPE_CANDIDATE = REGISTRY.gauge(
+    "dl4j_tpu_devtime_scope_pallas_candidate",
+    "1 when the last gap report flagged this scope as a Pallas "
+    "candidate (the AUTHORITATIVE flag — consumers must read it, "
+    "not re-derive the rule)", ("scope",))
+DEVTIME_PALLAS_CANDIDATES = REGISTRY.gauge(
+    "dl4j_tpu_devtime_pallas_candidates",
+    "scopes the last gap report flagged as Pallas-kernel candidates "
+    "(high share, low utilization, not already a custom call)")
 
 # parallel training (parallel/wrapper.py): the optimizer-state HBM
 # footprint the ZeRO sharded update divides by N — layout is

@@ -203,6 +203,28 @@ def counter(name: str, values: Dict[str, Any],
     _emit(ev)
 
 
+def async_span(name: str, aid, t0: float, t1: float,
+               args: Optional[Dict[str, Any]] = None,
+               cat: str = "request") -> None:
+    """Record one phase of an ASYNC track (Chrome nestable async
+    ``b``/``e`` event pair sharing ``id``): request-scoped spans live
+    here because a request's life overlaps other requests on the same
+    worker thread — complete-span (``X``) nesting by interval
+    containment would interleave them into garbage, while async
+    tracks render one lane per ``id``. Same off-path contract as
+    :func:`add_span` (one branch, zero events)."""
+    if not _enabled:
+        return
+    base = {"cat": cat, "id": format(int(aid), "x"),
+            "pid": os.getpid(), "tid": threading.get_ident()}
+    b: Dict[str, Any] = {"ph": "b", "name": name,
+                         "ts": round(t0 * 1e6, 3), **base}
+    if args:
+        b["args"] = args
+    _emit(b)
+    _emit({"ph": "e", "name": name, "ts": round(t1 * 1e6, 3), **base})
+
+
 def instant(name: str, args: Optional[Dict[str, Any]] = None) -> None:
     """Record a point-in-time marker (Chrome ``i`` event)."""
     if not _enabled:

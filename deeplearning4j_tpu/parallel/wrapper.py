@@ -230,12 +230,17 @@ class ParallelWrapper:
         constrain. ``constrain=False`` for flat parameter shards —
         constraints are per-layer reductions and run on the gathered
         full tree instead."""
+        from deeplearning4j_tpu import obs
         net = self.net
-        updates, opt_state = net._optimizer.update(grads, opt_state,
-                                                   params)
-        params = optax.apply_updates(params, updates)
-        if constrain:
-            params = net._apply_constraints(params)
+        # devtime scope: one annotation covers every wrapper variant's
+        # optimizer phase (trace-time HLO metadata only)
+        with obs.devtime.scope("optimizer.update"):
+            updates, opt_state = net._optimizer.update(grads,
+                                                       opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            if constrain:
+                params = net._apply_constraints(params)
         return params, opt_state, updates
 
     # -- ZeRO sharded-update plumbing ------------------------------------

@@ -104,10 +104,16 @@ class FlatShardLayout:
         an exact power-of-two scale)."""
         import jax
 
-        flat = self.flatten(tree)
-        return jax.tree.map(
-            lambda f: psum_scatter(f, axis_name, tiled=True) / self.n,
-            flat)
+        from deeplearning4j_tpu.obs import devtime
+
+        # devtime scope: names the ZeRO reduce-scatter phase's device
+        # time (trace-time HLO metadata only)
+        with devtime.scope("zero.reduce_scatter"):
+            flat = self.flatten(tree)
+            return jax.tree.map(
+                lambda f: psum_scatter(f, axis_name, tiled=True)
+                / self.n,
+                flat)
 
     def gather(self, shard_tree, axis_name: str):
         """All-gather per-replica shards back into the original-shape
@@ -115,9 +121,15 @@ class FlatShardLayout:
         lockstep invariant the param-divergence fence asserts)."""
         import jax
 
-        full = jax.tree.map(
-            lambda s: all_gather(s, axis_name, tiled=True), shard_tree)
-        return self.unflatten(full)
+        from deeplearning4j_tpu.obs import devtime
+
+        # devtime scope: names the ZeRO param all-gather phase — the
+        # overlap target ROADMAP item 3 wants measured
+        with devtime.scope("zero.all_gather"):
+            full = jax.tree.map(
+                lambda s: all_gather(s, axis_name, tiled=True),
+                shard_tree)
+            return self.unflatten(full)
 
     # -- host-side helpers --------------------------------------------------
     def shard_structs(self):

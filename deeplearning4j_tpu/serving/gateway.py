@@ -24,6 +24,7 @@ an injected/real step failure aborts in-flight sequences.
 """
 from __future__ import annotations
 
+import itertools
 import threading
 from collections import deque
 from typing import Dict, Optional
@@ -49,6 +50,11 @@ class SequenceAborted(RuntimeError):
         self.cause = cause
 
 
+#: request ids for the request-scoped trace spans — process-unique,
+#: monotonic, cheap (no uuid allocation on the submit path)
+_RID = itertools.count(1)
+
+
 class TokenStream:
     """One request's streaming observable: tokens arrive as the
     continuous batch produces them; ``result()`` waits for the full
@@ -63,12 +69,37 @@ class TokenStream:
         self.temperature = temperature
         self.eos_id = eos_id
         self.deadline = deadline        # absolute obs.now() time
+        self.rid = next(_RID)
         self.t_submit = obs.now()
+        self.t_admit: Optional[float] = None
         self.t_first: Optional[float] = None
         self._tokens: list = []
         self._done = False
         self._error: Optional[Exception] = None
         self._cond = threading.Condition()
+
+    def _trace_done(self, outcome: str) -> None:
+        """Emit the request's async trace track (submit → admit →
+        prefill → decode-steps → retire/abort) at terminal time — one
+        ``trace.enabled()`` branch on the off path, like PR 2."""
+        if not obs.trace.enabled():
+            return
+        t1 = obs.now()
+        a = {"rid": self.rid, "tenant": self.tenant,
+             "outcome": outcome, "tokens": len(self._tokens)}
+        obs.trace.async_span("serving.request", self.rid,
+                             self.t_submit, t1, a)
+        if self.t_admit is not None:
+            obs.trace.async_span("serving.request/queue_wait",
+                                 self.rid, self.t_submit,
+                                 self.t_admit)
+            if self.t_first is not None:
+                obs.trace.async_span("serving.request/prefill",
+                                     self.rid, self.t_admit,
+                                     self.t_first)
+                obs.trace.async_span("serving.request/decode_steps",
+                                     self.rid, self.t_first, t1,
+                                     {"tokens": len(self._tokens)})
 
     # -- scheduler-facing callbacks (duck-typed request protocol) --------
     def push(self, tok: int) -> None:
@@ -82,15 +113,21 @@ class TokenStream:
 
     def finish(self) -> None:
         with self._cond:
+            if self._done:
+                return
             self._done = True
+            self._trace_done("retired")
             self._cond.notify_all()
 
     def fail(self, e: Exception) -> None:
         with self._cond:
+            if self._done:
+                return
             if isinstance(e, SequenceAborted) and not e.tokens:
                 e.tokens = list(self._tokens)
             self._error = e
             self._done = True
+            self._trace_done(f"aborted:{type(e).__name__}")
             self._cond.notify_all()
 
     # -- client API ------------------------------------------------------
@@ -258,6 +295,11 @@ class ServingGateway:
             self.eos_id,
             deadline=(obs.now() + deadline_s
                       if deadline_s is not None else None))
+        if obs.trace.enabled():     # off path: one branch, zero events
+            obs.trace.instant("serving.request/submit",
+                              {"rid": stream.rid, "tenant": tenant,
+                               "prompt": int(prompt.size),
+                               "max_new": max_new})
         with self._lock:
             # re-check under the lock: shutdown() drains the queues
             # under this same lock, so a submit that raced past the
@@ -440,6 +482,9 @@ class ServingGateway:
             head = self._next_admission()
             if head is None:
                 return admitted
+            # the admit timestamp anchors the request's queue_wait /
+            # prefill trace phases (emitted at terminal time)
+            head.t_admit = obs.now()
             try:
                 if not self._sched.admit(head):
                     # capacity race (cannot happen single-mutator, but

@@ -90,6 +90,14 @@ class KVPager:
         self._free: List[int] = list(range(n_pages - 1, 0, -1))
         self._owner: Dict[int, object] = {}
         self._pages_of: Dict[int, List[int]] = {}
+        # per-tenant reserved-page accounting (owners carry .tenant —
+        # the gateway's TokenStream does); label cardinality capped
+        # like the gateway's request counter: tenant names are
+        # caller-controlled and a gauge child lives forever
+        self._tenant_of: Dict[int, str] = {}
+        self._tenant_pages: Dict[str, int] = {}
+        self._tenant_labels: set = set()
+        self.max_tenant_labels = 64
         self._gauge()
 
     # -- device pool -----------------------------------------------------
@@ -127,6 +135,10 @@ class KVPager:
         for p in pages:
             self._owner[p] = owner
         self._pages_of.setdefault(id(owner), []).extend(pages)
+        tenant = self._tenant_label(owner)
+        self._tenant_of[id(owner)] = tenant
+        self._tenant_pages[tenant] = \
+            self._tenant_pages.get(tenant, 0) + n
         self._gauge()
         return pages
 
@@ -136,14 +148,36 @@ class KVPager:
         for p in pages:
             self._owner.pop(p, None)
             self._free.append(p)
+        tenant = self._tenant_of.pop(id(owner), None)
+        if tenant is not None and pages:
+            self._tenant_pages[tenant] = max(
+                0, self._tenant_pages.get(tenant, 0) - len(pages))
         self._gauge()
         return len(pages)
 
     def owned(self, owner) -> List[int]:
         return list(self._pages_of.get(id(owner), []))
 
+    def reserved_by_tenant(self) -> Dict[str, int]:
+        """Live reserved-page counts per tenant label (the gauge's
+        source — whole-life reservations, not just written pages)."""
+        return {t: n for t, n in self._tenant_pages.items() if n}
+
+    def _tenant_label(self, owner) -> str:
+        tenant = str(getattr(owner, "tenant", "") or "unknown")
+        if tenant in self._tenant_labels or \
+                len(self._tenant_labels) < self.max_tenant_labels:
+            self._tenant_labels.add(tenant)
+            return tenant
+        return "other"
+
     def _gauge(self) -> None:
         _metrics.SERVING_PAGES_FREE.set(len(self._free))
+        usable = self.n_pages - 1
+        _metrics.SERVING_KV_OCCUPANCY.set(
+            (usable - len(self._free)) / usable)
+        for tenant, n in self._tenant_pages.items():
+            _metrics.SERVING_KV_RESERVED.labels(tenant=tenant).set(n)
 
     # -- invariants (tests/test_serving.py churn fence) ------------------
     def check_invariants(self) -> None:

@@ -165,13 +165,18 @@ class DecodeScheduler:
         # the pager's arrays (donation-friendly on accelerators)
         def step(params, pool, page_table, lengths, active, prev,
                  temps, top_p, ctr):
-            x = params["layer_0"]["W"][prev]            # [S, F]
+            # devtime scopes (obs/devtime.py): trace-time HLO metadata
+            # naming each paged block's share of the serving hot path
+            with obs.devtime.scope("paged_decode.embed"):
+                x = params["layer_0"]["W"][prev]        # [S, F]
             for i in range(L):
-                x, pool = self._paged_block_step(
-                    params[f"layer_{i + 1}"], i, x, pool, page_table,
-                    lengths, active)
-            x = _rms(x, params[f"layer_{L + 1}"]["gamma"])
-            logits = model._head_logits(params, x)
+                with obs.devtime.scope(f"paged_decode.block_{i}"):
+                    x, pool = self._paged_block_step(
+                        params[f"layer_{i + 1}"], i, x, pool,
+                        page_table, lengths, active)
+            with obs.devtime.scope("paged_decode.lm_head"):
+                x = _rms(x, params[f"layer_{L + 1}"]["gamma"])
+                logits = model._head_logits(params, x)
             key = jax.random.fold_in(
                 jax.random.PRNGKey(self.seed), ctr)
             nxt = model._pick(

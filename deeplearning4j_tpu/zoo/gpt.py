@@ -475,13 +475,19 @@ class CausalTransformerLM(ZooModel):
             h = jax.nn.silu(h @ pblk["Wg"]) * (h @ pblk["Wu"])
             return x + h @ pblk["Wd"], ckv
 
-        x = params["layer_0"]["W"][tok]             # [rows, F]
+        # devtime scopes (obs/devtime.py): HLO metadata only — the
+        # per-token device time of each decode block gets a name
+        with obs.devtime.scope("decode.embed"):
+            x = params["layer_0"]["W"][tok]         # [rows, F]
         new_caches = []
         for i, ckv in enumerate(caches):
-            x, ckv = block_step(params[f"layer_{i + 1}"], x, ckv)
+            with obs.devtime.scope(f"decode.block_{i}"):
+                x, ckv = block_step(params[f"layer_{i + 1}"], x, ckv)
             new_caches.append(ckv)
-        x = rms(x, params[f"layer_{self.n_layers + 1}"]["gamma"])
-        return self._head_logits(params, x), tuple(new_caches)
+        with obs.devtime.scope("decode.lm_head"):
+            x = rms(x, params[f"layer_{self.n_layers + 1}"]["gamma"])
+            logits = self._head_logits(params, x)
+        return logits, tuple(new_caches)
 
     def _head_logits(self, params, x):
         """LM-head matmul, honoring ``tie_embeddings`` (the tied W is
@@ -512,40 +518,46 @@ class CausalTransformerLM(ZooModel):
         hd = self.hidden // self.n_heads
         n_kv = self.n_kv_heads
         rms = _rms
-        x = params["layer_0"]["W"][toks]            # [B, Tb, F]
+        with obs.devtime.scope("prefill.embed"):
+            x = params["layer_0"]["W"][toks]        # [B, Tb, F]
         caches = []
         for i in range(self.n_layers):
             pblk = params[f"layer_{i + 1}"]
-            h = rms(x, pblk["ln1"]["gamma"])
-            mha = pblk["mha"]
-            q = (h @ mha["Wq"]).reshape(bsz, tb, self.n_heads, hd)
-            k = (h @ mha["Wk"]).reshape(bsz, tb, n_kv, hd)
-            v = (h @ mha["Wv"]).reshape(bsz, tb, n_kv, hd)
-            q = rotary_embedding(q, self.rope_theta)
-            k = rotary_embedding(k, self.rope_theta)
-            a = scaled_dot_attention(q, k, v, causal=True)
-            x = x + a.reshape(bsz, tb, -1) @ mha["Wo"] + mha["bo"]
-            h = rms(x, pblk["ln2"]["gamma"])
-            h = jax.nn.silu(h @ pblk["Wg"]) * (h @ pblk["Wu"])
-            x = x + h @ pblk["Wd"]
-            # cache layout [B, Hkv, 2D, T] (see _token_logits): one
-            # relayout transpose here at prefill, zero padding waste
-            # on every decode step's cache read
-            pad = ((0, 0), (0, 0), (0, 0), (0, cache_len - tb))
-            to_t = lambda z: z.transpose(0, 2, 3, 1)
-            kv_full = jnp.concatenate([to_t(k), to_t(v)], axis=2)
-            if self.cache_quant:
-                w8, s = _quant_kv(
-                    kv_full.reshape(bsz, n_kv, 2, hd, tb), 3)
-                caches.append((
-                    jnp.pad(w8.reshape(bsz, n_kv, 2 * hd, tb), pad),
-                    jnp.pad(s, pad)))
-            else:
-                caches.append(jnp.pad(kv_full, pad))
-        x = rms(x, params[f"layer_{self.n_layers + 1}"]["gamma"])
-        x_last = jax.lax.dynamic_index_in_dim(x, t0 - 1, axis=1,
-                                              keepdims=False)
-        return self._head_logits(params, x_last), tuple(caches)
+            # devtime scope: names each prefill block's device share
+            with obs.devtime.scope(f"prefill.block_{i}"):
+                h = rms(x, pblk["ln1"]["gamma"])
+                mha = pblk["mha"]
+                q = (h @ mha["Wq"]).reshape(bsz, tb, self.n_heads, hd)
+                k = (h @ mha["Wk"]).reshape(bsz, tb, n_kv, hd)
+                v = (h @ mha["Wv"]).reshape(bsz, tb, n_kv, hd)
+                q = rotary_embedding(q, self.rope_theta)
+                k = rotary_embedding(k, self.rope_theta)
+                a = scaled_dot_attention(q, k, v, causal=True)
+                x = x + a.reshape(bsz, tb, -1) @ mha["Wo"] + mha["bo"]
+                h = rms(x, pblk["ln2"]["gamma"])
+                h = jax.nn.silu(h @ pblk["Wg"]) * (h @ pblk["Wu"])
+                x = x + h @ pblk["Wd"]
+                # cache layout [B, Hkv, 2D, T] (see _token_logits):
+                # one relayout transpose here at prefill, zero padding
+                # waste on every decode step's cache read
+                pad = ((0, 0), (0, 0), (0, 0), (0, cache_len - tb))
+                to_t = lambda z: z.transpose(0, 2, 3, 1)
+                kv_full = jnp.concatenate([to_t(k), to_t(v)], axis=2)
+                if self.cache_quant:
+                    w8, s = _quant_kv(
+                        kv_full.reshape(bsz, n_kv, 2, hd, tb), 3)
+                    caches.append((
+                        jnp.pad(w8.reshape(bsz, n_kv, 2 * hd, tb),
+                                pad),
+                        jnp.pad(s, pad)))
+                else:
+                    caches.append(jnp.pad(kv_full, pad))
+        with obs.devtime.scope("prefill.lm_head"):
+            x = rms(x, params[f"layer_{self.n_layers + 1}"]["gamma"])
+            x_last = jax.lax.dynamic_index_in_dim(x, t0 - 1, axis=1,
+                                                  keepdims=False)
+            logits = self._head_logits(params, x_last)
+        return logits, tuple(caches)
 
     def _pick(self, logits, temperature, top_p, key, *, sample, top_k,
               nucleus):

@@ -333,3 +333,85 @@ def test_lint_rule7_missing_feed_table(tmp_path):
         "        return sentry.jit(lambda x: x)\n")
     problems = lint_instrumentation.run(tmp_path)
     assert any("no WARMUP_FEEDS dict literal" in p for p in problems)
+
+
+def test_lint_rule8_missing_scope_annotation(tmp_path):
+    """Rule 8: a SCOPE_SITES function stripped of its devtime.scope /
+    named_scope call fails the lint — attribution would silently lose
+    that path's layers into the op:* bucket."""
+    nn_dir = tmp_path / "nn"
+    nn_dir.mkdir()
+    (nn_dir / "multilayer.py").write_text(
+        "class MultiLayerNetwork:\n"
+        "    def _forward(self, params, x):\n"
+        "        return x\n")
+    problems = lint_instrumentation.run(tmp_path)
+    assert any("multilayer.py" in p and "_forward" in p
+               and "devtime.scope" in p for p in problems), problems
+    # annotated variant passes (either spelling)
+    (nn_dir / "multilayer.py").write_text(
+        "from deeplearning4j_tpu import obs\n"
+        "class MultiLayerNetwork:\n"
+        "    def _forward(self, params, x):\n"
+        "        with obs.devtime.scope('layer_0.Dense'):\n"
+        "            return x\n")
+    assert not lint_instrumentation.run(tmp_path)
+    (nn_dir / "multilayer.py").write_text(
+        "import jax\n"
+        "class MultiLayerNetwork:\n"
+        "    def _forward(self, params, x):\n"
+        "        with jax.named_scope('dl4j.layer_0.Dense'):\n"
+        "            return x\n")
+    assert not lint_instrumentation.run(tmp_path)
+
+
+def test_lint_rule8_renamed_annotation_point(tmp_path):
+    """A SCOPE_SITES entry whose function vanished is reported — the
+    table must follow refactors, not rot."""
+    zoo_dir = tmp_path / "zoo"
+    zoo_dir.mkdir()
+    (zoo_dir / "gpt.py").write_text(
+        "class CausalTransformerLM:\n"
+        "    def _renamed_decode(self):\n"
+        "        pass\n")
+    problems = lint_instrumentation.run(tmp_path)
+    assert any("gpt.py" in p and "_token_logits" in p
+               and "no longer exists" in p for p in problems)
+
+
+def test_lint_rule8_gap_keys_must_resolve(tmp_path):
+    """Every gap.<key> token OPS.md / tpu_watch references must be a
+    GAP_KEYS member; devtime families must exist in FAMILIES."""
+    pkg, tools_dir, docs_dir = _metrics_tree(
+        tmp_path,
+        {"dl4j_tpu_devtime_scope_share": "gauge"},
+        body="REGISTRY.gauge('dl4j_tpu_devtime_scope_share', 'd',"
+             " ('scope',))\n",
+        ops="rank by gap.share, filter gap.pallas_candidate, and "
+            "never gap.bogus_column\n")
+    obs_dir = pkg / "obs"
+    (obs_dir / "devtime.py").write_text(
+        "GAP_KEYS = ('scope', 'share', 'pallas_candidate')\n")
+    problems = lint_instrumentation.run(pkg, tools_dir=tools_dir,
+                                        docs_dir=docs_dir)
+    assert any("gap.bogus_column" in p and "GAP_KEYS" in p
+               for p in problems), problems
+    assert not any("gap.share" in p for p in problems)
+    # deleting the devtime family block is caught
+    (obs_dir / "metrics.py").write_text(
+        "FAMILIES = {'dl4j_tpu_steps_total': 'counter'}\n"
+        "class MetricsRegistry:\n    pass\n"
+        "REGISTRY = MetricsRegistry()\n"
+        "REGISTRY.counter('dl4j_tpu_steps_total', 'd')\n")
+    problems = lint_instrumentation.run(pkg, tools_dir=tools_dir,
+                                        docs_dir=docs_dir)
+    assert any("no dl4j_tpu_devtime_* family" in p for p in problems)
+
+
+def test_lint_rule8_real_package_annotation_points_hold():
+    """The live package: every SCOPE_SITES function exists and is
+    annotated, and the real OPS.md/tpu_watch gap keys resolve."""
+    problems = [p for p in lint_instrumentation.run()
+                if "devtime" in p or "gap." in p
+                or "named_scope" in p]
+    assert not problems, "\n".join(problems)

@@ -581,3 +581,163 @@ def test_continuous_batching_beats_request_at_a_time(tiny):
     assert "dl4j_tpu_serving_tokens_total" in names
     assert "dl4j_tpu_serving_kv_pages_free" in names
     assert "dl4j_tpu_serving_step_seconds_count" in names
+
+
+# =========================================================================
+# request-scoped serving traces (ISSUE 14 satellite): submit → admit →
+# prefill → decode-steps → retire/abort as async tracks keyed by
+# request id, zero events with tracing off
+# =========================================================================
+
+def test_request_traces_off_path_zero_events(tiny):
+    from deeplearning4j_tpu import obs
+
+    model, net = tiny
+    gw = ServingGateway(model, net, max_slots=2, block=8,
+                        max_context=32, default_max_new=4)
+    gw.warmup(prompt_lens=(4,))
+    e0 = obs.trace.events_recorded()
+    gw.submit(np.arange(4, dtype=np.int32) % 64).result(timeout=60)
+    gw.shutdown()
+    assert obs.trace.events_recorded() == e0
+
+
+def test_request_traces_nested_phases_with_ids(tiny, tmp_path):
+    from deeplearning4j_tpu import obs
+
+    model, net = tiny
+    gw = ServingGateway(model, net, max_slots=2, block=8,
+                        max_context=32, default_max_new=4)
+    gw.warmup(prompt_lens=(4,))
+    path = str(tmp_path / "serving_trace.jsonl")
+    obs.trace.enable(path)
+    try:
+        streams = [gw.submit(np.arange(4, dtype=np.int32) % 64,
+                             tenant=f"t{i % 2}") for i in range(3)]
+        for s in streams:
+            s.result(timeout=60)
+    finally:
+        obs.trace.disable()
+    gw.shutdown()
+    evs = obs.trace.read_trace(path)
+    reqs = [e for e in evs
+            if str(e.get("name", "")).startswith("serving.request")]
+    # every phase present, as async b/e pairs sharing the request id
+    by_phase = {}
+    for e in reqs:
+        by_phase.setdefault(e["name"], []).append(e)
+    for phase in ("serving.request", "serving.request/queue_wait",
+                  "serving.request/prefill",
+                  "serving.request/decode_steps"):
+        pair = by_phase[phase]
+        assert {p["ph"] for p in pair} == {"b", "e"}
+        assert len(pair) == 6       # 3 requests x (b, e)
+    assert len(by_phase["serving.request/submit"]) == 3
+    # ids: one async track per request, phases share their request's
+    # id, and args carry rid + tenant + outcome
+    ids = {e["id"] for e in reqs if e.get("ph") in ("b", "e")}
+    assert len(ids) == 3
+    lives = [e for e in by_phase["serving.request"]
+             if e["ph"] == "b"]
+    assert {e["args"]["tenant"] for e in lives} == {"t0", "t1"}
+    assert all(e["args"]["outcome"] == "retired" for e in lives)
+    assert all(e["args"]["tokens"] == 4 for e in lives)
+    # nesting: each request's inner phases sit inside its life span
+    for life in lives:
+        rid = life["id"]
+        end = next(e for e in by_phase["serving.request"]
+                   if e["ph"] == "e" and e["id"] == rid)
+        for phase in ("serving.request/queue_wait",
+                      "serving.request/prefill",
+                      "serving.request/decode_steps"):
+            inner = [e for e in by_phase[phase] if e["id"] == rid]
+            assert inner, (phase, rid)
+            assert all(life["ts"] <= e["ts"] <= end["ts"] + 1e-3
+                       for e in inner)
+
+
+def test_aborted_request_trace_carries_outcome(tiny, tmp_path):
+    from deeplearning4j_tpu import obs
+    from deeplearning4j_tpu.resilience import faults
+
+    model, net = tiny
+    gw = ServingGateway(model, net, max_slots=2, block=8,
+                        max_context=32, default_max_new=8)
+    gw.warmup(prompt_lens=(4,))
+    path = str(tmp_path / "abort_trace.jsonl")
+    obs.trace.enable(path)
+    try:
+        with faults.active("serving:error=RuntimeError:nth=2:max=1"):
+            st = gw.submit(np.arange(4, dtype=np.int32) % 64)
+            with pytest.raises(SequenceAborted):
+                st.result(timeout=60)
+    finally:
+        obs.trace.disable()
+    gw.shutdown()
+    evs = obs.trace.read_trace(path)
+    lives = [e for e in evs if e.get("name") == "serving.request"
+             and e.get("ph") == "b"]
+    assert len(lives) == 1
+    assert lives[0]["args"]["outcome"].startswith("aborted:")
+    assert lives[0]["args"]["tokens"] >= 1   # salvaged tokens counted
+
+
+# =========================================================================
+# KV-pager occupancy observability (ISSUE 14 satellite)
+# =========================================================================
+
+def test_kv_occupancy_and_per_tenant_reserved_gauges(tiny):
+    from deeplearning4j_tpu.obs import metrics
+
+    model, net = tiny
+    sched = DecodeScheduler(model, net, max_slots=2, block=8,
+                            max_context=32)
+    usable = sched.pager.n_pages - 1
+    assert metrics.SERVING_KV_OCCUPANCY.snapshot()[""] == 0.0
+
+    class _T(_Req):
+        def __init__(self, prompt, max_new, tenant):
+            super().__init__(prompt, max_new)
+            self.tenant = tenant
+
+    a = _T(np.arange(4) % 64, 8, "alice")
+    b = _T(np.arange(4) % 64, 8, "bob")
+    assert sched.admit(a) and sched.admit(b)
+    occ = metrics.SERVING_KV_OCCUPANCY.snapshot()[""]
+    used = usable - sched.pager.free_pages()
+    assert occ == pytest.approx(used / usable)
+    reserved = sched.pager.reserved_by_tenant()
+    assert set(reserved) == {"alice", "bob"}
+    assert reserved["alice"] == len(sched.pager.owned(a))
+    fams = metrics.parse_exposition(metrics.exposition())
+    assert fams[("dl4j_tpu_serving_kv_pages_reserved",
+                 (("tenant", "alice"),))] == reserved["alice"]
+    # release returns the gauges to empty
+    sched.evict(a)
+    sched.evict(b)
+    assert metrics.SERVING_KV_OCCUPANCY.snapshot()[""] == 0.0
+    assert sched.pager.reserved_by_tenant() == {}
+    fams = metrics.parse_exposition(metrics.exposition())
+    assert fams[("dl4j_tpu_serving_kv_pages_reserved",
+                 (("tenant", "alice"),))] == 0.0
+
+
+def test_pager_tenant_label_cardinality_capped():
+    pager = KVPager(n_layers=1, n_kv_heads=1, head_dim=4,
+                    n_pages=200, block=8, cache_quant=None)
+    pager.max_tenant_labels = 3
+
+    class _O:
+        def __init__(self, tenant):
+            self.tenant = tenant
+
+    owners = [_O(f"tenant{i}") for i in range(6)]
+    for o in owners:
+        assert pager.alloc(1, o) is not None
+    reserved = pager.reserved_by_tenant()
+    assert set(reserved) == {"tenant0", "tenant1", "tenant2", "other"}
+    assert reserved["other"] == 3
+    for o in owners:
+        pager.release(o)
+    assert pager.reserved_by_tenant() == {}
+    pager.check_invariants()

@@ -201,8 +201,14 @@ def _gateway_scenario(plan_name: str) -> dict:
     ``SequenceAborted`` (tokens-so-far attached) or complete, the
     paged pool must come back whole (no leaked page, invariants
     clean), and the SAME worker must serve a post-fault wave — never
-    a wedged slot."""
-    from deeplearning4j_tpu.obs import metrics
+    a wedged slot. The drill runs under an obs trace so the Chrome
+    JSONL carries the REQUEST-SCOPED spans (submit → admit → prefill
+    → decode-steps → retire/abort, async tracks keyed by request id)
+    — asserted here: every submitted request must leave a terminal
+    ``serving.request`` span, aborts included."""
+    import tempfile
+
+    from deeplearning4j_tpu.obs import metrics, trace as obs_trace
     from deeplearning4j_tpu.resilience import faults
     from deeplearning4j_tpu.serving import SequenceAborted, ServingGateway
     from deeplearning4j_tpu.zoo import GPTNano
@@ -216,30 +222,65 @@ def _gateway_scenario(plan_name: str) -> dict:
     rng = np.random.RandomState(0)
     prompts = rng.randint(0, 64, (8, 6)).astype(np.int32)
     completed, aborted, tokens_salvaged = 0, 0, 0
+    # reuse a live user trace (enable() would close and redirect it);
+    # otherwise trace into a drill-local file and tear down after
+    trace_was_on = obs_trace.enabled() and obs_trace.trace_path()
+    if trace_was_on:
+        trace_path = obs_trace.trace_path()
+        started_trace = False
+    else:
+        trace_path = tempfile.mktemp(prefix="dl4j_gateway_drill_",
+                                     suffix=".jsonl")
+        obs_trace.enable(trace_path)
+        started_trace = True
     t0 = time.perf_counter()
-    with faults.active(plan_name):
-        wave = [gw.submit(p) for p in prompts]
-        for ob in wave:
-            try:
-                ob.result(timeout=60)
-                completed += 1
-            except SequenceAborted as e:
-                aborted += 1
-                tokens_salvaged += len(e.tokens)
-        fired = sum(s["fires"] for s in faults.stats().values())
-    # the worker survived: a post-fault wave round-trips on the same
-    # gateway, and the pool is conserved
-    post = [gw.submit(p, max_new=8) for p in prompts[:3]]
-    post_ok = sum(ob.result(timeout=60).shape == (14,) for ob in post)
+    try:
+        with faults.active(plan_name):
+            wave = [gw.submit(p) for p in prompts]
+            for ob in wave:
+                try:
+                    ob.result(timeout=60)
+                    completed += 1
+                except SequenceAborted as e:
+                    aborted += 1
+                    tokens_salvaged += len(e.tokens)
+            fired = sum(s["fires"] for s in faults.stats().values())
+        # the worker survived: a post-fault wave round-trips on the
+        # same gateway, and the pool is conserved
+        post = [gw.submit(p, max_new=8) for p in prompts[:3]]
+        post_ok = sum(ob.result(timeout=60).shape == (14,)
+                      for ob in post)
+    finally:
+        obs_trace.flush()
+        if started_trace:
+            obs_trace.disable()
     gw._sched.pager.check_invariants()
     pages_whole = (gw._sched.pager.free_pages()
                    == gw._sched.pager.n_pages - 1)
     shed_fault = metrics.SERVING_SHED.labels(reason="fault").get()
     gw.shutdown()
     wall = time.perf_counter() - t0
+    # request-scoped span fence: 11 submits -> 11 terminal request
+    # tracks (retired or aborted), nested decode phases present (>=
+    # when riding a pre-existing user trace with earlier traffic)
+    evs = obs_trace.read_trace(trace_path)
+    req_begins = [e for e in evs if e.get("ph") == "b"
+                  and e.get("name") == "serving.request"]
+    phases = {e.get("name") for e in evs
+              if e.get("ph") in ("b", "i")
+              and str(e.get("name", "")).startswith("serving.request")}
+    outcomes = [e["args"].get("outcome") for e in req_begins
+                if "args" in e]
+    spans_ok = (len(req_begins) >= 11
+                and {"serving.request", "serving.request/submit",
+                     "serving.request/queue_wait",
+                     "serving.request/prefill",
+                     "serving.request/decode_steps"} <= phases
+                and any(o.startswith("aborted") for o in outcomes)
+                and any(o == "retired" for o in outcomes))
     ok = (fired > 0 and aborted > 0 and completed + aborted == 8
           and tokens_salvaged > 0 and post_ok == 3 and pages_whole
-          and wall < 60.0)
+          and spans_ok and wall < 60.0)
     return {"mode": "serving-gateway", "plan": plan_name,
             "requests": 8, "completed": completed, "aborted": aborted,
             "tokens_salvaged": tokens_salvaged,
@@ -247,6 +288,9 @@ def _gateway_scenario(plan_name: str) -> dict:
             "pages_conserved": pages_whole,
             "shed_fault_metric": shed_fault, "faults_fired": fired,
             "worker_survived": True,
+            "request_spans": len(req_begins),
+            "request_span_phases": sorted(phases),
+            "trace_jsonl": trace_path,
             "wall_s": round(wall, 2), "ok": bool(ok)}
 
 

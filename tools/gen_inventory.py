@@ -395,6 +395,71 @@ never a wedged slot or leaked page. Drill it:
 
 asserts both front ends (batched queue + gateway) shed-and-survive,
 with page conservation checked.
+
+**Request-scoped traces.** Under `DL4J_TPU_TRACE` every request
+leaves an async track in the Chrome JSONL keyed by its request id:
+`serving.request` (submit → retire/abort, tenant + outcome + token
+count in the args) with nested `serving.request/queue_wait`,
+`/prefill`, and `/decode_steps` phases — drop the file into Perfetto
+to see exactly where one tenant's p99 went. With tracing off the
+request path emits zero events (one branch, the PR 2 contract).
+
+**KV-page occupancy.** `dl4j_tpu_serving_kv_page_occupancy` (fraction
+of usable pages reserved — 1.0 means admission control is the
+bottleneck, add pages or shed earlier) and
+`dl4j_tpu_serving_kv_pages_reserved` per tenant (whole-life
+reservations — one tenant pinning the pool starves the rest; the
+`tpu_watch` serving view surfaces both next to `kv_pages_free`).
+"""
+
+# hand-maintained operations doc, re-emitted on every regeneration
+# (ISSUE 14 satellite: the Pallas-gap-naming runbook lives in
+# docs/OPS.md next to the other runbooks)
+DEVTIME_OPS_SECTION = """
+## Naming the Pallas gaps (obs/devtime.py)
+
+ARCHITECTURE §4's policy is "Pallas only where XLA has a gap"; the
+device-time observatory (ARCHITECTURE.md §16) is the instrument that
+names the gaps. Host wall-clock spans cannot attribute
+asynchronously-dispatched device time to layers — this pipeline asks
+the device itself.
+
+**On demand.** The perf dossier emits the ranked report on every run:
+
+    python tools/perf_dossier.py --smoke --out dossier.json
+    # -> the "hot_path_gaps" section
+
+Each entry carries `gap.scope` (the `named_scope`-derived layer /
+phase name, or `op:<class>` for unattributed ops), `gap.device_ms` /
+`gap.share` (measured device time and its share of the window),
+`gap.ops` / `gap.fusions` / `gap.backward_ms`, `gap.flops` /
+`gap.bytes` (HLO-derived estimates), `gap.utilization` and
+`gap.bound` (achieved-vs-roofline fraction of the binding resource,
+peaks from `DL4J_TPU_PEAK_TFLOPS` / `DL4J_TPU_PEAK_HBM_GBS`), and
+`gap.pallas_candidate` — true when the scope is ≥5% of the window,
+under 35% of roofline, and not already a custom call. Rank by
+`gap.share`, filter by `gap.pallas_candidate`: that list IS the
+kernel-library backlog, with the evidence attached.
+
+**On cadence.** `DL4J_TPU_DEVTIME=1` installs the fit-loop monitor:
+every `DL4J_TPU_DEVTIME_EVERY`-th iteration opens a
+`jax.profiler.trace` window for `DL4J_TPU_DEVTIME_STEPS` steps,
+attributes it, and publishes `dl4j_tpu_devtime_scope_seconds` /
+`dl4j_tpu_devtime_scope_share` / `dl4j_tpu_devtime_scope_utilization`
+(per scope, last capture), `dl4j_tpu_devtime_pallas_candidates`, and
+the capture-cost meters `dl4j_tpu_devtime_captures_total` /
+`dl4j_tpu_devtime_capture_seconds_total` — budget the cadence with
+the latter: a capture costs a profiler session plus an xplane parse,
+so keep `EVERY` in the hundreds. `tpu_watch --metrics-url` renders
+the ranking as the `devtime` view. Unset, the fit loops pay one
+branch and run zero profiler sessions (counter-fenced).
+
+**Raw captures.** `tools/xprof_summary.py DIR` summarizes the newest
+capture session under DIR, merging every host's `*.xplane.pb`; pass
+an explicit `.xplane.pb` file to read one host. Attribution quality:
+scopes come from the executed programs' HLO metadata — AOT-warm the
+step (`net.warmup(...)`) before capturing, or un-warmed programs fall
+back to `op:<class>` buckets.
 """
 
 
@@ -551,7 +616,8 @@ def main():
                  "", NUMERICS_OPS_SECTION.strip(),
                  "", ELASTIC_OPS_SECTION.strip(),
                  "", FLEET_OPS_SECTION.strip(),
-                 "", SERVING_OPS_SECTION.strip()]
+                 "", SERVING_OPS_SECTION.strip(),
+                 "", DEVTIME_OPS_SECTION.strip()]
     ops_out = os.path.join(os.path.dirname(out), "OPS.md")
     with open(ops_out, "w") as f:
         f.write("\n".join(op_lines) + "\n")

@@ -1,7 +1,7 @@
 """Instrumentation lint — the telemetry spine's CI fence (tier-1 via
 ``tests/test_lint_instrumentation.py``).
 
-Seven AST rules over ``deeplearning4j_tpu/``:
+Eight AST rules over ``deeplearning4j_tpu/``:
 
 1. **Every ``sentry.jit``-wrapped hot path emits obs telemetry.** A
    module that builds jitted entry points with ``sentry.jit(...)`` is
@@ -79,6 +79,22 @@ Seven AST rules over ``deeplearning4j_tpu/``:
    ``ParallelWrapper`` feed-table rule): builders ⊆ feeds ⊆ builders,
    and ``warmup`` must actually read the table.
 
+8. **The device-time observatory's scope contract holds.** Per-layer
+   device-time attribution (``obs/devtime.py``, ARCHITECTURE.md §16)
+   only works while the annotation points stay annotated: the layer
+   loops in ``nn/multilayer.py``/``nn/graph.py`` ``_forward`` (ONE
+   site covers every registered layer type and every zoo model built
+   from them), the hand-rolled zoo transformer's decode/prefill
+   paths, the serving scheduler's paged decode step, and the ZeRO
+   collective phases — each listed function must contain a
+   ``devtime.scope``/``jax.named_scope`` call (:data:`SCOPE_SITES`).
+   The ``dl4j_tpu_devtime_*`` family block must exist in the
+   FAMILIES table (rule 6 already checks kinds — this catches the
+   block being deleted outright), and every ``gap.<key>`` token
+   ``docs/OPS.md``/``tools/tpu_watch.py`` reference must resolve
+   against ``obs/devtime.py``'s ``GAP_KEYS`` tuple, so the runbook
+   and dashboard can't drift from the gap-report schema.
+
 Exit status 0 = clean; 1 = violations (printed one per line).
 """
 from __future__ import annotations
@@ -133,6 +149,22 @@ FAMILY_TOKEN_ALLOWLIST = {
     # the span tracer's default output file, dl4j_tpu_trace_<pid>.jsonl
     "dl4j_tpu_trace_",
 }
+
+# rule 8 annotation points: each listed function must contain a
+# devtime.scope / jax.named_scope call. ONE site in each fit forward
+# covers every registered layer type (and every zoo model built from
+# layers); the remaining entries are the hand-rolled programs the fit
+# forwards never trace.
+SCOPE_SITES = {
+    "nn/multilayer.py": ("_forward",),
+    "nn/graph.py": ("_forward",),
+    "zoo/gpt.py": ("_token_logits", "_prefill_forward"),
+    "serving/scheduler.py": ("_build_step_fn",),
+    "parallel/zero.py": ("scatter_mean", "gather"),
+}
+
+# rule 8 source of truth for gap-report keys
+DEVTIME_PATH = "obs/devtime.py"
 
 
 def _calls(tree: ast.AST):
@@ -591,6 +623,103 @@ def _lint_serving_jits(package_dir: Path) -> List[str]:
     return problems
 
 
+_GAP_TOKEN_RE = None
+
+
+def _parse_gap_keys(devtime_path: Path) -> Optional[set]:
+    """``GAP_KEYS`` tuple literal from ``obs/devtime.py`` — AST only.
+    None when the file/tuple is absent (synthetic trees)."""
+    if not devtime_path.is_file():
+        return None
+    tree = ast.parse(devtime_path.read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "GAP_KEYS"
+                for t in node.targets):
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                return {e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)}
+    return None
+
+
+def _scope_call(chain: str) -> bool:
+    parts = chain.split(".")
+    return (parts[-1] == "scope" and "devtime" in parts) or \
+        parts[-1] == "named_scope"
+
+
+def _lint_devtime_scopes(package_dir: Path,
+                         tools_dir: Optional[Path],
+                         docs_dir: Optional[Path]) -> List[str]:
+    """Rule 8: annotation points annotated, devtime family block
+    present, and consumer ``gap.<key>`` tokens resolve against
+    GAP_KEYS."""
+    global _GAP_TOKEN_RE
+    problems: List[str] = []
+    for rel, fn_names in sorted(SCOPE_SITES.items()):
+        path = package_dir / rel
+        if not path.is_file():
+            continue                # synthetic tree: nothing to hold
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue                # rule-agnostic: lint_file reports it
+        for want in fn_names:
+            found = annotated = False
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) and \
+                        node.name == want:
+                    found = True
+                    if any(_scope_call(_attr_chain(c.func))
+                           for c in _calls(node)):
+                        annotated = True
+            if not found:
+                problems.append(
+                    f"{rel}: SCOPE_SITES names function {want!r} "
+                    "which no longer exists — update the rule-8 "
+                    "table to the renamed annotation point")
+            elif not annotated:
+                problems.append(
+                    f"{rel}: {want}() carries no devtime.scope / "
+                    "jax.named_scope — device-time attribution loses "
+                    "this path's layers (every op lands in the "
+                    "unattributed op:* bucket)")
+    families = _parse_families(package_dir / METRICS_PATH)
+    devtime_keys = _parse_gap_keys(package_dir / DEVTIME_PATH)
+    if (package_dir / DEVTIME_PATH).is_file() and families is not None:
+        if not any(f.startswith("dl4j_tpu_devtime_")
+                   for f in families):
+            problems.append(
+                f"{METRICS_PATH}: no dl4j_tpu_devtime_* family in "
+                "FAMILIES — the device-time observatory has no "
+                "metric surface (the block was deleted?)")
+    if devtime_keys is None:
+        return problems
+    if _GAP_TOKEN_RE is None:
+        import re
+        _GAP_TOKEN_RE = re.compile(r"\bgap\.([a-z_]+)")
+    consumers = []
+    if tools_dir is not None and (Path(tools_dir)
+                                  / "tpu_watch.py").is_file():
+        consumers.append(("tools/tpu_watch.py",
+                          (Path(tools_dir) / "tpu_watch.py")
+                          .read_text()))
+    if docs_dir is not None and (Path(docs_dir) / "OPS.md").is_file():
+        consumers.append(("docs/OPS.md",
+                          (Path(docs_dir) / "OPS.md").read_text()))
+    for label, text in consumers:
+        for token in sorted(set(_GAP_TOKEN_RE.findall(text))):
+            if token not in devtime_keys:
+                problems.append(
+                    f"{label}: references gap-report key "
+                    f"'gap.{token}' which is not in {DEVTIME_PATH} "
+                    "GAP_KEYS — the runbook/dashboard is reading a "
+                    "column the gap report does not emit")
+    return problems
+
+
 def run(package_dir: Path = PACKAGE,
         tests_dir: Optional[Path] = None,
         tools_dir: Optional[Path] = None,
@@ -610,6 +739,8 @@ def run(package_dir: Path = PACKAGE,
     problems.extend(_lint_metric_families(package_dir, tools_dir,
                                           docs_dir))
     problems.extend(_lint_serving_jits(package_dir))
+    problems.extend(_lint_devtime_scopes(package_dir, tools_dir,
+                                         docs_dir))
     return problems
 
 

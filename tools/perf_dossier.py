@@ -603,6 +603,51 @@ def _numerics_section():
                 jax.random.fold_in(jax.random.PRNGKey(0), 0))}
 
 
+def _hot_path_gaps():
+    """Device-time observatory section (obs/devtime.py): warm the
+    LeNet train step (the smoke model the numerics section shares),
+    run a short ``jax.profiler.trace`` window over real fit steps, and
+    emit the gap report — scopes ranked by device-time share with
+    roofline utilization and the ``pallas_candidate`` flag. THE
+    structured evidence ROADMAP item "Pallas only where XLA has a gap"
+    consumes; on ``--smoke`` the utilizations are wiring-validation
+    only (CPU time against TPU peaks, labeled)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.obs import devtime
+    from deeplearning4j_tpu.perf.warmup import WarmupSpec
+    from deeplearning4j_tpu.zoo import LeNet
+
+    b = 8 if SMOKE else 256
+    net = LeNet(num_classes=10, seed=0).init()
+    # AOT-warm so attribution can read the exact executed HLO (the
+    # scope map + cost_analysis source) without recompiling anything
+    net.warmup([WarmupSpec(features=(b, 28, 28, 1), labels=(b, 10))])
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((b, 28, 28, 1)), jnp.float32)
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[
+        rng.integers(0, 10, b)])
+    net.fit(x, y)                  # settle: first step off the window
+    steps = 2 if SMOKE else 5
+    rep = devtime.capture(
+        lambda: [net.fit(x, y) for _ in range(steps)],
+        executables=devtime.sentry_executables(net._train_step_fn),
+        label="perf_dossier.lenet")
+    cap = rep["capture"]
+    return {
+        "model": f"LeNet b{b}@28x28",
+        "window_steps": steps,
+        "capture_wall_s": rep["capture_wall_s"],
+        "total_device_ms": cap["total_device_ms"],
+        "scope_coverage": cap["scope_coverage"],
+        "peaks": cap["peaks"],
+        "gaps": rep["gaps"],
+        "pallas_candidates": [g["scope"] for g in rep["gaps"]
+                              if g["pallas_candidate"]],
+    }
+
+
 def main(names):
     global SMOKE
     if "--smoke" in names:
@@ -697,6 +742,22 @@ def main(names):
                     **obs.fleet.measure_publish_overhead(
                         step_seconds=steps[len(steps) // 2]),
                     "smoke": SMOKE})
+    # device-time observatory (obs/devtime.py): the hot-path gap
+    # report — per-scope device time + roofline utilization from a
+    # short profiler window over the smoke model, ranking where a
+    # Pallas kernel would buy the most (ARCHITECTURE.md §16). Skipped
+    # inside --trace: the dossier's own profiler session owns the
+    # process and a nested capture would fail.
+    if trace_dir:
+        print("hot_path_gaps: skipped under --trace (one profiler "
+              "session per process)")
+    else:
+        try:
+            payload.append({"config": "hot_path_gaps",
+                            **_hot_path_gaps(), "smoke": SMOKE})
+        except Exception as e:
+            print(f"hot_path_gaps: FAILED {type(e).__name__}: {e}")
+            failed.append("hot_path_gaps")
     # ZeRO-DP sharded weight update (parallel/zero.py): before/after
     # row — replicated vs sharded SYNC step time, per-device
     # optimizer-state bytes, est. peak HBM. Own forced-CPU

@@ -14,6 +14,14 @@ approach: the watcher reads the SAME ``/metrics`` exposition and trace
 JSONL every other consumer uses (``docs/OPS.md`` "Telemetry
 operations").
 
+Devtime (obs/devtime.py): when the run publishes device-time
+observatory families (``dl4j_tpu_devtime_*``, a ``DL4J_TPU_DEVTIME``
+cadence monitor or explicit captures) each sample also emits a
+``devtime`` view: the last capture's scope ranking (share, device ms,
+roofline utilization — the gap report's ``gap.scope``/``gap.share``/
+``gap.utilization`` columns) and the scopes flagged
+``gap.pallas_candidate``.
+
 Fleet (obs/fleet.py): pass ``--fleet-dir <elastic_dir>`` to tail an
 elastic fleet's telemetry snapshots incrementally (same model as the
 trace-JSONL tail: the snapshots are small atomic files, the skew
@@ -95,7 +103,8 @@ _METRIC_KEYS = ("dl4j_tpu_step_latency_seconds_count",
                 "dl4j_tpu_retrace_", "dl4j_tpu_compile_",
                 "dl4j_tpu_worker_stale",
                 "dl4j_tpu_inference_requests_total",
-                "dl4j_tpu_numerics_", "dl4j_tpu_serving_")
+                "dl4j_tpu_numerics_", "dl4j_tpu_serving_",
+                "dl4j_tpu_devtime_")
 
 # numerics view state: total-grad-norm history across samples feeds the
 # sparkline (bounded — one char per retained sample)
@@ -201,11 +210,54 @@ def _serving_view(fams) -> dict:
         est = _hist_quantile(fams, "dl4j_tpu_serving_ttft_seconds", q)
         if est is not None:
             view[key] = est
+    occ = val("dl4j_tpu_serving_kv_page_occupancy")
+    if occ is not None:
+        view["kv_page_occupancy"] = round(occ, 4)
+    reserved = {dict(labels).get("tenant", ""): int(v)
+                for (n, labels), v in fams.items()
+                if n == "dl4j_tpu_serving_kv_pages_reserved" and v > 0}
+    if reserved:
+        view["kv_pages_reserved"] = dict(sorted(
+            reserved.items(), key=lambda kv: -kv[1])[:8])
     shed = {dict(labels).get("reason", ""): int(v)
             for (n, labels), v in fams.items()
             if n == "dl4j_tpu_serving_requests_shed_total" and v > 0}
     if shed:
         view["SHED"] = shed
+    return view
+
+
+def _devtime_view(fams) -> dict:
+    """Render the device-time observatory families from one /metrics
+    scrape: the last capture's scope ranking (each entry mirrors the
+    gap report's ``gap.scope`` / ``gap.share`` / ``gap.utilization``
+    columns) and the scopes it flagged as ``gap.pallas_candidate``."""
+    def by_scope(name):
+        return {dict(labels).get("scope", ""): v
+                for (n, labels), v in fams.items() if n == name}
+
+    shares = by_scope("dl4j_tpu_devtime_scope_share")
+    if not shares:
+        return {}
+    secs = by_scope("dl4j_tpu_devtime_scope_seconds")
+    utils_ = by_scope("dl4j_tpu_devtime_scope_utilization")
+    top = sorted(shares.items(), key=lambda kv: -kv[1])[:8]
+    view: dict = {
+        "captures": fams.get(("dl4j_tpu_devtime_captures_total", ())),
+        "top_scopes": {
+            s: {"share": round(v, 4),
+                "device_ms": round(secs.get(s, 0.0) * 1e3, 3),
+                **({"utilization": round(utils_[s], 4)}
+                   if s in utils_ else {})}
+            for s, v in top},
+    }
+    # the AUTHORITATIVE per-scope flag published with the gap report
+    # — never re-derive the candidate rule scrape-side
+    cands = sorted(
+        s for s, v in by_scope(
+            "dl4j_tpu_devtime_scope_pallas_candidate").items() if v)
+    if cands:
+        view["PALLAS_CANDIDATES"] = cands
     return view
 
 
@@ -277,6 +329,9 @@ def _scrape_telemetry(metrics_url, healthz_url, trace_jsonl,
             sview = _serving_view(fams)
             if sview:
                 _log(event="serving", url=metrics_url, **sview)
+            dview = _devtime_view(fams)
+            if dview:
+                _log(event="devtime", url=metrics_url, **dview)
         except Exception as e:
             _log(event="metrics", url=metrics_url, error=repr(e))
     if healthz_url:

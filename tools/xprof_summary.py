@@ -2,9 +2,10 @@
 PR 2, of an ``obs`` span-trace JSONL.
 
 XProf mode parses the ``*.xplane.pb`` a ``jax.profiler.trace`` run
-writes (e.g. ``perf_dossier.py --trace DIR``) with
-``jax.profiler.ProfileData`` — no tensorboard needed — and prints,
-from the device plane's "XLA Ops" line:
+writes (e.g. ``perf_dossier.py --trace DIR``) through the
+dependency-free wire parser in ``obs/devtime.py`` (this jaxlib has no
+``jax.profiler.ProfileData``, and the tensorboard plugin wheel ships
+no xplane proto) and prints:
 
 - steps observed and mean device step time (cross-checks the
   wall-clock differencing protocol in ``perf_dossier._timeit``);
@@ -12,27 +13,35 @@ from the device plane's "XLA Ops" line:
   kernels, convolution/dot = MXU, copies, ...);
 - the top-K individual ops by total time with their share.
 
+A DIRECTORY argument resolves to the newest capture session under it
+and merges EVERY ``*.xplane.pb`` of that session — one file per host,
+so a multi-host capture summarizes the whole fleet instead of
+silently dropping all hosts but one. An explicit ``*.xplane.pb`` FILE
+argument reads exactly that plane (one host of a fleet capture).
+
 Obs mode reads the Chrome-trace JSONL the telemetry spine writes
 (``DL4J_TPU_TRACE=...``, ``deeplearning4j_tpu/obs/trace.py``) — the
 host-side step/ETL/sync attribution complementing XProf's device view
 — and prints per-span-name totals, counts, and share of the traced
 wall time per thread.
 
-    python tools/xprof_summary.py DIR_OR_TRACE [--top 10]
+    python tools/xprof_summary.py DIR_OR_FILE [--top 10]
 
 A ``*.jsonl``/``*.json`` path (or a dir containing one but no
-``*.xplane.pb``) selects obs mode; otherwise the newest
-``*.xplane.pb`` under the dir is read.
+``*.xplane.pb``) selects obs mode; a ``*.xplane.pb`` path or a
+capture dir selects XProf mode.
 """
 from __future__ import annotations
 
 import argparse
 import re
+import sys
 from collections import defaultdict
 from pathlib import Path
 
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-_NAME_RE = re.compile(r"%([a-zA-Z0-9_-]+?)(?:\.\d+)? =")
+_NAME_RE = re.compile(r"%?([a-zA-Z0-9_-]+?)(?:\.\d+)? =")
 _KIND_RE = re.compile(r"kind=(k\w+)")
 
 
@@ -42,46 +51,44 @@ def _classify(name: str) -> str:
     if base == "fusion":
         k = _KIND_RE.search(name)
         return f"fusion:{k.group(1)[1:].lower()}" if k else "fusion"
-    return base
+    # bare post-optimization names ("broadcast_maximum_fusion",
+    # "dot.5") — strip the trailing .N the regex above missed
+    base = name.split(" ")[0].lstrip("%")
+    return base.rsplit(".", 1)[0] if \
+        base.rsplit(".", 1)[-1].isdigit() else base
 
 
-def summarize(trace_dir: str, top: int = 10):
-    import jax
+def summarize(trace_path: str, top: int = 10):
+    """Per-op device-time table from an XProf capture: an explicit
+    ``*.xplane.pb`` file, or a dir whose NEWEST session's planes are
+    all merged (multi-host captures keep every host)."""
+    from deeplearning4j_tpu.obs import devtime
 
-    paths = sorted(Path(trace_dir).rglob("*.xplane.pb"),
-                   key=lambda p: p.stat().st_mtime)
-    if not paths:
-        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
-    pd = jax.profiler.ProfileData.from_file(str(paths[-1]))
-    dev = next((p for p in pd.planes if "/device:" in p.name), None)
-    if dev is None:
-        raise SystemExit(
-            f"{paths[-1]} has no device plane — was the capture taken "
-            "on CPU, or did every traced run fail before touching the "
-            "device?")
+    paths = devtime.xplane_paths(trace_path)
     steps, per_op, per_class = [], defaultdict(float), \
         defaultdict(float)
     counts = defaultdict(int)
-    for line in dev.lines:
-        if line.name == "Steps":
-            steps = [e.duration_ns for e in line.events]
-        if line.name != "XLA Ops":
-            continue
-        for e in line.events:
-            cls = _classify(e.name)
+    for p in paths:
+        xs = devtime.read_xspace(p)
+        steps.extend(devtime.step_durations_ns(xs))
+        for ev in devtime.op_events(xs):
+            cls = _classify(ev["op"])
             if cls in ("while", "conditional", "call"):
                 continue        # containers: children counted already
-            per_op[e.name.split(" = ")[0]] += e.duration_ns
-            per_class[cls] += e.duration_ns
+            per_op[ev["op"]] += ev["dur_ns"]
+            per_class[cls] += ev["dur_ns"]
             counts[cls] += 1
     total = sum(per_class.values())
     if not total:
         raise SystemExit(
-            f"{paths[-1]}'s device plane has no 'XLA Ops' events — "
-            "nothing executed under the trace")
+            f"{trace_path} has no XLA-op execution events — nothing "
+            "executed under the trace (or the capture is host-only)")
     out = []
-    out.append(f"steps: {len(steps)}, mean device step "
-               f"{sum(steps) / max(1, len(steps)) / 1e6:.2f} ms")
+    out.append(f"planes: {len(paths)} file(s) "
+               f"({', '.join(Path(p).name for p in paths)})")
+    if steps:
+        out.append(f"steps: {len(steps)}, mean device step "
+                   f"{sum(steps) / max(1, len(steps)) / 1e6:.2f} ms")
     out.append("")
     out.append("| op class | total ms | % | count |")
     out.append("|---|---|---|---|")

@@ -100,6 +100,12 @@ def _run_bench_child():
     # the same reason as zero_dp.
     from deeplearning4j_tpu.serving import loadgen
     parsed["serving"] = loadgen.subprocess_report()
+    # fused-primitive kernel library (ops/fused_norms.py): per-kernel
+    # interpret-parity status + fallback timings. Forced-CPU
+    # subprocess like zero_dp — parity is the contract the same
+    # Mosaic-lowered code honors on TPU.
+    from deeplearning4j_tpu.ops import fused_norms
+    parsed["fused_kernels"] = fused_norms.subprocess_report()
     print(json.dumps(parsed))
 
 

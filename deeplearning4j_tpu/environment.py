@@ -65,6 +65,18 @@ _register("DL4J_TPU_FLASH_MIN_T", 1024, int,
           "key-sequence length at/above which scaled_dot_attention "
           "dispatches to the Pallas flash kernel on TPU (crossover "
           "measured on v5e, tools/flash_crossover.py)")
+_register("DL4J_TPU_KERNEL_FORCE", False, _bool,
+          "force every gated fused-kernel dispatch site "
+          "(scaled_dot_attention flash, ops/fused_norms.py norm "
+          "epilogues) onto the Pallas kernel path regardless of "
+          "platform/size gates — interpret mode on CPU, so CI can "
+          "exercise the dispatch decision itself; semantic refusals "
+          "(float64, causal Tq>Tk, shard_map-on-CPU) still fall back")
+_register("DL4J_TPU_FUSED_NORM_MIN_F", 256, int,
+          "trailing feature dim at/above which the norm epilogues "
+          "(ops/fused_norms.py) dispatch to the fused Pallas kernels "
+          "on TPU — below it the row pads to a full 128-lane block "
+          "for no bandwidth win")
 
 # -- compile subsystem (perf/: persistent XLA cache + retrace sentry) ------
 _register("DL4J_TPU_COMPILE_CACHE",

@@ -79,12 +79,18 @@ def _use_flash(q, k, causal: bool = False) -> bool:
     rows have NO live keys, and the two paths define that degenerate
     row differently (kernel: zeros; einsum: uniform average).
     Threshold via DL4J_TPU_FLASH_MIN_T (crossover measured on v5e,
-    tools/flash_crossover.py)."""
+    tools/flash_crossover.py). ``DL4J_TPU_KERNEL_FORCE`` skips the
+    platform/size gates (interpret-mode kernel on CPU) so CI can
+    exercise the dispatch decision itself; the SEMANTIC refusals —
+    causal Tq > Tk, float64 — hold either way."""
     from deeplearning4j_tpu.environment import get_flag
-    return (k.shape[1] >= get_flag("DL4J_TPU_FLASH_MIN_T")
+    semantic_ok = (not (causal and q.shape[1] > k.shape[1])
+                   and q.dtype != jnp.float64)
+    if get_flag("DL4J_TPU_KERNEL_FORCE"):
+        return semantic_ok
+    return (semantic_ok
+            and k.shape[1] >= get_flag("DL4J_TPU_FLASH_MIN_T")
             and q.shape[1] >= 128
-            and not (causal and q.shape[1] > k.shape[1])
-            and q.dtype != jnp.float64
             and jax.default_backend() == "tpu")
 
 
@@ -409,13 +415,17 @@ class TransformerDecoderBlock(Layer):
         return params, {}, tuple(input_shape)
 
     def _body(self, params, x, mask, train, rng):
+        from deeplearning4j_tpu.ops import fused_norms
         r1, r2 = (jax.random.split(rng) if rng is not None
                   else (None, None))
         h, _ = self._ln1.apply(params["ln1"], {}, x)
         a, _ = self._mha.apply(params["mha"], {}, h, train=train,
                                rng=r1, mask=mask)
-        x = x + a
-        h, _ = self._ln2.apply(params["ln2"], {}, x)
+        # residual add + RMSNorm as ONE fused epilogue on TPU
+        # (ops/fused_norms.py); gate-off runs the exact pre-existing
+        # add-then-norm pair
+        h, x = fused_norms.add_rms_norm(x, a, params["ln2"]["gamma"],
+                                        eps=self._ln2.eps)
         h = jax.nn.silu(h @ params["Wg"]) * (h @ params["Wu"])
         return x + self._maybe_dropout(h @ params["Wd"], train, r2)
 

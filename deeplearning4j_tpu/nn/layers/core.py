@@ -252,10 +252,13 @@ class LayerNormalization(Layer):
                  "beta": jnp.zeros((c,), dtype)}, {}, tuple(input_shape))
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        mu = jnp.mean(x, axis=-1, keepdims=True)
-        var = jnp.var(x, axis=-1, keepdims=True)
-        y = (x - mu) / jnp.sqrt(var + self.eps)
-        return y * params["gamma"] + params["beta"], state
+        # platform-helper dispatch (ops/fused_norms.py): fused Pallas
+        # LayerNorm on TPU, the exact pre-existing XLA expression
+        # otherwise (gate-off programs byte-identical)
+        from deeplearning4j_tpu.ops import fused_norms
+        return fused_norms.layer_norm(x, params["gamma"],
+                                      params["beta"],
+                                      eps=self.eps), state
 
 
 #: default RMSNorm epsilon — zoo/gpt.py's KV-cache decode re-derives
@@ -277,8 +280,12 @@ class RMSNorm(Layer):
         return {"gamma": jnp.ones((c,), dtype)}, {}, tuple(input_shape)
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
-        ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
-        return x * jax.lax.rsqrt(ms + self.eps) * params["gamma"], state
+        # platform-helper dispatch (ops/fused_norms.py): fused Pallas
+        # RMSNorm on TPU, the exact pre-existing XLA expression
+        # otherwise (gate-off programs byte-identical)
+        from deeplearning4j_tpu.ops import fused_norms
+        return fused_norms.rms_norm(x, params["gamma"],
+                                    eps=self.eps), state
 
 
 @register_layer

@@ -377,7 +377,9 @@ class MultiLayerNetwork:
             diag = obs.numerics.build_diag(
                 new_params, grads, updates, act_stats, layers,
                 histograms=histograms)
-            return new_params, new_opt, new_state, loss, diag
+            # packed: 2 host transfers per diag step instead of ~10
+            return (new_params, new_opt, new_state, loss,
+                    obs.numerics.pack_diag(diag))
 
         return sentry.jit(diag_update,
                           name="MultiLayerNetwork.diag_step",

@@ -721,9 +721,13 @@ def attribute(paths: Iterable[str],
 #: the gap-report entry schema. ``tools/lint_instrumentation.py``
 #: rule 8 resolves every ``gap.<key>`` token in docs/OPS.md and
 #: tools/tpu_watch.py against THIS tuple — extend it here first.
+#: ``closed_by`` (ISSUE 15): the registered fused kernel
+#: (``ops/kernel_registry.py``) this scope now dispatches to, or None
+#: while the gap is open — a closed scope is never a candidate and its
+#: ``dl4j_tpu_devtime_scope_pallas_candidate`` gauge reads 0.
 GAP_KEYS = ("scope", "device_ms", "share", "ops", "fusions",
             "backward_ms", "flops", "bytes", "utilization", "bound",
-            "pallas_candidate")
+            "pallas_candidate", "closed_by")
 
 
 def _is_pallas_candidate(share: float, util: Optional[float],
@@ -742,12 +746,19 @@ def _is_pallas_candidate(share: float, util: Optional[float],
 def gap_report(capture_: Dict[str, Any], top: int = 12
                ) -> List[Dict[str, Any]]:
     """Rank the capture's scopes by device-time share; every entry
-    carries exactly :data:`GAP_KEYS`."""
+    carries exactly :data:`GAP_KEYS`. A scope covered by a registered
+    (gate-active) fused kernel reports that kernel as ``closed_by``
+    and is never a ``pallas_candidate`` — the loop-closing half of the
+    observatory: the report that NAMED the gap is also the proof the
+    gap was filled (``tools/perf_dossier.py`` ``hot_path_gaps`` prints
+    the closed/open split)."""
+    from deeplearning4j_tpu.ops import kernel_registry
     rows = []
     for name, e in capture_["scopes"].items():
         rl = e.get("roofline")
         util = rl["utilization"] if rl else None
         bound = rl["bound"] if rl else "unknown"
+        closed = kernel_registry.closed_by(name)
         rows.append({
             "scope": name,
             "device_ms": e["device_ms"],
@@ -759,8 +770,9 @@ def gap_report(capture_: Dict[str, Any], top: int = 12
             "bytes": e["bytes"],
             "utilization": util,
             "bound": bound,
-            "pallas_candidate": _is_pallas_candidate(
+            "pallas_candidate": closed is None and _is_pallas_candidate(
                 e["share"], util, e["custom_call_ms"], e["device_ms"]),
+            "closed_by": closed,
         })
     rows.sort(key=lambda r: -r["share"])
     assert all(tuple(r) == GAP_KEYS for r in rows)

@@ -142,11 +142,114 @@ def reset_counters() -> None:
 
 # -- in-program stat builders (traced inside the diagnostic step) ------------
 
+_TAP_FN = None
+
+
+def _tap_barrier():
+    """Lazy ``optimization_barrier`` wrapper (module keeps jax imports
+    inside functions). The barrier pins the tapped tensor to the ONE
+    buffer the real computation produced: without it XLA happily
+    re-materialises the producer chain into the tap's consumer — on
+    the CPU smoke LeNet it duplicated the pooling reduce-windows into
+    every activation tap, which was most of the residual diag-on cost
+    after the reduction fusion (measured +60 ms → +10 ms). The
+    ``custom_jvp`` with a zero tangent exists because this jaxlib has
+    no differentiation rule for the barrier primitive — diagnostics
+    are never differentiated, so zero is exact."""
+    global _TAP_FN
+    if _TAP_FN is None:
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        @jax.custom_jvp
+        def tap(v):
+            return lax.optimization_barrier(v)
+
+        @tap.defjvp
+        def _tap_jvp(primals, tangents):
+            (v,) = primals
+            return lax.optimization_barrier(v), jnp.zeros_like(v)
+
+        _TAP_FN = tap
+    return _TAP_FN
+
+
+def fused_moments(v, barrier: bool = False):
+    """``(Σx, Σx², max|x|, finite-count)`` of one tensor in ONE
+    variadic ``lax.reduce`` — the fused-tap primitive of the ISSUE 15
+    diag-cost work. The old form issued four separate XLA reductions
+    over the masked tensor; XLA:CPU does not multi-output-fuse
+    reductions, so every diagnostic tap re-walked the activation four
+    to six times (measured 18.8 ms vs 1.25 ms for this form on a 4M-
+    element f32 — most of the old ~17% diag-on overhead). A single
+    variadic reduce walks the tensor once and the elementwise
+    mask/square/abs fuse into the reduce loop on every backend.
+    ``barrier=True`` (the mid-forward activation taps) additionally
+    pins the tap to the buffer the real forward produced — see
+    :func:`_tap_barrier`; leave it off for tensors that are already
+    materialised program outputs/operands (grads, updates, params),
+    where the barrier only costs scheduling freedom (measured +50 ms
+    on the smoke LeNet's grad taps). ``stop_gradient`` keeps autodiff
+    from asking the reduce for a JVP rule (diagnostics are never
+    differentiated; without it linearize trips over the int operand's
+    symbolic-zero tangent)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    v = lax.stop_gradient(
+        v if v.dtype == jnp.float32 else v.astype(jnp.float32))
+    if barrier:
+        v = _tap_barrier()(v)
+    if v.ndim == 0:
+        v = v.reshape(1)
+    finite = jnp.isfinite(v)
+    safe = jnp.where(finite, v, 0.0)
+
+    def comp(acc, op):
+        s1, s2, mx, c = acc
+        a, b, m, f = op
+        return (s1 + a, s2 + b, jnp.maximum(mx, m), c + f)
+
+    return lax.reduce(
+        (safe, jnp.square(safe), jnp.abs(safe),
+         finite.astype(jnp.int32)),
+        (jnp.float32(0), jnp.float32(0), jnp.float32(0), jnp.int32(0)),
+        comp, tuple(range(v.ndim)))
+
+
 def act_summary(x) -> Dict[str, Any]:
     """Scalar summary of one layer's activation tensor, traced inside
     the training forward: mean/std/absmax over the finite mask plus a
     non-finite count (the attribution signal — masking keeps the
-    summary stats themselves finite even mid-divergence)."""
+    summary stats themselves finite even mid-divergence).
+
+    ONE pass (ISSUE 15 tentpole b): all four stats come out of a
+    single :func:`fused_moments` reduce, and the variance is assembled
+    from the moments (E[x²] − E[x]², clamped ≥ 0) instead of a second
+    full ``(x − mean)²`` walk. The moment form accumulates in f32 over
+    a masked tensor; for |mean| ≫ std it loses the same low-order
+    variance bits the one-pass BatchNorm trade (ARCHITECTURE §5)
+    already accepts — these are diagnostics, the signal is orders of
+    magnitude. The pre-fusion two-pass form is kept as
+    :func:`act_summary_twopass` — the baseline the diag-cost
+    regression fence beats."""
+    import jax.numpy as jnp
+
+    s1, s2, mx, n_ok = fused_moments(x, barrier=True)
+    n = jnp.maximum(n_ok, 1)
+    mean = s1 / n
+    var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)
+    return {"mean": mean, "std": jnp.sqrt(var), "absmax": mx,
+            "nonfinite": jnp.asarray(x.size, jnp.int32) - n_ok}
+
+
+def act_summary_twopass(x) -> Dict[str, Any]:
+    """The PR 4 two-pass form (shifted variance: a second full
+    ``(x − mean)²`` walk over the activation). Kept ONLY as the
+    measured baseline for the fused-tap regression fence
+    (tests/test_fused_kernels.py) — production diag steps trace
+    :func:`act_summary`."""
     import jax.numpy as jnp
 
     v = x.astype(jnp.float32)
@@ -168,44 +271,51 @@ def _zero_act_summary():
             "nonfinite": jnp.int32(0)}
 
 
+def _flat_layer(leaves):
+    """One layer's leaves as a single flat f32 vector (a concat is one
+    cheap copy; the payoff is ONE reduce per layer instead of one per
+    leaf — at smoke batch sizes the diag program's cost is its HLO op
+    COUNT, ~3-6 µs of XLA:CPU thunk dispatch per op, not its bytes)."""
+    import jax.numpy as jnp
+
+    flat = [jnp.ravel(l).astype(jnp.float32) for l in leaves]
+    return flat[0] if len(flat) == 1 else jnp.concatenate(flat)
+
+
 def layer_summary(sub) -> Tuple[Any, Any, Any]:
     """(l2_norm, absmax, nonfinite_count) over one layer's leaves —
-    norms over the finite mask (the count carries the NaN signal)."""
+    norms over the finite mask (the count carries the NaN signal).
+    ONE :func:`fused_moments` reduce over the layer's concatenated
+    leaves (was four separate reductions per leaf — the same
+    fused-tap trade as ``act_summary``; the concat reassociates the
+    float sum across leaf boundaries, an at-most-ulps change in a
+    diagnostic)."""
     import jax
     import jax.numpy as jnp
 
     leaves = jax.tree.leaves(sub)
     if not leaves:
         return jnp.float32(0.0), jnp.float32(0.0), jnp.int32(0)
-    sq = jnp.float32(0.0)
-    am = jnp.float32(0.0)
-    nf = jnp.int32(0)
-    for leaf in leaves:
-        v = leaf.astype(jnp.float32)
-        finite = jnp.isfinite(v)
-        nf = nf + jnp.asarray(v.size, jnp.int32) - jnp.sum(
-            finite, dtype=jnp.int32)
-        safe = jnp.where(finite, v, 0.0)
-        sq = sq + jnp.sum(jnp.square(safe))
-        am = jnp.maximum(am, jnp.max(jnp.abs(safe)))
-    return jnp.sqrt(sq), am, nf
+    v = _flat_layer(leaves)
+    _, s2, mx, n_ok = fused_moments(v)
+    nf = jnp.asarray(v.size, jnp.int32) - n_ok
+    return jnp.sqrt(s2), mx, nf
 
 
 def layer_norm(sub):
     """Plain (unmasked) L2 norm over one layer's leaves — the cheap
     reduction for trees that don't need attribution counts (updates,
     post-update params): a non-finite leaf simply propagates into the
-    norm, which is itself diagnostic."""
+    norm, which is itself diagnostic. One reduce over the
+    concatenated leaves (op-count trade, see :func:`_flat_layer`)."""
     import jax
     import jax.numpy as jnp
 
     leaves = jax.tree.leaves(sub)
     if not leaves:
         return jnp.float32(0.0)
-    sq = jnp.float32(0.0)
-    for leaf in leaves:
-        sq = sq + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
-    return jnp.sqrt(sq)
+    v = _flat_layer(leaves)
+    return jnp.sqrt(jnp.sum(jnp.square(v)))
 
 
 def log2_sketch(sub):
@@ -227,6 +337,58 @@ def log2_sketch(sub):
             idx, weights=ok.astype(jnp.int32),
             length=HIST_BINS).astype(jnp.int32)
     return counts
+
+
+def pack_diag(diag: Dict[str, Any]) -> Dict[str, Any]:
+    """Concatenate the diag dict's arrays into ONE f32 and ONE i32
+    vector, key names encoded in the packed dict's KEYS (static pytree
+    structure, so nothing but the two buffers crosses to host).
+    Shrinks the diag program's output surface and turns the per-step
+    host pull from ~10 small transfers into 2 — at tiny smoke batches
+    the per-transfer sync was a visible slice of the whole diag
+    overhead. Inverse: :func:`unpack_diag`."""
+    import jax.numpy as jnp
+
+    f32_keys = sorted(k for k, v in diag.items()
+                      if v.dtype != jnp.int32)
+    i32_keys = sorted(k for k, v in diag.items()
+                      if v.dtype == jnp.int32)
+    out: Dict[str, Any] = {}
+    if f32_keys:
+        out["f32:" + ";".join(f32_keys)] = jnp.concatenate(
+            [jnp.ravel(diag[k]).astype(jnp.float32)
+             for k in f32_keys])
+    if i32_keys:
+        out["i32:" + ";".join(i32_keys)] = jnp.concatenate(
+            [jnp.ravel(diag[k]) for k in i32_keys])
+    return out
+
+
+def unpack_diag(host: Dict[str, Any], n_layers: int) -> Dict[str, Any]:
+    """Rebuild the per-key diag dict from :func:`pack_diag` output
+    (host-side numpy). Every entry is ``[L]`` except the ``*_hist``
+    sketches (``[L, HIST_BINS]``). Un-packed dicts pass through, so
+    hand-built diag trees in tests keep working."""
+    import numpy as np
+
+    if not any(":" in k for k in host):
+        return host
+    out: Dict[str, Any] = {}
+    for key, vec in host.items():
+        if ":" not in key:
+            out[key] = vec
+            continue
+        _, names = key.split(":", 1)
+        vec = np.asarray(vec)
+        off = 0
+        for name in names.split(";"):
+            n = (n_layers * HIST_BINS if name.endswith("_hist")
+                 else n_layers)
+            chunk = vec[off:off + n]
+            off += n
+            out[name] = (chunk.reshape(n_layers, HIST_BINS)
+                         if name.endswith("_hist") else chunk)
+    return out
 
 
 def layer_norms_vector(tree, layers: List[str]):
@@ -338,18 +500,26 @@ def first_nonfinite(num: Dict[str, Any], layers: List[str]
     return None
 
 
-def measure_diag_overhead(net, p, o, s, feed, rng, k: int = 10
-                          ) -> Dict[str, Any]:
-    """Time ``k`` plain steps vs ``k`` diagnostic steps (cadence=1,
-    per-step loss sync, scalars-only diag pull) on a live
-    (params, opt_state, state) tree — the shared harness behind
-    ``bench.py``'s ``numerics`` section and the dossier's
-    ``numerics_observatory`` entry. ``feed`` is the net's step feed
-    after (p, o, s): e.g. ``(x, y, None, None)`` for a
-    MultiLayerNetwork, ``({name: x}, [y], {}, {})`` for a
-    ComputationGraph. Attaches a non-raising monitor when none is
+def measure_diag_overhead(net, p, o, s, feed, rng, k: int = 10,
+                          rounds: int = 3) -> Dict[str, Any]:
+    """Time plain steps vs diagnostic steps (cadence=1, per-step loss
+    sync, scalars-only diag pull) on a live (params, opt_state, state)
+    tree — the shared harness behind ``bench.py``'s ``numerics``
+    section and the dossier's ``numerics_observatory`` entry. ``feed``
+    is the net's step feed after (p, o, s): e.g. ``(x, y, None,
+    None)`` for a MultiLayerNetwork, ``({name: x}, [y], {}, {})`` for
+    a ComputationGraph. Attaches a non-raising monitor when none is
     present; consumes/returns nothing from the passed trees (donated
-    buffers are replaced step over step)."""
+    buffers are replaced step over step).
+
+    Protocol: the two arms run as INTERLEAVED ``k``-step bursts and
+    each arm reports its median burst (the ``_timeit`` rationale from
+    ``tools/perf_dossier.py``, applied to an A/B: on a shared CI box
+    the machine's throughput drifts ±10% over the tens of seconds one
+    arm takes, so timing arm A then arm B folds that drift straight
+    into the overhead column — the round-5 ~17% reading carried more
+    box drift than diagnostics; interleaving samples both arms under
+    the same drift)."""
     import jax
 
     if getattr(net, "_numerics", None) is None:
@@ -357,22 +527,25 @@ def measure_diag_overhead(net, p, o, s, feed, rng, k: int = 10
     plain = net._make_train_step()
     diag = net._make_diag_step()
 
-    def timed(step, with_diag):
+    def burst(step, with_diag, n):
         nonlocal p, o, s
-        out = step(p, o, s, *feed, rng)          # compile + warm
-        p, o, s = out[0], out[1], out[2]
-        float(out[3])
         t0 = _trace.now()
-        for _ in range(k):
+        for _ in range(n):
             out = step(p, o, s, *feed, rng)
             p, o, s = out[0], out[1], out[2]
             float(out[3])                  # per-step loss sync
             if with_diag:
                 jax.device_get(out[4])     # the scalars-only pull
-        return (_trace.now() - t0) / k
+        return (_trace.now() - t0) / n
 
-    t_off = timed(plain, False)
-    t_on = timed(diag, True)
+    burst(plain, False, 1)                 # compile + warm both arms
+    burst(diag, True, 1)
+    offs, ons = [], []
+    for _ in range(max(1, rounds)):
+        offs.append(burst(plain, False, k))
+        ons.append(burst(diag, True, k))
+    t_off = sorted(offs)[len(offs) // 2]
+    t_on = sorted(ons)[len(ons) // 2]
     return {
         "step_ms_off": round(t_off * 1e3, 3),
         "step_ms_on": round(t_on * 1e3, 3),
@@ -438,7 +611,7 @@ class NumericsMonitor:
         import numpy as np
 
         t0 = _trace.now()
-        host = jax.device_get(diag)
+        host = unpack_diag(jax.device_get(diag), len(layers))
         with _lock:
             _counters["diag_dispatches"] += 1
             _counters["host_pulls"] += 1
@@ -511,6 +684,7 @@ class NumericsMonitor:
 
 
 __all__ = ["NonFiniteError", "NumericsMonitor", "act_summary",
+           "act_summary_twopass",
            "layer_summary", "log2_sketch", "layer_norms_vector",
            "build_diag", "reduce_act_stats", "tree_norms",
            "sketch_as_histogram", "first_nonfinite",

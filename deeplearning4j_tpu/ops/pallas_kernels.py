@@ -47,18 +47,34 @@ def _interpret() -> bool:
 def _vma(*xs) -> frozenset:
     """Union of the inputs' varying-manual-axes. Outside ``shard_map``
     this is empty; inside, ``pallas_call`` out_shapes must declare it
-    (check_vma) — outputs vary over every axis an input varies over."""
+    (check_vma) — outputs vary over every axis an input varies over.
+    Old jax (0.4.x) has neither ``jax.typeof`` nor vma tracking: there
+    the union is always empty and the vma plumbing degrades to no-ops,
+    which is exactly right — check_vma does not exist on that runtime."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
     out: frozenset = frozenset()
     for x in xs:
         if x is not None:
-            out = out | getattr(jax.typeof(x), "vma", frozenset())
+            out = out | getattr(typeof(x), "vma", frozenset())
     return out
+
+
+def _sds(shape, dtype, vma: frozenset):
+    """``ShapeDtypeStruct`` carrying vma only when non-empty (the kwarg
+    does not exist on old jax, where vma is always empty anyway)."""
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _align_vma(x, vma: frozenset):
     """Broadcast a replicated operand onto varying manual axes so every
     kernel operand carries the same vma (mixed vmas trip check_vma
     inside pallas interpret mode)."""
+    if not vma:
+        return x                    # incl. old jax: vma never tracked
     missing = vma - getattr(jax.typeof(x), "vma", frozenset())
     return lax.pcast(x, tuple(missing), to="varying") if missing else x
 
@@ -263,9 +279,9 @@ def _flash_fwd(q, k, v, km, offs, causal: bool, block_q: int,
     offs = _align_vma(offs.astype(jnp.int32), vma)
     nq, nk = tq // block_q, tk // block_k
     g = groups
-    oshape = jax.ShapeDtypeStruct((bh, tq, dp), q.dtype, vma=vma)
+    oshape = _sds((bh, tq, dp), q.dtype, vma)
     ospec = pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, i, 0))
-    lshape = jax.ShapeDtypeStruct((bh, tq, 128), jnp.float32, vma=vma)
+    lshape = _sds((bh, tq, 128), jnp.float32, vma)
     lspec = pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0))
     res = pl.pallas_call(
         functools.partial(_flash_kernel, scale=scale, causal=causal,
@@ -605,12 +621,9 @@ def _flash_bwd(q, k, v, out, lse, g, km, offs, causal, block_q,
     if tq * dp * 4 <= _FUSED_BWD_DQ_VMEM:
         dq, dk, dv = pl.pallas_call(
             functools.partial(_flash_bwd_fused_kernel, **kw),
-            out_shape=(jax.ShapeDtypeStruct((bh, tq, dp), q.dtype,
-                                            vma=vma),
-                       jax.ShapeDtypeStruct((bh, tk, dp), k.dtype,
-                                            vma=vma),
-                       jax.ShapeDtypeStruct((bh, tk, dp), v.dtype,
-                                            vma=vma)),
+            out_shape=(_sds((bh, tq, dp), q.dtype, vma),
+                       _sds((bh, tk, dp), k.dtype, vma),
+                       _sds((bh, tk, dp), v.dtype, vma)),
             grid=(bh, nk, nq),
             in_specs=[qspec2, kspec2, kspec2, qspec2, qspec2, lspec2,
                       kmspec2, sspec],
@@ -637,7 +650,7 @@ def _flash_bwd(q, k, v, out, lse, g, km, offs, causal, block_q,
     # grid (bh, i, j): q-side blocks follow grid axis 1, kv axis 2
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, **kw),
-        out_shape=jax.ShapeDtypeStruct((bh, tq, dp), q.dtype, vma=vma),
+        out_shape=_sds((bh, tq, dp), q.dtype, vma),
         grid=(bh, nq, nk),
         in_specs=[qspec, kspec, kspec, qspec, qspec, lspec, kmspec,
                   sspec],
@@ -647,9 +660,8 @@ def _flash_bwd(q, k, v, out, lse, g, km, offs, causal, block_q,
     )(qp, kp, vp, dop, op, lsep, kmp, offs)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, **kw),
-        out_shape=(jax.ShapeDtypeStruct((bh, tk, dp), k.dtype, vma=vma),
-                   jax.ShapeDtypeStruct((bh, tk, dp), v.dtype,
-                                        vma=vma)),
+        out_shape=(_sds((bh, tk, dp), k.dtype, vma),
+                   _sds((bh, tk, dp), v.dtype, vma)),
         grid=(bh, nk, nq),
         in_specs=[qspec2, kspec2, kspec2, qspec2, qspec2, lspec2,
                   kmspec2, sspec],
@@ -717,10 +729,12 @@ def flash_block_fwd(q, k, v, km=None, offs=None, causal: bool = False,
     the measured v5e sweep — (1024, 512) up to 4k-key blocks (the
     usual ring regime; 1.44x vs the einsum pair at T/N=4096, see
     BASELINE.md), block_k 1024 beyond."""
+    from deeplearning4j_tpu.obs import devtime
     block_q, block_k = _ring_block_defaults(block_q, block_k,
                                             k.shape[1])
-    return _flash_fwd(q, k, v, km, offs, causal, block_q, block_k,
-                      return_lse=True, groups=groups)
+    with devtime.scope("ops.flash_block_fwd"):
+        return _flash_fwd(q, k, v, km, offs, causal, block_q, block_k,
+                          return_lse=True, groups=groups)
 
 
 def flash_block_bwd(q, k, v, out, lse, g, km=None, offs=None,
@@ -733,10 +747,12 @@ def flash_block_bwd(q, k, v, out, lse, g, km=None, offs=None,
     this block's totals (at the KV head count when ``groups`` > 1)
     once every q block has contributed. (_flash_bwd itself falls back
     to the jnp backward under shard_map-on-CPU.)"""
+    from deeplearning4j_tpu.obs import devtime
     block_q, block_k = _ring_block_defaults(block_q, block_k,
                                             k.shape[1])
-    return _flash_bwd(q, k, v, out, lse, g, km, offs, causal,
-                      block_q, block_k, groups=groups)
+    with devtime.scope("ops.flash_block_bwd"):
+        return _flash_bwd(q, k, v, out, lse, g, km, offs, causal,
+                          block_q, block_k, groups=groups)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
@@ -807,8 +823,13 @@ def flash_attention(q, k, v, causal: bool = False,
     if mask is not None:
         # per-example key mask → per-(batch·kv-head) rows
         km = jnp.repeat(mask.astype(jnp.float32), h_kv, axis=0)
-    o = _flash(fold(q), fold(k), fold(v), km, causal, block_q, block_k,
-               h // h_kv, k.shape[1] - t if causal else 0)
+    # devtime scope (ops/kernel_registry.py contract): the kernel's
+    # own device time gets its own name in the gap report
+    from deeplearning4j_tpu.obs import devtime
+    with devtime.scope("ops.flash_attention"):
+        o = _flash(fold(q), fold(k), fold(v), km, causal, block_q,
+                   block_k, h // h_kv,
+                   k.shape[1] - t if causal else 0)
     return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
@@ -838,6 +859,24 @@ def _decode_kernel(p_ref, tau_ref, out_ref):
                            jnp.where(code == 2, -tau, 0.0))
 
 
+def _jnp_threshold_encode(g2, tau, size, shape):
+    """jnp fallback of :func:`threshold_encode` over the padded
+    ``(16, C)`` group layout — used under shard_map-on-CPU (interpret
+    mode cannot run there) and declared in
+    ``ops/kernel_registry.py``."""
+    g2 = g2.astype(jnp.float32)
+    tau_f = jnp.asarray(tau, jnp.float32)
+    code = jnp.where(g2 > tau_f, 1, jnp.where(g2 < -tau_f, 2, 0))
+    qv = jnp.where(g2 > tau_f, tau_f,
+                   jnp.where(g2 < -tau_f, -tau_f, 0.0))
+    shifts = 2 * jnp.arange(_GROUP, dtype=jnp.int32)[:, None]
+    packed = jnp.sum(code.astype(jnp.int32) << shifts, axis=0,
+                     keepdims=True)
+    resid = g2 - qv
+    residual = resid.T.reshape(-1)[:size].reshape(shape)
+    return packed[0], residual
+
+
 def threshold_encode(grad: jax.Array, tau):
     """Fused threshold encode: grad → (packed int32 codes, residual).
 
@@ -845,6 +884,7 @@ def threshold_encode(grad: jax.Array, tau):
     ``EncodedGradientsAccumulator``): q = τ·sign(g)·1[|g|>τ]; 2 bits
     per element (code 0 / +τ=1 / −τ=2), residual = g − q.
     """
+    from deeplearning4j_tpu.obs import devtime
     shape, size = grad.shape, grad.size
     flat = grad.reshape(-1)
     c = -(-size // _GROUP)
@@ -856,59 +896,55 @@ def threshold_encode(grad: jax.Array, tau):
     c = -(-c // bc) * bc
     g2 = jnp.pad(g2, ((0, 0), (0, c - g2.shape[1])))
     if _jnp_fallback(grad):
-        g2 = g2.astype(jnp.float32)
-        tau_f = jnp.asarray(tau, jnp.float32)
-        code = jnp.where(g2 > tau_f, 1, jnp.where(g2 < -tau_f, 2, 0))
-        qv = jnp.where(g2 > tau_f, tau_f,
-                       jnp.where(g2 < -tau_f, -tau_f, 0.0))
-        shifts = 2 * jnp.arange(_GROUP, dtype=jnp.int32)[:, None]
-        packed = jnp.sum(code.astype(jnp.int32) << shifts, axis=0,
-                         keepdims=True)
-        resid = g2 - qv
-        residual = resid.T.reshape(-1)[:size].reshape(shape)
-        return packed[0], residual
+        return _jnp_threshold_encode(g2, tau, size, shape)
     tau_arr = _align_vma(tau_arr, _vma(grad))
-    packed, resid = pl.pallas_call(
-        _encode_kernel,
-        out_shape=(jax.ShapeDtypeStruct((1, c), jnp.int32,
-                                        vma=_vma(grad)),
-                   jax.ShapeDtypeStruct((_GROUP, c), jnp.float32,
-                                        vma=_vma(grad))),
-        grid=(c // bc,),
-        in_specs=[pl.BlockSpec((_GROUP, bc), lambda i: (0, i)),
-                  pl.BlockSpec(memory_space=pltpu.SMEM)],
-        out_specs=(pl.BlockSpec((1, bc), lambda i: (0, i)),
-                   pl.BlockSpec((_GROUP, bc), lambda i: (0, i))),
-        interpret=_interpret(),
-    )(g2.astype(jnp.float32), tau_arr)
+    with devtime.scope("ops.threshold_encode"):
+        packed, resid = pl.pallas_call(
+            _encode_kernel,
+            out_shape=(_sds((1, c), jnp.int32, _vma(grad)),
+                       _sds((_GROUP, c), jnp.float32, _vma(grad))),
+            grid=(c // bc,),
+            in_specs=[pl.BlockSpec((_GROUP, bc), lambda i: (0, i)),
+                      pl.BlockSpec(memory_space=pltpu.SMEM)],
+            out_specs=(pl.BlockSpec((1, bc), lambda i: (0, i)),
+                       pl.BlockSpec((_GROUP, bc), lambda i: (0, i))),
+            interpret=_interpret(),
+        )(g2.astype(jnp.float32), tau_arr)
     residual = resid.T.reshape(-1)[:size].reshape(shape)
     return packed[0], residual
 
 
+def _jnp_threshold_decode(packed, tau, size, shape):
+    """jnp fallback of :func:`threshold_decode` (shard_map-on-CPU;
+    declared in ``ops/kernel_registry.py``)."""
+    tau_f = jnp.asarray(tau, jnp.float32)
+    shifts = 2 * jnp.arange(_GROUP, dtype=jnp.int32)[:, None]
+    code = (packed[None, :] >> shifts) & 3
+    out = jnp.where(code == 1, tau_f,
+                    jnp.where(code == 2, -tau_f, 0.0))
+    dense = out.T.reshape(-1)[:size]
+    return dense.reshape(shape) if shape is not None else dense
+
+
 def threshold_decode(packed: jax.Array, tau, size: int, shape=None):
     """Reference op ``decode_threshold``: packed codes → dense ±τ."""
+    from deeplearning4j_tpu.obs import devtime
     c0 = packed.shape[0]
     bc = min(c0, _BLOCK_COLS)
     c = -(-c0 // bc) * bc
     packed = jnp.pad(packed, (0, c - c0))
     if _jnp_fallback(packed):
-        tau_f = jnp.asarray(tau, jnp.float32)
-        shifts = 2 * jnp.arange(_GROUP, dtype=jnp.int32)[:, None]
-        code = (packed[None, :] >> shifts) & 3
-        out = jnp.where(code == 1, tau_f,
-                        jnp.where(code == 2, -tau_f, 0.0))
-        dense = out.T.reshape(-1)[:size]
-        return dense.reshape(shape) if shape is not None else dense
+        return _jnp_threshold_decode(packed, tau, size, shape)
     tau_arr = _align_vma(jnp.asarray([tau], jnp.float32), _vma(packed))
-    out = pl.pallas_call(
-        _decode_kernel,
-        out_shape=jax.ShapeDtypeStruct((_GROUP, c), jnp.float32,
-                                       vma=_vma(packed)),
-        grid=(c // bc,),
-        in_specs=[pl.BlockSpec((1, bc), lambda i: (0, i)),
-                  pl.BlockSpec(memory_space=pltpu.SMEM)],
-        out_specs=pl.BlockSpec((_GROUP, bc), lambda i: (0, i)),
-        interpret=_interpret(),
-    )(packed.reshape(1, c), tau_arr)
+    with devtime.scope("ops.threshold_decode"):
+        out = pl.pallas_call(
+            _decode_kernel,
+            out_shape=_sds((_GROUP, c), jnp.float32, _vma(packed)),
+            grid=(c // bc,),
+            in_specs=[pl.BlockSpec((1, bc), lambda i: (0, i)),
+                      pl.BlockSpec(memory_space=pltpu.SMEM)],
+            out_specs=pl.BlockSpec((_GROUP, bc), lambda i: (0, i)),
+            interpret=_interpret(),
+        )(packed.reshape(1, c), tau_arr)
     dense = out.T.reshape(-1)[:size]
     return dense.reshape(shape) if shape is not None else dense

@@ -123,8 +123,11 @@ class FlatShardLayout:
 
         from deeplearning4j_tpu.obs import devtime
 
-        # devtime scope: names the ZeRO param all-gather phase — the
-        # overlap target ROADMAP item 3 wants measured
+        # devtime scope: names the ZeRO param all-gather phase.
+        # ParallelWrapper(gather_overlap=True) moves this gather to
+        # the TOP of the next step so it overlaps that step's forward
+        # (ISSUE 15 tentpole c — measured by zero_dp_report's
+        # sharded_overlap row); the scope covers both placements
         with devtime.scope("zero.all_gather"):
             full = jax.tree.map(
                 lambda s: all_gather(s, axis_name, tiled=True),
@@ -271,9 +274,10 @@ def zero_dp_report(n_devices: Optional[int] = None, steps: int = 10,
     y = np.eye(classes, dtype=np.float32)[
         rng.integers(0, classes, batch)]
 
-    def drive(sharded: bool) -> Dict[str, Any]:
+    def drive(sharded: bool, overlap: bool = False) -> Dict[str, Any]:
         net = mk_net()
-        w = ParallelWrapper(net, workers=n, sharded_update=sharded)
+        w = ParallelWrapper(net, workers=n, sharded_update=sharded,
+                            gather_overlap=overlap)
         it = ListDataSetIterator(DataSet(x, y), batch_size=batch)
         w.fit(it, epochs=2)               # build + warm the step
         t0 = obs.now()
@@ -292,32 +296,50 @@ def zero_dp_report(n_devices: Optional[int] = None, steps: int = 10,
                     2 * p_bytes + opt_bytes,
                 "params": net.params}
 
+    def max_rel(a_tree, b_tree) -> float:
+        rel = 0.0
+        for a, b in zip(jax.tree_util.tree_leaves(a_tree),
+                        jax.tree_util.tree_leaves(b_tree)):
+            a, b = np.asarray(a), np.asarray(b)
+            rel = max(rel, float(np.max(np.abs(a - b) /
+                                        (np.abs(a) + 1e-6))))
+        return rel
+
     rep = drive(False)
     sh = drive(True)
-    # the two trajectories are identical in exact arithmetic; XLA
-    # compiles the two programs with different fusion/FMA choices so
+    # gather/forward overlap (ISSUE 15 tentpole c): the all-gather of
+    # updated params moves to the top of the NEXT step so it overlaps
+    # that step's forward — same math, reordered across the step
+    # boundary (bit-identical to the end-gather sharded trajectory on
+    # this mesh; measured so the dossier's zero_overlap row carries a
+    # step-time delta, not a promise)
+    ov = drive(True, overlap=True)
+    # the trajectories are identical in exact arithmetic; XLA
+    # compiles the programs with different fusion/FMA choices so
     # agreement is to float rounding, not bitwise
-    rel = 0.0
-    for a, b in zip(jax.tree_util.tree_leaves(rep["params"]),
-                    jax.tree_util.tree_leaves(sh["params"])):
-        a, b = np.asarray(a), np.asarray(b)
-        rel = max(rel, float(np.max(np.abs(a - b) /
-                                    (np.abs(a) + 1e-6))))
+    rel = max_rel(rep["params"], sh["params"])
+    rel_ov = max_rel(rep["params"], ov["params"])
     rep.pop("params")
     sh.pop("params")
+    ov.pop("params")
     return {
         "n_devices": n,
         "platform": jax.devices()[0].platform,
         "model": f"mlp {features}-{hidden}-{hidden}-{classes} adam",
         "replicated": rep,
         "sharded": sh,
+        "sharded_overlap": ov,
         "opt_state_ratio": round(
             sh["opt_state_bytes_per_device"]
             / max(1, rep["opt_state_bytes_per_device"]), 4),
         "step_time_ratio": round(
             sh["step_ms"] / rep["step_ms"], 3) if rep["step_ms"] > 0
             else None,
+        "overlap_step_ratio": round(
+            ov["step_ms"] / sh["step_ms"], 3) if sh["step_ms"] > 0
+            else None,
         "max_param_rel_diff": rel,
+        "max_param_rel_diff_overlap": rel_ov,
     }
 
 

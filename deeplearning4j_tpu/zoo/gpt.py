@@ -36,9 +36,11 @@ from deeplearning4j_tpu.nn import updaters as upd
 
 def _rms(x, gamma):
     """RMSNorm shared by the prefill forward and the per-token decode
-    step — one derivation of the block normalisation, not three."""
-    return x * jax.lax.rsqrt(
-        jnp.mean(jnp.square(x), -1, keepdims=True) + RMSNORM_EPS) * gamma
+    step — one derivation of the block normalisation, not three.
+    Platform-helper dispatched (ops/fused_norms.py): fused Pallas
+    kernel on TPU, the exact pre-existing XLA expression otherwise."""
+    from deeplearning4j_tpu.ops import fused_norms
+    return fused_norms.rms_norm(x, gamma, eps=RMSNORM_EPS)
 
 
 def prompt_bucket(t0: int, max_len: Optional[int] = None) -> int:

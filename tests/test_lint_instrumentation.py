@@ -415,3 +415,132 @@ def test_lint_rule8_real_package_annotation_points_hold():
                 if "devtime" in p or "gap." in p
                 or "named_scope" in p]
     assert not problems, "\n".join(problems)
+
+
+# -------------------------------------------------------------------------
+# rule 9: Pallas kernels registered, contained, and contracted
+# -------------------------------------------------------------------------
+
+_CLEAN_KERNEL_MODULE = (
+    "from jax.experimental import pallas as pl\n"
+    "from deeplearning4j_tpu.obs import devtime\n"
+    "def _rms_fwd_call(x):\n"
+    "    return pl.pallas_call(None)(x)\n"
+    "def rms_norm_reference(x, g):\n"
+    "    return x\n"
+    "def rms_norm(x, g):\n"
+    "    with devtime.scope('ops.rms_norm'):\n"
+    "        return _rms_fwd_call(x)\n")
+
+
+def _kernel_registry_text(parity="tests/test_k.py::test_rms",
+                          fallback="rms_norm_reference",
+                          scope="ops.rms_norm",
+                          name="rms_norm"):
+    return (
+        "KERNEL_REGISTRY = {\n"
+        f"    '{name}': {{\n"
+        "        'module': 'ops/fused_norms.py',\n"
+        f"        'fallback': '{fallback}',\n"
+        f"        'parity': '{parity}',\n"
+        f"        'scope': '{scope}',\n"
+        "        'closes': ('*.RMSNorm',),\n"
+        "        'gate': 'fused_norm',\n"
+        "    },\n"
+        "}\n")
+
+
+def _mk_kernel_tree(tmp_path, module=_CLEAN_KERNEL_MODULE,
+                    registry=None, with_test=True):
+    ops = tmp_path / "ops"
+    ops.mkdir()
+    # named fused_norms.py so the synthetic kernel resolves against
+    # the real SCOPE_SITES table
+    (ops / "fused_norms.py").write_text(module)
+    (ops / "kernel_registry.py").write_text(
+        registry if registry is not None else _kernel_registry_text())
+    tests = tmp_path / "tests"
+    tests.mkdir()
+    if with_test:
+        (tests / "test_k.py").write_text("def test_rms():\n    pass\n")
+    return tests
+
+
+def test_lint_rule9_clean_kernel_module_passes(tmp_path):
+    tests = _mk_kernel_tree(tmp_path)
+    problems = [p for p in lint_instrumentation.run(
+        tmp_path, tests_dir=tests) if "kernel" in p.lower()
+        or "pallas" in p.lower()]
+    assert not problems, "\n".join(problems)
+
+
+def test_lint_rule9_pallas_call_outside_ops(tmp_path):
+    _mk_kernel_tree(tmp_path)
+    (tmp_path / "rogue.py").write_text(
+        "from jax.experimental import pallas as pl\n"
+        "out = pl.pallas_call(None)(1)\n")
+    problems = lint_instrumentation.run(tmp_path,
+                                        tests_dir=tmp_path / "tests")
+    assert any("rogue.py" in p and "pallas_call" in p
+               for p in problems)
+
+
+def test_lint_rule9_unregistered_public_kernel(tmp_path):
+    tests = _mk_kernel_tree(
+        tmp_path,
+        module=_CLEAN_KERNEL_MODULE + (
+            "def layer_norm(x, g):\n"
+            "    with devtime.scope('ops.layer_norm'):\n"
+            "        return _rms_fwd_call(x)\n"))
+    problems = lint_instrumentation.run(tmp_path, tests_dir=tests)
+    assert any("layer_norm" in p and "no KERNEL_REGISTRY entry" in p
+               for p in problems)
+
+
+def test_lint_rule9_stale_registry_entry(tmp_path):
+    stale = (
+        "    'gone_kernel': {\n"
+        "        'module': 'ops/fused_norms.py',\n"
+        "        'fallback': 'rms_norm_reference',\n"
+        "        'parity': 'tests/test_k.py::test_rms',\n"
+        "        'scope': 'ops.gone',\n"
+        "        'closes': (),\n"
+        "        'gate': 'always',\n"
+        "    },\n}\n")
+    base = _kernel_registry_text()
+    assert base.endswith("}\n")
+    tests = _mk_kernel_tree(tmp_path, registry=base[:-2] + stale)
+    problems = lint_instrumentation.run(tmp_path, tests_dir=tests)
+    assert any("gone_kernel" in p and "stale" in p for p in problems)
+
+
+def test_lint_rule9_missing_fallback_parity_and_scope(tmp_path):
+    tests = _mk_kernel_tree(
+        tmp_path,
+        registry=_kernel_registry_text(
+            fallback="no_such_fn",
+            parity="tests/test_k.py::test_missing",
+            scope="ops.wrong_scope"))
+    problems = lint_instrumentation.run(tmp_path, tests_dir=tests)
+    assert any("no_such_fn" in p for p in problems)
+    assert any("test_missing" in p and "parity" in p for p in problems)
+    assert any("ops.wrong_scope" in p and "devtime.scope" in p
+               for p in problems)
+
+
+def test_lint_rule9_missing_registry_table(tmp_path):
+    ops = tmp_path / "ops"
+    ops.mkdir()
+    (ops / "fused_norms.py").write_text(_CLEAN_KERNEL_MODULE)
+    problems = lint_instrumentation.run(tmp_path)
+    assert any("KERNEL_REGISTRY" in p and "missing" in p
+               for p in problems)
+
+
+def test_lint_rule9_real_package_kernels_registered():
+    """The live package: every public kernel in ops/ is registered
+    with a resolvable fallback/parity/scope, and no pallas_call lives
+    outside ops/."""
+    problems = [p for p in lint_instrumentation.run()
+                if "pallas" in p.lower() or "KERNEL_REGISTRY" in p]
+    assert not problems, "\n".join(problems)

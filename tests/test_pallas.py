@@ -14,7 +14,17 @@ from deeplearning4j_tpu.nn.layers.attention import scaled_dot_attention
 # ---------------------------------------------------------------------------
 # flash attention
 # ---------------------------------------------------------------------------
-@pytest.mark.parametrize("causal", [False, True])
+# The interpret-mode flash tests became RUNNABLE on this old-jaxlib CI
+# env with ISSUE 15's jax.typeof/vma compat fix (they AttributeError'd
+# before). The deep backward/variant sweeps cost seconds each in
+# interpret mode, and tier-1's 870 s wall-clock budget was already ~96%
+# utilised — so the quick parity core stays tier-1 and the heavy
+# variants ride the slow lane (still run at round end).
+_SLOW = pytest.mark.slow
+
+
+@pytest.mark.parametrize("causal", [pytest.param(False, marks=_SLOW),
+                                    True])
 @pytest.mark.parametrize("t", [64, 200])
 def test_flash_matches_reference(rng, causal, t):
     B, H, D = 2, 2, 32
@@ -41,7 +51,8 @@ def test_flash_gradients_match_reference(rng):
         assert float(jnp.max(jnp.abs(a - b))) < 5e-5
 
 
-@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("causal", [pytest.param(False, marks=_SLOW),
+                                    True])
 @pytest.mark.parametrize("t", [64, 200, 130])
 def test_flash_backward_matches_reference(rng, causal, t):
     """The Pallas dQ/dKV kernels (FlashAttention-2 recompute style)
@@ -63,6 +74,7 @@ def test_flash_backward_matches_reference(rng, causal, t):
         assert float(jnp.max(jnp.abs(a - b))) < 5e-5
 
 
+@_SLOW
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_backward_split_fallback(monkeypatch, rng, causal):
     """Very long sequences fall back from the fused single-pass
@@ -86,6 +98,7 @@ def test_flash_backward_split_fallback(monkeypatch, rng, causal):
         assert float(jnp.max(jnp.abs(a - b))) < 5e-5
 
 
+@_SLOW
 def test_flash_backward_finite_difference(rng):
     """Directional finite-difference check straight through the Pallas
     custom_vjp (float64-free: central difference in f32 with a loose
@@ -112,6 +125,7 @@ def test_flash_backward_finite_difference(rng):
         assert abs(float(fd - an)) < 5e-2 * max(1.0, abs(float(an)))
 
 
+@_SLOW
 def test_flash_backward_bf16(rng):
     """bf16 inputs keep f32 accumulation in the backward kernels."""
     B, T, H, D = 1, 64, 2, 16
@@ -134,7 +148,8 @@ def test_flash_backward_bf16(rng):
         assert err < 0.15, err   # bf16 rounding, not accumulation error
 
 
-@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("causal", [pytest.param(False, marks=_SLOW),
+                                    True])
 def test_flash_masked_matches_einsum(rng, causal):
     """Per-example key masks through the Pallas kernel (VERDICT r2 #3):
     padded-batch sequences must match the masked einsum reference —
@@ -168,6 +183,7 @@ def test_flash_masked_matches_einsum(rng, causal):
         assert float(jnp.max(jnp.abs(a - b))) < 5e-5
 
 
+@_SLOW
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_gqa_matches_repeat(rng, causal):
     """Native GQA (kv BlockSpec index map b // groups) must equal
@@ -252,6 +268,7 @@ def test_flash_block_bwd_composes(rng):
         assert float(jnp.max(jnp.abs(a - b))) < 5e-5
 
 
+@_SLOW
 def test_flash_block_bwd_kv_longer_than_q(rng):
     """Rectangular kv>q: dk/dv must come back at the KV length, not
     truncated to the q length (regression: dk[:, :t] slice bug)."""
@@ -412,7 +429,8 @@ def test_flash_dispatch_routes_cross_attention(monkeypatch, rng):
     assert calls == [(256, 2048, False), (2048, 2048, True)]
 
 
-@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("causal", [pytest.param(False, marks=_SLOW),
+                                    True])
 def test_flash_cross_attention_matches_einsum(rng, causal):
     """Tq != Tk through the kernel: end-aligned causal diagonal
     (tril(.., Tk - Tq)) and key masks must match the dense path,

@@ -463,6 +463,55 @@ back to `op:<class>` buckets.
 """
 
 
+# hand-maintained operations doc, re-emitted on every regeneration
+# (ISSUE 15 satellite: the gap-closing runbook lives in docs/OPS.md
+# next to the gap-naming runbook it completes)
+FUSED_OPS_SECTION = """
+## Closing a named gap (ops/ fused-primitive library)
+
+The §4 policy is "Pallas only where XLA has a gap"; "Naming the
+Pallas gaps" (above) produces the candidate list. This runbook is the
+other half — turning a named gap into a closed one (ARCHITECTURE §17).
+
+**1. Confirm the gap.** Re-run the dossier and check the scope still
+ranks: `python tools/perf_dossier.py --smoke --out d.json`, read
+`hot_path_gaps` — you want `gap.share` ≥ 5%, `gap.utilization` < 35%,
+`gap.closed_by` null. Scopes already closed are listed under
+`closed_gaps` with the kernel that closed them; `open_gaps` is the
+remaining backlog.
+
+**2. Write the kernel in `ops/`.** Fwd + bwd Pallas kernels with a
+`jax.custom_vjp`, a trace-time dispatch gate (TPU or
+`DL4J_TPU_KERNEL_FORCE`), and a fallback that is the EXACT expression
+the call site ran before — gate-off programs must stay
+byte-identical. `ops/fused_norms.py` is the template: single-pass
+forward, recompute-style backward, cross-row parameter grads
+accumulated over the sequential grid.
+
+**3. Register it.** Add a `KERNEL_REGISTRY` entry
+(`ops/kernel_registry.py`): fallback, parity test reference, the
+kernel's own `devtime.scope` name, and `closes` patterns matching the
+gap-report scopes it serves. Add the kernel to `SCOPE_SITES`
+(`tools/lint_instrumentation.py`). Lint rule 9 fails tier-1 until all
+of it lines up — and rejects any `pl.pallas_call` outside `ops/`.
+
+**4. Prove the close.** Parity tests (fwd AND bwd, interpret mode,
+run under `DL4J_TPU_KERNEL_FORCE=1`), the gate-off byte-identity
+fence, and a before/after dossier row. The next `gap_report()` marks
+the scope `gap.closed_by` = your kernel, drops its
+`dl4j_tpu_devtime_scope_pallas_candidate` gauge to 0, and the
+`fused_kernels` bench section / `fused_epilogues` dossier row carry
+the per-kernel parity status from then on.
+
+**Ride-alongs to check.** If the kernel serves the training path,
+verify the numerics observatory still attributes (the diagnostic taps
+ride the same forward) and the strict-sentry fit fence still passes
+(the kernel must not add traced shapes). If it serves decode/serving,
+re-run the serving identity fences (paged decode is token-identical
+to dense decode by contract).
+"""
+
+
 def main():
     import warnings
     warnings.filterwarnings("ignore")
@@ -617,7 +666,8 @@ def main():
                  "", ELASTIC_OPS_SECTION.strip(),
                  "", FLEET_OPS_SECTION.strip(),
                  "", SERVING_OPS_SECTION.strip(),
-                 "", DEVTIME_OPS_SECTION.strip()]
+                 "", DEVTIME_OPS_SECTION.strip(),
+                 "", FUSED_OPS_SECTION.strip()]
     ops_out = os.path.join(os.path.dirname(out), "OPS.md")
     with open(ops_out, "w") as f:
         f.write("\n".join(op_lines) + "\n")

@@ -1,7 +1,7 @@
 """Instrumentation lint — the telemetry spine's CI fence (tier-1 via
 ``tests/test_lint_instrumentation.py``).
 
-Eight AST rules over ``deeplearning4j_tpu/``:
+Nine AST rules over ``deeplearning4j_tpu/``:
 
 1. **Every ``sentry.jit``-wrapped hot path emits obs telemetry.** A
    module that builds jitted entry points with ``sentry.jit(...)`` is
@@ -95,6 +95,22 @@ Eight AST rules over ``deeplearning4j_tpu/``:
    against ``obs/devtime.py``'s ``GAP_KEYS`` tuple, so the runbook
    and dashboard can't drift from the gap-report schema.
 
+9. **The fused-kernel library stays registered and honest.** Pallas
+   kernels live in ``ops/`` ONLY (a raw ``pl.pallas_call`` anywhere
+   else bypasses the dispatch-gate/fallback/parity contract of
+   ARCHITECTURE §17), and every PUBLIC kernel — a non-underscore
+   module-level function that reaches a ``pallas_call`` through
+   private same-module helpers — must be declared in
+   ``ops/kernel_registry.py`` ``KERNEL_REGISTRY`` with (a) a
+   ``fallback`` naming a function that exists in its module (the
+   value-identical XLA path the gate-off program runs), (b) a
+   ``parity`` test reference that resolves to a real test
+   (``tests/<file>.py::<test>``), and (c) a ``scope`` that the kernel
+   function actually emits via ``devtime.scope`` AND that is listed in
+   :data:`SCOPE_SITES` so rule 8 keeps enforcing it — the same
+   table-driven fence that keeps rules 4/7/8 honest, in both
+   directions (no unregistered kernels, no stale registry entries).
+
 Exit status 0 = clean; 1 = violations (printed one per line).
 """
 from __future__ import annotations
@@ -154,17 +170,28 @@ FAMILY_TOKEN_ALLOWLIST = {
 # devtime.scope / jax.named_scope call. ONE site in each fit forward
 # covers every registered layer type (and every zoo model built from
 # layers); the remaining entries are the hand-rolled programs the fit
-# forwards never trace.
+# forwards never trace. The ops/ entries are the PUBLIC Pallas kernels
+# — rule 9 requires every registry kernel to be listed here, and this
+# rule then keeps the kernel's own devtime scope from silently
+# disappearing.
 SCOPE_SITES = {
     "nn/multilayer.py": ("_forward",),
     "nn/graph.py": ("_forward",),
     "zoo/gpt.py": ("_token_logits", "_prefill_forward"),
     "serving/scheduler.py": ("_build_step_fn",),
     "parallel/zero.py": ("scatter_mean", "gather"),
+    "ops/pallas_kernels.py": ("flash_attention", "flash_block_fwd",
+                              "flash_block_bwd", "threshold_encode",
+                              "threshold_decode"),
+    "ops/fused_norms.py": ("rms_norm", "add_rms_norm", "layer_norm"),
 }
 
 # rule 8 source of truth for gap-report keys
 DEVTIME_PATH = "obs/devtime.py"
+
+# rule 9: the Pallas kernel library's home + its registry table
+OPS_DIR = "ops"
+KERNEL_REGISTRY_PATH = "ops/kernel_registry.py"
 
 
 def _calls(tree: ast.AST):
@@ -720,6 +747,213 @@ def _lint_devtime_scopes(package_dir: Path,
     return problems
 
 
+def _parse_kernel_registry(path: Path) -> Optional[dict]:
+    """``{kernel: {field: str | tuple}}`` from the KERNEL_REGISTRY
+    dict literal — AST only. None when the file/table is absent
+    (synthetic trees)."""
+    if not path.is_file():
+        return None
+    tree = ast.parse(path.read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            named = any(isinstance(t, ast.Name)
+                        and t.id == "KERNEL_REGISTRY"
+                        for t in node.targets)
+        elif isinstance(node, ast.AnnAssign):   # KERNEL_REGISTRY: ... =
+            named = (isinstance(node.target, ast.Name)
+                     and node.target.id == "KERNEL_REGISTRY"
+                     and node.value is not None)
+        else:
+            continue
+        if named:
+            if not isinstance(node.value, ast.Dict):
+                continue
+            out = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                        and isinstance(v, ast.Dict)):
+                    continue
+                entry = {}
+                for fk, fv in zip(v.keys, v.values):
+                    if not (isinstance(fk, ast.Constant)
+                            and isinstance(fk.value, str)):
+                        continue
+                    if isinstance(fv, ast.Constant):
+                        entry[fk.value] = fv.value
+                    elif isinstance(fv, (ast.Tuple, ast.List)):
+                        entry[fk.value] = tuple(
+                            e.value for e in fv.elts
+                            if isinstance(e, ast.Constant))
+                out[k.value] = entry
+            return out
+    return None
+
+
+def _is_pallas_call(chain: str) -> bool:
+    return chain == "pallas_call" or chain.endswith(".pallas_call")
+
+
+def _public_kernels(tree: ast.AST):
+    """Public kernel surface of one ops module: non-underscore
+    module-level functions that reach a ``pallas_call`` directly or
+    through PRIVATE (underscore) module-level helpers — reachability
+    stops at public functions, so a bench helper calling the public
+    kernels is a consumer, not a kernel. Returns
+    ``{fn_name: scope_literals_emitted_inside}``."""
+    fns = {n.name: n for n in tree.body
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    direct = {}
+    callees = {}
+    for name, node in fns.items():
+        chains = [_attr_chain(c.func) for c in _calls(node)]
+        direct[name] = any(_is_pallas_call(ch) for ch in chains)
+        # module-local calls appear as bare names
+        callees[name] = {ch for ch in chains if ch in fns}
+
+    def reaches(name, seen=()):
+        if direct.get(name):
+            return True
+        if name in seen:
+            return False
+        for g in callees.get(name, ()):
+            if g.startswith("_") and reaches(g, seen + (name,)):
+                return True
+        return False
+
+    out = {}
+    for name, node in fns.items():
+        if name.startswith("_") or not reaches(name):
+            continue
+        scopes = set()
+        for c in _calls(node):
+            if _scope_call(_attr_chain(c.func)) and c.args and \
+                    isinstance(c.args[0], ast.Constant) and \
+                    isinstance(c.args[0].value, str):
+                scopes.add(c.args[0].value)
+        out[name] = scopes
+    return out
+
+
+def _lint_kernel_registry(package_dir: Path,
+                          tests_dir: Optional[Path]) -> List[str]:
+    """Rule 9 (see module doc): pallas containment + registry/kernel
+    lockstep + fallback/parity/scope resolution."""
+    problems: List[str] = []
+    ops_dir = package_dir / OPS_DIR
+    # (a) no pallas_call outside ops/
+    for path in sorted(package_dir.rglob("*.py")):
+        rel = path.relative_to(package_dir).as_posix()
+        if rel.startswith(OPS_DIR + "/"):
+            continue
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue                # rule-agnostic: lint_file reports it
+        for c in _calls(tree):
+            if _is_pallas_call(_attr_chain(c.func)):
+                problems.append(
+                    f"{rel}:{c.lineno}: raw pl.pallas_call outside "
+                    f"{OPS_DIR}/ — kernels live in the ops library "
+                    "behind the dispatch-gate/fallback/parity "
+                    "contract (ARCHITECTURE §17); move it there and "
+                    "register it in ops/kernel_registry.py")
+    registry = _parse_kernel_registry(
+        package_dir / KERNEL_REGISTRY_PATH)
+    if not ops_dir.is_dir():
+        return problems
+    # public kernels per ops module
+    module_kernels: dict = {}      # rel -> {fn: scopes}
+    any_pallas = False
+    for path in sorted(ops_dir.glob("*.py")):
+        rel = f"{OPS_DIR}/{path.name}"
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue
+        if any(_is_pallas_call(_attr_chain(c.func))
+               for c in _calls(tree)):
+            any_pallas = True
+        module_kernels[rel] = _public_kernels(tree)
+    if registry is None:
+        if any_pallas:
+            problems.append(
+                f"{KERNEL_REGISTRY_PATH}: missing (or no "
+                "KERNEL_REGISTRY dict literal) while ops/ contains "
+                "Pallas kernels — the kernel library has no "
+                "fallback/parity/scope contract")
+        return problems
+    declared_by_module: dict = {}
+    for kname, entry in registry.items():
+        declared_by_module.setdefault(entry.get("module", ""),
+                                      {})[kname] = entry
+    # a registry entry pointing at a module that doesn't exist would
+    # otherwise skip every per-module check below — dead entries must
+    # be flagged no matter how they died
+    for mod in sorted(set(declared_by_module) - set(module_kernels)):
+        for kname in sorted(declared_by_module[mod]):
+            problems.append(
+                f"{KERNEL_REGISTRY_PATH}: entry {kname!r} declares "
+                f"module {mod!r} which is not an ops/ module — stale "
+                "registry entry (moved/removed/typo'd module path?)")
+    for rel, kernels in sorted(module_kernels.items()):
+        declared = declared_by_module.get(rel, {})
+        for fn in sorted(set(kernels) - set(declared)):
+            problems.append(
+                f"{rel}: public kernel {fn}() reaches pallas_call but "
+                f"has no KERNEL_REGISTRY entry in "
+                f"{KERNEL_REGISTRY_PATH} — undeclared kernels ship "
+                "without a fallback/parity/scope contract")
+        for kname in sorted(set(declared) - set(kernels)):
+            problems.append(
+                f"{KERNEL_REGISTRY_PATH}: entry {kname!r} names no "
+                f"public kernel in {rel} — stale registry entry "
+                "(renamed/removed kernel?)")
+        # per-entry contract
+        mod_tree = ast.parse((package_dir / rel).read_text())
+        defs = {n.name for n in ast.walk(mod_tree)
+                if isinstance(n, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef))}
+        for kname in sorted(set(declared) & set(kernels)):
+            entry = declared[kname]
+            fb = entry.get("fallback")
+            if not fb or fb not in defs:
+                problems.append(
+                    f"{KERNEL_REGISTRY_PATH}: kernel {kname!r} "
+                    f"declares fallback {fb!r} which is not a "
+                    f"function in {rel} — the gate-off path has no "
+                    "value-identical XLA implementation")
+            parity = entry.get("parity", "")
+            if tests_dir is not None and Path(tests_dir).is_dir():
+                ok = False
+                if "::" in parity:
+                    tfile, tname = parity.split("::", 1)
+                    tpath = Path(tests_dir) / Path(tfile).name
+                    ok = tpath.is_file() and \
+                        f"def {tname}" in tpath.read_text()
+                if not ok:
+                    problems.append(
+                        f"{KERNEL_REGISTRY_PATH}: kernel {kname!r} "
+                        f"parity reference {parity!r} resolves to no "
+                        "test — an unverified kernel's outputs drift "
+                        "silently from its fallback")
+            scope_lit = entry.get("scope")
+            if not scope_lit or scope_lit not in kernels[kname]:
+                problems.append(
+                    f"{KERNEL_REGISTRY_PATH}: kernel {kname!r} "
+                    f"declares scope {scope_lit!r} but {kname}() in "
+                    f"{rel} never emits it via devtime.scope — its "
+                    "device time lands unattributed")
+            site_fns = SCOPE_SITES.get(rel, ())
+            if kname not in site_fns:
+                problems.append(
+                    f"{KERNEL_REGISTRY_PATH}: kernel {kname!r} is not "
+                    f"listed in SCOPE_SITES[{rel!r}] "
+                    "(tools/lint_instrumentation.py) — rule 8 cannot "
+                    "keep its devtime scope from disappearing")
+    return problems
+
+
 def run(package_dir: Path = PACKAGE,
         tests_dir: Optional[Path] = None,
         tools_dir: Optional[Path] = None,
@@ -741,6 +975,7 @@ def run(package_dir: Path = PACKAGE,
     problems.extend(_lint_serving_jits(package_dir))
     problems.extend(_lint_devtime_scopes(package_dir, tools_dir,
                                          docs_dir))
+    problems.extend(_lint_kernel_registry(package_dir, tests_dir))
     return problems
 
 

@@ -202,11 +202,13 @@ class ServingGateway:
                  top_p: Optional[float] = None, seed: int = 0,
                  eos_id: Optional[int] = None,
                  starvation_patience: float = 5.0,
-                 start: bool = True):
+                 start: bool = True, spec_k: int = 1,
+                 prefix_sharing: bool = False):
         self._sched = DecodeScheduler(
             model, net, max_slots=max_slots, block=block,
             n_pages=n_pages, max_context=max_context, sample=sample,
-            top_k=top_k, top_p=top_p, seed=seed)
+            top_k=top_k, top_p=top_p, seed=seed, spec_k=spec_k,
+            prefix_sharing=prefix_sharing)
         self.queue_limit = int(queue_limit)
         self.default_max_new = int(default_max_new)
         self.eos_id = eos_id
@@ -229,6 +231,8 @@ class ServingGateway:
         self._lock = threading.RLock()  # by the worker next iteration
         self._work = threading.Condition(self._lock)
         self._shutdown = threading.Event()
+        self._pause = threading.Event()     # worker hold request
+        self._parked = threading.Event()    # worker's "I'm held" ack
         self._stop = threading.Event()
         self._worker: Optional[threading.Thread] = None
         if start:
@@ -242,6 +246,29 @@ class ServingGateway:
         Call BEFORE taking traffic (the worker is idle then; mid-
         traffic warmup would race the worker's compile cache)."""
         return self._sched.warmup(prompt_lens)
+
+    def pause(self, timeout: float = 30.0) -> bool:
+        """Park the worker at its next loop top (any in-flight step
+        finishes first). Benchmark hook: with the worker parked, a
+        whole burst can be queued before a single admission happens,
+        so the first admission sweep sees all of it and measured TTFT
+        is admission cost — not the submit-thread/worker race. Returns
+        True once the worker acknowledges the park (False on timeout
+        or when no worker is running)."""
+        self._pause.set()
+        with self._lock:
+            self._work.notify_all()
+        if self._worker is None or not self._worker.is_alive():
+            return False
+        return self._parked.wait(timeout)
+
+    def resume(self) -> None:
+        """Release a :meth:`pause` hold; the worker re-enters its
+        admit/step loop immediately."""
+        self._parked.clear()
+        self._pause.clear()
+        with self._lock:
+            self._work.notify_all()
 
     def submit(self, prompt, max_new: Optional[int] = None,
                tenant: str = "default",
@@ -510,6 +537,11 @@ class ServingGateway:
     def _loop(self) -> None:
         obs.trace.set_thread_name("serving-gateway")
         while not self._stop.is_set():
+            if self._pause.is_set():
+                self._parked.set()
+                with self._lock:
+                    self._work.wait(0.05)
+                continue
             self._drain_cancels()
             if not self._shutdown.is_set():
                 self._admit_queued()

@@ -30,11 +30,26 @@ unallocated page-table entries route there, so a fixed-shape step can
 always scatter/gather without corrupting live sequences (reads of
 trash positions are masked by each slot's length).
 
-The pager itself is host-side bookkeeping: free list, page->owner
-map, and the invariants the tests fence (no page owned twice,
-allocation conservation). The device arrays live here too so the
-scheduler can thread them through its jitted step and write the
-updated pool back.
+Pages are REFCOUNTED: several live sequences may reference the same
+physical page (copy-on-write prefix sharing — a KV page is a pure
+function of the tokens it covers, so requests that share a prompt
+prefix can share its pages byte-for-byte). The pager keeps a
+content-addressed **page-chain index** keyed by the token bytes each
+full-page prefix covers: admission hashes the prompt's page chain
+(:meth:`KVPager.match_prefix`), adopts the shared pages with
+:meth:`KVPager.adopt` (refcount bump, no prefill), and the scheduler
+copies a page before writing it whenever its refcount exceeds one
+(:meth:`KVPager.cow` does the bookkeeping; the device copy is the
+scheduler's sentried page-copy program). A page returns to the free
+list only when its LAST reference releases.
+
+The pager itself is host-side bookkeeping: free list, per-page
+refcounts, per-owner page lists, the chain index, and the invariants
+the tests fence (refcount conservation — the sum of live table
+references per page equals its refcount, trash page exempt — no page
+both free and referenced, allocation conservation). The device arrays
+live here too so the scheduler can thread them through its jitted
+step and write the updated pool back.
 """
 from __future__ import annotations
 
@@ -46,12 +61,13 @@ from deeplearning4j_tpu.obs import metrics as _metrics
 
 
 class PageTableError(RuntimeError):
-    """A pager invariant broke (page owned twice, free-list leak) —
-    raised by :meth:`KVPager.check_invariants`, the churn tests' fence."""
+    """A pager invariant broke (page referenced without a matching
+    refcount, free-list leak, double free) — raised by
+    :meth:`KVPager.check_invariants`, the churn tests' fence."""
 
 
 class KVPager:
-    """Fixed pool of KV pages with free-list allocation.
+    """Fixed pool of refcounted KV pages with free-list allocation.
 
     ``n_pages`` counts the trash page: usable capacity is
     ``n_pages - 1`` pages of ``block`` tokens each.
@@ -85,15 +101,26 @@ class KVPager:
                           jnp.float32))
         else:
             self._pool = (jnp.zeros(shape, jnp.dtype(dtype)),)
-        # host bookkeeping: LIFO free list (hot pages stay hot) and the
-        # page -> owner map the invariant checks walk
+        # host bookkeeping: LIFO free list (hot pages stay hot), the
+        # page -> refcount map, and the per-owner page lists the
+        # invariant checks cross-foot against the refcounts
         self._free: List[int] = list(range(n_pages - 1, 0, -1))
-        self._owner: Dict[int, object] = {}
+        self._refs: Dict[int, int] = {}
         self._pages_of: Dict[int, List[int]] = {}
+        # content-addressed page-chain index: (kind, n_tokens,
+        # token_bytes) -> page list. "pages" entries cover full pages
+        # of a prompt prefix; "tail" entries cover a whole prompt
+        # including its partial last page (adopters must CoW it before
+        # recomputing the final position). Entries die with any member
+        # page (reverse map below).
+        self._chains: Dict[tuple, List[int]] = {}
+        self._page_keys: Dict[int, set] = {}
         # per-tenant reserved-page accounting (owners carry .tenant —
         # the gateway's TokenStream does); label cardinality capped
         # like the gateway's request counter: tenant names are
-        # caller-controlled and a gauge child lives forever
+        # caller-controlled and a gauge child lives forever. Shared
+        # pages bill EVERY tenant referencing them (reservation
+        # semantics: each owner's whole-life claim).
         self._tenant_of: Dict[int, str] = {}
         self._tenant_pages: Dict[str, int] = {}
         self._tenant_labels: set = set()
@@ -124,44 +151,182 @@ class KVPager:
         return -(-int(n_tokens) // self.block)
 
     def alloc(self, n: int, owner) -> Optional[List[int]]:
-        """Take ``n`` pages for ``owner`` (any hashable-by-id object —
-        the gateway uses the request stream). Returns the page ids in
-        position order, or None when the pool can't satisfy the
-        request — admission control's signal to keep the request
-        queued rather than wedge a slot mid-flight."""
+        """Take ``n`` exclusive pages (refcount 1) for ``owner`` (any
+        hashable-by-id object — the gateway uses the request stream).
+        Returns the page ids in position order, or None when the pool
+        can't satisfy the request — admission control's signal to keep
+        the request queued rather than wedge a slot mid-flight."""
         if n > len(self._free):
             return None
         pages = [self._free.pop() for _ in range(n)]
         for p in pages:
-            self._owner[p] = owner
+            self._refs[p] = 1
         self._pages_of.setdefault(id(owner), []).extend(pages)
-        tenant = self._tenant_label(owner)
-        self._tenant_of[id(owner)] = tenant
-        self._tenant_pages[tenant] = \
-            self._tenant_pages.get(tenant, 0) + n
+        self._bill_tenant(owner, n)
         self._gauge()
         return pages
 
-    def release(self, owner) -> int:
-        """Return every page ``owner`` holds to the free list."""
-        pages = self._pages_of.pop(id(owner), [])
+    def adopt(self, pages: List[int], owner) -> None:
+        """Reference already-live pages for ``owner`` (prefix sharing:
+        the admission that matched a page chain rides the donor's
+        physical pages). Refcounts bump by one per page; the pages
+        come back via the same :meth:`release` as allocated ones."""
+        mine = self._pages_of.setdefault(id(owner), [])
         for p in pages:
-            self._owner.pop(p, None)
-            self._free.append(p)
+            if p == 0:
+                raise PageTableError("cannot adopt trash page 0")
+            rc = self._refs.get(p)
+            if rc is None:
+                raise PageTableError(
+                    f"cannot adopt page {p}: not live")
+            if p in mine:
+                raise PageTableError(
+                    f"owner already references page {p}")
+            self._refs[p] = rc + 1
+            mine.append(p)
+        self._bill_tenant(owner, len(pages))
+        self._gauge()
+
+    def drop_ref(self, owner, page: int) -> bool:
+        """Drop ``owner``'s reference on one page (the CoW path:
+        after copying a shared page the writer releases the original).
+        Returns True when this was the last reference and the page
+        went back to the free list."""
+        mine = self._pages_of.get(id(owner), [])
+        if page not in mine:
+            raise PageTableError(
+                f"owner does not reference page {page}")
+        mine.remove(page)
+        self._bill_tenant(owner, -1)
+        freed = self._decref(page)
+        self._gauge()
+        return freed
+
+    def cow(self, owner, old_page: int) -> int:
+        """Copy-on-write bookkeeping: take a fresh exclusive page for
+        ``owner`` and drop its reference on ``old_page`` (which stays
+        live for its other holders). The caller performs the device
+        page copy BEFORE redirecting writes. Raises when the free list
+        is empty — admissions that adopt a writable (tail) page
+        reserve the CoW target up front so this never fires
+        mid-flight."""
+        if not self._free:
+            raise PageTableError(
+                "copy-on-write needs a free page but the pool is "
+                "empty — tail-sharing admissions must reserve one")
+        new = self.alloc(1, owner)[0]
+        self.drop_ref(owner, old_page)
+        return new
+
+    def release(self, owner) -> int:
+        """Drop every reference ``owner`` holds; pages whose LAST
+        reference this was go back to the free list. Returns the
+        number of pages actually freed (== pages held, when none were
+        shared)."""
+        pages = self._pages_of.pop(id(owner), [])
+        freed = 0
+        for p in pages:
+            freed += self._decref(p)
         tenant = self._tenant_of.pop(id(owner), None)
         if tenant is not None and pages:
             self._tenant_pages[tenant] = max(
                 0, self._tenant_pages.get(tenant, 0) - len(pages))
         self._gauge()
-        return len(pages)
+        return freed
 
     def owned(self, owner) -> List[int]:
         return list(self._pages_of.get(id(owner), []))
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
+
+    def shared_pages(self) -> int:
+        """Pages currently referenced by more than one live sequence
+        (the ``dl4j_tpu_serving_prefix_shared_pages`` gauge)."""
+        return sum(1 for rc in self._refs.values() if rc > 1)
+
+    def _decref(self, p: int) -> bool:
+        rc = self._refs.get(p)
+        if rc is None:
+            raise PageTableError(f"double free of page {p}")
+        if rc > 1:
+            self._refs[p] = rc - 1
+            return False
+        del self._refs[p]
+        self._free.append(p)
+        # a freed page invalidates every chain entry it belonged to
+        for key in self._page_keys.pop(p, set()):
+            entry = self._chains.pop(key, None)
+            if entry:
+                for q in entry:
+                    ks = self._page_keys.get(q)
+                    if ks is not None:
+                        ks.discard(key)
+        return True
+
+    # -- content-addressed page-chain index ------------------------------
+    def register_chain(self, tokens: np.ndarray,
+                       pages: List[int]) -> None:
+        """Index ``tokens``'s page chain so later admissions with a
+        shared prefix can ride these pages. One entry per full-page
+        prefix (key: the token bytes the pages cover) plus one "tail"
+        entry for the whole prompt (its last page may be partial —
+        adopters CoW it). First registrant wins on key collisions."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        t0 = int(tokens.shape[0])
+        for i in range(1, t0 // self.block + 1):
+            key = ("pages", i * self.block,
+                   tokens[:i * self.block].tobytes())
+            self._index(key, pages[:i])
+        npg = self.pages_for(t0)
+        if len(pages) >= npg:
+            self._index(("tail", t0, tokens.tobytes()), pages[:npg])
+
+    def _index(self, key: tuple, pages: List[int]) -> None:
+        if key in self._chains or not pages:
+            return
+        if any(self._refs.get(p) is None or p == 0 for p in pages):
+            return      # never index dead or trash pages
+        self._chains[key] = list(pages)
+        for p in pages:
+            self._page_keys.setdefault(p, set()).add(key)
+
+    def match_prefix(self, tokens: np.ndarray
+                     ) -> Optional[Tuple[int, List[int], bool]]:
+        """Longest indexed prefix of ``tokens``: returns
+        ``(shared_len, pages, tail)`` or None. ``tail=True`` means the
+        whole prompt matched — the adopter shares every page but must
+        CoW the last one and recompute position ``t0-1`` (shared
+        coverage is capped at ``t0-1`` so admission always produces
+        the first generated token from its own logits). ``tail=False``
+        shares full pages only (``shared_len`` a multiple of
+        ``block``, at most ``t0-1``) — shared pages are then never
+        written by the adopter."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        t0 = int(tokens.shape[0])
+        entry = self._chains.get(("tail", t0, tokens.tobytes()))
+        if entry is not None:
+            return t0 - 1, list(entry), True
+        for i in range((t0 - 1) // self.block, 0, -1):
+            entry = self._chains.get(
+                ("pages", i * self.block,
+                 tokens[:i * self.block].tobytes()))
+            if entry is not None:
+                return i * self.block, list(entry), False
+        return None
 
     def reserved_by_tenant(self) -> Dict[str, int]:
         """Live reserved-page counts per tenant label (the gauge's
         source — whole-life reservations, not just written pages)."""
         return {t: n for t, n in self._tenant_pages.items() if n}
+
+    def _bill_tenant(self, owner, n: int) -> None:
+        tenant = self._tenant_of.get(id(owner))
+        if tenant is None:
+            tenant = self._tenant_label(owner)
+            self._tenant_of[id(owner)] = tenant
+        self._tenant_pages[tenant] = max(
+            0, self._tenant_pages.get(tenant, 0) + n)
 
     def _tenant_label(self, owner) -> str:
         tenant = str(getattr(owner, "tenant", "") or "unknown")
@@ -176,31 +341,49 @@ class KVPager:
         usable = self.n_pages - 1
         _metrics.SERVING_KV_OCCUPANCY.set(
             (usable - len(self._free)) / usable)
+        _metrics.SERVING_PREFIX_SHARED.set(self.shared_pages())
         for tenant, n in self._tenant_pages.items():
             _metrics.SERVING_KV_RESERVED.labels(tenant=tenant).set(n)
 
     # -- invariants (tests/test_serving.py churn fence) ------------------
     def check_invariants(self) -> None:
-        """No page owned twice, no owned page on the free list, trash
-        page never allocated, and conservation: free + owned ==
-        n_pages - 1. Raises :class:`PageTableError` on any breach."""
+        """Refcount conservation (per page, the number of live table
+        references equals its refcount — trash page exempt because it
+        is never allocated), no page both free and referenced, trash
+        page out of circulation, no double free, and allocation
+        conservation: free + referenced == n_pages - 1. Raises
+        :class:`PageTableError` on any breach."""
         free = set(self._free)
         if len(free) != len(self._free):
             raise PageTableError("duplicate pages on the free list")
-        owned: Dict[int, int] = {}
-        for oid, pages in self._pages_of.items():
+        counts: Dict[int, int] = {}
+        for pages in self._pages_of.values():
             for p in pages:
-                if p in owned:
-                    raise PageTableError(
-                        f"page {p} owned by two live sequences "
-                        f"({owned[p]:#x} and {oid:#x})")
-                owned[p] = oid
-        if 0 in owned or 0 in free:
+                counts[p] = counts.get(p, 0) + 1
+        if 0 in counts or 0 in free or 0 in self._refs:
             raise PageTableError("trash page 0 entered circulation")
-        if free & set(owned):
+        for p in set(counts) | set(self._refs):
+            occ, rc = counts.get(p, 0), self._refs.get(p, 0)
+            if occ > rc:
+                raise PageTableError(
+                    f"page {p}: {occ} table references != refcount "
+                    f"{rc} (two live sequences sharing a page must "
+                    "both hold a ref)")
+            if occ < rc:
+                raise PageTableError(
+                    f"page {p}: refcount {rc} leaks past its {occ} "
+                    "live table references")
+        if free & set(self._refs):
             raise PageTableError(
-                f"pages both free and owned: {sorted(free & set(owned))}")
-        if len(free) + len(owned) != self.n_pages - 1:
+                f"pages both free and referenced: "
+                f"{sorted(free & set(self._refs))}")
+        if len(free) + len(self._refs) != self.n_pages - 1:
             raise PageTableError(
-                f"page leak: {len(free)} free + {len(owned)} owned "
-                f"!= {self.n_pages - 1} usable")
+                f"page leak: {len(free)} free + {len(self._refs)} "
+                f"referenced != {self.n_pages - 1} usable")
+        for key, pages in self._chains.items():
+            for p in pages:
+                if p not in self._refs:
+                    raise PageTableError(
+                        f"chain entry {key[:2]} references freed "
+                        f"page {p}")

@@ -67,6 +67,32 @@ def gen_requests(*, n_requests: int, tenants=("tenant0", "tenant1"),
     return out
 
 
+def gen_shared_prefix_requests(*, n_requests: int,
+                               tenants=("tenant0", "tenant1"),
+                               prefix_len: int = 96,
+                               suffix_lens=(2, 8), max_new: int = 32,
+                               vocab_size: int = 256, seed: int = 0):
+    """The multi-tenant SHARED-PREFIX trace: every request carries the
+    same long system prompt (``prefix_len`` tokens) followed by a
+    short per-request user suffix — the traffic shape where
+    copy-on-write prefix sharing pays (admission cost goes with the
+    suffix, not the prompt). Deterministic per seed."""
+    rng = np.random.default_rng(seed)
+    system = rng.integers(0, vocab_size, prefix_len).astype(np.int32)
+    lo, hi = suffix_lens
+    out = []
+    for i in range(n_requests):
+        sfx = rng.integers(
+            0, vocab_size, int(rng.integers(lo, hi + 1))
+        ).astype(np.int32)
+        out.append({
+            "tenant": tenants[i % len(tenants)],
+            "prompt": np.concatenate([system, sfx]),
+            "max_new": max_new,
+        })
+    return out
+
+
 def run_trace(gateway, requests, *, mode: str = "closed",
               rate: float = 50.0, clients: int = 8,
               deadline_s: Optional[float] = None,
@@ -84,6 +110,9 @@ def run_trace(gateway, requests, *, mode: str = "closed",
     # the step histogram is process-cumulative: snapshot so THIS
     # trace's per-token number isn't polluted by earlier gateways
     step0 = dict(M.SERVING_STEP.snapshot().get("", {}))
+    hits0 = M.SERVING_PREFIX_HITS.snapshot().get("", 0)
+    saved0 = M.SERVING_PREFIX_SAVED.snapshot().get("", 0)
+    acc0 = dict(M.SERVING_SPEC_ACCEPT.snapshot().get("", {}))
     t_bench0 = time.perf_counter()
 
     def submit(r):
@@ -108,8 +137,15 @@ def run_trace(gateway, requests, *, mode: str = "closed",
             return None
 
     if mode == "burst":
+        # a true burst: park the worker while the queue is stuffed so
+        # the first admission sweep sees every request at once —
+        # otherwise the worker races the submit loop and decode steps
+        # interleave with (and pollute) the measured admission TTFTs
+        paused = hasattr(gateway, "pause") and gateway.pause()
         for req in requests:
             submit(req)
+        if paused:
+            gateway.resume()
         for st in list(streams):
             try:
                 st.result(timeout=timeout_s)
@@ -168,6 +204,13 @@ def run_trace(gateway, requests, *, mode: str = "closed",
     d_count = step1.get("count", 0) - step0.get("count", 0)
     d_sum = step1.get("sum", 0.0) - step0.get("sum", 0.0)
     per_token_ms = 1e3 * d_sum / d_count if d_count else None
+    # prefix-sharing / spec-decode deltas for THIS trace (zero /
+    # None on gateways running without those features)
+    hits = M.SERVING_PREFIX_HITS.snapshot().get("", 0) - hits0
+    saved = M.SERVING_PREFIX_SAVED.snapshot().get("", 0) - saved0
+    acc1 = M.SERVING_SPEC_ACCEPT.snapshot().get("", {})
+    da_count = acc1.get("count", 0) - acc0.get("count", 0)
+    da_sum = acc1.get("sum", 0.0) - acc0.get("sum", 0.0)
     return {
         "mode": mode,
         "requests": len(requests),
@@ -186,6 +229,11 @@ def run_trace(gateway, requests, *, mode: str = "closed",
         "per_token_mean_ms": (round(per_token_ms, 3)
                               if per_token_ms else None),
         "shed_rate": round(shed[0] / max(1, len(requests)), 4),
+        "prefix_hit_rate": (round(hits / len(streams), 4)
+                            if streams else None),
+        "prefill_tokens_saved": int(saved),
+        "spec_accept_rate": (round(da_sum / da_count, 4)
+                             if da_count else None),
     }
 
 
@@ -258,8 +306,93 @@ def smoke_report(n_requests: int = 32, max_new: int = 32,
     }
 
 
-def subprocess_report(timeout: int = 420) -> Dict[str, Any]:
-    """Run :func:`smoke_report` in a fresh forced-CPU process (the
+def shared_prefix_report(n_requests: int = 32, prefix_len: int = 216,
+                         max_new: int = 16, max_slots: int = 32,
+                         spec_k: int = 4) -> Dict[str, Any]:
+    """The ISSUE 16 acceptance measurement on the same weight-read-
+    bound CPU smoke LM: one long system prompt, short user suffixes
+    (:func:`gen_shared_prefix_requests`), three gateways —
+
+    - **A**: no sharing, single-token decode (the PR 8 gateway);
+    - **B**: prefix sharing + speculative decode (both features on).
+
+    Reports A-vs-B p50 TTFT ratio (sharing's admission win — the
+    acceptance bar is >= 3x) and tokens/sec ratio (spec decode's
+    throughput win over single-token paged decode — bar >= 1.5x),
+    plus prefix-hit rate, prefill tokens saved, the spec accept rate,
+    and B's retrace count after warmup (must stay zero)."""
+    from deeplearning4j_tpu.perf import sentry
+    from deeplearning4j_tpu.serving.gateway import ServingGateway
+    from deeplearning4j_tpu.zoo import CausalTransformerLM
+
+    model = CausalTransformerLM(vocab_size=512, hidden=256,
+                                n_layers=4, n_heads=4, n_kv_heads=2,
+                                max_len=256, seed=3)
+    net = model.init()
+    requests = gen_shared_prefix_requests(
+        n_requests=n_requests, prefix_len=prefix_len,
+        suffix_lens=(2, 8), max_new=max_new,
+        vocab_size=model.vocab_size, seed=1)
+    hi = max(len(r["prompt"]) for r in requests)
+    mc = min(model.max_len,
+             ((hi + max_new + 15) // 16 + 1) * 16)
+
+    def run(tag, trials=2, **kw):
+        # Two measured trials against one warmed gateway; per-metric
+        # best-of-N strips cold-process jitter (first trial also primes
+        # CPU caches) the same way bench_matmul's repeat loop does.
+        gw = ServingGateway(model, net, max_slots=max_slots,
+                            block=16, max_context=mc,
+                            queue_limit=n_requests + 8,
+                            default_max_new=max_new, **kw)
+        warm = gw.warmup(prompt_lens=range(1, hi + 1))
+        traces_before = sentry.total_traces()
+        runs = [run_trace(gw, requests, mode="burst")
+                for _ in range(trials)]
+        stats = min(runs, key=lambda s: s["ttft_p50_ms"] or 1e18)
+        stats["ttft_p50_ms"] = min(
+            s["ttft_p50_ms"] for s in runs if s["ttft_p50_ms"])
+        stats["tokens_per_sec"] = max(
+            s["tokens_per_sec"] for s in runs if s["tokens_per_sec"])
+        stats["trials"] = trials
+        stats["retraces_after_warmup"] = (sentry.total_traces()
+                                          - traces_before)
+        stats["warmup"] = warm
+        gw.shutdown()
+        return stats
+
+    base = run("baseline")
+    both = run("spec+sharing", prefix_sharing=True, spec_k=spec_k)
+    b_ttft, s_ttft = base["ttft_p50_ms"], both["ttft_p50_ms"]
+    b_tps, s_tps = base["tokens_per_sec"], both["tokens_per_sec"]
+    return {
+        "model": "causal-LM v512 L4 h256 (CPU smoke)",
+        "n_requests": n_requests,
+        "prefix_len": prefix_len,
+        "max_new": max_new,
+        "max_slots": max_slots,
+        "spec_k": spec_k,
+        "baseline_ttft_p50_ms": b_ttft,
+        "shared_ttft_p50_ms": s_ttft,
+        "ttft_speedup": (round(b_ttft / s_ttft, 3)
+                         if b_ttft and s_ttft else None),
+        "baseline_tokens_per_sec": b_tps,
+        "shared_tokens_per_sec": s_tps,
+        "tokens_per_sec_speedup": (round(s_tps / b_tps, 3)
+                                   if b_tps and s_tps else None),
+        "prefix_hit_rate": both["prefix_hit_rate"],
+        "prefill_tokens_saved": both["prefill_tokens_saved"],
+        "spec_accept_rate": both["spec_accept_rate"],
+        "completed": both["completed"],
+        "failed": both["failed"],
+        "retraces_after_warmup": both["retraces_after_warmup"],
+    }
+
+
+def subprocess_report(timeout: int = 420, report: str = "smoke"
+                      ) -> Dict[str, Any]:
+    """Run :func:`smoke_report` (or :func:`shared_prefix_report` with
+    ``report="shared-prefix"``) in a fresh forced-CPU process (the
     ``parallel/zero.py`` idiom): callable from bench/dossier runs
     without touching their backend; any failure returns a structured
     skip instead of sinking the headline metric."""
@@ -273,9 +406,15 @@ def subprocess_report(timeout: int = 420) -> Dict[str, Any]:
         f for f in env.get("XLA_FLAGS", "").split()
         if not f.startswith("--xla_force_host_platform_device_count"))
     env["XLA_FLAGS"] = flags
+    argv = [sys.executable, "-m", "deeplearning4j_tpu.serving.loadgen"]
+    if report == "shared-prefix":
+        argv.append("--shared-prefix")
+    elif report != "smoke":
+        return {"skipped": True,
+                "reason": f"unknown report {report!r}"}
     try:
         proc = subprocess.run(
-            [sys.executable, "-m", "deeplearning4j_tpu.serving.loadgen"],
+            argv,
             capture_output=True, text=True, timeout=timeout, env=env,
             cwd=os.path.dirname(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__)))))
@@ -301,7 +440,10 @@ def subprocess_report(timeout: int = 420) -> Dict[str, Any]:
 def _main() -> None:
     import jax
     jax.config.update("jax_platforms", "cpu")
-    print(json.dumps(smoke_report()), flush=True)
+    if "--shared-prefix" in sys.argv[1:]:
+        print(json.dumps(shared_prefix_report()), flush=True)
+    else:
+        print(json.dumps(smoke_report()), flush=True)
 
 
 if __name__ == "__main__":

@@ -22,6 +22,19 @@ TOKEN-IDENTICAL to dense ``generate()`` — the pager-correctness fence
 in ``tests/test_serving.py`` asserts it for both the float and the
 int8-KV cache paths.
 
+Two opt-in multipliers ride the same machinery (PR 16). With
+``spec_k > 1`` each iteration drafts k-1 tokens on the host (prompt
+lookup over the slot's own history — no second model), verifies all k
+in ONE fixed-shape step whose per-row positions/masks generalize the
+single-token step, and emits the agreeing prefix: because an accepted
+row's cache context is exactly the sequential path's, greedy spec
+output is token-identical to dense ``generate()`` by construction.
+With ``prefix_sharing=True`` admission consults the pager's
+content-addressed page-chain index: a prompt whose prefix already
+sits in live pages ADOPTS them (refcount++), prefill runs only on the
+novel suffix, and any write to a page with refcount > 1 first clones
+it (copy-on-write) so siblings never observe the writer.
+
 The scheduler is single-threaded host logic (the gateway's worker
 drives it); requests are duck-typed: ``.prompt`` (1-D int32),
 ``.max_new``, ``.temperature``, ``.eos_id``, and ``push(tok)`` /
@@ -51,7 +64,27 @@ WARMUP_FEEDS = {
         "(params, pool, page_ids[tb/block]i32, prompt[1,tb]i32, "
         "t0 i32, temp f32, top_p f32, ctr i32) — one signature per "
         "power-of-two prompt bucket (prompt_bucket), each warmed",
+    "_build_spec_step_fn":
+        "(params, pool, page_table[S,MP]i32, lengths[S]i32, "
+        "active[S]bool, prev[S]i32, drafts[S,k-1]i32) — one "
+        "signature per k in SPEC_KS (the k grid); the configured k "
+        "is warmed",
+    "_build_suffix_admit_fn":
+        "(params, pool, page_row[MP]i32, suffix[1,sb]i32, start i32, "
+        "t0 i32, temp f32, top_p f32, ctr i32) — one signature per "
+        "power-of-two SUFFIX bucket; warmup covers the downward "
+        "closure of the reachable prompt buckets (a shared prefix "
+        "can leave any shorter suffix)",
+    "_build_cow_fn":
+        "(pool, src i32, dst i32) — one signature total, warmed once",
 }
+
+#: the speculative-decode k grid: ``spec_k`` must come from this tuple
+#: so :meth:`DecodeScheduler.warmup` AOT-captures the verify step the
+#: live path will run — lint rule 10 holds this constant, the
+#: ``_build_spec_step_fn`` WARMUP_FEEDS entry and the warmup() body in
+#: lockstep (an off-grid k would cold-trace on the first spec step)
+SPEC_KS = (2, 4, 8)
 
 
 def _rotary_rows(x, theta: float, pos):
@@ -74,12 +107,16 @@ def _rotary_rows(x, theta: float, pos):
 class _Slot:
     """Host state of one occupied decode slot."""
 
-    __slots__ = ("req", "length", "remaining")
+    __slots__ = ("req", "length", "remaining", "history")
 
-    def __init__(self, req, length: int, remaining: int):
+    def __init__(self, req, length: int, remaining: int,
+                 history: Optional[list] = None):
         self.req = req
         self.length = length        # cache positions written so far
         self.remaining = remaining  # tokens still to generate
+        # prompt + emitted tokens, host-side: the prompt-lookup draft
+        # source for speculative decode (no second model needed)
+        self.history = history if history is not None else []
 
 
 class DecodeScheduler:
@@ -98,7 +135,10 @@ class DecodeScheduler:
                  block: int = 16, n_pages: Optional[int] = None,
                  max_context: Optional[int] = None,
                  sample: bool = False, top_k: Optional[int] = None,
-                 top_p: Optional[float] = None, seed: int = 0):
+                 top_p: Optional[float] = None, seed: int = 0,
+                 spec_k: int = 1, prefix_sharing: bool = False):
+        import jax.numpy as jnp
+
         self.model = model
         self.net = net
         self.max_slots = int(max_slots)
@@ -120,6 +160,19 @@ class DecodeScheduler:
         self.top_k = top_k
         self.top_p = top_p
         self.seed = int(seed)
+        self.spec_k = int(spec_k)
+        if self.spec_k != 1:
+            if self.spec_k not in SPEC_KS:
+                raise ValueError(
+                    f"spec_k={spec_k} not in SPEC_KS={SPEC_KS} — "
+                    "warmup only pre-captures the k grid, an off-grid "
+                    "k would cold-trace on the first live step")
+            if self.sample:
+                raise ValueError(
+                    "speculative decode is greedy-only: the accept "
+                    "rule compares per-row argmax against the draft; "
+                    "under sampling it would skew the distribution")
+        self.prefix_sharing = bool(prefix_sharing)
         hd = model.hidden // model.n_heads
         self.pager = KVPager(
             n_layers=model.n_layers, n_kv_heads=model.n_kv_heads,
@@ -143,10 +196,21 @@ class DecodeScheduler:
         self._dev_feed: Optional[dict] = None
         self._feed_dirty = True
         self._ctr = 0               # rng fold counter (step + admit)
+        # admission-path scalar constants, uploaded once: top_p never
+        # changes per request and temp defaults to 1.0 — re-wrapping
+        # them per admit is pure fixed overhead on the TTFT path
+        self._topp_dev = jnp.asarray(
+            1.0 if self.top_p is None else self.top_p, jnp.float32)
+        self._temp_one = jnp.asarray(1.0, jnp.float32)
         self.steps = 0
         self.tokens_out = 0
         self._step_fn = self._build_step_fn()
         self._admit_fns: Dict[int, object] = {}
+        self._spec_fn = (self._build_spec_step_fn(self.spec_k)
+                         if self.spec_k > 1 else None)
+        self._suffix_fns: Dict[int, object] = {}
+        self._cow_fn = (self._build_cow_fn()
+                        if self.prefix_sharing else None)
 
     # -- jitted entry points (lint rule 7: sentry.jit, WARMUP_FEEDS) -----
     def _build_step_fn(self):
@@ -188,7 +252,12 @@ class DecodeScheduler:
             # upload — only admissions/retirements dirty the feed
             return nxt, pool, lengths + active.astype(lengths.dtype)
 
-        return sentry.jit(step, name="serving.decode_step")
+        # pool is donated: the caller always rebinds the returned pool
+        # (scheduler invariant), so XLA may alias in/out and the step
+        # writes pages in place — without this, every call on a
+        # donation-capable backend copies the whole multi-MB pool
+        return sentry.jit(step, name="serving.decode_step",
+                          donate_argnums=(1,))
 
     def _paged_block_step(self, pblk, li, x, pool, pt, pos, active):
         """One transformer block at one position per slot, reading and
@@ -264,6 +333,148 @@ class DecodeScheduler:
         h = jax.nn.silu(h @ pblk["Wg"]) * (h @ pblk["Wu"])
         return x + h @ pblk["Wd"], pool
 
+    def _paged_rows_step(self, pblk, li, x, pool, pt, pos, act):
+        """One transformer block at R positions per slot — the
+        multirow generalization of :meth:`_paged_block_step` the
+        speculative verify step and the shared-prefix suffix prefill
+        both run. ``x`` is [S, R, F], ``pos`` [S, R] i32, ``act``
+        bool broadcastable to [S, R] (False rows scatter into the
+        trash page). Every matmul runs on the flattened [S*R, F] view
+        and the attention einsums just grow an ``r`` axis, so each
+        row's arithmetic matches the single-row path element-for-
+        element — the spec-decode identity fence leans on that.
+        Out-of-bounds positions (a row past the slot's page table)
+        are clamped EXPLICITLY and routed to trash: JAX gathers clamp
+        silently, and a junk row must never land in a live page."""
+        import jax
+        import jax.numpy as jnp
+
+        model = self.model
+        S, R = x.shape[0], x.shape[1]
+        hd = model.hidden // model.n_heads
+        n_kv = model.n_kv_heads
+        block = self.block
+        h = _rms(x.reshape(S * R, -1), pblk["ln1"]["gamma"])
+        mha = pblk["mha"]
+        q = (h @ mha["Wq"]).reshape(S * R, model.n_heads, hd)
+        k = (h @ mha["Wk"]).reshape(S * R, n_kv, hd)
+        v = (h @ mha["Wv"]).reshape(S * R, n_kv, hd)
+        pflat = pos.reshape(S * R)
+        q = _rotary_rows(q, model.rope_theta, pflat).reshape(
+            S, R, model.n_heads, hd)
+        k = _rotary_rows(k, model.rope_theta, pflat)
+        kv = jnp.concatenate([k.reshape(S, R, n_kv, hd),
+                              v.reshape(S, R, n_kv, hd)],
+                             axis=3)                    # [S, R, Kv, 2D]
+        cap = pt.shape[1] * block
+        inb = act & (pos < cap)
+        pidx = jnp.minimum(pos // block, pt.shape[1] - 1)
+        pids = jnp.where(inb, jnp.take_along_axis(pt, pidx, axis=1), 0)
+        offs = pos % block
+        if model.cache_quant:
+            codes, scales = pool
+            q8, s_new = _quant_kv(kv.reshape(S, R, n_kv, 2, hd), 4)
+            codes = codes.at[li, pids, :, :, offs].set(
+                q8.reshape(S, R, n_kv, 2 * hd))
+            scales = scales.at[li, pids, :, :, offs].set(s_new)
+            pool = (codes, scales)
+            dt = x.dtype
+            gath = codes[li, pt]    # [S, MP, Kv, 2D, block]
+            ctx = gath.transpose(0, 2, 3, 1, 4).reshape(
+                S, n_kv, 2 * hd, -1)
+            sc = scales[li, pt].transpose(0, 2, 3, 1, 4).reshape(
+                S, n_kv, 2, -1)
+            ck = ctx[:, :, :hd, :].astype(dt)
+            cv = ctx[:, :, hd:, :].astype(dt)
+            k_scale = sc[:, :, 0, None, None, :]
+            v_scale = sc[:, :, 1, None, None, :]
+        else:
+            (kvpool,) = pool
+            kvpool = kvpool.at[li, pids, :, :, offs].set(
+                kv.reshape(S, R, n_kv, 2 * hd).astype(kvpool.dtype))
+            pool = (kvpool,)
+            ctx = kvpool[li, pt].transpose(0, 2, 3, 1, 4).reshape(
+                S, n_kv, 2 * hd, -1)
+            ck, cv = ctx[:, :, :hd, :], ctx[:, :, hd:, :]
+            k_scale = v_scale = None
+        groups = model.n_heads // n_kv
+        qg = q.transpose(0, 2, 1, 3).reshape(S, n_kv, groups, R, hd)
+        s = jnp.einsum("bkgrd,bkdt->bkgrt", qg, ck) / jnp.sqrt(
+            jnp.asarray(hd, x.dtype))
+        if k_scale is not None:
+            s = (s * k_scale).astype(x.dtype)
+        # per-ROW causal mask: row r sees keys <= pos[s, r]. The
+        # scatter above runs before the gather, so a row attends its
+        # own key and every earlier row's — later rows' keys (and any
+        # stale speculative garbage past the accepted length) sit
+        # strictly beyond pos and stay at exact-zero softmax weight
+        live = (jnp.arange(ck.shape[3])[None, None, None, None, :]
+                <= pos[:, None, None, :, None])
+        s = jnp.where(live, s, -1e9)
+        w = jax.nn.softmax(s, axis=-1)
+        if v_scale is not None:
+            w = (w * v_scale).astype(x.dtype)
+        a = jnp.einsum("bkgrt,bkdt->bkgrd", w, cv).transpose(
+            0, 3, 1, 2, 4).reshape(S * R, -1)
+        x = x + (a @ mha["Wo"] + mha["bo"]).reshape(S, R, -1)
+        h = _rms(x.reshape(S * R, -1), pblk["ln2"]["gamma"])
+        h = jax.nn.silu(h @ pblk["Wg"]) * (h @ pblk["Wu"])
+        return x + (h @ pblk["Wd"]).reshape(S, R, -1), pool
+
+    def _build_spec_step_fn(self, k: int):
+        """Speculative verify step: score ``prev`` plus the k-1 host
+        drafts in ONE fixed-shape forward ([S, k] rows at positions
+        lengths..lengths+k-1), take the per-row greedy argmax, accept
+        the agreeing prefix. Because row r's cache context is exactly
+        the sequential path's whenever drafts 1..r matched, every
+        accepted token is the token single-step decode would have
+        produced — the identity fence holds by construction, the step
+        just emits 1..k of them per slot. Rejected rows leave stale KV
+        at positions length+e..length+k-1; the NEXT step's k writes
+        start at length+e and e >= 1, so the garbage is overwritten
+        before any mask can see it (the in-program rollback)."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.perf import sentry
+
+        model = self.model
+        L = model.n_layers
+        S = self.max_slots
+
+        def step(params, pool, page_table, lengths, active, prev,
+                 drafts):
+            toks = jnp.concatenate([prev[:, None], drafts], axis=1)
+            pos = (lengths[:, None]
+                   + jnp.arange(k, dtype=lengths.dtype)[None, :])
+            with obs.devtime.scope("spec_decode.embed"):
+                x = params["layer_0"]["W"][toks.reshape(-1)].reshape(
+                    S, k, -1)
+            for i in range(L):
+                with obs.devtime.scope(f"spec_decode.block_{i}"):
+                    x, pool = self._paged_rows_step(
+                        params[f"layer_{i + 1}"], i, x, pool,
+                        page_table, pos, active[:, None])
+            with obs.devtime.scope("spec_decode.lm_head"):
+                h = _rms(x.reshape(S * k, -1),
+                         params[f"layer_{L + 1}"]["gamma"])
+                logits = model._head_logits(params, h).reshape(
+                    S, k, -1)
+            # per-row greedy pick — same argmax `_pick(sample=False)`
+            # runs, just vectorized over the k rows
+            m = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            agree = (m[:, :-1] == drafts).astype(jnp.int32)
+            e = 1 + jnp.sum(jnp.cumprod(agree, axis=1), axis=1)
+            e = jnp.where(active, e, 0)
+            m = jnp.where(active[:, None], m, 0)
+            prev_next = jnp.take_along_axis(
+                m, jnp.maximum(e - 1, 0)[:, None], axis=1)[:, 0]
+            # lengths advance by the ACCEPTED count in-program — the
+            # steady-state feedback loop needs no host upload beyond
+            # the k-1 draft ints per slot
+            return m, e, pool, lengths + e, prev_next
+
+        return sentry.jit(step, name=f"serving.spec_step_k{k}",
+                          donate_argnums=(1,))
+
     def _build_admit_fn(self, tb: int):
         """Prefill-into-pages for prompt bucket ``tb``: ONE batched
         causal forward over the padded prompt (the same
@@ -312,12 +523,85 @@ class DecodeScheduler:
                              sample=self.sample, top_k=self.top_k,
                              nucleus=self.top_p is not None)
             return pool, g0
-        return sentry.jit(admit, name="serving.prefill")
+        return sentry.jit(admit, name="serving.prefill",
+                          donate_argnums=(1,))
 
     def _admit_fn(self, tb: int):
         fn = self._admit_fns.get(tb)
         if fn is None:
             fn = self._admit_fns[tb] = self._build_admit_fn(tb)
+        return fn
+
+    def _build_suffix_admit_fn(self, sb: int):
+        """Prefill ONLY the novel suffix of a shared-prefix admission:
+        the first ``start`` positions already sit in adopted pages, so
+        the forward runs the ``sb``-bucketed suffix rows through
+        :meth:`_paged_rows_step` (S=1) — they attend the shared pages
+        through the slot's page table and write their own KV into the
+        novel (or copy-on-write) pages. Admission cost scales with the
+        SUFFIX, not the prompt (PAPERS.md: arxiv 2603.09555's O(1)
+        shared-prefix caching contract). Logits are read at prompt row
+        ``t0-1-start`` and fed through the same ``_pick`` the dense
+        admit uses, so the first token comes from the identical
+        pick rule."""
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.perf import sentry
+
+        model = self.model
+        L = model.n_layers
+
+        def admit(params, pool, page_row, suffix_pad, start, t0, temp,
+                  top_p, ctr):
+            pos = (start
+                   + jnp.arange(sb, dtype=jnp.int32))[None, :]
+            act = jnp.arange(sb, dtype=jnp.int32)[None, :] < (t0
+                                                              - start)
+            pt = page_row[None, :]
+            with obs.devtime.scope("suffix_prefill.embed"):
+                x = params["layer_0"]["W"][
+                    suffix_pad.reshape(-1)].reshape(1, sb, -1)
+            for i in range(L):
+                with obs.devtime.scope(f"suffix_prefill.block_{i}"):
+                    x, pool = self._paged_rows_step(
+                        params[f"layer_{i + 1}"], i, x, pool, pt,
+                        pos, act)
+            with obs.devtime.scope("suffix_prefill.lm_head"):
+                row = jax.lax.dynamic_slice_in_dim(
+                    x[0], t0 - 1 - start, 1, axis=0)
+                hrow = _rms(row, params[f"layer_{L + 1}"]["gamma"])
+                logits0 = model._head_logits(params, hrow)
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(self.seed), ctr)
+            _, sub = jax.random.split(key)
+            g0 = model._pick(logits0, temp, top_p, sub,
+                             sample=self.sample, top_k=self.top_k,
+                             nucleus=self.top_p is not None)
+            return pool, g0
+
+        return sentry.jit(admit, name="serving.suffix_prefill",
+                          donate_argnums=(1,))
+
+    def _build_cow_fn(self):
+        """Copy one physical page (all layers, codes AND scales) —
+        the copy-on-write primitive: a writer holding a page whose
+        refcount exceeds one clones it before its next KV write so
+        sibling readers keep the original bytes."""
+        from deeplearning4j_tpu.perf import sentry
+
+        def cow_copy(pool, src, dst):
+            return tuple(a.at[:, dst].set(a[:, src]) for a in pool)
+
+        # donated: the clone is an in-place one-page write on a
+        # donation-capable backend rather than a whole-pool copy —
+        # this keeps shared admissions O(suffix), not O(pool)
+        return sentry.jit(cow_copy, name="serving.cow_copy",
+                          donate_argnums=(0,))
+
+    def _suffix_fn(self, sb: int):
+        fn = self._suffix_fns.get(sb)
+        if fn is None:
+            fn = self._suffix_fns[sb] = self._build_suffix_admit_fn(sb)
         return fn
 
     # -- host-side scheduling -------------------------------------------
@@ -354,6 +638,11 @@ class DecodeScheduler:
         slot = self.free_slot()
         if slot is None:
             return False
+        if self.prefix_sharing:
+            match = self.pager.match_prefix(prompt)
+            if match is not None:
+                return self._admit_shared(req, slot, prompt, t0,
+                                          max_new, match)
         tb = prompt_bucket(t0, self.max_context)
         # resolve (possibly build) the bucket executable BEFORE taking
         # pages: everything after the reservation is under the
@@ -379,10 +668,9 @@ class DecodeScheduler:
                 jnp.asarray(np.asarray(pages[:tb // self.block],
                                        np.int32)),
                 jnp.asarray(pad), jnp.asarray(t0, jnp.int32),
-                jnp.asarray(1.0 if temp is None else temp,
-                            jnp.float32),
-                jnp.asarray(1.0 if self.top_p is None else self.top_p,
-                            jnp.float32),
+                (self._temp_one if temp is None
+                 else jnp.asarray(temp, jnp.float32)),
+                self._topp_dev,
                 jnp.asarray(self._ctr, jnp.int32))
             self.pager.pool = pool
             ts2 = obs.now()
@@ -398,8 +686,102 @@ class DecodeScheduler:
         obs.record_step("serving.prefill", ts0, ts1, ts2, ts3,
                         args={"bucket": tb, "t0": t0, "slot": slot})
         obs.metrics.SERVING_PREFILL.observe(ts3 - ts0)
+        if self.prefix_sharing:
+            # publish this prompt's page chain so later admissions
+            # with the same prefix can adopt the pages instead of
+            # re-prefilling them
+            self.pager.register_chain(prompt, pages)
+        self._occupy(slot, req, t0, max_new, first, temp, prompt)
+        return True
+
+    def _admit_shared(self, req, slot, prompt, t0: int, max_new: int,
+                      match) -> bool:
+        """Admit ``req`` by ADOPTING a matched prefix chain: incref
+        the shared pages, allocate only the novel remainder of the
+        whole-life reservation, and prefill just the suffix. A
+        whole-prompt (tail-key) match copy-on-writes the final shared
+        page first — position ``t0-1`` must be recomputed there to
+        recover the first-token logits, and that write may not touch
+        a page siblings still read."""
+        import jax.numpy as jnp
+
+        shared_len, spages, tail = match
+        total = self.pages_needed(t0, max_new)
+        novel = total - len(spages) + (1 if tail else 0)
+        suffix = t0 - shared_len
+        sb = prompt_bucket(suffix, self.max_context)
+        # resolve (possibly build) the suffix executable BEFORE taking
+        # pages — same discipline as the dense path
+        fn = self._suffix_fn(sb)
+        new_pages = self.pager.alloc(novel, req)
+        if new_pages is None:
+            return False
+        ts0 = obs.now()
+        try:
+            self.pager.adopt(spages, req)
+        except BaseException:
+            self.pager.release(req)
+            raise
+        try:
+            if tail:
+                old_tail = spages[-1]
+                target = new_pages[0]
+                self.pager.pool = self._cow_fn(
+                    self.pager.pool, jnp.asarray(old_tail, jnp.int32),
+                    jnp.asarray(target, jnp.int32))
+                self.pager.drop_ref(req, old_tail)
+                obs.metrics.SERVING_PREFIX_COW.inc()
+                row_pages = list(spages[:-1]) + [target] \
+                    + list(new_pages[1:])
+            else:
+                row_pages = list(spages) + list(new_pages)
+            row = self._page_table[slot]
+            row[:] = 0
+            row[:len(row_pages)] = row_pages
+            pad = np.zeros((1, sb), np.int32)
+            pad[0, :suffix] = prompt[shared_len:]
+            self._ctr += 1
+            temp = getattr(req, "temperature", None)
+            ts1 = obs.now()
+            pool, g0 = fn(
+                self.model._decode_params(self.net), self.pager.pool,
+                jnp.asarray(np.asarray(row, np.int32)),
+                jnp.asarray(pad), jnp.asarray(shared_len, jnp.int32),
+                jnp.asarray(t0, jnp.int32),
+                (self._temp_one if temp is None
+                 else jnp.asarray(temp, jnp.float32)),
+                self._topp_dev,
+                jnp.asarray(self._ctr, jnp.int32))
+            self.pager.pool = pool
+            ts2 = obs.now()
+            first = int(np.asarray(g0)[0])  # blocking device sync
+        except BaseException:
+            # one release drops BOTH the adopted refs and the novel
+            # pages — shared pages survive for their siblings
+            self._page_table[slot] = 0
+            self._feed_dirty = True
+            self.pager.release(req)
+            raise
+        ts3 = obs.now()
+        obs.record_step("serving.prefill", ts0, ts1, ts2, ts3,
+                        args={"bucket": sb, "t0": t0, "slot": slot,
+                              "shared": shared_len})
+        obs.metrics.SERVING_PREFILL.observe(ts3 - ts0)
+        obs.metrics.SERVING_PREFIX_HITS.inc()
+        obs.metrics.SERVING_PREFIX_SAVED.inc(shared_len)
+        self.pager.register_chain(prompt, row_pages)
+        self._occupy(slot, req, t0, max_new, first, temp, prompt)
+        return True
+
+    def _occupy(self, slot: int, req, t0: int, max_new: int,
+                first: int, temp, prompt) -> None:
+        """Post-prefill slot bookkeeping shared by the dense and the
+        shared-prefix admission paths: mirror state, emit the TTFT
+        token, retire immediately if the budget was one token."""
         self._slots[slot] = _Slot(req, length=t0,
-                                  remaining=max_new - 1)
+                                  remaining=max_new - 1,
+                                  history=list(map(int, prompt))
+                                  + [first])
         self._lengths[slot] = t0
         self._prev[slot] = first
         self._temps[slot] = 1.0 if temp is None else temp
@@ -411,19 +793,13 @@ class DecodeScheduler:
         if self._slots[slot].remaining <= 0 or first == getattr(
                 req, "eos_id", None):
             self._retire(slot)
-        return True
 
-    def step(self) -> int:
-        """One continuous-batching iteration: step every active slot
-        one token, deliver, retire finished sequences (their pages go
-        back to the free list). Returns tokens produced (0 = idle)."""
+    def _ensure_feed(self, act) -> dict:
+        """Rebuild the device-side feed if an admit/retire/shed
+        dirtied it; otherwise hand back the resident arrays (the
+        zero-h2d steady state)."""
         import jax.numpy as jnp
 
-        act = [i for i, s in enumerate(self._slots) if s is not None]
-        if not act:
-            return 0
-        ts0 = obs.now()
-        self._ctr += 1
         if self._feed_dirty or self._dev_feed is None:
             active = np.zeros(self.max_slots, bool)
             active[act] = True
@@ -438,7 +814,30 @@ class DecodeScheduler:
                     jnp.float32),
             }
             self._feed_dirty = False
-        f = self._dev_feed
+        return self._dev_feed
+
+    def step(self) -> int:
+        """One continuous-batching iteration: step every active slot
+        one token, deliver, retire finished sequences (their pages go
+        back to the free list). Returns tokens produced (0 = idle).
+        With ``spec_k > 1`` the iteration runs the speculative
+        draft/verify/accept step instead and can emit up to k tokens
+        per slot."""
+        import jax.numpy as jnp
+
+        act = [i for i, s in enumerate(self._slots) if s is not None]
+        if not act:
+            return 0
+        if self.spec_k > 1:
+            return self._step_spec(act)
+        if self.prefix_sharing:
+            # defense-in-depth: admission CoWs the tail eagerly, so a
+            # live slot should never write a shared page — but if one
+            # slipped through, clone it before the step can clobber it
+            self._cow_writable(act, 1)
+        ts0 = obs.now()
+        self._ctr += 1
+        f = self._ensure_feed(act)
         ts1 = obs.now()
         nxt, pool, len_next = self._step_fn(
             self.model._decode_params(self.net), self.pager.pool,
@@ -468,6 +867,123 @@ class DecodeScheduler:
         obs.metrics.SERVING_TOKENS.inc(len(act))
         self.tokens_out += len(act)
         return len(act)
+
+    def _step_spec(self, act) -> int:
+        """One speculative iteration: host-draft k-1 tokens per slot
+        (prompt lookup over its token history — the one small h2d this
+        mode pays per step, a documented deviation from the
+        single-token path's zero-upload steady state), verify all k
+        in one fixed-shape step, deliver the accepted prefix. Device
+        lengths advance by the accepted count in-program; any slot
+        that retires mid-acceptance (eos / budget) dirties the feed,
+        so the rebuilt host mirror re-synchronizes the truncation."""
+        import jax.numpy as jnp
+
+        k = self.spec_k
+        if self.prefix_sharing:
+            self._cow_writable(act, k)
+        ts0 = obs.now()
+        self._ctr += 1
+        f = self._ensure_feed(act)
+        drafts_np = np.zeros((self.max_slots, k - 1), np.int32)
+        for i in act:
+            drafts_np[i] = self._draft(self._slots[i].history, k - 1)
+        ts1 = obs.now()
+        m, e, pool, len_next, prev_next = self._spec_fn(
+            self.model._decode_params(self.net), self.pager.pool,
+            f["pt"], f["lengths"], f["active"], f["prev"],
+            jnp.asarray(drafts_np))
+        self.pager.pool = pool
+        f["prev"], f["lengths"] = prev_next, len_next
+        ts2 = obs.now()
+        toks = np.asarray(m)            # blocking device sync
+        counts = np.asarray(e)
+        ts3 = obs.now()
+        self.steps += 1
+        produced = 0
+        for i in act:
+            s = self._slots[i]
+            n_acc = int(counts[i])
+            pushed = 0
+            retire = False
+            for j in range(n_acc):
+                tok = int(toks[i, j])
+                s.req.push(tok)
+                s.history.append(tok)
+                pushed += 1
+                s.remaining -= 1
+                if s.remaining <= 0 or tok == getattr(
+                        s.req, "eos_id", None):
+                    retire = True
+                    break
+            s.length += pushed
+            self._lengths[i] += pushed
+            self._prev[i] = int(toks[i, pushed - 1])
+            produced += pushed
+            obs.metrics.SERVING_SPEC_DRAFTED.inc(k - 1)
+            obs.metrics.SERVING_SPEC_ACCEPTED.inc(n_acc - 1)
+            obs.metrics.SERVING_SPEC_ACCEPT.observe(
+                (n_acc - 1) / (k - 1))
+            if retire:
+                self._retire(i)
+        obs.record_step("serving.spec_step", ts0, ts1, ts2, ts3,
+                        args={"active": len(act), "k": k,
+                              "produced": produced})
+        obs.metrics.SERVING_STEP.observe(ts3 - ts0)
+        obs.metrics.SERVING_TOKENS.inc(produced)
+        self.tokens_out += produced
+        return produced
+
+    def _cow_writable(self, act, k: int) -> None:
+        """Copy-on-write every page the next step's k writes could
+        touch if its refcount exceeds one: clone the bytes, swap the
+        clone into this slot's table row, decref the original —
+        sibling readers keep the shared page untouched."""
+        import jax.numpy as jnp
+
+        for i in act:
+            s = self._slots[i]
+            length = int(self._lengths[i])
+            lo = length // self.block
+            hi = min((length + k - 1) // self.block,
+                     self.max_pages_per_seq - 1)
+            for pi in range(lo, hi + 1):
+                pid = int(self._page_table[i, pi])
+                if pid and self.pager.refcount(pid) > 1:
+                    new = self.pager.cow(s.req, pid)
+                    self.pager.pool = self._cow_fn(
+                        self.pager.pool, jnp.asarray(pid, jnp.int32),
+                        jnp.asarray(new, jnp.int32))
+                    self._page_table[i, pi] = new
+                    self._feed_dirty = True
+                    obs.metrics.SERVING_PREFIX_COW.inc()
+
+    def _draft(self, hist, n: int):
+        """Prompt-lookup drafting: find the LATEST earlier occurrence
+        of the trailing bigram (unigram fallback) in this slot's own
+        history and propose its continuation; pad by repeating the
+        last candidate. Free to compute, surprisingly accurate on
+        repetitive continuations — and a wrong draft only costs the
+        verify row it rode in."""
+        L = len(hist)
+        idx = None
+        if L >= 2:
+            a, b = hist[-2], hist[-1]
+            for j in range(L - 3, -1, -1):
+                if hist[j] == a and hist[j + 1] == b:
+                    idx = j + 2
+                    break
+        if idx is None and L >= 1:
+            a = hist[-1]
+            for j in range(L - 2, -1, -1):
+                if hist[j] == a:
+                    idx = j + 1
+                    break
+        cand = list(hist[idx:idx + n]) if idx is not None else []
+        last = cand[-1] if cand else (hist[-1] if hist else 0)
+        while len(cand) < n:
+            cand.append(last)
+        return cand
 
     def _retire(self, slot: int) -> None:
         s = self._slots[slot]
@@ -523,7 +1039,10 @@ class DecodeScheduler:
         import jax.numpy as jnp
 
         assert set(WARMUP_FEEDS) == {"_build_step_fn",
-                                     "_build_admit_fn"}
+                                     "_build_admit_fn",
+                                     "_build_spec_step_fn",
+                                     "_build_suffix_admit_fn",
+                                     "_build_cow_fn"}
         if prompt_lens is None:
             prompt_lens = range(1, self.max_context)
         buckets = sorted({prompt_bucket(t, self.max_context)
@@ -547,5 +1066,34 @@ class DecodeScheduler:
                 sds((), jnp.float32), sds((), i32))
             compiled += dt > 0
             seconds += dt
+        if self.spec_k > 1:
+            # the configured k is the one the live path runs; __init__
+            # pinned it to the SPEC_KS grid so this warm covers it
+            assert self.spec_k in SPEC_KS
+            dt = self._spec_fn.warmup(
+                params, pool_sds, sds((S, MP), i32), sds((S,), i32),
+                sds((S,), jnp.bool_), sds((S,), i32),
+                sds((S, self.spec_k - 1), i32))
+            compiled += dt > 0
+            seconds += dt
+        if self.prefix_sharing:
+            dt = self._cow_fn.warmup(pool_sds, sds((), i32),
+                                     sds((), i32))
+            compiled += dt > 0
+            seconds += dt
+            # a shared prefix can leave ANY suffix shorter than the
+            # prompt, so warm the downward closure of the reachable
+            # prompt buckets — admission order then never traces
+            top = max(buckets) if buckets else 16
+            sbuckets = sorted({prompt_bucket(t, self.max_context)
+                               for t in range(1, top + 1)})
+            for sb in sbuckets:
+                dt = self._suffix_fn(sb).warmup(
+                    params, pool_sds, sds((MP,), i32),
+                    sds((1, sb), i32), sds((), i32), sds((), i32),
+                    sds((), jnp.float32), sds((), jnp.float32),
+                    sds((), i32))
+                compiled += dt > 0
+                seconds += dt
         return {"compiled": int(compiled), "seconds": seconds,
-                "buckets": list(buckets)}
+                "buckets": list(buckets), "spec_k": self.spec_k}

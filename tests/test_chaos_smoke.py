@@ -65,3 +65,16 @@ def test_chaos_serving_plan_sheds_and_survives():
     assert gw["completed"] + gw["aborted"] == gw["requests"]
     assert gw["post_fault_completed"] == 3
     assert gw["pages_conserved"] is True
+    # and once more with CoW prefix sharing + speculative decode live:
+    # a mid-trace fault under refcounted shared pages must shed only
+    # the aborted sequences' refs (shared pages survive their
+    # siblings, the pool is conserved both after the shed and after a
+    # post-fault shared wave whose outputs are dense-identical)
+    cow = out["results"][2]
+    assert cow["mode"] == "serving-gateway-cow"
+    assert cow["faults_fired"] >= 1
+    assert cow["aborted"] > 0
+    assert cow["completed"] + cow["aborted"] == cow["requests"]
+    assert cow["prefix_hits"] >= 5 and cow["cow_copies"] >= 3
+    assert cow["post_fault_dense_identical"] == 3
+    assert cow["pages_conserved"] is True

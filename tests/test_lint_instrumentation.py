@@ -335,6 +335,108 @@ def test_lint_rule7_missing_feed_table(tmp_path):
     assert any("no WARMUP_FEEDS dict literal" in p for p in problems)
 
 
+def _spec_scheduler(tmp_path, text):
+    sdir = tmp_path / "pkg" / "serving"
+    sdir.mkdir(parents=True, exist_ok=True)
+    (sdir / "scheduler.py").write_text(text)
+    return tmp_path / "pkg"
+
+
+def test_lint_rule10_spec_builder_needs_grid_and_feed(tmp_path):
+    """Rule 10: a _build_spec* builder without a module-level SPEC_KS
+    tuple literal (nothing pins admissible draft widths to the warmed
+    k grid) and without a WARMUP_FEEDS entry is flagged on both
+    counts."""
+    pkg = _spec_scheduler(
+        tmp_path,
+        "WARMUP_FEEDS = {'_build_step_fn': 'feed'}\n"
+        "class S:\n"
+        "    def _build_step_fn(self):\n"
+        "        return None\n"
+        "    def _build_spec_step_fn(self):\n"
+        "        return None\n"
+        "    def warmup(self):\n"
+        "        return WARMUP_FEEDS\n")
+    problems = lint_instrumentation.run(pkg, tmp_path / "tests")
+    assert any("no module-level SPEC_KS tuple literal" in p
+               for p in problems)
+    assert any("_build_spec_step_fn" in p
+               and "outside the warmup table" in p for p in problems)
+
+
+def test_lint_rule10_warmup_must_walk_spec_grid(tmp_path):
+    """Rule 10: SPEC_KS exists and the builder is fed, but warmup()
+    never references the grid — the warmed spec signatures and the
+    admissible widths can silently drift apart."""
+    pkg = _spec_scheduler(
+        tmp_path,
+        "SPEC_KS = (2, 4)\n"
+        "WARMUP_FEEDS = {'_build_spec_step_fn': 'feed'}\n"
+        "class S:\n"
+        "    def _build_spec_step_fn(self):\n"
+        "        return None\n"
+        "    def warmup(self):\n"
+        "        return WARMUP_FEEDS\n")
+    problems = lint_instrumentation.run(pkg, tmp_path / "tests")
+    assert any("warmup() never references SPEC_KS" in p
+               for p in problems)
+
+
+# the real scheduler's rule-8 SCOPE_SITES entries apply to any tree
+# carrying serving/scheduler.py, so the clean synthetic module must
+# define all three annotation points with devtime scopes
+_CLEAN_SPEC_SCHEDULER = (
+    "SPEC_KS = (2, 4, 8)\n"
+    "WARMUP_FEEDS = {'_build_spec_step_fn': 'feed'}\n"
+    "class S:\n"
+    "    def _build_step_fn(self):\n"
+    "        return devtime.scope('serve.decode')\n"
+    "    def _build_spec_step_fn(self):\n"
+    "        return devtime.scope('serve.spec')\n"
+    "    def _build_suffix_admit_fn(self):\n"
+    "        return devtime.scope('serve.admit')\n"
+    "    def warmup(self):\n"
+    "        for k in SPEC_KS:\n"
+    "            pass\n"
+    "        return WARMUP_FEEDS\n")
+
+
+def test_lint_rule10_clean_scheduler_passes(tmp_path):
+    pkg = _spec_scheduler(tmp_path, _CLEAN_SPEC_SCHEDULER)
+    assert not lint_instrumentation.run(pkg, tmp_path / "tests")
+
+
+def test_lint_rule10_consumer_spec_tokens(tmp_path):
+    """Rule 10 consumer side: a spec/prefix family token in
+    tpu_watch/OPS.md that matches no FAMILIES entry is flagged with
+    the spec-decode message, and a consumer that watches prefix
+    families but no dl4j_tpu_serving_spec_* family leaves the accept
+    rate without a dashboard/runbook surface."""
+    pkg, tools_dir, docs_dir = _metrics_tree(
+        tmp_path,
+        families={"dl4j_tpu_serving_spec_accept_rate": "histogram",
+                  "dl4j_tpu_serving_prefix_hits_total": "counter"},
+        body='H = REGISTRY.histogram('
+             '"dl4j_tpu_serving_spec_accept_rate", "d")\n'
+             'C = REGISTRY.counter('
+             '"dl4j_tpu_serving_prefix_hits_total", "d")\n',
+        watch='KEYS = ("dl4j_tpu_serving_spec_accept_rate",\n'
+              '        "dl4j_tpu_serving_spec_ghost_total")\n',
+        ops="Watch `dl4j_tpu_serving_prefix_hits_total` only.\n")
+    _spec_scheduler(tmp_path, _CLEAN_SPEC_SCHEDULER)
+    problems = lint_instrumentation.run(pkg, tmp_path / "tests",
+                                        tools_dir, docs_dir)
+    assert any("tpu_watch" in p
+               and "dl4j_tpu_serving_spec_ghost_total" in p
+               and "spec-decode metric" in p for p in problems)
+    assert any("OPS.md" in p
+               and "no dl4j_tpu_serving_spec_* family" in p
+               for p in problems)
+    assert not any("tpu_watch" in p
+                   and "no dl4j_tpu_serving_spec_* family" in p
+                   for p in problems)
+
+
 def test_lint_rule8_missing_scope_annotation(tmp_path):
     """Rule 8: a SCOPE_SITES function stripped of its devtime.scope /
     named_scope call fails the lint — attribution would silently lose

@@ -294,6 +294,85 @@ def _gateway_scenario(plan_name: str) -> dict:
             "wall_s": round(wall, 2), "ok": bool(ok)}
 
 
+def _gateway_cow_scenario(plan_name: str) -> dict:
+    """Gateway drill under copy-on-write prefix sharing + speculative
+    decode (ISSUE 16 satellite): a serving-site fault takes a decode
+    iteration while sibling sequences share refcounted pages. The
+    fence: aborted sequences release only their OWN refs (the donor
+    retiring early must not free pages its siblings still read, and a
+    mid-flight shed must not leak or double-free a shared page), the
+    pool comes back conserved with invariants clean, and the same
+    worker then serves a fresh shared wave whose outputs match the
+    dense ``generate()`` token-for-token."""
+    from deeplearning4j_tpu.obs import metrics
+    from deeplearning4j_tpu.resilience import faults
+    from deeplearning4j_tpu.serving import SequenceAborted, ServingGateway
+    from deeplearning4j_tpu.zoo import GPTNano
+
+    model = GPTNano(vocab_size=64, max_len=64, seed=7)
+    net = model.init()
+    gw = ServingGateway(model, net, max_slots=4, block=8,
+                        max_context=64, queue_limit=32,
+                        default_max_new=24, spec_k=2,
+                        prefix_sharing=True)
+    # every prompt in the drill is the 12-token base (bucket 16) and
+    # the suffix warmup closes downward on its own — more admit
+    # buckets would only add fresh-model compile time to the smoke
+    gw.warmup(prompt_lens=(12,))
+    rng = np.random.RandomState(3)
+    base = rng.randint(0, 64, 12).astype(np.int32)
+    hits0 = metrics.SERVING_PREFIX_HITS.snapshot().get("", 0)
+    cow0 = metrics.SERVING_PREFIX_COW.snapshot().get("", 0)
+    completed = aborted = 0
+    t0 = time.perf_counter()
+    with faults.active(plan_name):
+        # park the worker so the whole wave admits in ONE sweep: the
+        # donor registers the prefix chain and every sibling adopts
+        # its pages (tail CoW) before the first — faultable — step
+        gw.pause()
+        wave = [gw.submit(base, max_new=2)]          # donor: retires
+        wave += [gw.submit(base, max_new=24)          # early, sharers
+                 for _ in range(3)]                   # decode on
+        gw.resume()
+        for ob in wave:
+            try:
+                ob.result(timeout=60)
+                completed += 1
+            except SequenceAborted:
+                aborted += 1
+        fired = sum(s["fires"] for s in faults.stats().values())
+    gw._sched.pager.check_invariants()
+    pages_whole = (gw._sched.pager.free_pages()
+                   == gw._sched.pager.n_pages - 1)
+    # post-fault: same worker, fresh shared wave, dense-identical out
+    dense = np.asarray(model.generate(net, base[None], n_new=8))[0]
+    gw.pause()
+    post = [gw.submit(base, max_new=8) for _ in range(3)]
+    gw.resume()
+    post_ok = sum(
+        bool(np.array_equal(np.asarray(ob.result(timeout=60)), dense))
+        for ob in post)
+    gw._sched.pager.check_invariants()
+    pages_whole &= (gw._sched.pager.free_pages()
+                    == gw._sched.pager.n_pages - 1)
+    hits = metrics.SERVING_PREFIX_HITS.snapshot().get("", 0) - hits0
+    cows = metrics.SERVING_PREFIX_COW.snapshot().get("", 0) - cow0
+    gw.shutdown()
+    wall = time.perf_counter() - t0
+    # 3 wave siblings + >=2 post siblings adopt the donor chain; each
+    # whole-prompt adoption clones the tail page before writing it
+    ok = (fired > 0 and aborted > 0 and completed + aborted == 4
+          and post_ok == 3 and pages_whole and hits >= 5
+          and cows >= 3 and wall < 60.0)
+    return {"mode": "serving-gateway-cow", "plan": plan_name,
+            "requests": 4, "completed": completed, "aborted": aborted,
+            "post_fault_dense_identical": post_ok,
+            "pages_conserved": pages_whole,
+            "prefix_hits": int(hits), "cow_copies": int(cows),
+            "faults_fired": fired, "worker_survived": True,
+            "wall_s": round(wall, 2), "ok": bool(ok)}
+
+
 # ---------------------------------------------------------------------------
 # elastic multi-host drill (resilience/elastic.py on tests/mp_harness.py)
 # ---------------------------------------------------------------------------
@@ -728,12 +807,14 @@ def main() -> int:
             results.append(
                 _example_scenario(args.example, spec, args.restarts))
         elif any(r.site.startswith("serving") for r in parsed.rules):
-            # serving plans drill BOTH front ends: the batched
-            # ParallelInference queue and the continuous-batching
-            # gateway (each parses the plan fresh -> independent rule
-            # state, the nth/max counters start over)
+            # serving plans drill all three front-end postures: the
+            # batched ParallelInference queue, the continuous-batching
+            # gateway, and the gateway with CoW prefix sharing +
+            # speculative decode live (each parses the plan fresh ->
+            # independent rule state, the nth/max counters start over)
             results.append(_serving_scenario(plan))
             results.append(_gateway_scenario(plan))
+            results.append(_gateway_cow_scenario(plan))
         elif any(r.site.startswith(("host_death", "coordinator"))
                  for r in parsed.rules):
             results.append(_elastic_preempt_scenario(

@@ -413,6 +413,78 @@ reservations — one tenant pinning the pool starves the rest; the
 """
 
 # hand-maintained operations doc, re-emitted on every regeneration
+# (ISSUE 16 satellite: the spec-decode + prefix-sharing runbook lives
+# in docs/OPS.md next to the serving runbook it extends)
+SPEC_DECODE_OPS_SECTION = """
+## Speculative decode + prefix sharing (serving/)
+
+Two opt-in gateway features (ARCHITECTURE.md §18) that attack the
+serving cost from both ends — admission (copy-on-write prefix
+sharing: requests repeating a known prefix adopt its pages and
+prefill only the novel suffix) and steady-state decode (self-
+speculative multi-token steps: k-1 host-drafted tokens verified in
+one fixed-shape forward, the agreeing prefix accepted):
+
+    gw = ServingGateway(model, net, max_slots=16, block=16,
+                        spec_k=4, prefix_sharing=True)
+    gw.warmup()    # + per-k spec step, CoW copy, suffix buckets
+
+**The k grid.** `spec_k` must come from `scheduler.SPEC_KS` (the
+constructor rejects off-grid widths): warmup AOT-compiles one spec
+executable per configured k plus the downward closure of suffix
+prefill buckets, so ANY admission order — fresh prompt, whole-prompt
+repeat, partial-prefix extension — stays retrace-free. Lint rule 10
+(`tools/lint_instrumentation.py`) holds the builder set, the
+`WARMUP_FEEDS` table, and `SPEC_KS` in lockstep, and fails CI when a
+`dl4j_tpu_serving_spec_*` family loses its dashboard/runbook surface.
+
+**Watch the accept rate.** `dl4j_tpu_serving_spec_accept_rate`
+(per-step histogram of accepted/(k-1)) is the feature's health
+number: tokens/step = `1 + accept_rate * (k-1)`, so a rate pinned
+near 0 means the verify rows are pure overhead — lower k or turn
+spec off for that workload. The cumulative pair
+`dl4j_tpu_serving_spec_accepted_total` /
+`dl4j_tpu_serving_spec_drafted_total` gives the same ratio across a
+whole deployment window (`tpu_watch`'s serving view renders it as
+`spec_accept_rate`). Greedy only: the gateway refuses
+`sample=True` + spec, because the accept rule compares argmax.
+
+**Watch the sharing win.** `dl4j_tpu_serving_prefix_hits_total` over
+`dl4j_tpu_serving_requests_total` is the admission hit rate;
+`dl4j_tpu_serving_prefix_prefill_tokens_saved_total` is the prefill
+work sharing deleted (the TTFT win is proportional);
+`dl4j_tpu_serving_prefix_shared_pages` gauges how much of the pool is
+multi-referenced right now, and
+`dl4j_tpu_serving_prefix_cow_copies_total` counts tail-page clones —
+a high CoW rate with a low hit rate means prompts share page-aligned
+prefixes rarely (raise the system-prompt length, or align it to
+`block`).
+
+**Acceptance measurement.** The shared-system-prompt A/B (baseline
+gateway vs spec+sharing on the same weight-read-bound CPU smoke LM):
+
+    python tools/serving_trace.py --shared-prefix
+
+reports TTFT and tokens/sec speedups beside prefix-hit rate, prefill
+tokens saved, and the accept rate; the dossier's `spec_decode` row
+records the same report via the forced-CPU subprocess protocol.
+Custom traces: `--prefix-sharing --spec-k 4` on any
+`tools/serving_trace.py` run.
+
+**Fault posture.** Refcounted pages keep the shed contract exact: an
+aborted sequence drops only its OWN refs — shared pages survive for
+their siblings, and the pager's `check_invariants()` machine-checks
+refcount conservation (no free-while-referenced, no leak) after
+every transition. Drill it:
+
+    python tools/chaos.py --plan serving-crash
+
+runs the gateway with CoW sharing + spec decode live, faults a step
+mid-trace, and asserts page conservation plus a dense-identical
+post-fault shared wave.
+"""
+
+# hand-maintained operations doc, re-emitted on every regeneration
 # (ISSUE 14 satellite: the Pallas-gap-naming runbook lives in
 # docs/OPS.md next to the other runbooks)
 DEVTIME_OPS_SECTION = """
@@ -666,6 +738,7 @@ def main():
                  "", ELASTIC_OPS_SECTION.strip(),
                  "", FLEET_OPS_SECTION.strip(),
                  "", SERVING_OPS_SECTION.strip(),
+                 "", SPEC_DECODE_OPS_SECTION.strip(),
                  "", DEVTIME_OPS_SECTION.strip(),
                  "", FUSED_OPS_SECTION.strip()]
     ops_out = os.path.join(os.path.dirname(out), "OPS.md")

@@ -1,7 +1,7 @@
 """Instrumentation lint — the telemetry spine's CI fence (tier-1 via
 ``tests/test_lint_instrumentation.py``).
 
-Nine AST rules over ``deeplearning4j_tpu/``:
+Ten AST rules over ``deeplearning4j_tpu/``:
 
 1. **Every ``sentry.jit``-wrapped hot path emits obs telemetry.** A
    module that builds jitted entry points with ``sentry.jit(...)`` is
@@ -111,6 +111,23 @@ Nine AST rules over ``deeplearning4j_tpu/``:
    table-driven fence that keeps rules 4/7/8 honest, in both
    directions (no unregistered kernels, no stale registry entries).
 
+10. **The speculative-decode grid stays warmable and observable.**
+    The serving scheduler's spec-decode entry points compile one
+    executable per draft width ``k`` — if ``serving/scheduler.py``
+    defines any ``_build_spec*`` builder it must also define the
+    module-level ``SPEC_KS`` tuple literal (the supported k grid the
+    constructor pins requests to), list the builder in
+    ``WARMUP_FEEDS`` (rule 7's table), and ``warmup()`` must reference
+    ``SPEC_KS`` so the warmed signatures and the admissible widths
+    cannot drift apart (an off-grid k would cold-trace mid-traffic —
+    exactly the stall the zero-retrace fence exists to prevent). On
+    the consumer side every ``dl4j_tpu_serving_spec_*`` /
+    ``dl4j_tpu_serving_prefix_*`` token in ``tools/tpu_watch.py`` and
+    ``docs/OPS.md`` must resolve against the FAMILIES table, and each
+    consumer must reference at least one ``dl4j_tpu_serving_spec_*``
+    family — a spec-decode rollout whose accept rate no dashboard or
+    runbook watches regresses silently.
+
 Exit status 0 = clean; 1 = violations (printed one per line).
 """
 from __future__ import annotations
@@ -178,7 +195,8 @@ SCOPE_SITES = {
     "nn/multilayer.py": ("_forward",),
     "nn/graph.py": ("_forward",),
     "zoo/gpt.py": ("_token_logits", "_prefill_forward"),
-    "serving/scheduler.py": ("_build_step_fn",),
+    "serving/scheduler.py": ("_build_step_fn", "_build_spec_step_fn",
+                             "_build_suffix_admit_fn"),
     "parallel/zero.py": ("scatter_mean", "gather"),
     "ops/pallas_kernels.py": ("flash_attention", "flash_block_fwd",
                               "flash_block_bwd", "threshold_encode",
@@ -650,6 +668,110 @@ def _lint_serving_jits(package_dir: Path) -> List[str]:
     return problems
 
 
+# rule 10: the spec-decode scheduler module and the metric-family
+# prefixes its dashboard/runbook coverage is checked under
+SCHEDULER_PATH = "serving/scheduler.py"
+SPEC_FAMILY_PREFIXES = ("dl4j_tpu_serving_spec_",
+                        "dl4j_tpu_serving_prefix_")
+
+
+def _lint_spec_decode(package_dir: Path,
+                      tools_dir: Optional[Path],
+                      docs_dir: Optional[Path]) -> List[str]:
+    """Rule 10: any ``_build_spec*`` builder in the serving scheduler
+    implies a module-level ``SPEC_KS`` tuple literal (the admissible
+    draft-width grid), a ``WARMUP_FEEDS`` entry for the builder, and a
+    ``warmup()`` that references ``SPEC_KS`` — the warmed (k, bucket)
+    signatures and the widths the constructor admits must come from
+    the same table. Consumer side: spec/prefix family tokens in
+    tpu_watch/OPS.md resolve, and each consumer watches at least one
+    ``dl4j_tpu_serving_spec_*`` family."""
+    sched = package_dir / SCHEDULER_PATH
+    if not sched.is_file():
+        return []
+    try:
+        tree = ast.parse(sched.read_text())
+    except SyntaxError:
+        return []                   # rule-agnostic: lint_file reports it
+    problems: List[str] = []
+    spec_builders = set()
+    warmup_refs_grid = False
+    feeds = None
+    spec_ks: Optional[set] = None
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name.startswith("_build_spec"):
+                spec_builders.add(node.name)
+            elif node.name == "warmup":
+                warmup_refs_grid = warmup_refs_grid or any(
+                    isinstance(n, ast.Name) and n.id == "SPEC_KS"
+                    for n in ast.walk(node))
+        elif isinstance(node, ast.Assign):
+            names = {t.id for t in node.targets
+                     if isinstance(t, ast.Name)}
+            if "SPEC_KS" in names and isinstance(
+                    node.value, (ast.Tuple, ast.List)):
+                spec_ks = {e.value for e in node.value.elts
+                           if isinstance(e, ast.Constant)
+                           and isinstance(e.value, int)}
+            if "WARMUP_FEEDS" in names and isinstance(node.value,
+                                                      ast.Dict):
+                feeds = {k.value for k in node.value.keys
+                         if isinstance(k, ast.Constant)
+                         and isinstance(k.value, str)}
+    if not spec_builders:
+        return problems
+    if not spec_ks:
+        problems.append(
+            f"{SCHEDULER_PATH}: has spec-decode builders "
+            f"({', '.join(sorted(spec_builders))}) but no module-"
+            "level SPEC_KS tuple literal — nothing pins admissible "
+            "draft widths to the warmed k grid, so an arbitrary k "
+            "cold-traces on its first live step")
+    if feeds is not None:
+        for b in sorted(spec_builders - feeds):
+            problems.append(
+                f"{SCHEDULER_PATH}: spec builder {b} has no "
+                "WARMUP_FEEDS entry — its per-k executables are "
+                "outside the warmup table and every configured k "
+                "cold-traces mid-traffic")
+    if spec_ks and not warmup_refs_grid:
+        problems.append(
+            f"{SCHEDULER_PATH}: warmup() never references SPEC_KS — "
+            "the warmed spec signatures and the constructor's "
+            "admissible k grid can silently drift apart")
+    families = _parse_families(package_dir / METRICS_PATH)
+    if families is None:
+        return problems
+    consumers = []
+    if tools_dir is not None and (Path(tools_dir)
+                                  / "tpu_watch.py").is_file():
+        consumers.append(("tools/tpu_watch.py",
+                          (Path(tools_dir) / "tpu_watch.py")
+                          .read_text()))
+    if docs_dir is not None and (Path(docs_dir) / "OPS.md").is_file():
+        consumers.append(("docs/OPS.md",
+                          (Path(docs_dir) / "OPS.md").read_text()))
+    for label, text in consumers:
+        tokens = sorted({t for t in _family_tokens(text)
+                         if t.startswith(SPEC_FAMILY_PREFIXES)})
+        for token in tokens:
+            if not _resolve_family(token, families):
+                problems.append(
+                    f"{label}: references {token!r} which matches no "
+                    f"family in {METRICS_PATH} FAMILIES — the "
+                    "dashboard/runbook watches a spec-decode metric "
+                    "the code does not emit")
+        if not any(t.startswith("dl4j_tpu_serving_spec_")
+                   for t in tokens):
+            problems.append(
+                f"{label}: no dl4j_tpu_serving_spec_* family "
+                "referenced — the speculative-decode accept rate has "
+                "no dashboard/runbook surface, so a draft-quality "
+                "regression lands unwatched")
+    return problems
+
+
 _GAP_TOKEN_RE = None
 
 
@@ -973,6 +1095,8 @@ def run(package_dir: Path = PACKAGE,
     problems.extend(_lint_metric_families(package_dir, tools_dir,
                                           docs_dir))
     problems.extend(_lint_serving_jits(package_dir))
+    problems.extend(_lint_spec_decode(package_dir, tools_dir,
+                                      docs_dir))
     problems.extend(_lint_devtime_scopes(package_dir, tools_dir,
                                          docs_dir))
     problems.extend(_lint_kernel_registry(package_dir, tests_dir))

@@ -823,6 +823,14 @@ def main(names):
     from deeplearning4j_tpu.serving import loadgen
     payload.append({"config": "continuous_batching",
                     **loadgen.subprocess_report(), "smoke": SMOKE})
+    # speculative decode + copy-on-write prefix sharing (serving/):
+    # baseline gateway vs spec_k=4 + prefix_sharing on the shared-
+    # system-prompt trace — TTFT and tokens/sec speedups, prefix-hit
+    # rate, prefill tokens saved, accept rate. Same forced-CPU
+    # subprocess protocol as the continuous_batching row.
+    payload.append({"config": "spec_decode",
+                    **loadgen.subprocess_report(
+                        report="shared-prefix"), "smoke": SMOKE})
     if out_path:
         Path(out_path).write_text(json.dumps(payload, indent=1))
     if SMOKE:

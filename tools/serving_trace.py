@@ -10,9 +10,17 @@ so a run with ``DL4J_TPU_METRICS_PORT`` set is scrapeable (and
 ``tools/tpu_watch.py`` renders a ``serving`` view per sample).
 
     python tools/serving_trace.py --smoke                 # CPU wiring run
+    python tools/serving_trace.py --shared-prefix         # CoW + spec preset
     python tools/serving_trace.py --mode open --rate 200 \\
         --requests 256 --tenants 4 --slots 16             # open-loop sweep
     python tools/serving_trace.py --mode closed --clients 32 --baseline
+    python tools/serving_trace.py --mode burst --prefix-sharing \\
+        --spec-k 4                                        # custom shared run
+
+The ``--shared-prefix`` preset runs ``loadgen.shared_prefix_report``:
+one long system prompt shared across tenants, baseline gateway vs the
+prefix-sharing + speculative-decode gateway, reporting prefix-hit
+rate and prefill tokens saved beside the TTFT/tokens-sec speedups.
 
 Exit status 0; one JSON report on stdout (last line).
 """
@@ -41,6 +49,13 @@ def main() -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="the bench/dossier CPU smoke row "
                          "(loadgen.smoke_report) and exit")
+    ap.add_argument("--shared-prefix", action="store_true",
+                    help="the spec-decode + prefix-sharing acceptance "
+                         "row (loadgen.shared_prefix_report) and exit")
+    ap.add_argument("--spec-k", type=int, default=1,
+                    help="speculative decode width (1 = single-token)")
+    ap.add_argument("--prefix-sharing", action="store_true",
+                    help="enable copy-on-write prefix sharing")
     ap.add_argument("--mode", choices=("open", "closed", "burst"),
                     default="closed")
     ap.add_argument("--rate", type=float, default=100.0,
@@ -70,6 +85,9 @@ def main() -> int:
     if args.smoke:
         print(json.dumps(loadgen.smoke_report()))
         return 0
+    if args.shared_prefix:
+        print(json.dumps(loadgen.shared_prefix_report()))
+        return 0
 
     if args.model == "mini":
         model = GPTMini(compute_dtype=None)
@@ -90,7 +108,9 @@ def main() -> int:
         vocab_size=model.vocab_size, seed=args.seed)
 
     report = {"model": args.model, "slots": args.slots,
-              "block": args.block, "max_context": mc}
+              "block": args.block, "max_context": mc,
+              "spec_k": args.spec_k,
+              "prefix_sharing": args.prefix_sharing}
     if args.baseline:
         # full warm pass first: every prompt BUCKET must compile
         # before the timed run, or cold jits deflate the baseline and
@@ -103,7 +123,9 @@ def main() -> int:
                         block=args.block,
                         n_pages=args.pages or None, max_context=mc,
                         queue_limit=args.queue_limit,
-                        default_max_new=args.max_new)
+                        default_max_new=args.max_new,
+                        spec_k=args.spec_k,
+                        prefix_sharing=args.prefix_sharing)
     report["warmup"] = gw.warmup(prompt_lens=range(1, hi + 1))
     stats = loadgen.run_trace(gw, requests, mode=args.mode,
                               rate=args.rate, clients=args.clients,

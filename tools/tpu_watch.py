@@ -224,6 +224,28 @@ def _serving_view(fams) -> dict:
             if n == "dl4j_tpu_serving_requests_shed_total" and v > 0}
     if shed:
         view["SHED"] = shed
+    # speculative decode: live accept rate from the cumulative
+    # drafted/accepted counters (dl4j_tpu_serving_spec_accept_rate is
+    # the per-step histogram; the counter ratio is the cheap scrape-
+    # time aggregate)
+    drafted = val("dl4j_tpu_serving_spec_drafted_total")
+    if drafted:
+        accepted = val("dl4j_tpu_serving_spec_accepted_total", 0)
+        view["spec_drafted"] = int(drafted)
+        view["spec_accept_rate"] = round(accepted / drafted, 4)
+    # copy-on-write prefix sharing: admission hits, prefill tokens the
+    # shared pages saved, pages currently multi-referenced, CoW clones
+    hits = val("dl4j_tpu_serving_prefix_hits_total")
+    if hits:
+        view["prefix_hits"] = int(hits)
+        view["prefix_tokens_saved"] = int(
+            val("dl4j_tpu_serving_prefix_prefill_tokens_saved_total",
+                0))
+        view["prefix_cow_copies"] = int(
+            val("dl4j_tpu_serving_prefix_cow_copies_total", 0))
+    shared = val("dl4j_tpu_serving_prefix_shared_pages")
+    if shared:
+        view["prefix_shared_pages"] = int(shared)
     return view
 
 

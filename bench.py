@@ -106,6 +106,13 @@ def _run_bench_child():
     # Mosaic-lowered code honors on TPU.
     from deeplearning4j_tpu.ops import fused_norms
     parsed["fused_kernels"] = fused_norms.subprocess_report()
+    # communication observatory (obs/commtime.py): the ZeRO sharded
+    # step's per-scope wire ledger gated against the PR 5 HLO byte
+    # model (reduce-scatter ≈ grad_bytes/N, all-gather ≈ param
+    # bytes), plus the off-path fence numbers. Own forced-CPU
+    # subprocess like zero_dp.
+    from deeplearning4j_tpu.obs import commtime
+    parsed["comm"] = commtime.subprocess_report()
     print(json.dumps(parsed))
 
 

@@ -157,6 +157,23 @@ _register("DL4J_TPU_PEAK_TFLOPS", 197.0, float,
 _register("DL4J_TPU_PEAK_HBM_GBS", 819.0, float,
           "roofline memory peak in GB/s (default: v5e HBM)")
 
+# -- communication observatory (obs/commtime.py) ---------------------------
+_register("DL4J_TPU_COMMTIME", "", str,
+          "communication observatory (obs/commtime.py): '' off (the "
+          "fit loops pay one branch); truthy installs the cadence "
+          "monitor — every DL4J_TPU_COMMTIME_EVERY-th step opens a "
+          "short jax.profiler.trace window, attributes collective "
+          "device time + static HLO wire bytes to the named_scope'd "
+          "phases, and publishes dl4j_tpu_comm_* gauges")
+_register("DL4J_TPU_COMMTIME_EVERY", 100, int,
+          "comm capture-window cadence in fit iterations")
+_register("DL4J_TPU_COMMTIME_STEPS", 3, int,
+          "fit steps each comm capture window stays open for")
+_register("DL4J_TPU_PEAK_ICI_GBS", 45.0, float,
+          "interconnect roofline peak in GB/s per link direction "
+          "(default: v5e ICI; the denominator of commtime's link "
+          "utilization — CPU/gloo captures are estimate-only)")
+
 # -- fleet observability plane (obs/fleet.py) ------------------------------
 _register("DL4J_TPU_FLEET_PUBLISH_SECS", 1.0, float,
           "telemetry-snapshot publish cadence: each elastic host "
@@ -220,6 +237,11 @@ def apply_startup_flags() -> None:
     if os.environ.get("DL4J_TPU_DEVTIME", "").strip():
         from deeplearning4j_tpu.obs import devtime as obs_devtime
         obs_devtime.configure_from_env()
+    # communication observatory: same raw-env gate — unset leaves the
+    # fit-loop comm hooks on the one-branch monitor-is-None path
+    if os.environ.get("DL4J_TPU_COMMTIME", "").strip():
+        from deeplearning4j_tpu.obs import commtime as obs_commtime
+        obs_commtime.configure_from_env()
     # fault injection: gate on the raw env so the unset path never
     # imports the resilience package at startup
     if os.environ.get("DL4J_TPU_FAULT_PLAN", "").strip():

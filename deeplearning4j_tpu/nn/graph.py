@@ -713,9 +713,11 @@ class ComputationGraph:
         if nm is not None and nm.due(self.iteration):
             return self._fit_batch_diag(inputs, labels, masks, lmasks,
                                         t0)
-        # devtime capture window (obs/devtime.py): off path is one
-        # module-global branch inside the hook
+        # devtime + commtime capture windows (obs/devtime.py,
+        # obs/commtime.py): off path is one module-global branch
+        # inside each hook
         obs.devtime.step_started(self.iteration)
+        obs.commtime.step_started(self.iteration)
         rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed),
                                  self.iteration)
         t1 = obs.now()
@@ -725,6 +727,7 @@ class ComputationGraph:
         t2 = obs.now()
         self.score_ = float(loss)     # blocking device sync
         obs.devtime.step_ended(self._train_step_fn)
+        obs.commtime.step_ended(self._train_step_fn)
         obs.record_step("ComputationGraph.fit", t0, t1, t2, obs.now())
         self.iteration += 1
         if nm is not None:

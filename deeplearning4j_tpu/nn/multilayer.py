@@ -480,6 +480,7 @@ class MultiLayerNetwork:
         if self._train_loop_fn is None:
             self._train_loop_fn = self._make_train_loop()
         obs.devtime.step_started(self.iteration)
+        obs.commtime.step_started(self.iteration)
         xs = jnp.stack([jnp.asarray(np.asarray(x)) for x, _ in group])
         ys = jnp.stack([jnp.asarray(np.asarray(y)) for _, y in group])
         base = jax.random.PRNGKey(self.conf.seed)
@@ -505,6 +506,7 @@ class MultiLayerNetwork:
         losses = np.asarray(losses)   # blocking device sync
         t3 = obs.now()
         obs.devtime.step_ended(self._train_loop_fn)
+        obs.commtime.step_ended(self._train_loop_fn)
         obs.record_step("MultiLayerNetwork.fit", t0, t1, t2, t3,
                         args={"steps": len(group)})
         tl0 = obs.now()
@@ -603,9 +605,11 @@ class MultiLayerNetwork:
             return self._fit_batch_diag(x, y, fmask, lmask, t0)
         if self._train_step_fn is None:
             self._train_step_fn = self._make_train_step()
-        # devtime capture window (obs/devtime.py): off path is one
-        # module-global branch inside the hook
+        # devtime + commtime capture windows (obs/devtime.py,
+        # obs/commtime.py): off path is one module-global branch
+        # inside each hook
         obs.devtime.step_started(self.iteration)
+        obs.commtime.step_started(self.iteration)
         rng = jax.random.fold_in(jax.random.PRNGKey(self.conf.seed),
                                  self.iteration)
         t1 = obs.now()
@@ -616,6 +620,7 @@ class MultiLayerNetwork:
             t2 = obs.now()
             self.score_ = float(loss)   # blocking device sync
             obs.devtime.step_ended(self._train_step_fn)
+            obs.commtime.step_ended(self._train_step_fn)
         except Exception as e:       # HBM OOM → diagnostic dump
             from deeplearning4j_tpu.utils import crashreport
             if crashreport.is_oom(e):

@@ -26,6 +26,10 @@ per-tool private formats) with one layer (ARCHITECTURE.md §9):
   ``named_scope``-annotated programs' HLO into per-scope device-time
   totals, roofline utilization, and the Pallas-gap report
   (ARCHITECTURE.md §16).
+- :mod:`~deeplearning4j_tpu.obs.commtime` — the comm sibling: a
+  static per-collective wire ledger for any compiled program plus
+  per-scope collective device time and interconnect-roofline
+  utilization from the same capture pipeline (ARCHITECTURE.md §19).
 - :func:`report` — the merged JSON snapshot consumed by
   ``StatsListener`` records, ``bench.py``'s ``obs`` section,
   ``tools/perf_dossier.py``, and ``utils/crashreport.py``.
@@ -41,6 +45,7 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 from deeplearning4j_tpu.obs import devtime as devtime
+from deeplearning4j_tpu.obs import commtime as commtime
 from deeplearning4j_tpu.obs import health as health
 from deeplearning4j_tpu.obs import metrics as metrics
 from deeplearning4j_tpu.obs import numerics as numerics
@@ -156,6 +161,7 @@ def snapshot() -> Dict[str, Any]:
 
 
 __all__ = ["trace", "metrics", "health", "numerics", "fleet",
-           "devtime", "span", "now", "record_step", "record_etl",
+           "devtime", "commtime", "span", "now", "record_step",
+           "record_etl",
            "record_worker_step", "summary", "report",
            "overhead_report", "snapshot"]

@@ -584,6 +584,26 @@ def _op_class(op: str) -> str:
     return m.group(1) if m else op
 
 
+#: the five HLO collective opcodes — the comm axis of the gap report
+#: and the event filter of ``obs/commtime.py`` (which layers the wire
+#: ledger + interconnect roofline on top of this classification)
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all")
+
+_COLLECTIVE_RE = re.compile(
+    r"^(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?:-start)?(?:\.\d+)?$")
+
+
+def collective_kind(op_or_kind: str) -> Optional[str]:
+    """Base collective kind of an HLO op name/opcode, or None. The
+    async ``-start`` form classifies (its device event carries the
+    transfer duration); ``-done`` does not (a sync point — counting
+    both would double-book every async collective)."""
+    m = _COLLECTIVE_RE.match(op_or_kind)
+    return m.group(1) if m else None
+
+
 def attribute(paths: Iterable[str],
               maps: Optional[Dict[str, Any]] = None,
               peaks: Optional[Tuple[float, float]] = None
@@ -634,6 +654,7 @@ def attribute(paths: Iterable[str],
                 e = scopes[key] = {
                     "device_ns": 0.0, "ops": 0, "fusions": 0,
                     "backward_ns": 0.0, "custom_call_ns": 0.0,
+                    "collective_ns": 0.0,
                     "flops": 0.0, "bytes": 0.0, "kinds": {}}
             dur = ev["dur_ns"]
             total_ns += dur
@@ -650,6 +671,8 @@ def attribute(paths: Iterable[str],
                 e["fusions"] += 1
             if "custom-call" in kind or "custom-call" in ev["op"]:
                 e["custom_call_ns"] += dur
+            if collective_kind(kind) or collective_kind(ev["op"]):
+                e["collective_ns"] += dur
             if info is not None:
                 e["flops"] += info["flops"]
                 e["bytes"] += info["bytes"]
@@ -667,6 +690,7 @@ def attribute(paths: Iterable[str],
             "ops": e["ops"], "fusions": e["fusions"],
             "backward_ms": round(e["backward_ns"] / 1e6, 6),
             "custom_call_ms": round(e["custom_call_ns"] / 1e6, 6),
+            "comm_ms": round(e["collective_ns"] / 1e6, 6),
             "flops": e["flops"], "bytes": e["bytes"],
             "kinds": dict(sorted(e["kinds"].items(),
                                  key=lambda kv: -kv[1])),
@@ -725,9 +749,17 @@ def attribute(paths: Iterable[str],
 #: (``ops/kernel_registry.py``) this scope now dispatches to, or None
 #: while the gap is open — a closed scope is never a candidate and its
 #: ``dl4j_tpu_devtime_scope_pallas_candidate`` gauge reads 0.
+#: ``comm_ms`` (ISSUE 17): device time the scope spent inside
+#: collective ops — when it dominates, ``bound`` reads ``"wire"`` (the
+#: interconnect, not a kernel, is the ceiling) and the scope is never
+#: a Pallas candidate.
 GAP_KEYS = ("scope", "device_ms", "share", "ops", "fusions",
-            "backward_ms", "flops", "bytes", "utilization", "bound",
-            "pallas_candidate", "closed_by")
+            "backward_ms", "comm_ms", "flops", "bytes", "utilization",
+            "bound", "pallas_candidate", "closed_by")
+
+#: a scope whose collective time exceeds this fraction of its device
+#: time is wire-bound (the gap report + commtime WIRE_BOUND alarm)
+WIRE_BOUND_SHARE = 0.5
 
 
 def _is_pallas_candidate(share: float, util: Optional[float],
@@ -758,6 +790,13 @@ def gap_report(capture_: Dict[str, Any], top: int = 12
         rl = e.get("roofline")
         util = rl["utilization"] if rl else None
         bound = rl["bound"] if rl else "unknown"
+        comm_ms = e.get("comm_ms", 0.0)
+        # the comm axis: collective-dominated scopes are WIRE-bound —
+        # the interconnect is the ceiling, so no kernel closes them
+        wire = (e["device_ms"] > 0
+                and comm_ms > WIRE_BOUND_SHARE * e["device_ms"])
+        if wire:
+            bound = "wire"
         closed = kernel_registry.closed_by(name)
         rows.append({
             "scope": name,
@@ -766,11 +805,13 @@ def gap_report(capture_: Dict[str, Any], top: int = 12
             "ops": e["ops"],
             "fusions": e["fusions"],
             "backward_ms": e["backward_ms"],
+            "comm_ms": comm_ms,
             "flops": e["flops"],
             "bytes": e["bytes"],
             "utilization": util,
             "bound": bound,
-            "pallas_candidate": closed is None and _is_pallas_candidate(
+            "pallas_candidate": closed is None and not wire
+            and _is_pallas_candidate(
                 e["share"], util, e["custom_call_ms"], e["device_ms"]),
             "closed_by": closed,
         })
@@ -1037,4 +1078,5 @@ __all__ = ["scope", "capture", "attribute", "gap_report", "roofline",
            "configure", "configure_from_env", "disable",
            "step_started", "step_ended", "captures",
            "profiler_sessions", "reset_counters", "last_report",
-           "measure_capture_overhead", "GAP_KEYS", "SCOPE_PREFIX"]
+           "measure_capture_overhead", "GAP_KEYS", "SCOPE_PREFIX",
+           "COLLECTIVE_KINDS", "collective_kind", "WIRE_BOUND_SHARE"]

@@ -125,6 +125,15 @@ FAMILIES = {
     "dl4j_tpu_devtime_scope_utilization": "gauge",
     "dl4j_tpu_devtime_scope_pallas_candidate": "gauge",
     "dl4j_tpu_devtime_pallas_candidates": "gauge",
+    # communication observatory (obs/commtime.py)
+    "dl4j_tpu_comm_captures_total": "counter",
+    "dl4j_tpu_comm_capture_seconds_total": "counter",
+    "dl4j_tpu_comm_scope_wire_bytes_per_step": "gauge",
+    "dl4j_tpu_comm_scope_collective_seconds": "gauge",
+    "dl4j_tpu_comm_scope_step_share": "gauge",
+    "dl4j_tpu_comm_scope_link_utilization": "gauge",
+    "dl4j_tpu_comm_op_count": "gauge",
+    "dl4j_tpu_comm_wire_bound_scopes": "gauge",
     # fleet observability plane (obs/fleet.py)
     "dl4j_tpu_fleet_snapshots_published_total": "counter",
     "dl4j_tpu_flight_recorder_dumps_total": "counter",
@@ -553,6 +562,43 @@ DEVTIME_PALLAS_CANDIDATES = REGISTRY.gauge(
     "dl4j_tpu_devtime_pallas_candidates",
     "scopes the last gap report flagged as Pallas-kernel candidates "
     "(high share, low utilization, not already a custom call)")
+
+# communication observatory (obs/commtime.py): the wire sibling of the
+# devtime plane — per-scope collective time, static HLO wire bytes,
+# and interconnect-roofline utilization (ARCHITECTURE.md §19)
+COMM_CAPTURES = REGISTRY.counter(
+    "dl4j_tpu_comm_captures_total",
+    "completed communication capture-and-attribute pipelines")
+COMM_CAPTURE_SECONDS = REGISTRY.counter(
+    "dl4j_tpu_comm_capture_seconds_total",
+    "wall time spent inside comm capture windows (profiler session + "
+    "xplane parse + ledger join)")
+COMM_SCOPE_WIRE_BYTES = REGISTRY.gauge(
+    "dl4j_tpu_comm_scope_wire_bytes_per_step",
+    "ring-model wire bytes per step per scope from the static HLO "
+    "ledger of the captured executables (last capture)", ("scope",))
+COMM_SCOPE_SECONDS = REGISTRY.gauge(
+    "dl4j_tpu_comm_scope_collective_seconds",
+    "device seconds spent inside collective ops per scope over the "
+    "LAST capture window", ("scope",))
+COMM_SCOPE_SHARE = REGISTRY.gauge(
+    "dl4j_tpu_comm_scope_step_share",
+    "share of total measured device time this scope spent in "
+    "collectives (last capture) — the WIRE_BOUND alarm input",
+    ("scope",))
+COMM_SCOPE_LINK_UTILIZATION = REGISTRY.gauge(
+    "dl4j_tpu_comm_scope_link_utilization",
+    "achieved interconnect GB/s over DL4J_TPU_PEAK_ICI_GBS per scope "
+    "(last capture; estimate-only off TPU)", ("scope",))
+COMM_OP_COUNT = REGISTRY.gauge(
+    "dl4j_tpu_comm_op_count",
+    "collective op executions per kind over the last capture window",
+    ("kind",))
+COMM_WIRE_BOUND_SCOPES = REGISTRY.gauge(
+    "dl4j_tpu_comm_wire_bound_scopes",
+    "scopes the last comm capture flagged wire-bound (collective time "
+    "dominates the scope's device time) — 1 per flagged scope, the "
+    "AUTHORITATIVE flag set tpu_watch --comm renders", ("scope",))
 
 # parallel training (parallel/wrapper.py): the optimizer-state HBM
 # footprint the ZeRO sharded update divides by N — layout is

@@ -639,6 +639,116 @@ def test_lint_rule9_missing_registry_table(tmp_path):
                for p in problems)
 
 
+# -------------------------------------------------------------------------
+# rule 11: communication observatory — scoped collectives + comm plane
+# -------------------------------------------------------------------------
+
+def test_lint_rule11_unscoped_collective_emission(tmp_path):
+    """Rule 11: a collective primitive called outside any scope-
+    carrying function in a COLLECTIVE_SCOPE_PATHS module is flagged —
+    its wire bytes could only land in the anonymous op:* bucket."""
+    pdir = tmp_path / "parallel"
+    pdir.mkdir()
+    (pdir / "zero.py").write_text(
+        "import jax\n"
+        "from deeplearning4j_tpu.obs import devtime\n"
+        "def scatter_mean(grads, axis_name):\n"
+        "    with devtime.scope('zero.reduce_scatter'):\n"
+        "        return jax.lax.psum_scatter(grads, axis_name)\n"
+        "def gather(shards, axis_name):\n"
+        "    return jax.lax.all_gather(shards, axis_name)\n")
+    problems = lint_instrumentation.run(tmp_path)
+    assert any("zero.py:7" in p and "all_gather" in p
+               and "op:*" in p for p in problems), problems
+    # the scoped site is NOT flagged
+    assert not any("zero.py:5" in p for p in problems)
+    # annotating the bare site clears the rule; a collective inside a
+    # nested helper of a scoped function is covered too
+    (pdir / "zero.py").write_text(
+        "import jax\n"
+        "from deeplearning4j_tpu.obs import devtime\n"
+        "def scatter_mean(grads, axis_name):\n"
+        "    with devtime.scope('zero.reduce_scatter'):\n"
+        "        return jax.lax.psum_scatter(grads, axis_name)\n"
+        "def gather(shards, axis_name):\n"
+        "    def _pull(s):\n"
+        "        return jax.lax.all_gather(s, axis_name)\n"
+        "    with devtime.scope('zero.all_gather'):\n"
+        "        return _pull(shards)\n")
+    assert not lint_instrumentation.run(tmp_path)
+
+
+def test_lint_rule11_module_level_collective_flagged(tmp_path):
+    """A module-level (function-less) collective emission can never be
+    covered by a scope — always flagged."""
+    pdir = tmp_path / "parallel"
+    pdir.mkdir()
+    (pdir / "compression.py").write_text(
+        "import jax\n"
+        "TOTAL = jax.lax.psum(1, 'data')\n")
+    problems = lint_instrumentation.run(tmp_path)
+    assert any("compression.py:2" in p and "psum" in p
+               for p in problems), problems
+
+
+def test_lint_rule11_comm_family_block_and_consumer_tokens(tmp_path):
+    """While obs/commtime.py exists: the dl4j_tpu_comm_* block must
+    exist in FAMILIES, comm tokens in tpu_watch/OPS.md must resolve,
+    and tpu_watch must watch at least one comm family."""
+    pkg, tools_dir, docs_dir = _metrics_tree(
+        tmp_path,
+        families={"dl4j_tpu_comm_scope_wire_bytes": "gauge"},
+        body='G = REGISTRY.gauge('
+             '"dl4j_tpu_comm_scope_wire_bytes", "d")\n',
+        watch='KEYS = ("dl4j_tpu_comm_scope_wire_bytes",\n'
+              '        "dl4j_tpu_comm_ghost_total")\n',
+        ops="Watch `dl4j_tpu_comm_retired_gauge` here.\n")
+    (pkg / "obs" / "commtime.py").write_text("WIRE = 1\n")
+    problems = lint_instrumentation.run(pkg, tmp_path / "tests",
+                                        tools_dir, docs_dir)
+    assert any("tpu_watch" in p and "dl4j_tpu_comm_ghost_total" in p
+               and "comm metric" in p for p in problems), problems
+    assert any("OPS.md" in p and "dl4j_tpu_comm_retired_gauge" in p
+               for p in problems)
+    assert not any("dl4j_tpu_comm_scope_wire_bytes" in p
+                   for p in problems)
+    # no comm family block at all while commtime.py exists → flagged,
+    # and a tpu_watch with no comm token leaves the plane unwatched
+    pkg2 = tmp_path / "p2"
+    p2, tools2, docs2 = _metrics_tree(
+        pkg2, families={"dl4j_tpu_steps_total": "counter"},
+        body='C = REGISTRY.counter("dl4j_tpu_steps_total", "d")\n',
+        watch='KEYS = ("dl4j_tpu_steps_total",)\n')
+    (p2 / "obs" / "commtime.py").write_text("WIRE = 1\n")
+    problems = lint_instrumentation.run(p2, pkg2 / "tests",
+                                        tools2, docs2)
+    assert any("no dl4j_tpu_comm_* family in" in p
+               for p in problems), problems
+    assert any("tpu_watch" in p
+               and "no dl4j_tpu_comm_* family referenced" in p
+               for p in problems)
+
+
+def test_lint_rule11_gated_off_without_commtime(tmp_path):
+    """A tree without obs/commtime.py gets no comm-plane demands (the
+    collective-scope check still applies to existing modules)."""
+    pkg, tools_dir, docs_dir = _metrics_tree(
+        tmp_path, families={"dl4j_tpu_steps_total": "counter"},
+        body='C = REGISTRY.counter("dl4j_tpu_steps_total", "d")\n',
+        watch='KEYS = ("dl4j_tpu_steps_total",)\n')
+    assert not lint_instrumentation.run(pkg, tmp_path / "tests",
+                                        tools_dir, docs_dir)
+
+
+def test_lint_rule11_real_package_collectives_scoped():
+    """The live package: every explicit collective emission in the
+    scanned parallel/ modules is scope-covered and the comm plane has
+    its dashboard surface."""
+    problems = [p for p in lint_instrumentation.run()
+                if "comm" in p or "collective emission" in p]
+    assert not problems, "\n".join(problems)
+
+
 def test_lint_rule9_real_package_kernels_registered():
     """The live package: every public kernel in ops/ is registered
     with a resolvable fallback/parity/scope, and no pallas_call lives

@@ -18,7 +18,6 @@ Wire-volume model (ring algorithms, per device):
 """
 import argparse
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -35,86 +34,32 @@ jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
+# the HLO collective walker now lives in the communication
+# observatory; this tool is a thin analytic front-end over it
+from deeplearning4j_tpu.obs import commtime as _commtime  # noqa: E402
+
 # public v5e figure (jax-ml.github.io/scaling-book): ICI 45 GB/s per
 # link per direction (2D torus; ring collectives ride one link
 # direction per neighbor hop)
 V5E_ICI_GBPS = 45e9
 
-# HLO line shape: `%name = <shape-or-tuple> <opcode>(...), ...` — the
-# result may be a TUPLE (XLA fuses many gradients into one all-reduce)
-_LINE_RE = re.compile(
-    r"=\s*(\(?[^(=]*?(?:\([^)]*\))?)\s*"
-    r"(all-reduce|all-gather|reduce-scatter|collective-permute|"
-    r"all-to-all)(?:-start)?\(")
-_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
-
-_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s32": 4,
-                "u32": 4, "s64": 8, "u64": 8, "s16": 2, "u16": 2,
-                "s8": 1, "u8": 1, "pred": 1, "c64": 8, "c128": 16}
-
-
-def _bytes(dtype, dims):
-    n = 1
-    for d in dims.split(",") if dims else []:
-        n *= int(d)
-    return n * _DTYPE_BYTES.get(dtype, 4)
-
-
 def collectives_of(compiled, n_devices=8):
     """Parse optimized HLO → [(kind, tensor_bytes, wire_bytes)].
 
+    Delegates to :func:`obs.commtime.collective_records`;
+    ``uniform_ring=n_devices`` pins the legacy analytic model (every
+    ring sized to the full mesh) so the BASELINE rows stay put.
     Collectives inside a `while` body (the ring attention fori_loop)
     execute once per trip; the ring's trip count is the mesh size, so
     those are multiplied by ``n_devices``.
     """
-    out = []
-    for line in compiled.as_text().splitlines():
-        head = line.split("metadata=")[0]
-        m = _LINE_RE.search(head)
-        if not m or "-done" in head:
-            continue
-        shapes, kind = m.groups()
-        nb = sum(_bytes(d, dims)
-                 for d, dims in _SHAPE_RE.findall(shapes))
-        n = n_devices
-        wire = {"all-reduce": 2 * nb * (n - 1) / n,
-                # HLO all-gather result is the FULL gathered tensor;
-                # each device sends its shard to n-1 peers
-                "all-gather": nb / n * (n - 1),
-                "reduce-scatter": nb * (n - 1),   # result is the shard
-                "collective-permute": nb,
-                "all-to-all": nb * (n - 1) / n}[kind]
-        trips = n_devices if "/while/" in line else 1
-        out.append((kind, nb, wire * trips))
-    return out
+    return [(r["kind"], r["tensor_bytes"], r["wire_bytes"])
+            for r in _commtime.collective_records(
+                compiled.as_text(), uniform_ring=n_devices)]
 
 
-_GROUPS_LITERAL_RE = re.compile(r"replica_groups=\{(\{[0-9,]+\}"
-                                r"(?:,\{[0-9,]+\})*)\}")
-_GROUPS_IOTA_RE = re.compile(
-    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\]"
-    r"(?:T\(([0-9,]+)\))?")
-
-
-def parse_replica_groups(line):
-    """Replica groups of one HLO collective line, as a frozenset of
-    frozensets of device ids — handles both the literal
-    ``{{0,2},{1,3}}`` and the iota ``[G,S]<=[dims]T(perm)`` forms."""
-    m = _GROUPS_LITERAL_RE.search(line)
-    if m:
-        return frozenset(
-            frozenset(int(d) for d in g.split(","))
-            for g in m.group(1)[1:-1].split("},{"))
-    m = _GROUPS_IOTA_RE.search(line)
-    if m:
-        g, s = int(m.group(1)), int(m.group(2))
-        dims = [int(d) for d in m.group(3).split(",")]
-        arr = np.arange(int(np.prod(dims))).reshape(dims)
-        if m.group(4):
-            arr = arr.transpose([int(p) for p in m.group(4).split(",")])
-        arr = arr.reshape(g, s)
-        return frozenset(frozenset(int(d) for d in row) for row in arr)
-    return None
+# re-exported from the observatory (the walker's canonical home)
+parse_replica_groups = _commtime.parse_replica_groups
 
 
 def axis_groups(mesh_axes):
@@ -146,21 +91,11 @@ def collectives_with_axes(compiled, mesh_axes):
     subgrid contains every source→target hop instead)."""
     expected = axis_groups(mesh_axes)
     out = []
-    for line in compiled.as_text().splitlines():
-        head = line.split("metadata=")[0]
-        m = _LINE_RE.search(head)
-        if not m or "-done" in head:
-            continue
-        shapes, kind = m.groups()
-        nb = sum(_bytes(d, dims)
-                 for d, dims in _SHAPE_RE.findall(shapes))
+    for r in _commtime.collective_records(compiled.as_text()):
         axes = None
-        if kind == "collective-permute":
-            pm = re.search(r"source_target_pairs=\{([0-9,{} ]*)\}",
-                           line)
-            if pm:
-                pairs = [tuple(int(x) for x in p.split(","))
-                         for p in pm.group(1)[1:-1].split("},{")]
+        if r["kind"] == "collective-permute":
+            pairs = r["source_target_pairs"]
+            if pairs:
                 for ax, part in expected.items():
                     by = {frozenset(g) for g in part}
                     if all(any(s in g and t in g for g in by)
@@ -168,13 +103,13 @@ def collectives_with_axes(compiled, mesh_axes):
                         axes = ax
                         break
         else:
-            groups = parse_replica_groups(line)
+            groups = r["replica_groups"]
             if groups is not None:
                 for ax, part in expected.items():
                     if groups == part:
                         axes = ax
                         break
-        out.append((kind, nb, axes, "/while/" in line))
+        out.append((r["kind"], r["tensor_bytes"], axes, r["in_while"]))
     return out
 
 
@@ -224,8 +159,14 @@ def analyze(name, jitted, args, n_devices=8):
         c, tot = by_kind.get(kind, (0, 0.0))
         by_kind[kind] = (c + 1, tot + w)
     t_ici = wire / V5E_ICI_GBPS
+    # per-scope wire account through the observatory's metadata join
+    # (group-sized rings, so composed meshes may differ from the
+    # uniform-ring analytic column — that is the point)
+    led = _commtime.wire_ledger([compiled], n_devices=n_devices)
     return {"name": name, "collectives": by_kind,
-            "wire_bytes": wire, "t_ici_ms": t_ici * 1e3}
+            "wire_bytes": wire, "t_ici_ms": t_ici * 1e3,
+            "by_scope": {k: round(v["wire_bytes"] / 1e6, 3)
+                         for k, v in sorted(led["by_scope"].items())}}
 
 
 # ---------------------------------------------------------------------------
@@ -320,6 +261,43 @@ def dp_sharded_wrapper(mesh_devices=8, sharded_update=True):
     return w._step, args, acct
 
 
+def encoded_wrapper(mesh_devices=8):
+    """ParallelWrapper ENCODED step (same MLP geometry as
+    ``dp_sharded_wrapper``): threshold-encode per shard, exchange,
+    decode. The plain encoded exchange psums the DECODED f32
+    gradients — DENSE wire volume on the wire; the measured-vs-dense
+    column this row feeds is the honest number the ROADMAP item-4
+    packed exchange (1-bit words all-gathered, ~16x less) must beat.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deeplearning4j_tpu.nn import (MultiLayerNetwork,
+                                       NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.config import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn import updaters as upd
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater(upd.Adam(learning_rate=1e-3)).list()
+            .layer(DenseLayer(n_out=256, activation="relu"))
+            .layer(DenseLayer(n_out=256, activation="relu"))
+            .layer(OutputLayer(n_out=16, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(64)).build())
+    net = MultiLayerNetwork(conf).init()
+    w = ParallelWrapper(net, workers=mesh_devices,
+                        mode=ParallelWrapper.ENCODED)
+    w._prepare()
+    dshard = NamedSharding(w.mesh, P("data"))
+    b = 8 * mesh_devices
+    x = jax.device_put(jnp.zeros((b, 64), jnp.float32), dshard)
+    y = jax.device_put(jnp.zeros((b, 16), jnp.float32), dshard)
+    rng = jax.random.PRNGKey(0)
+    args = (net.params, net.opt_state, net.state, w._dp_state, x, y,
+            rng)
+    return w._step, args
+
+
 def tp_mlp(mesh_devices=8):
     """Tensor-parallel 2-layer MLP (col→row sharded): all-reduce of
     activations, not params."""
@@ -365,6 +343,21 @@ def sp_ring(mesh_devices=8, t_total=8192):
     return jitted, (q,)
 
 
+def _try_row(rows, name, build_and_analyze):
+    """One table row, or a visibly-skipped placeholder when the
+    config needs a capability this environment lacks (the ring
+    attention path wants ``jax.typeof``) — a broken config must not
+    take down the other rows' evidence."""
+    try:
+        row = build_and_analyze()
+    except Exception as e:
+        row = {"name": name, "collectives": {}, "wire_bytes": 0.0,
+               "t_ici_ms": 0.0, "by_scope": {},
+               "skipped": f"{type(e).__name__}: {e}"}
+    rows.append(row)
+    return row
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--markdown", action="store_true")
@@ -375,30 +368,53 @@ def main():
                         ("TP MLP col→row (activation allreduce)",
                          tp_mlp),
                         ("SP ring attention T=8k causal", sp_ring)]:
-        jitted, a = build()
-        rows.append(analyze(name, jitted, a))
+        _try_row(rows, name,
+                 lambda name=name, build=build: analyze(
+                     name, *build()[:2]))
     # ZeRO-DP sharded weight update: reduce-scatter + all-gather
     # replace the gradient allreduce at identical ring wire volume
-    jitted, a, _acct = dp_sharded_wrapper()
-    rows.append(analyze("ZeRO-DP MLP (sharded weight update)", jitted,
-                        a))
-    # composed DP×SP×TP LM step: compiled under its ambient context
-    step, a, ctx, _axes = composed_lm()
-    with ctx:
-        rows.append(analyze("Composed DP×SP×TP causal-LM step", step,
-                            a))
+    _try_row(rows, "ZeRO-DP MLP (sharded weight update)",
+             lambda: analyze("ZeRO-DP MLP (sharded weight update)",
+                             *dp_sharded_wrapper()[:2]))
+    # dense DP baseline on the SAME model — the comparator the
+    # encoded row is measured against
+    dense = _try_row(
+        rows, "DP MLP dense baseline (replicated update)",
+        lambda: analyze("DP MLP dense baseline (replicated update)",
+                        *dp_sharded_wrapper(sharded_update=False)[:2]))
+    # encoded-gradient exchange (ROADMAP item 4's measurement bed):
+    # measured wire vs the dense baseline, through the ledger API
+    enc = _try_row(
+        rows, "Encoded DP MLP (ParallelWrapper ENCODED)",
+        lambda: analyze("Encoded DP MLP (ParallelWrapper ENCODED)",
+                        *encoded_wrapper()))
+    if not enc.get("skipped") and dense["wire_bytes"]:
+        enc["vs_dense"] = enc["wire_bytes"] / dense["wire_bytes"]
+
+    def _composed():
+        step, a, ctx, _axes = composed_lm()
+        with ctx:   # compiled under its ambient context
+            return analyze("Composed DP×SP×TP causal-LM step", step, a)
+
+    _try_row(rows, "Composed DP×SP×TP causal-LM step", _composed)
 
     if args.markdown:
         print("| config | collectives (count × kind) | wire MB/step "
-              "| projected ICI ms (45 GB/s link) |")
-        print("|---|---|---|---|")
+              "| projected ICI ms (45 GB/s link) | vs dense |")
+        print("|---|---|---|---|---|")
         for r in rows:
+            if r.get("skipped"):
+                print(f"| {r['name']} | skipped: {r['skipped']} "
+                      "| — | — | — |")
+                continue
             kinds = ", ".join(f"{c}× {k}"
                               for k, (c, _) in sorted(
                                   r["collectives"].items()))
+            vs = (f"{r['vs_dense']:.2f}×"
+                  if r.get("vs_dense") is not None else "—")
             print(f"| {r['name']} | {kinds} "
                   f"| {r['wire_bytes'] / 1e6:.1f} "
-                  f"| {r['t_ici_ms']:.2f} |")
+                  f"| {r['t_ici_ms']:.2f} | {vs} |")
     else:
         for r in rows:
             print(r)

@@ -584,6 +584,61 @@ to dense decode by contract).
 """
 
 
+# hand-maintained operations doc, re-emitted on every regeneration
+# (ISSUE 17 satellite: the wire-bound-hunting runbook lives in
+# docs/OPS.md next to the gap-naming runbook it extends)
+COMM_OPS_SECTION = """
+## Hunting wire-bound steps (obs/commtime.py)
+
+"Naming the Pallas gaps" (above) attributes device time to scopes;
+this runbook attributes the INTERCONNECT — per-collective wire bytes
+and collective device time, joined to the same `dl4j.*` scopes
+(ARCHITECTURE.md §19). A scope whose collective time exceeds half its
+device time is wire-bound: the link, not a kernel, is the ceiling, so
+it is never a Pallas candidate — fix it with overlap, sharding, or
+gradient compression instead.
+
+**Static (any box, no capture).** The wire ledger reads compiled HLO:
+
+    python -m tools.collective_volume --markdown
+
+prints per-config collective counts, ring-model wire bytes/step, the
+projected ICI time at the `DL4J_TPU_PEAK_ICI_GBS` roofline (default
+45 GB/s, the public v5e figure), and the measured-vs-dense column for
+the encoded-gradient exchange. In code,
+`commtime.wire_ledger(executables)` gives the same account per scope
+(`by_scope["zero.reduce_scatter"]`, ...) — anonymous collectives land
+in `op:<kind>` buckets, and lint rule 11 keeps the in-repo emitters
+scoped so those stay empty.
+
+**On cadence.** `DL4J_TPU_COMMTIME=1` installs the fit-loop monitor
+(`DL4J_TPU_COMMTIME_EVERY` / `DL4J_TPU_COMMTIME_STEPS`, same shape as
+the devtime monitor): each window publishes
+`dl4j_tpu_comm_scope_wire_bytes_per_step`,
+`dl4j_tpu_comm_scope_collective_seconds`,
+`dl4j_tpu_comm_scope_step_share`,
+`dl4j_tpu_comm_scope_link_utilization` (achieved GB/s over the
+`DL4J_TPU_PEAK_ICI_GBS` peak), `dl4j_tpu_comm_op_count` per kind,
+`dl4j_tpu_comm_wire_bound_scopes`, and the capture meters
+`dl4j_tpu_comm_captures_total` /
+`dl4j_tpu_comm_capture_seconds_total`. `tpu_watch --comm` renders the
+ranking; the fleet snapshot carries it host-labeled for free. Unset,
+the fit loops pay one branch and run zero profiler sessions
+(counter-fenced).
+
+**Reading the numbers.** On TPU the collective seconds are ICI time
+and `link_utilization` is achieved-vs-peak; on CPU/gloo captures they
+time host-side copies — the views are marked `estimate_only` and only
+the ledger bytes are exact. `gap.bound == "wire"` in the dossier's
+`hot_path_gaps` (and `comm_observatory.wire_bound_scopes`) is the
+per-scope alarm; `tools/xprof_summary.py DIR --comm` is the offline
+twin over a kept capture. Gates: the ZeRO step's ledger must show
+reduce-scatter tensor bytes ≈ grad_bytes/N under
+`zero.reduce_scatter` and all-gather tensor bytes ≈ param bytes under
+`zero.all_gather` (the bench `comm` section asserts both ≈ 1.0).
+"""
+
+
 def main():
     import warnings
     warnings.filterwarnings("ignore")
@@ -740,7 +795,8 @@ def main():
                  "", SERVING_OPS_SECTION.strip(),
                  "", SPEC_DECODE_OPS_SECTION.strip(),
                  "", DEVTIME_OPS_SECTION.strip(),
-                 "", FUSED_OPS_SECTION.strip()]
+                 "", FUSED_OPS_SECTION.strip(),
+                 "", COMM_OPS_SECTION.strip()]
     ops_out = os.path.join(os.path.dirname(out), "OPS.md")
     with open(ops_out, "w") as f:
         f.write("\n".join(op_lines) + "\n")

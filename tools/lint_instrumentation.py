@@ -1,7 +1,7 @@
 """Instrumentation lint — the telemetry spine's CI fence (tier-1 via
 ``tests/test_lint_instrumentation.py``).
 
-Ten AST rules over ``deeplearning4j_tpu/``:
+Eleven AST rules over ``deeplearning4j_tpu/``:
 
 1. **Every ``sentry.jit``-wrapped hot path emits obs telemetry.** A
    module that builds jitted entry points with ``sentry.jit(...)`` is
@@ -128,6 +128,27 @@ Ten AST rules over ``deeplearning4j_tpu/``:
     family — a spec-decode rollout whose accept rate no dashboard or
     runbook watches regresses silently.
 
+11. **The communication observatory's attribution contract holds.**
+    The wire ledger (``obs/commtime.py``, ARCHITECTURE.md §19) joins
+    every collective to a ``dl4j.*`` scope — which only works while
+    the modules that EMIT collectives explicitly keep their emitting
+    phases scope-annotated. Every bare or ``jax.lax.*`` call to a
+    collective primitive (``psum``/``pmean``/``psum_scatter``/
+    ``all_gather``/``ppermute``/``all_to_all``/``pshuffle``) in
+    :data:`COLLECTIVE_SCOPE_PATHS` (``parallel/zero.py``,
+    ``parallel/composed.py``, ``parallel/compression.py``) must sit
+    inside a function carrying a ``devtime.scope``/``named_scope``
+    call — an unscoped collective lands in the ledger's anonymous
+    ``op:*`` bucket and the per-scope wire attribution silently
+    degrades. While ``obs/commtime.py`` exists the
+    ``dl4j_tpu_comm_*`` family block must exist in FAMILIES (rule 6
+    already checks kinds — this catches the block being deleted
+    outright), every ``dl4j_tpu_comm_*`` token in
+    ``tools/tpu_watch.py``/``docs/OPS.md`` must resolve against the
+    table, and ``tpu_watch`` must reference at least one comm family
+    — a wire-bound regression with no dashboard surface lands
+    unwatched.
+
 Exit status 0 = clean; 1 = violations (printed one per line).
 """
 from __future__ import annotations
@@ -210,6 +231,19 @@ DEVTIME_PATH = "obs/devtime.py"
 # rule 9: the Pallas kernel library's home + its registry table
 OPS_DIR = "ops"
 KERNEL_REGISTRY_PATH = "ops/kernel_registry.py"
+
+# rule 11: the communication observatory module, its metric-family
+# prefix, the modules whose EXPLICIT collective emissions must be
+# scope-annotated (GSPMD-inserted collectives are attributed through
+# named_scope metadata already), and the primitive names that count
+# as an emission
+COMMTIME_PATH = "obs/commtime.py"
+COMM_FAMILY_PREFIX = "dl4j_tpu_comm_"
+COLLECTIVE_SCOPE_PATHS = ("parallel/zero.py", "parallel/composed.py",
+                          "parallel/compression.py")
+COLLECTIVE_EMITTERS = frozenset({
+    "psum", "pmean", "psum_scatter", "all_gather", "ppermute",
+    "all_to_all", "pshuffle"})
 
 
 def _calls(tree: ast.AST):
@@ -1076,6 +1110,82 @@ def _lint_kernel_registry(package_dir: Path,
     return problems
 
 
+def _lint_comm_observatory(package_dir: Path,
+                           tools_dir: Optional[Path],
+                           docs_dir: Optional[Path]) -> List[str]:
+    """Rule 11 (see module doc): collective emissions scoped, comm
+    family block present, comm consumer tokens resolve, and tpu_watch
+    actually watches the plane."""
+    problems: List[str] = []
+    for rel in COLLECTIVE_SCOPE_PATHS:
+        path = package_dir / rel
+        if not path.is_file():
+            continue                # synthetic tree: nothing to hold
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue                # rule-agnostic: lint_file reports it
+        # a collective call is covered when ANY enclosing function
+        # (ast.walk of an outer def sees nested defs' calls too)
+        # carries a devtime.scope / named_scope call
+        covered = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            coll = [c for c in _calls(node)
+                    if _attr_chain(c.func).split(".")[-1]
+                    in COLLECTIVE_EMITTERS]
+            if coll and any(_scope_call(_attr_chain(c.func))
+                            for c in _calls(node)):
+                covered.update(id(c) for c in coll)
+        for c in _calls(tree):
+            ch = _attr_chain(c.func)
+            if ch.split(".")[-1] not in COLLECTIVE_EMITTERS or \
+                    id(c) in covered:
+                continue
+            problems.append(
+                f"{rel}:{c.lineno}: collective emission ({ch}) outside "
+                "any devtime.scope / jax.named_scope-carrying function "
+                "— the communication observatory's wire ledger can "
+                "only attribute these bytes to the anonymous op:* "
+                "bucket; wrap the emitting phase in a devtime scope")
+    families = _parse_families(package_dir / METRICS_PATH)
+    if not (package_dir / COMMTIME_PATH).is_file() or families is None:
+        return problems
+    if not any(f.startswith(COMM_FAMILY_PREFIX) for f in families):
+        problems.append(
+            f"{METRICS_PATH}: no {COMM_FAMILY_PREFIX}* family in "
+            "FAMILIES — the communication observatory has no metric "
+            "surface (the block was deleted?)")
+    consumers = []
+    if tools_dir is not None and (Path(tools_dir)
+                                  / "tpu_watch.py").is_file():
+        consumers.append(("tools/tpu_watch.py",
+                          (Path(tools_dir) / "tpu_watch.py")
+                          .read_text()))
+    if docs_dir is not None and (Path(docs_dir) / "OPS.md").is_file():
+        consumers.append(("docs/OPS.md",
+                          (Path(docs_dir) / "OPS.md").read_text()))
+    for label, text in consumers:
+        tokens = sorted({t for t in _family_tokens(text)
+                         if t.startswith(COMM_FAMILY_PREFIX)})
+        for token in tokens:
+            if not _resolve_family(token, families):
+                problems.append(
+                    f"{label}: references {token!r} which matches no "
+                    f"family in {METRICS_PATH} FAMILIES — the "
+                    "dashboard/runbook watches a comm metric the code "
+                    "does not emit")
+        if label == "tools/tpu_watch.py" and not tokens:
+            problems.append(
+                f"{label}: no {COMM_FAMILY_PREFIX}* family referenced "
+                "— the wire-byte/link-utilization plane has no "
+                "dashboard surface, so a wire-bound regression lands "
+                "unwatched")
+    return problems
+
+
 def run(package_dir: Path = PACKAGE,
         tests_dir: Optional[Path] = None,
         tools_dir: Optional[Path] = None,
@@ -1100,6 +1210,8 @@ def run(package_dir: Path = PACKAGE,
     problems.extend(_lint_devtime_scopes(package_dir, tools_dir,
                                          docs_dir))
     problems.extend(_lint_kernel_registry(package_dir, tests_dir))
+    problems.extend(_lint_comm_observatory(package_dir, tools_dir,
+                                           docs_dir))
     return problems
 
 

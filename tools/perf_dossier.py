@@ -667,6 +667,11 @@ def _hot_path_gaps():
         "closed_gaps": {g["scope"]: g["closed_by"]
                         for g in rep["gaps"] if g["closed_by"]},
         "open_gaps": open_gaps,
+        # the comm axis (obs/commtime.py): scopes whose device time is
+        # dominated by collectives — a kernel won't close these, the
+        # wire will (gap.bound == "wire", gap.comm_ms)
+        "wire_bound_scopes": [g["scope"] for g in rep["gaps"]
+                              if g.get("bound") == "wire"],
     }
 
 
@@ -810,6 +815,13 @@ def main(names):
             "max_param_rel_diff_overlap":
                 zd["max_param_rel_diff_overlap"],
             "smoke": SMOKE})
+    # communication observatory (obs/commtime.py): the permanent
+    # wire-bytes axis next to step time — the ZeRO sharded step's
+    # per-scope wire ledger gated against the PR 5 HLO byte model,
+    # plus the off-path fence. Same forced-CPU subprocess protocol.
+    from deeplearning4j_tpu.obs import commtime
+    payload.append({"config": "comm_observatory",
+                    **commtime.subprocess_report(), "smoke": SMOKE})
     # fused-primitive kernel library (ops/fused_norms.py): per-kernel
     # interpret-parity + fallback timings — the fused_epilogues row
     # next to the existing flash-attn row.

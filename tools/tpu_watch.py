@@ -22,6 +22,15 @@ roofline utilization — the gap report's ``gap.scope``/``gap.share``/
 ``gap.utilization`` columns) and the scopes flagged
 ``gap.pallas_candidate``.
 
+Commtime (obs/commtime.py): when the run publishes communication
+observatory families (``dl4j_tpu_comm_*``, a ``DL4J_TPU_COMMTIME``
+cadence monitor or explicit captures) each sample also emits a
+``comm`` view: per-scope wire MB/step + collective ms, a link-
+utilization sparkline across samples, the top wire-bound scopes from
+the authoritative ``dl4j_tpu_comm_wire_bound_scopes`` flags, and a
+WIRE_BOUND alarm when collective seconds exceed half the measured
+device time. ``--comm`` narrows the metrics scrape to just this view.
+
 Fleet (obs/fleet.py): pass ``--fleet-dir <elastic_dir>`` to tail an
 elastic fleet's telemetry snapshots incrementally (same model as the
 trace-JSONL tail: the snapshots are small atomic files, the skew
@@ -104,7 +113,7 @@ _METRIC_KEYS = ("dl4j_tpu_step_latency_seconds_count",
                 "dl4j_tpu_worker_stale",
                 "dl4j_tpu_inference_requests_total",
                 "dl4j_tpu_numerics_", "dl4j_tpu_serving_",
-                "dl4j_tpu_devtime_")
+                "dl4j_tpu_devtime_", "dl4j_tpu_comm_")
 
 # numerics view state: total-grad-norm history across samples feeds the
 # sparkline (bounded — one char per retained sample)
@@ -283,6 +292,65 @@ def _devtime_view(fams) -> dict:
     return view
 
 
+# comm view state: per-sample max link utilization feeds the sparkline
+_LINK_HISTORY: list = []
+
+# WIRE_BOUND alarm threshold: total collective share of device time
+_WIRE_BOUND_ALARM_SHARE = 0.5
+
+
+def _comm_view(fams) -> dict:
+    """Render the communication observatory families from one
+    /metrics scrape: per-scope wire MB/step + collective ms table, a
+    link-utilization sparkline across samples, the top wire-bound
+    scopes (the AUTHORITATIVE ``dl4j_tpu_comm_wire_bound_scopes``
+    flags — never re-derived scrape-side), and a WIRE_BOUND alarm
+    when collective time exceeds half the measured device time."""
+    def by(name, label="scope"):
+        return {dict(labels).get(label, ""): v
+                for (n, labels), v in fams.items() if n == name}
+
+    secs = by("dl4j_tpu_comm_scope_collective_seconds")
+    wire = by("dl4j_tpu_comm_scope_wire_bytes_per_step")
+    if not secs and not wire:
+        return {}
+    shares = by("dl4j_tpu_comm_scope_step_share")
+    utils_ = by("dl4j_tpu_comm_scope_link_utilization")
+    names = sorted(set(secs) | set(wire),
+                   key=lambda s: -secs.get(s, 0.0))
+    view: dict = {
+        "captures": fams.get(("dl4j_tpu_comm_captures_total", ())),
+        "scopes": {
+            s: {"collective_ms": round(secs.get(s, 0.0) * 1e3, 3),
+                **({"wire_mb_per_step": round(wire[s] / 1e6, 3)}
+                   if s in wire else {}),
+                **({"share": round(shares[s], 4)}
+                   if s in shares else {}),
+                **({"link_utilization": round(utils_[s], 4)}
+                   if s in utils_ else {})}
+            for s in names[:8]},
+    }
+    if utils_:
+        _LINK_HISTORY.append(max(utils_.values()))
+        del _LINK_HISTORY[:-64]
+        view["link_utilization_sparkline"] = _sparkline(_LINK_HISTORY)
+    counts = by("dl4j_tpu_comm_op_count", label="kind")
+    if counts:
+        view["op_counts"] = {k: int(v) for k, v in sorted(
+            counts.items(), key=lambda kv: -kv[1])}
+    bound = sorted(s for s, v in by(
+        "dl4j_tpu_comm_wire_bound_scopes").items() if v)
+    if bound:
+        view["wire_bound_scopes"] = bound
+    total_share = sum(shares.values())
+    if total_share >= _WIRE_BOUND_ALARM_SHARE or bound:
+        view["WIRE_BOUND_ALARM"] = {
+            "comm_share": round(total_share, 4),
+            "scopes": bound,
+        }
+    return view
+
+
 # fleet view state: per-sample max collective skew feeds the sparkline
 # (bounded, like the grad-norm history)
 _SKEW_HISTORY: list = []
@@ -328,7 +396,7 @@ def _fleet_view(fleet_dir) -> dict:
 
 
 def _scrape_telemetry(metrics_url, healthz_url, trace_jsonl,
-                      fleet_dir=None) -> None:
+                      fleet_dir=None, comm_only=False) -> None:
     """One sample of a live run's telemetry, appended to the log.
     Scrape failures are logged, never fatal — the run may simply not
     have started its endpoint yet."""
@@ -341,6 +409,11 @@ def _scrape_telemetry(metrics_url, healthz_url, trace_jsonl,
         try:
             with urllib.request.urlopen(metrics_url, timeout=5) as r:
                 fams = obs_metrics.parse_exposition(r.read().decode())
+            if comm_only:
+                # --comm: the focused wire watch — just the comm view
+                cview = _comm_view(fams)
+                _log(event="comm", url=metrics_url, **cview)
+                return
             sample = {f"{name}{dict(labels) if labels else ''}": v
                       for (name, labels), v in sorted(fams.items())
                       if name.startswith(_METRIC_KEYS)}
@@ -354,6 +427,9 @@ def _scrape_telemetry(metrics_url, healthz_url, trace_jsonl,
             dview = _devtime_view(fams)
             if dview:
                 _log(event="devtime", url=metrics_url, **dview)
+            cview = _comm_view(fams)
+            if cview:
+                _log(event="comm", url=metrics_url, **cview)
         except Exception as e:
             _log(event="metrics", url=metrics_url, error=repr(e))
     if healthz_url:
@@ -406,6 +482,11 @@ def main() -> int:
                     help="/healthz endpoint to sample each interval")
     ap.add_argument("--trace-jsonl", default=None,
                     help="obs trace JSONL to summarize each interval")
+    ap.add_argument("--comm", action="store_true",
+                    help="narrow the --metrics-url scrape to the "
+                         "communication observatory view: per-scope "
+                         "wire MB/step, link-utilization sparkline, "
+                         "top wire-bound scopes, WIRE_BOUND alarm")
     ap.add_argument("--fleet-dir", default=None,
                     help="elastic fleet dir (DL4J_TPU_ELASTIC_DIR) to "
                          "aggregate each interval: per-host table, "
@@ -422,7 +503,8 @@ def main() -> int:
         if args.metrics_url or args.healthz_url or args.trace_jsonl \
                 or args.fleet_dir:
             _scrape_telemetry(args.metrics_url, args.healthz_url,
-                              args.trace_jsonl, args.fleet_dir)
+                              args.trace_jsonl, args.fleet_dir,
+                              comm_only=args.comm)
         ok, info = probe_backend(timeout=args.probe_timeout)
         _log(event="probe", attempt=attempt, ok=ok, info=info)
         if ok:

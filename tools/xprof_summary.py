@@ -19,6 +19,13 @@ so a multi-host capture summarizes the whole fleet instead of
 silently dropping all hosts but one. An explicit ``*.xplane.pb`` FILE
 argument reads exactly that plane (one host of a fleet capture).
 
+``--comm`` mode reuses the communication observatory's attribution
+(``obs/commtime.py``) over the same xplane capture: per-scope
+collective device time (scope from each event's ``op_name`` metadata
+when no executables are registered), per-kind collective op counts,
+total comm share of device time, and the wire-bound scopes — the
+offline twin of ``tpu_watch --comm``.
+
 Obs mode reads the Chrome-trace JSONL the telemetry spine writes
 (``DL4J_TPU_TRACE=...``, ``deeplearning4j_tpu/obs/trace.py``) — the
 host-side step/ETL/sync attribution complementing XProf's device view
@@ -107,6 +114,47 @@ def summarize(trace_path: str, top: int = 10):
     return "\n".join(out)
 
 
+def summarize_comm(trace_path: str, top: int = 10) -> str:
+    """Per-scope collective-time table from an XProf capture via the
+    comm observatory's attribution. With no registered executables
+    the scope join falls back to the events' ``op_name`` metadata —
+    sufficient for any capture of ``named_scope``-annotated programs
+    (``perf_dossier.py --trace DIR``)."""
+    from deeplearning4j_tpu.obs import commtime, devtime
+
+    paths = devtime.xplane_paths(trace_path)
+    view = commtime.attribute(paths, maps=None)
+    if not view["total_device_ms"]:
+        raise SystemExit(
+            f"{trace_path} has no XLA-op execution events — nothing "
+            "executed under the trace (or the capture is host-only)")
+    out = [f"planes: {view['planes']} file(s); total device "
+           f"{view['total_device_ms']:.2f} ms, collective "
+           f"{view['collective_ms']:.2f} ms "
+           f"({100 * view['comm_share']:.1f}%)"]
+    if view["estimate_only"]:
+        out.append("NOTE: non-TPU capture — collective timings are "
+                   "host-side copies, estimate-only")
+    if view["by_kind"]:
+        out.append("op counts: " + ", ".join(
+            f"{c}× {k}" for k, c in view["by_kind"].items()))
+    out.append("")
+    out.append("| scope | collective ms | share of device | kinds |")
+    out.append("|---|---|---|---|")
+    ranked = sorted(view["scopes"].items(),
+                    key=lambda kv: -kv[1]["collective_ms"])[:top]
+    for name, r in ranked:
+        kinds = ", ".join(f"{c}× {k}"
+                          for k, c in sorted(r["kinds"].items()))
+        out.append(f"| {name} | {r['collective_ms']:.3f} | "
+                   f"{100 * r['share']:.1f}% | {kinds or '—'} |")
+    if view["wire_bound_scopes"]:
+        out.append("")
+        out.append("wire-bound scopes: "
+                   + ", ".join(view["wire_bound_scopes"]))
+    return "\n".join(out)
+
+
 def summarize_obs(path: str, top: int = 10) -> str:
     """Span-name totals from an obs trace JSONL: wall coverage per
     thread, per-name total/count/share — the table the acceptance
@@ -173,9 +221,14 @@ def main():
     ap.add_argument("trace_dir",
                     help="XProf capture dir, or an obs trace JSONL")
     ap.add_argument("--top", type=int, default=10)
+    ap.add_argument("--comm", action="store_true",
+                    help="per-scope COLLECTIVE time view of an xplane "
+                         "capture (obs/commtime.py attribution)")
     args = ap.parse_args()
     p = Path(args.trace_dir)
-    if _is_obs_trace(p):
+    if args.comm:
+        print(summarize_comm(args.trace_dir, args.top))
+    elif _is_obs_trace(p):
         if p.is_dir():
             p = sorted(p.rglob("*.jsonl"),
                        key=lambda q: q.stat().st_mtime)[-1]

@@ -91,6 +91,13 @@ _register("DL4J_TPU_COMPILE_CACHE_MIN_BYTES", -1, int,
 _register("DL4J_TPU_COMPILE_CACHE_MIN_SECS", 0.0, float,
           "min compile wall-time eligible for the persistent cache "
           "(0: cache everything)")
+_register("DL4J_TPU_COMPILE_STORE", "", str,
+          "content-addressed compile store root "
+          "(perf/compile_store.py): fleet-shared compiled artifacts "
+          "fenced by (store version, jaxlib, topology); when set it "
+          "supersedes DL4J_TPU_COMPILE_CACHE — its fenced xla/ plane "
+          "becomes the JAX persistent-cache dir ('' | '0' | 'off' "
+          "disables; explicit opt-in, so it applies on CPU too)")
 _register("DL4J_TPU_RETRACE_BUDGET", 16, int,
           "distinct UNPLANNED traced shapes tolerated per jitted entry "
           "point before the retrace sentry warns (warmed-up shapes "
@@ -173,6 +180,13 @@ _register("DL4J_TPU_PEAK_ICI_GBS", 45.0, float,
           "interconnect roofline peak in GB/s per link direction "
           "(default: v5e ICI; the denominator of commtime's link "
           "utilization — CPU/gloo captures are estimate-only)")
+
+# -- elastic serving fleet (serving/fleet.py) ------------------------------
+_register("DL4J_TPU_FLEET_SHED_BUDGET", 8, int,
+          "max in-flight streams the serving router may structurally "
+          "shed per replica eviction (each surfaced as "
+          "SequenceAborted); beyond it the router keeps re-routing "
+          "instead of aborting")
 
 # -- fleet observability plane (obs/fleet.py) ------------------------------
 _register("DL4J_TPU_FLEET_PUBLISH_SECS", 1.0, float,

@@ -106,6 +106,7 @@ AGGREGATE_FAMILIES = {
     "dl4j_tpu_collective_straggler": "gauge",
     "dl4j_tpu_fleet_hosts": "gauge",
     "dl4j_tpu_fleet_snapshot_age_seconds": "gauge",
+    "dl4j_tpu_serving_fleet_replica_ready": "gauge",
 }
 
 # -- off-path fence counters (tests assert both stay 0 with no plane) --------
@@ -196,6 +197,7 @@ class FleetTelemetry:
         self._io_lock = threading.Lock()
         self.step = -1
         self.mesh_epoch = 0
+        self.serving: Optional[Dict[str, Any]] = None
 
     @property
     def telemetry_path(self) -> Path:
@@ -246,12 +248,22 @@ class FleetTelemetry:
         self._ring.append(rec)
         self.publish(force=True)
 
+    def update_serving(self, **info: Any) -> None:
+        """Attach/refresh this host's serving section (queue depth,
+        KV-page occupancy, readiness, port...) — it rides the next
+        snapshot, so the router's eligibility read and the training
+        skew view share one publication plane. Serving replicas call
+        this every tick; non-serving hosts never carry the section."""
+        if self.serving is None:
+            self.serving = {}
+        self.serving.update(info)
+
     # -- publishing -----------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
         """The compact host snapshot: everything a fleet aggregator
         needs to merge this process into the fleet view."""
         from deeplearning4j_tpu.obs import health as _health
-        return {
+        snap = {
             "version": SNAPSHOT_VERSION,
             "host": self.host,
             "pid": os.getpid(),
@@ -265,6 +277,9 @@ class FleetTelemetry:
             "numerics": _numerics_tail(),
             "exposition": _metrics.exposition(),
         }
+        if self.serving is not None:
+            snap["serving"] = dict(self.serving)
+        return snap
 
     def maybe_publish(self) -> bool:
         """Publish when more than ``every_s`` has passed — the
@@ -527,6 +542,30 @@ class FleetView:
                 for h, s in sorted(self.snapshots.items())}
         return self._table
 
+    def serving_table(self) -> Dict[str, Dict[str, Any]]:
+        """{host: serving section + lease/liveness columns} for every
+        snapshot carrying a ``serving`` section — the router's
+        eligibility read and ``tpu_watch --fleet-dir``'s replica
+        columns. ``live`` is lease evidence (the same verdict
+        ``_dead_hosts`` renders); ``ready`` comes from the replica's
+        own published readiness gate."""
+        dead = set(self._dead_hosts())
+        out: Dict[str, Dict[str, Any]] = {}
+        for h, s in sorted(self.snapshots.items()):
+            serving = s.get("serving")
+            if not isinstance(serving, dict):
+                continue
+            row = dict(serving)
+            row["ready"] = bool(serving.get("ready", False))
+            row["live"] = h not in dead
+            row["age_s"] = round(self.now - s.get("t_wall", 0.0), 3)
+            lease = self.leases.get(h)
+            row["lease_age_s"] = (round(lease["age_s"], 3)
+                                  if lease else None)
+            row["mesh_epoch"] = s.get("mesh_epoch", 0)
+            out[h] = row
+        return out
+
     def evicted(self) -> List[str]:
         """Hosts with an eviction bundle under ``postmortem/``."""
         if self.dir is None:
@@ -678,6 +717,16 @@ class FleetView:
                 f"{_metrics._label_str({'host': h})} {v['age_s']}"
                 for h, v in self.table().items()],
         }
+        srv = self.serving_table()
+        if srv:
+            # the autoscale drill's post-drill assertion target: one
+            # sample per serving replica, 1 only when lease-live AND
+            # warmup-ready (the router's own eligibility predicate)
+            agg["dl4j_tpu_serving_fleet_replica_ready"] = [
+                f"dl4j_tpu_serving_fleet_replica_ready"
+                f"{_metrics._label_str({'host': h})} "
+                f"{int(row['ready'] and row['live'])}"
+                for h, row in sorted(srv.items())]
         rep = self.skew_report()
         if rep:
             agg["dl4j_tpu_collective_skew_seconds"] = [

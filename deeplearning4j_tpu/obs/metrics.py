@@ -141,6 +141,17 @@ FAMILIES = {
     "dl4j_tpu_collective_straggler": "gauge",
     "dl4j_tpu_fleet_hosts": "gauge",
     "dl4j_tpu_fleet_snapshot_age_seconds": "gauge",
+    # elastic serving fleet: front-end router (serving/fleet.py)
+    "dl4j_tpu_router_requests_total": "counter",
+    "dl4j_tpu_router_sheds_total": "counter",
+    "dl4j_tpu_router_reroutes_total": "counter",
+    "dl4j_tpu_router_replicas_ready": "gauge",
+    # elastic serving fleet: replica lifecycle (serving/fleet.py +
+    # obs/fleet.py serving aggregation)
+    "dl4j_tpu_serving_fleet_spawns_total": "counter",
+    "dl4j_tpu_serving_fleet_evictions_total": "counter",
+    "dl4j_tpu_serving_fleet_warm_buckets": "gauge",
+    "dl4j_tpu_serving_fleet_replica_ready": "gauge",
 }
 
 
@@ -530,6 +541,38 @@ SERVING_PREFIX_COW = REGISTRY.counter(
     "dl4j_tpu_serving_prefix_cow_copies_total",
     "copy-on-write page copies (a write hit a shared page)")
 
+# elastic serving fleet (serving/fleet.py): the front-end router's
+# admission/shed/re-route ledger plus the replica-lifecycle counters
+# the autoscale drill asserts against (ARCHITECTURE.md §20)
+ROUTER_REQS = REGISTRY.counter(
+    "dl4j_tpu_router_requests_total",
+    "requests the front-end router forwarded, by replica",
+    ("replica",))
+ROUTER_SHEDS = REGISTRY.counter(
+    "dl4j_tpu_router_sheds_total",
+    "in-flight streams structurally shed by the router (every one "
+    "surfaced as SequenceAborted — never a hung client)", ("reason",))
+ROUTER_REROUTES = REGISTRY.counter(
+    "dl4j_tpu_router_reroutes_total",
+    "requests re-submitted to a different replica after their first "
+    "replica died or refused")
+ROUTER_READY = REGISTRY.gauge(
+    "dl4j_tpu_router_replicas_ready",
+    "replicas the router currently considers routable (lease live "
+    "AND warmup-ready)")
+FLEET_SPAWNS = REGISTRY.counter(
+    "dl4j_tpu_serving_fleet_spawns_total",
+    "replicas spawned by the fleet supervisor to restore target "
+    "capacity after an eviction")
+FLEET_EVICTIONS = REGISTRY.counter(
+    "dl4j_tpu_serving_fleet_evictions_total",
+    "serving replicas evicted from the membership plane (lease "
+    "expired)")
+FLEET_WARM_BUCKETS = REGISTRY.gauge(
+    "dl4j_tpu_serving_fleet_warm_buckets",
+    "warmup buckets this replica has AOT-compiled (readiness = all "
+    "declared buckets warm)")
+
 # device-time observatory (obs/devtime.py): short profiler windows
 # attributed to the named_scope'd layers — the instrument that names
 # the Pallas gaps (ARCHITECTURE.md §16)
@@ -724,6 +767,35 @@ def parse_exposition(text: str) -> Dict[Tuple[str, Tuple], float]:
 
 # -- /metrics + /healthz server ----------------------------------------------
 
+#: readiness probes consulted by ``/healthz``: name -> zero-arg
+#: callable returning truthy when ready. Readiness ≠ liveness — a
+#: replica that is alive but still AOT-compiling its warmup buckets
+#: answers 503 with status "warming", so a router never routes a
+#: request that would cold-trace (serving/fleet.py registers one per
+#: gateway; empty registry = always ready, the pre-fleet behavior)
+_readiness: Dict[str, Any] = {}
+
+
+def register_readiness(name: str, probe) -> None:
+    """Add/replace a named readiness probe (None removes it)."""
+    if probe is None:
+        _readiness.pop(name, None)
+    else:
+        _readiness[name] = probe
+
+
+def readiness() -> Dict[str, bool]:
+    """Evaluate every registered probe (a raising probe reads as not
+    ready — never as a dropped healthz)."""
+    out = {}
+    for name, probe in sorted(_readiness.items()):
+        try:
+            out[name] = bool(probe())
+        except Exception:
+            out[name] = False
+    return out
+
+
 #: shared elastic dir the ``/fleet`` path aggregates over (None = 404)
 _fleet_dir: Optional[str] = None
 
@@ -756,8 +828,20 @@ class MetricsServer:
         from deeplearning4j_tpu.obs import health
         chk = health.check()
         stale = sorted(w for w, s in chk.items() if s["stale"])
+        ready = readiness()
+        warming = sorted(n for n, ok in ready.items() if not ok)
+        status = "ok"
+        if warming:
+            status = "warming"
+        if stale:
+            status = "stale_workers"
         return {
-            "status": "stale_workers" if stale else "ok",
+            "status": status,
+            # readiness gate (serving fleet): probes registered via
+            # register_readiness — 503/"warming" until every one is
+            # true (a cold replica must not take traffic)
+            "ready": not warming,
+            "warming": warming,
             # ONE staleness table: worker heartbeats and elastic host
             # leases (mirrored in via health.observe_age with their
             # own lease window) — stale_hosts is the host: subset with

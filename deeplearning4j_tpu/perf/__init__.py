@@ -6,6 +6,10 @@ ambient surprise:
 - :mod:`~deeplearning4j_tpu.perf.compile_cache` — JAX's on-disk
   compilation cache wired to the tier-2 flag system; restarts and
   multi-process workers reuse each other's compiles.
+- :mod:`~deeplearning4j_tpu.perf.compile_store` — the fleet-shared,
+  content-addressed tier above it: (jaxlib, topology,
+  program-fingerprint)-keyed entries with version fencing and
+  corrupt-entry quarantine (ARCHITECTURE.md §20).
 - :mod:`~deeplearning4j_tpu.perf.warmup` — ``.lower().compile()``
   every declared shape bucket from abstract shapes before traffic.
 - :mod:`~deeplearning4j_tpu.perf.sentry` — count distinct traced
@@ -15,8 +19,12 @@ ambient surprise:
 See ARCHITECTURE.md "Compilation lifecycle".
 """
 from deeplearning4j_tpu.perf import compile_cache as compile_cache
+from deeplearning4j_tpu.perf import compile_store as compile_store
 from deeplearning4j_tpu.perf import sentry as sentry
 from deeplearning4j_tpu.perf import warmup as warmup
+from deeplearning4j_tpu.perf.compile_store import (
+    CompileStore as CompileStore,
+    program_fingerprint as program_fingerprint)
 from deeplearning4j_tpu.perf.sentry import (
     RetraceBudgetExceeded as RetraceBudgetExceeded)
 from deeplearning4j_tpu.perf.warmup import (
@@ -41,5 +49,6 @@ def compile_report() -> dict:
     }
 
 
-__all__ = ["compile_cache", "sentry", "warmup", "WarmupSpec",
+__all__ = ["compile_cache", "compile_store", "CompileStore",
+           "program_fingerprint", "sentry", "warmup", "WarmupSpec",
            "warmup_plan", "RetraceBudgetExceeded", "compile_report"]

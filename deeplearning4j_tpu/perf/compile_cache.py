@@ -32,6 +32,7 @@ from typing import Any, Dict, Optional
 _LOCK = threading.Lock()
 _state: Dict[str, Any] = {
     "dir": None,             # active cache dir (None -> disabled)
+    "store": None,           # CompileStore when routed through one
     "listeners": False,      # monitoring listeners installed
     "requests": 0,           # compile requests eligible for the cache
     "hits": 0,               # persistent-cache hits
@@ -93,16 +94,30 @@ def configure(cache_dir: Optional[str] = None,
     from deeplearning4j_tpu import environment
     import jax
 
+    store = None
     if cache_dir is None:
-        if "DL4J_TPU_COMPILE_CACHE" not in os.environ \
+        # the content-addressed fleet store (perf/compile_store.py)
+        # supersedes the flat cache dir when configured: its fenced
+        # xla/ plane becomes the JAX cache dir, so a jaxlib/topology
+        # change can never serve a stale executable. Explicit opt-in,
+        # so it works on CPU too (same contract as an explicit
+        # DL4J_TPU_COMPILE_CACHE).
+        from deeplearning4j_tpu.perf import compile_store
+        store = compile_store.from_env()
+        if store is not None:
+            cache_dir = str(store.xla_dir)
+        elif "DL4J_TPU_COMPILE_CACHE" not in os.environ \
                 and not _accelerator_configured():
             with _LOCK:
                 _state["dir"] = None
+                _state["store"] = None
             return None
-        cache_dir = environment.get_flag("DL4J_TPU_COMPILE_CACHE")
+        else:
+            cache_dir = environment.get_flag("DL4J_TPU_COMPILE_CACHE")
     if cache_dir is None or str(cache_dir).strip().lower() in _DISABLED:
         with _LOCK:
             _state["dir"] = None
+            _state["store"] = None
         return None
     cache_dir = os.path.expanduser(str(cache_dir))
     os.makedirs(cache_dir, exist_ok=True)
@@ -127,7 +142,14 @@ def configure(cache_dir: Optional[str] = None,
     _install_listeners()
     with _LOCK:
         _state["dir"] = cache_dir
+        _state["store"] = store
     return cache_dir
+
+
+def active_store():
+    """The :class:`~deeplearning4j_tpu.perf.compile_store.CompileStore`
+    the cache is routed through, or None (flat dir / disabled)."""
+    return _state["store"]
 
 
 def configure_from_env() -> Optional[str]:
@@ -139,6 +161,7 @@ def configure_from_env() -> Optional[str]:
     except Exception:
         with _LOCK:
             _state["dir"] = None
+            _state["store"] = None
         return None
 
 
@@ -176,7 +199,8 @@ def cache_stats() -> Dict[str, Any]:
                     pass
     with _LOCK:
         requests, hits = _state["requests"], _state["hits"]
-    return {
+        store = _state["store"]
+    out = {
         "dir": d,
         "enabled": d is not None,
         "entries": entries,
@@ -185,6 +209,10 @@ def cache_stats() -> Dict[str, Any]:
         "persistent_hits": hits,
         "persistent_misses": max(0, requests - hits),
     }
+    if store is not None:
+        out["store_fence"] = store.fence
+        out["store"] = store.counters()
+    return out
 
 
 def reset_counters() -> None:

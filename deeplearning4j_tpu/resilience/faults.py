@@ -27,6 +27,12 @@ site                      where it fires
 ``coordinator``           ``elastic.MembershipCoordinator`` lease renewal
                           and agreement rounds (coordination-plane IO
                           flakes)
+``router``                ``serving.fleet.ServingRouter.submit`` — every
+                          request the front-end router forwards to a
+                          replica (routing-plane flakes)
+``replica_spawn``         ``serving.fleet.ServingReplica.start`` and the
+                          supervisor's respawn path — a replica dying
+                          during bring-up (before it takes its lease)
 ========================  ===================================================
 
 Plans are env-gated (``DL4J_TPU_FAULT_PLAN``) and the **off path is one
@@ -103,7 +109,8 @@ def _error_class(name: str):
 #: plan fails loudly instead of silently never firing
 KNOWN_SITES = frozenset({"ckpt_write", "ckpt_commit", "step",
                          "iterator", "worker_step", "serving",
-                         "host_death", "coordinator"})
+                         "host_death", "coordinator", "router",
+                         "replica_spawn"})
 
 #: the chaos vocabulary: plan names accepted by ``FaultPlan.parse``,
 #: ``tools/chaos.py --plan`` and ``DL4J_TPU_FAULT_PLAN`` itself
@@ -125,6 +132,17 @@ NAMED_PLANS = {
     # coordination-plane IO flakes: lease renewals / agreement rounds
     # hit a flaky shared filesystem
     "coord-flake": "coordinator:error=OSError:p=0.4:seed=9:max=2",
+    # one serving replica hard-dies mid-trace (`error=exit` = the
+    # in-process kill -9 analog, fired at the gateway worker's per-
+    # iteration serving site): the router must stop routing to it
+    # within a lease window and the supervisor respawns capacity
+    "replica-crash": "serving:error=exit:nth=25:max=1",
+    # the routing plane itself flakes: one forwarded request hits a
+    # connection error -> re-route, shed only within budget
+    "router-flake": "router:error=ConnectionError:nth=3:max=1",
+    # a replica dies during bring-up, before its first lease: the
+    # supervisor must observe the missing lease and spawn again
+    "spawn-crash": "replica_spawn:error=exit:nth=1:max=1",
 }
 
 _EXIT_CODE = 17         # `error=exit` status — distinguishable from crashes

@@ -16,8 +16,18 @@ over a paged/block KV cache, behind a front end that keeps
   pages, per-tenant round-robin fairness, graceful ``shutdown()``;
 - :mod:`~deeplearning4j_tpu.serving.loadgen` — the open/closed-loop
   synthetic trace driver (``tools/serving_trace.py`` CLI; bench/
-  dossier rows).
+  dossier rows);
+- :mod:`~deeplearning4j_tpu.serving.fleet` — the elastic fleet layer
+  (ARCHITECTURE.md §20): leased replicas publishing serving telemetry,
+  a health-steered :class:`ServingRouter`, and a capacity supervisor
+  with compile-store-backed zero-cold-start respawn.
 """
+from deeplearning4j_tpu.serving.fleet import (FleetSupervisor,
+                                              ReplicaServer,
+                                              RouterError,
+                                              ServingReplica,
+                                              ServingRouter,
+                                              STARTUP_PREFETCH)
 from deeplearning4j_tpu.serving.gateway import (SequenceAborted,
                                                 ServingGateway,
                                                 TokenStream)
@@ -25,4 +35,6 @@ from deeplearning4j_tpu.serving.kv_pager import KVPager, PageTableError
 from deeplearning4j_tpu.serving.scheduler import DecodeScheduler
 
 __all__ = ["ServingGateway", "TokenStream", "SequenceAborted",
-           "KVPager", "PageTableError", "DecodeScheduler"]
+           "KVPager", "PageTableError", "DecodeScheduler",
+           "ServingReplica", "ServingRouter", "ReplicaServer",
+           "FleetSupervisor", "RouterError", "STARTUP_PREFETCH"]

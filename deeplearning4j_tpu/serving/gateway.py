@@ -235,6 +235,7 @@ class ServingGateway:
         self._parked = threading.Event()    # worker's "I'm held" ack
         self._stop = threading.Event()
         self._worker: Optional[threading.Thread] = None
+        self._warm_report = None        # set by warmup(); ready() gate
         if start:
             self._worker = threading.Thread(target=self._loop,
                                             daemon=True)
@@ -245,7 +246,24 @@ class ServingGateway:
         """AOT-compile the decode step + every prefill bucket.
         Call BEFORE taking traffic (the worker is idle then; mid-
         traffic warmup would race the worker's compile cache)."""
-        return self._sched.warmup(prompt_lens)
+        report = self._sched.warmup(prompt_lens)
+        # the readiness gate's evidence: /healthz (and a fleet
+        # router) may only see this replica ready once every declared
+        # bucket is AOT-compiled — readiness ≠ liveness
+        self._warm_report = report
+        return report
+
+    def ready(self) -> bool:
+        """True once :meth:`warmup` has AOT-compiled every declared
+        bucket (and the gateway is not shut down). A live-but-cold
+        gateway is NOT ready: routing to it would cold-trace on the
+        request path."""
+        return (getattr(self, "_warm_report", None) is not None
+                and not self._shutdown.is_set())
+
+    def warm_report(self):
+        """The last :meth:`warmup` report (None before first warmup)."""
+        return getattr(self, "_warm_report", None)
 
     def pause(self, timeout: float = 30.0) -> bool:
         """Park the worker at its next loop top (any in-flight step

@@ -78,3 +78,38 @@ def test_chaos_serving_plan_sheds_and_survives():
     assert cow["prefix_hits"] >= 5 and cow["cow_copies"] >= 3
     assert cow["post_fault_dense_identical"] == 3
     assert cow["pages_conserved"] is True
+
+
+# the ISSUE 18 acceptance drill: 3 leased replicas, a multi-tenant
+# trace, one replica killed mid-trace (plan "replica-crash"), and the
+# router + supervisor + compile-store triad must hold every contract at
+# once — detection within one lease window, zero hung clients, losses
+# within the shed budget (all structured aborts), a respawned replica
+# whose warm path rides the compile store (aot/persistent-hit
+# evidence, cold TTFT ≤ 1.2× warm), and a post-drill epoch flip with
+# the new replica live+ready. ~20 s of wall plus warmups: slow lane.
+@pytest.mark.slow
+def test_chaos_serving_fleet_drill():
+    r = subprocess.run(
+        [sys.executable, CHAOS, "--serving-fleet"], cwd=REPO,
+        text=True, capture_output=True, timeout=580,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stdout[-4000:] + r.stderr[-4000:]
+    out = json.loads(r.stdout[r.stdout.index("{"):])
+    assert out["ok"] is True, out
+    res = out["results"][0]
+    assert res["mode"] == "serving-fleet"
+    assert res["victim_rc"] == 17                  # fault-plan exit
+    assert res["detect_s"] <= res["lease_s"] + 1.0
+    assert res["hung"] == 0
+    assert res["completed"] + res["aborted"] == res["requests"]
+    assert res["aborted"] <= res["shed_budget"]
+    assert res["router_sheds"] <= res["shed_budget"]
+    assert res["router_reroutes"] >= 1
+    assert res["new_manifest_hit"] is True
+    assert res["new_persistent_hits"] > 0 and res["new_aot_hits"] > 0
+    assert res["cold_ttft_p50_s"] <= \
+        1.2 * res["warm_ttft_p50_s"] + 0.01
+    assert res["epoch_after"] > res["epoch_before"]
+    assert res["new_replica_ready"] and res["new_replica_live"]
+    assert res["clean_exit"] is True

@@ -749,6 +749,178 @@ def test_lint_rule11_real_package_collectives_scoped():
     assert not problems, "\n".join(problems)
 
 
+# rule 12: the elastic serving fleet — prefetch table lockstep,
+# warm-before-lease ordering, and the router/fleet metric surface
+
+# the synthetic scheduler must satisfy rules 7/8/10 on its own (rule 8
+# SCOPE_SITES applies to any tree carrying serving/scheduler.py)
+_FLEET_SCHED = (
+    "SPEC_KS = (2,)\n"
+    "WARMUP_FEEDS = {'_build_step_fn': 'f',\n"
+    "                '_build_spec_step_fn': 'f',\n"
+    "                '_build_suffix_admit_fn': 'f'}\n"
+    "class S:\n"
+    "    def _build_step_fn(self):\n"
+    "        return devtime.scope('serve.decode')\n"
+    "    def _build_spec_step_fn(self):\n"
+    "        return devtime.scope('serve.spec')\n"
+    "    def _build_suffix_admit_fn(self):\n"
+    "        return devtime.scope('serve.admit')\n"
+    "    def warmup(self):\n"
+    "        for k in SPEC_KS:\n"
+    "            pass\n"
+    "        return WARMUP_FEEDS\n")
+
+_CLEAN_FLEET = (
+    "STARTUP_PREFETCH = ('_build_step_fn', '_build_spec_step_fn',\n"
+    "                    '_build_suffix_admit_fn')\n"
+    "class ServingReplica:\n"
+    "    def start(self):\n"
+    "        self.gateway.warmup()\n"
+    "        self.coord.renew()\n"
+    "        self.coord.start_auto_renew()\n")
+
+
+def _fleet_tree(tmp_path, fleet_text, sched_text=_FLEET_SCHED):
+    sdir = tmp_path / "pkg" / "serving"
+    sdir.mkdir(parents=True, exist_ok=True)
+    (sdir / "fleet.py").write_text(fleet_text)
+    if sched_text is not None:
+        (sdir / "scheduler.py").write_text(sched_text)
+    return tmp_path / "pkg"
+
+
+def test_lint_rule12_clean_fleet_passes(tmp_path):
+    pkg = _fleet_tree(tmp_path, _CLEAN_FLEET)
+    assert not lint_instrumentation.run(pkg, tmp_path / "tests")
+
+
+def test_lint_rule12_prefetch_mirrors_warmup_feeds(tmp_path):
+    """Rule 12: a scheduler builder missing from STARTUP_PREFETCH
+    cold-traces on the respawned replica's first request; a prefetch
+    entry naming no builder is stale — both directions flagged."""
+    pkg = _fleet_tree(
+        tmp_path,
+        "STARTUP_PREFETCH = ('_build_step_fn',\n"
+        "                    '_build_spec_step_fn',\n"
+        "                    '_build_ghost_fn')\n"
+        "class ServingReplica:\n"
+        "    def start(self):\n"
+        "        self.gateway.warmup()\n"
+        "        self.coord.renew()\n")
+    problems = lint_instrumentation.run(pkg, tmp_path / "tests")
+    assert any("_build_suffix_admit_fn" in p
+               and "missing from STARTUP_PREFETCH" in p
+               for p in problems)
+    assert any("'_build_ghost_fn'" in p and "stale" in p
+               for p in problems)
+
+
+def test_lint_rule12_missing_prefetch_table(tmp_path):
+    pkg = _fleet_tree(
+        tmp_path,
+        "class ServingReplica:\n"
+        "    def start(self):\n"
+        "        self.gateway.warmup()\n"
+        "        self.coord.renew()\n")
+    problems = lint_instrumentation.run(pkg, tmp_path / "tests")
+    assert any("no module-level STARTUP_PREFETCH" in p
+               for p in problems)
+
+
+def test_lint_rule12_lease_before_warm_flagged(tmp_path):
+    """Rule 12 ordering: a ServingReplica.start that acquires its
+    membership lease before warmup() advertises a cold replica to the
+    router; a start that never warms is flagged too."""
+    pkg = _fleet_tree(
+        tmp_path,
+        "STARTUP_PREFETCH = ('_build_step_fn',\n"
+        "                    '_build_spec_step_fn',\n"
+        "                    '_build_suffix_admit_fn')\n"
+        "class ServingReplica:\n"
+        "    def start(self):\n"
+        "        self.coord.renew()\n"
+        "        self.gateway.warmup()\n")
+    problems = lint_instrumentation.run(pkg, tmp_path / "tests")
+    assert any("lease before warmup()" in p for p in problems)
+    pkg = _fleet_tree(
+        tmp_path,
+        "STARTUP_PREFETCH = ('_build_step_fn',\n"
+        "                    '_build_spec_step_fn',\n"
+        "                    '_build_suffix_admit_fn')\n"
+        "class ServingReplica:\n"
+        "    def start(self):\n"
+        "        self.coord.start_auto_renew()\n")
+    problems = lint_instrumentation.run(pkg, tmp_path / "tests")
+    assert any("never calls warmup()" in p for p in problems)
+
+
+def test_lint_rule12_fleet_metric_surface(tmp_path):
+    """Rule 12 metric side: a declared-but-unemitted fleet family, a
+    consumer token matching no family, a tpu_watch with no router
+    family, and a FAMILIES table with no serving-fleet prefix at all
+    are each flagged with fleet-specific messages."""
+    pkg, tools_dir, docs_dir = _metrics_tree(
+        tmp_path,
+        families={"dl4j_tpu_router_requests_total": "counter",
+                  "dl4j_tpu_router_sheds_total": "counter"},
+        body='C = REGISTRY.counter('
+             '"dl4j_tpu_router_requests_total", "d")\n',
+        watch='KEYS = ("dl4j_tpu_router_requests_total",)\n',
+        ops="Watch `dl4j_tpu_router_ghost_total` here.\n")
+    _fleet_tree(tmp_path, _CLEAN_FLEET)
+    problems = lint_instrumentation.run(pkg, tmp_path / "tests",
+                                        tools_dir, docs_dir)
+    assert any("dl4j_tpu_router_sheds_total" in p
+               and "never emitted" in p for p in problems)
+    assert any("OPS.md" in p and "dl4j_tpu_router_ghost_total" in p
+               and "fleet metric" in p for p in problems)
+    assert any("no dl4j_tpu_serving_fleet_* family" in p
+               for p in problems)
+    # the watch references a router family: not flagged for that
+    assert not any("tpu_watch" in p
+                   and "no dl4j_tpu_router_* family" in p
+                   for p in problems)
+
+
+def test_lint_rule12_watch_must_reference_router(tmp_path):
+    pkg, tools_dir, docs_dir = _metrics_tree(
+        tmp_path,
+        families={"dl4j_tpu_router_requests_total": "counter",
+                  "dl4j_tpu_serving_fleet_spawns_total": "counter"},
+        body='C = REGISTRY.counter('
+             '"dl4j_tpu_router_requests_total", "d")\n'
+             'S = REGISTRY.counter('
+             '"dl4j_tpu_serving_fleet_spawns_total", "d")\n',
+        watch='KEYS = ("dl4j_tpu_serving_fleet_spawns_total",)\n')
+    _fleet_tree(tmp_path, _CLEAN_FLEET)
+    problems = lint_instrumentation.run(pkg, tmp_path / "tests",
+                                        tools_dir, docs_dir)
+    assert any("tpu_watch" in p
+               and "no dl4j_tpu_router_* family" in p
+               for p in problems)
+
+
+def test_lint_rule12_gated_off_without_fleet_module(tmp_path):
+    """A tree without serving/fleet.py gets no fleet-plane demands."""
+    pkg, tools_dir, docs_dir = _metrics_tree(
+        tmp_path, families={"dl4j_tpu_steps_total": "counter"},
+        body='C = REGISTRY.counter("dl4j_tpu_steps_total", "d")\n',
+        watch='KEYS = ("dl4j_tpu_steps_total",)\n')
+    assert not lint_instrumentation.run(pkg, tmp_path / "tests",
+                                        tools_dir, docs_dir)
+
+
+def test_lint_rule12_real_package_fleet_contract():
+    """The live package: the prefetch table mirrors the warmup feeds,
+    start() warms before it leases, and the router/fleet families all
+    have emit sites + dashboard coverage."""
+    problems = [p for p in lint_instrumentation.run()
+                if "fleet" in p or "STARTUP_PREFETCH" in p
+                or "router" in p]
+    assert not problems, "\n".join(problems)
+
+
 def test_lint_rule9_real_package_kernels_registered():
     """The live package: every public kernel in ops/ is registered
     with a resolvable fallback/parity/scope, and no pallas_call lives

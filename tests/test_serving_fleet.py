@@ -1,0 +1,404 @@
+"""Elastic serving fleet (serving/fleet.py — ISSUE 18).
+
+Unit-level fences under the chaos drill
+(``tools/chaos.py --serving-fleet``, the slow-lane acceptance):
+
+- **eligibility**: the router admits only to live (lease evidence)
+  AND ready (warmup-complete) replicas — an expired lease or a
+  published ``ready=False`` removes a replica within one aggregator
+  read;
+- **steering**: least-loaded placement uses published load PLUS the
+  router's own in-flight accounting, so stale ties never pin the
+  whole fleet onto the lexically first host;
+- **loss discipline**: a transport failure re-routes; an impossible
+  placement is a *structured* ``SequenceAborted`` bounded by the shed
+  budget — never a hang, never a bare exception;
+- **cold-start ordering**: ``ServingReplica.start`` warms every
+  ``STARTUP_PREFETCH`` bucket (compile-store manifest consulted)
+  BEFORE the first lease renewal, and ``/healthz`` answers 503 until
+  the gateway is warm (the readiness gate satellite);
+- **supervision**: the supervisor respawns to target without
+  double-spawning a pending replica.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deeplearning4j_tpu.obs import fleet as obs_fleet
+from deeplearning4j_tpu.perf.compile_store import CompileStore
+from deeplearning4j_tpu.resilience import faults
+from deeplearning4j_tpu.resilience.elastic import MembershipCoordinator
+from deeplearning4j_tpu.serving import scheduler as serving_scheduler
+from deeplearning4j_tpu.serving.fleet import (STARTUP_PREFETCH,
+                                              FleetSupervisor,
+                                              HttpTransport,
+                                              ReplicaServer, RouterError,
+                                              ServingReplica,
+                                              ServingRouter)
+from deeplearning4j_tpu.serving.gateway import (SequenceAborted,
+                                                ServingGateway)
+from deeplearning4j_tpu.zoo.gpt import CausalTransformerLM
+
+
+# =========================================================================
+# static contracts: fault sites, prefetch table
+# =========================================================================
+
+def test_fleet_fault_sites_registered():
+    """The drill's kill switches exist: per-request "router" site,
+    per-bring-up "replica_spawn" site, and the named "replica-crash"
+    plan targeting the gateway's serving loop."""
+    assert "router" in faults.KNOWN_SITES
+    assert "replica_spawn" in faults.KNOWN_SITES
+    assert faults.NAMED_PLANS["replica-crash"].startswith("serving:")
+    assert "replica_spawn" in faults.NAMED_PLANS["spawn-crash"]
+
+
+def test_startup_prefetch_mirrors_warmup_feeds():
+    """Runtime half of lint rule 12: the fleet's prefetch table and
+    the scheduler's WARMUP_FEEDS declare the same builder set."""
+    assert sorted(STARTUP_PREFETCH) == \
+        sorted(serving_scheduler.WARMUP_FEEDS)
+    assert len(set(STARTUP_PREFETCH)) == len(STARTUP_PREFETCH)
+
+
+# =========================================================================
+# router: eligibility, steering, re-route, structured shed
+# =========================================================================
+
+class _ScriptedTransport:
+    """Injectable wire: per-addr action (exception to raise, callable,
+    or default success) + a call log."""
+
+    def __init__(self, script=None):
+        self.script = dict(script or {})
+        self.calls = []
+
+    def generate(self, addr, payload):
+        self.calls.append(addr)
+        action = self.script.get(addr)
+        if isinstance(action, Exception):
+            raise action
+        if callable(action):
+            return action(addr, payload)
+        return {"tokens": [1, 2, 3], "rid": len(self.calls)}
+
+
+class _Fleet:
+    """A telemetry+lease plane under a tmp dir with a settable fake
+    clock — publish replicas in any liveness/readiness state."""
+
+    def __init__(self, root):
+        self.root = root
+        self.t = [1000.0]
+        self.coords = {}
+
+    def clock(self):
+        return self.t[0]
+
+    def publish(self, host, *, ready=True, lease=True,
+                lease_secs=5.0, queue_depth=0, active=0):
+        if lease and host not in self.coords:
+            self.coords[host] = MembershipCoordinator(
+                self.root, host, n_devices=1, lease_secs=lease_secs,
+                clock=self.clock)
+        if lease:
+            self.coords[host].renew()
+        tel = obs_fleet.FleetTelemetry(self.root, host, every_s=0.0,
+                                       clock=self.clock)
+        tel.update_serving(ready=ready, addr=f"127.0.0.1:{host}",
+                           queue_depth=queue_depth, active=active)
+        tel.publish(force=True)
+
+    def router(self, transport, **kw):
+        kw.setdefault("shed_budget", 8)
+        kw.setdefault("retry_pause_s", 0.005)
+        return ServingRouter(self.root, transport=transport,
+                             clock=self.clock, **kw)
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    return _Fleet(tmp_path)
+
+
+def test_router_admits_only_live_and_ready(fleet):
+    fleet.publish("a", ready=True)
+    fleet.publish("b", ready=False)           # warming: leased, not ready
+    fleet.publish("c", ready=True, lease=False)   # no lease evidence
+    tr = _ScriptedTransport()
+    router = fleet.router(tr)
+    assert sorted(router.replicas()) == ["a"]
+    out = router.submit([1, 2], deadline_s=2.0)
+    assert out["replica"] == "a"
+    assert tr.calls == ["127.0.0.1:a"]
+
+
+def test_router_drops_replica_whose_lease_expired(fleet):
+    fleet.publish("a", ready=True, lease_secs=2.0)
+    router = fleet.router(_ScriptedTransport())
+    assert sorted(router.replicas()) == ["a"]
+    fleet.t[0] += 3.5                          # lease window elapses
+    assert router.replicas() == {}
+
+
+def test_router_reroutes_on_transport_failure(fleet):
+    fleet.publish("a", ready=True)
+    fleet.publish("b", ready=True)
+    tr = _ScriptedTransport(
+        {"127.0.0.1:a": RouterError("replica a unreachable")})
+    router = fleet.router(tr)
+    out = router.submit([1], deadline_s=5.0)
+    assert out["replica"] == "b"
+    assert router.reroutes == 1 and router.sheds == 0
+    assert tr.calls == ["127.0.0.1:a", "127.0.0.1:b"]
+
+
+def test_router_inflight_accounting_breaks_stale_ties(fleet):
+    """Published load refreshes once per replica tick; with two idle
+    replicas every published tie would send ALL traffic to the
+    lexically first host. The router's own in-flight count must steer
+    the second concurrent request to the other replica."""
+    fleet.publish("a", ready=True)
+    fleet.publish("b", ready=True)
+    placed = []
+    router = None
+
+    def outer(addr, payload):
+        placed.append(addr)
+        if len(placed) == 1:
+            # while the first request is in flight on this host, a
+            # second placement must pick the OTHER replica
+            inner = router.submit([9], deadline_s=5.0)
+            placed.append(("inner", inner["replica"]))
+        return {"tokens": []}
+
+    tr = _ScriptedTransport({"127.0.0.1:a": outer, "127.0.0.1:b": outer})
+    router = fleet.router(tr)
+    out = router.submit([1], deadline_s=5.0)
+    assert out["replica"] == "a"               # published tie -> first
+    assert placed[1] == "127.0.0.1:b"          # in-flight broke the tie
+    assert placed[2] == ("inner", "b")
+    # both slots drained afterwards
+    assert router._inflight == {}
+
+
+def test_router_sheds_structured_never_hangs(fleet):
+    """No replica at all: submit returns within the deadline with a
+    SequenceAborted (reason recorded), not a hang or a bare error."""
+    router = fleet.router(_ScriptedTransport(), shed_budget=8)
+    router.clock = time.time                   # real deadline math
+    t0 = time.time()
+    with pytest.raises(SequenceAborted) as e:
+        router.submit([1], deadline_s=0.25)
+    assert time.time() - t0 < 5.0
+    assert "no live+ready replica" in str(e.value)
+    assert router.sheds == 1
+
+
+def test_router_shed_budget_marks_over_budget(fleet):
+    router = fleet.router(_ScriptedTransport(), shed_budget=1)
+    router.clock = time.time
+    with pytest.raises(SequenceAborted):
+        router.submit([1], deadline_s=0.05)
+    with pytest.raises(SequenceAborted) as e:
+        router.submit([1], deadline_s=0.05)
+    assert "budget" in str(e.value)
+    assert router.sheds == 2
+
+
+def test_router_surfaces_replica_abort_without_retry(fleet):
+    """A 409 from the replica is the structured-abort contract mid-
+    stream — structural loss to surface, not a transport flake to
+    retry (retrying would double-bill the shed budget's evidence)."""
+    fleet.publish("a", ready=True)
+    fleet.publish("b", ready=True)
+    tr = _ScriptedTransport(
+        {"127.0.0.1:a": SequenceAborted("replica died mid-decode",
+                                        tokens=[4, 5])})
+    router = fleet.router(tr)
+    with pytest.raises(SequenceAborted) as e:
+        router.submit([1], deadline_s=5.0)
+    assert len(tr.calls) == 1                  # no blind retry
+    assert router.sheds == 1 and router.reroutes == 0
+    assert isinstance(e.value.cause, SequenceAborted)
+    assert list(e.value.cause.tokens) == [4, 5]
+
+
+def test_http_transport_maps_409_to_sequence_aborted():
+    """The wire preserves the structured abort: tokens-so-far + cause
+    cross the HTTP boundary intact; 5xx stays a re-routable
+    RouterError."""
+    import http.server
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            code = 409 if self.path == "/generate" else 500
+            body = json.dumps({"error": "aborted", "message": "boom",
+                               "tokens": [7, 8],
+                               "cause": "Evicted"}).encode()
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    addr = "127.0.0.1:%d" % httpd.server_address[1]
+    tr = HttpTransport(timeout_s=5.0)
+    try:
+        with pytest.raises(SequenceAborted) as e:
+            tr.generate(addr, {"prompt": [1]})
+        assert list(e.value.tokens) == [7, 8]
+        assert "boom" in str(e.value)
+        with pytest.raises(RouterError):
+            tr.generate("127.0.0.1:1", {"prompt": [1]})  # refused
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# =========================================================================
+# supervisor: respawn to target, pending is not double-spawned
+# =========================================================================
+
+class _FakeCoord:
+    def __init__(self, live):
+        self.live = list(live)
+        self.expired = []
+
+    def evict_expired(self, now=None):
+        out, self.expired = self.expired, []
+        return out
+
+    def live_members(self, now=None):
+        return sorted(self.live)
+
+
+def test_supervisor_respawns_to_target_without_double_spawn():
+    coord = _FakeCoord(["r0"])
+    n = [0]
+
+    def spawn():
+        n[0] += 1
+        return f"r{n[0]}"
+
+    sup = FleetSupervisor(coord, spawn, target=3, clock=lambda: 0.0)
+    out = sup.poll()
+    assert out["spawned"] == ["r1", "r2"]
+    # spawned-but-not-yet-leased replicas are pending, not respawned
+    assert sup.poll()["spawned"] == []
+    coord.live += ["r1", "r2"]                 # leases appear
+    assert sup.poll() == {"evicted": [], "live": ["r0", "r1", "r2"],
+                          "spawned": [], "pending": []}
+    # an eviction re-opens exactly one slot
+    coord.live.remove("r1")
+    coord.expired = ["r1"]
+    out = sup.poll()
+    assert out["evicted"] == ["r1"] and out["spawned"] == ["r3"]
+
+
+# =========================================================================
+# replica lifecycle: readiness gate + warm-before-lease ordering
+# =========================================================================
+
+def _tiny_gateway():
+    model = CausalTransformerLM(hidden=32, n_layers=2, n_heads=2,
+                                n_kv_heads=1, max_len=64, seed=9,
+                                vocab_size=64)
+    return ServingGateway(model, model.init(), max_slots=2, block=8,
+                          max_context=64)
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_healthz_gates_traffic_until_warm():
+    """The readiness satellite: /healthz (and /generate) answer 503
+    "warming" until warmup AOT-compiled every declared bucket — a
+    cold replica never cold-traces on the request path."""
+    gw = _tiny_gateway()
+    srv = ReplicaServer(gw).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        code, body = _get(base + "/healthz")
+        assert (code, body["status"]) == (503, "warming")
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"prompt": [1, 2, 3]}).encode(),
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 503
+        gw.warmup(prompt_lens=(8,))
+        code, body = _get(base + "/healthz")
+        assert (code, body["status"]) == (200, "ok")
+        stats = _get(base + "/stats")[1]
+        assert stats["ready"] is True and stats["aot_hits"] >= 0
+        assert stats["warm_buckets"]
+        out = HttpTransport(timeout_s=30).generate(
+            f"127.0.0.1:{srv.port}",
+            {"prompt": [1, 2, 3], "max_new": 4})
+        assert len(out["tokens"]) >= 4
+    finally:
+        srv.stop()
+        gw.shutdown()
+
+
+def test_replica_start_warms_before_lease(tmp_path):
+    """Runtime half of lint rule 12's ordering clause: start() runs
+    the startup prefetch and opens the HTTP front end BEFORE the first
+    lease renewal, so a router can never see a lease on a cold
+    replica. Also: the compile-store manifest misses cold and hits on
+    the next same-fingerprint bring-up."""
+    gw = _tiny_gateway()
+    store = CompileStore(tmp_path / "store", jaxlib="t", topology="cpu")
+    order = []
+    coord = MembershipCoordinator(tmp_path / "fleet", "h0",
+                                  n_devices=1, lease_secs=30.0)
+    tel = obs_fleet.FleetTelemetry(tmp_path / "fleet", "h0",
+                                   every_s=0.0)
+    real_warm, real_renew = gw.warmup, coord.renew
+    gw.warmup = lambda *a, **k: (order.append("warmup"),
+                                 real_warm(*a, **k))[1]
+    coord.renew = lambda: (order.append("renew"), real_renew())[1]
+    rep = ServingReplica(gw, coord, tel, store=store)
+    try:
+        report = rep.start(prompt_lens=(8,))
+        assert order == ["warmup", "renew"]
+        assert report["manifest_hit"] is False
+        assert gw.ready() and rep.server is not None
+        # the published snapshot is immediately router-visible
+        view = obs_fleet.aggregate(tmp_path / "fleet")
+        row = view.serving_table()["h0"]
+        assert row["ready"] and row["live"]
+        assert row["addr"] == f"127.0.0.1:{rep.server.port}"
+        tick = rep.tick()
+        assert "h0" in tick["live"]
+    finally:
+        rep.stop()
+    # same fingerprint, second bring-up: manifest hit (the fleet-store
+    # half of zero-cold-start; the xla/ plane is proven in the drill)
+    coord2 = MembershipCoordinator(tmp_path / "fleet", "h1",
+                                   n_devices=1, lease_secs=30.0)
+    tel2 = obs_fleet.FleetTelemetry(tmp_path / "fleet", "h1",
+                                    every_s=0.0)
+    gw2 = _tiny_gateway()
+    rep2 = ServingReplica(gw2, coord2, tel2, store=store)
+    try:
+        assert rep2.start(prompt_lens=(8,))["manifest_hit"] is True
+        assert store.counters()["hits"] >= 1
+    finally:
+        rep2.stop()

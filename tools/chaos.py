@@ -713,6 +713,371 @@ def _elastic_preempt_scenario(hosts: int = 2,
         return res
 
 
+REPLICA_WORKER = textwrap.dedent("""
+    import json, os, sys, time, warnings
+    sys.path.insert(0, %(repo)r)
+    warnings.filterwarnings("ignore")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from deeplearning4j_tpu.obs import fleet as obs_fleet
+    from deeplearning4j_tpu.perf import compile_store
+    from deeplearning4j_tpu.resilience.elastic import \\
+        MembershipCoordinator
+    from deeplearning4j_tpu.serving.fleet import ServingReplica
+    from deeplearning4j_tpu.serving.gateway import ServingGateway
+    from deeplearning4j_tpu.zoo import GPTNano
+
+    host = os.environ["REPLICA_ID"]
+    fleet_dir = os.environ["FLEET_DIR"]
+    lease = float(os.environ["LEASE_S"])
+    reports = os.path.join(fleet_dir, "reports")
+    os.makedirs(reports, exist_ok=True)
+
+    model = GPTNano(vocab_size=64, max_len=64, seed=7)
+    net = model.init()
+    gw = ServingGateway(model, net, max_slots=4, block=8,
+                        max_context=64)
+    co = MembershipCoordinator(fleet_dir, host, n_devices=1,
+                               lease_secs=lease)
+    tel = obs_fleet.FleetTelemetry(fleet_dir, host, every_s=0.05)
+    rep = ServingReplica(gw, co, tel,
+                         store=compile_store.from_env())
+    t0 = time.perf_counter()
+    report = rep.start(prompt_lens=(8, 16))
+    report["warm_s"] = round(time.perf_counter() - t0, 3)
+    report["port"] = rep.server.port
+    report["cache"] = dict(rep.server.stats().get("cache") or {})
+    with open(os.path.join(reports, host + ".json"), "w") as f:
+        json.dump(report, f)
+    print("REPLICA %%s READY port=%%d warm_s=%%.3f manifest_hit=%%s"
+          %% (host, rep.server.port, report["warm_s"],
+             report["manifest_hit"]), flush=True)
+    stop = os.path.join(fleet_dir, "STOP")
+    while not os.path.exists(stop):
+        rep.tick()
+        time.sleep(lease / 4.0)
+    final = rep.server.stats()
+    final["epoch"] = tel.mesh_epoch
+    with open(os.path.join(reports, host + "_final.json"), "w") as f:
+        json.dump(final, f)
+    rep.stop()
+    print("REPLICA %%s DONE epoch=%%d" %% (host, final["epoch"]),
+          flush=True)
+    sys.stdout.flush()
+    os._exit(0)
+""")
+
+
+def _serving_fleet_scenario(replicas: int = 3, lease_s: float = 2.0,
+                            trace_requests: int = 36,
+                            threads: int = 6,
+                            tenants: int = 4) -> dict:
+    """ISSUE 18 acceptance drill (``--serving-fleet``): a 3-replica
+    serving fleet under a multi-tenant loadgen trace; one replica is
+    killed mid-trace by the ``replica-crash`` plan (``os._exit`` at
+    its nth decode step). Asserts (a) the router stops routing to the
+    corpse within one lease window, (b) every loss is a structured
+    ``SequenceAborted`` bounded by the shed budget — zero hung
+    clients, (c) the supervisor respawns a replica whose startup
+    prefetch rides the shared compile store (manifest hit +
+    persistent-cache hits + AOT hits; p50 TTFT <= 1.2x warm), and
+    (d) the post-drill fleet view shows the membership epoch flipped
+    with the new replica live+ready."""
+    import statistics
+    import tempfile
+    import threading as _threading
+    import urllib.request
+
+    from deeplearning4j_tpu import environment
+    from deeplearning4j_tpu.resilience.elastic import \
+        MembershipCoordinator
+    from deeplearning4j_tpu.serving.fleet import (FleetSupervisor,
+                                                  HttpTransport,
+                                                  ServingRouter)
+    from deeplearning4j_tpu.serving.gateway import SequenceAborted
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    victim = "r1"
+    shed_budget = int(
+        environment.get_flag("DL4J_TPU_FLEET_SHED_BUDGET"))
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="chaos_fleet_") as d:
+        fleet_dir = os.path.join(d, "fleet")
+        os.makedirs(fleet_dir)
+        script = os.path.join(d, "replica_worker.py")
+        with open(script, "w") as f:
+            f.write(REPLICA_WORKER % {"repo": repo})
+        base_env = dict(os.environ,
+                        FLEET_DIR=fleet_dir, LEASE_S=str(lease_s),
+                        JAX_PLATFORMS="cpu",
+                        DL4J_TPU_COMPILE_STORE=os.path.join(d, "store"),
+                        DL4J_TPU_FLEET_PUBLISH_SECS="0.05")
+        base_env.pop("DL4J_TPU_FAULT_PLAN", None)
+        procs: dict = {}
+        logs: dict = {}
+        spawn_times: dict = {}
+        sup_stop = _threading.Event()
+
+        def spawn(host, plan=None):
+            env = dict(base_env, REPLICA_ID=host)
+            if plan:
+                env["DL4J_TPU_FAULT_PLAN"] = plan
+            logs[host] = os.path.join(d, f"{host}.log")
+            out = open(logs[host], "w")
+            spawn_times[host] = time.perf_counter()
+            procs[host] = subprocess.Popen(
+                [sys.executable, script], env=env, cwd=repo,
+                stdout=out, stderr=subprocess.STDOUT)
+            return host
+
+        def tails():
+            return {h: open(p).read()[-1500:]
+                    for h, p in logs.items() if os.path.exists(p)}
+
+        def fail(why, **extra):
+            # tear the fleet down before reporting: the drill never
+            # leaks subprocesses, even on a failed assertion path
+            sup_stop.set()
+            with open(os.path.join(fleet_dir, "STOP"), "w"):
+                pass
+            for p in list(procs.values()):
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            out = {"mode": "serving-fleet", "ok": False, "why": why,
+                   "wall_s": round(time.perf_counter() - t0, 2),
+                   "output_tails": tails()}
+            out.update(extra)
+            return out
+
+        for i in range(replicas):
+            spawn(f"r{i}",
+                  plan="replica-crash" if f"r{i}" == victim else None)
+        router = ServingRouter(fleet_dir, shed_budget=shed_budget,
+                               request_timeout_s=30.0)
+
+        deadline = time.perf_counter() + 300
+        while len(router.replicas()) < replicas:
+            if time.perf_counter() > deadline:
+                return fail("fleet never became ready")
+            time.sleep(0.1)
+
+        # stable membership baseline: every replica leased and the
+        # epoch committed over the full set before any chaos
+        co_sup = MembershipCoordinator(fleet_dir, "supervisor",
+                                       n_devices=1,
+                                       lease_secs=lease_s)
+        all_hosts = sorted(f"r{i}" for i in range(replicas))
+        deadline = time.perf_counter() + 120
+        epoch0 = None
+        while time.perf_counter() < deadline:
+            rec = co_sup.epoch_record()
+            if rec and sorted(rec.get("members", [])) == all_hosts:
+                epoch0 = int(rec["epoch"])
+                break
+            time.sleep(0.1)
+        if epoch0 is None:
+            return fail("no committed epoch over the full fleet")
+
+        transport = HttpTransport(timeout_s=30.0)
+
+        def probe_ttfts(host, n=5):
+            addr = router.replicas()[host]["addr"]
+            vals = []
+            for i in range(n):
+                out = transport.generate(addr, {
+                    "prompt": [1 + i, 2, 3, 4, 5, 6], "max_new": 4,
+                    "tenant": "probe", "temperature": None})
+                vals.append(float(out["ttft_s"]))
+            return vals
+
+        # warm TTFT baseline from the two survivors-to-be (probing
+        # the victim would advance its fault counter off-trace)
+        warm_ttfts = []
+        for h in all_hosts:
+            if h != victim:
+                warm_ttfts.extend(probe_ttfts(h, n=4))
+        warm_p50 = statistics.median(warm_ttfts)
+
+        # capacity supervisor: respawn on eviction, same worker
+        # script — its warm path must ride the shared compile store
+        next_id = [replicas]
+
+        def _spawn_next():
+            host = f"r{next_id[0]}"
+            next_id[0] += 1
+            return spawn(host)
+
+        sup = FleetSupervisor(co_sup, _spawn_next, target=replicas)
+
+        def _sup_loop():
+            while not sup_stop.is_set():
+                try:
+                    sup.poll()
+                except OSError:
+                    pass
+                sup_stop.wait(0.3)
+
+        sup_thread = _threading.Thread(target=_sup_loop, daemon=True)
+        sup_thread.start()
+
+        # the multi-tenant loadgen trace, driven through the router
+        rng = np.random.RandomState(17)
+        reqs = [{"prompt": rng.randint(0, 64, rng.randint(4, 15)
+                                       ).astype(int).tolist(),
+                 "max_new": int(rng.randint(6, 11)),
+                 "tenant": f"t{i % tenants}"}
+                for i in range(trace_requests)]
+        results: list = []
+        res_lock = _threading.Lock()
+
+        def drive(chunk):
+            for r in chunk:
+                try:
+                    out = router.submit(r["prompt"],
+                                        max_new=r["max_new"],
+                                        tenant=r["tenant"],
+                                        deadline_s=30.0)
+                    rec = {"ok": True, "replica": out["replica"],
+                           "ttft_s": out["ttft_s"]}
+                except SequenceAborted as e:
+                    rec = {"ok": False, "aborted": True,
+                           "message": str(e)}
+                except Exception as e:   # anything else fails the drill
+                    rec = {"ok": False, "aborted": False,
+                           "error": repr(e)}
+                with res_lock:
+                    results.append(rec)
+
+        drivers = [_threading.Thread(
+            target=drive, args=(reqs[i::threads],), daemon=True)
+            for i in range(threads)]
+        for th in drivers:
+            th.start()
+
+        # the victim self-destructs mid-trace (replica-crash plan);
+        # measure how long the router keeps believing in the corpse
+        deadline = time.perf_counter() + 120
+        while procs[victim].poll() is None:
+            if time.perf_counter() > deadline:
+                return fail("victim never crashed")
+            time.sleep(0.02)
+        t_dead = time.perf_counter()
+        detect_s = None
+        while time.perf_counter() - t_dead < 4 * lease_s:
+            if victim not in router.replicas():
+                detect_s = time.perf_counter() - t_dead
+                break
+            time.sleep(0.05)
+
+        for th in drivers:
+            th.join(timeout=180)
+        hung = sum(1 for th in drivers if th.is_alive())
+
+        # the respawned replica: ready via the compile store
+        new_host = f"r{replicas}"
+        deadline = time.perf_counter() + 300
+        while new_host not in router.replicas():
+            if time.perf_counter() > deadline:
+                return fail("supervisor never respawned capacity",
+                            detect_s=detect_s)
+            time.sleep(0.1)
+        respawn_ready_s = (time.perf_counter()
+                          - spawn_times.get(new_host, t_dead))
+        cold_p50 = statistics.median(probe_ttfts(new_host, n=5))
+        with open(os.path.join(fleet_dir, "reports",
+                               f"{new_host}.json")) as f:
+            new_report = json.load(f)
+        with urllib.request.urlopen(
+                "http://{}/stats".format(
+                    router.replicas()[new_host]["addr"]),
+                timeout=10) as r:
+            new_stats = json.loads(r.read())
+
+        # post-drill fleet view: epoch flipped, new replica live+ready
+        survivors = sorted(set(all_hosts) - {victim} | {new_host})
+        deadline = time.perf_counter() + 120
+        epoch_after = None
+        while time.perf_counter() < deadline:
+            rec = co_sup.epoch_record()
+            if rec and sorted(rec.get("members", [])) == survivors \
+                    and int(rec["epoch"]) > epoch0:
+                epoch_after = int(rec["epoch"])
+                break
+            time.sleep(0.1)
+        from deeplearning4j_tpu.obs import fleet as obs_fleet
+        table = obs_fleet.aggregate(fleet_dir).serving_table()
+        new_row = table.get(new_host) or {}
+
+        with open(os.path.join(fleet_dir, "STOP"), "w"):
+            pass
+        sup_stop.set()
+        sup_thread.join(timeout=10)
+        clean_exit = True
+        for h, p in procs.items():
+            try:
+                p.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                clean_exit = False
+        for h in survivors:
+            clean_exit = clean_exit and procs[h].returncode == 0
+
+        victim_log = open(logs[victim]).read()
+        completed = sum(1 for r in results if r["ok"])
+        aborted = sum(1 for r in results if r.get("aborted"))
+        errors = [r for r in results
+                  if not r["ok"] and not r.get("aborted")]
+        ok = (procs[victim].returncode == 17
+              and "fault injection: firing" in victim_log
+              and detect_s is not None and detect_s <= lease_s + 1.0
+              and hung == 0 and not errors
+              and completed + aborted == trace_requests
+              and aborted <= shed_budget
+              and router.sheds <= shed_budget
+              and router.reroutes >= 1
+              and new_report.get("manifest_hit") is True
+              and int(new_report.get("cache", {})
+                      .get("persistent_hits", 0)) > 0
+              and int(new_stats.get("aot_hits", 0)) > 0
+              and cold_p50 <= 1.2 * warm_p50 + 0.01
+              and epoch_after is not None
+              and bool(new_row.get("ready"))
+              and bool(new_row.get("live"))
+              and clean_exit)
+        res = {"mode": "serving-fleet", "replicas": replicas,
+               "victim": victim,
+               "victim_rc": procs[victim].returncode,
+               "lease_s": lease_s, "detect_s": detect_s,
+               "requests": trace_requests, "completed": completed,
+               "aborted": aborted, "hung": hung,
+               "router_sheds": router.sheds,
+               "router_reroutes": router.reroutes,
+               "shed_budget": shed_budget,
+               "warm_ttft_p50_s": round(warm_p50, 4),
+               "cold_ttft_p50_s": round(cold_p50, 4),
+               "respawn_ready_s": round(respawn_ready_s, 2),
+               "new_replica": new_host,
+               "new_manifest_hit": new_report.get("manifest_hit"),
+               "new_persistent_hits": int(
+                   new_report.get("cache", {})
+                   .get("persistent_hits", 0)),
+               "new_aot_hits": int(new_stats.get("aot_hits", 0)),
+               "new_warm_s": new_report.get("warm_s"),
+               "epoch_before": epoch0, "epoch_after": epoch_after,
+               "new_replica_ready": bool(new_row.get("ready")),
+               "new_replica_live": bool(new_row.get("live")),
+               "clean_exit": clean_exit,
+               "wall_s": round(time.perf_counter() - t0, 2),
+               "ok": bool(ok)}
+        if not ok:                  # post-mortem material
+            res["output_tails"] = tails()
+            res["errors"] = errors[:5]
+        return res
+
+
 def _example_scenario(example: str, plan: str, restarts: int) -> dict:
     """Slice-restart supervision: run the example under the plan env;
     a crash (injected fault escaping to the top) is answered by simply
@@ -778,6 +1143,15 @@ def main() -> int:
                          "victim departs via SIGTERM instead)")
     ap.add_argument("--hosts", type=int, default=3,
                     help="fleet size for --elastic")
+    ap.add_argument("--serving-fleet", action="store_true",
+                    help="elastic serving-fleet drill (ISSUE 18 "
+                         "acceptance): 3 leased replicas under a "
+                         "multi-tenant trace, one killed mid-trace "
+                         "by the replica-crash plan; asserts routing "
+                         "detection within one lease window, bounded "
+                         "structured sheds, zero hung clients, and a "
+                         "compile-store-warm respawn with flipped "
+                         "membership epoch")
     ap.add_argument("--list", action="store_true",
                     help="list named plans and exit")
     args = ap.parse_args()
@@ -785,6 +1159,12 @@ def main() -> int:
         for name, spec in NAMED_PLANS.items():
             print(f"{name:<16} {spec}")
         return 0
+    if args.serving_fleet:
+        results = [_serving_fleet_scenario(replicas=args.hosts)]
+        print(json.dumps({"results": results,
+                          "ok": all(r["ok"] for r in results)},
+                         indent=1))
+        return 0 if all(r["ok"] for r in results) else 1
     if args.elastic:
         if args.plan:
             results = [_elastic_preempt_scenario(hosts=args.hosts,

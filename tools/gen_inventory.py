@@ -485,6 +485,67 @@ post-fault shared wave.
 """
 
 # hand-maintained operations doc, re-emitted on every regeneration
+# (ISSUE 18 satellite: the serving-fleet autoscaling runbook lives in
+# docs/OPS.md between the serving runbook and the elastic-fleet
+# machinery it composes)
+SERVING_FLEET_OPS_SECTION = """
+## Serving fleet autoscaling (serving/fleet.py)
+
+One gateway is one process; the fleet layer (ARCHITECTURE.md §20)
+turns N of them into one elastic service on three already-shipped
+planes: PR 6 membership leases, PR 7 fleet telemetry, and the
+content-addressed compile store. Nothing here adds a side channel —
+the router steers by exactly what replicas publish.
+
+**Bring-up.** Each replica runs startup prefetch BEFORE its first
+lease: `ServingReplica.start()` AOT-compiles every `STARTUP_PREFETCH`
+bucket (lint rule 12 holds that tuple equal to the scheduler's
+`WARMUP_FEEDS` keys, and holds the warmup call ahead of the lease
+calls), consults the compile store's manifest for its program
+fingerprint, then opens the HTTP front end and renews. `/healthz`
+answers 503 `warming` until the gateway is warm — a cold replica is
+never routable. Point every replica and the router at the same
+shared directory; set `DL4J_TPU_COMPILE_STORE` to the fleet store so
+a respawned process deserializes its siblings' compiles (the
+`--serving-fleet` drill asserts cold p50 TTFT ≤ 1.2× warm via
+`aot_hits` and persistent-cache counters).
+
+**Routing.** `ServingRouter.submit` places each request on the
+least-loaded live+ready replica (published queue depth + active
+slots + the router's own in-flight count); transport failures
+re-route; an impossible placement is shed as a structured
+`SequenceAborted` bounded by `DL4J_TPU_FLEET_SHED_BUDGET` — never a
+hung client. Watch the plane:
+
+    python tools/tpu_watch.py --fleet-dir /shared/fleet
+
+adds replica columns (ready/live, queue depth, KV occupancy, warm
+buckets, sheds, lease age) and a NOT_READY alarm; the router's own
+exposition carries `dl4j_tpu_router_requests_total` (per replica),
+`dl4j_tpu_router_replicas_ready`, `dl4j_tpu_router_reroutes_total`,
+and `dl4j_tpu_router_sheds_total` (by reason — `no_replica` means
+capacity, `over_budget` means the contract breached, page the
+operator). Fleet capacity moves show as
+`dl4j_tpu_serving_fleet_spawns_total` /
+`dl4j_tpu_serving_fleet_evictions_total`, per-replica warmth as
+`dl4j_tpu_serving_fleet_warm_buckets` and
+`dl4j_tpu_serving_fleet_replica_ready`.
+
+**Scaling + failure.** `FleetSupervisor.poll()` evicts expired
+leases and respawns toward `target` (a spawn stays pending until its
+lease appears — no double-spawn). A killed replica disappears from
+routing within one lease window; its postmortem bundle lands under
+`<fleet>/postmortem/`. Drill the whole contract:
+
+    python tools/chaos.py --serving-fleet
+
+kills one of three replicas mid-trace and asserts detection ≤ one
+lease window, zero hung clients, losses ≤ the shed budget (all
+structured), store-warmed respawn TTFT, and the epoch flip with the
+new replica ready.
+"""
+
+# hand-maintained operations doc, re-emitted on every regeneration
 # (ISSUE 14 satellite: the Pallas-gap-naming runbook lives in
 # docs/OPS.md next to the other runbooks)
 DEVTIME_OPS_SECTION = """
@@ -794,6 +855,7 @@ def main():
                  "", FLEET_OPS_SECTION.strip(),
                  "", SERVING_OPS_SECTION.strip(),
                  "", SPEC_DECODE_OPS_SECTION.strip(),
+                 "", SERVING_FLEET_OPS_SECTION.strip(),
                  "", DEVTIME_OPS_SECTION.strip(),
                  "", FUSED_OPS_SECTION.strip(),
                  "", COMM_OPS_SECTION.strip()]

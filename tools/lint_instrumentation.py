@@ -1,7 +1,7 @@
 """Instrumentation lint — the telemetry spine's CI fence (tier-1 via
 ``tests/test_lint_instrumentation.py``).
 
-Eleven AST rules over ``deeplearning4j_tpu/``:
+Twelve AST rules over ``deeplearning4j_tpu/``:
 
 1. **Every ``sentry.jit``-wrapped hot path emits obs telemetry.** A
    module that builds jitted entry points with ``sentry.jit(...)`` is
@@ -148,6 +148,28 @@ Eleven AST rules over ``deeplearning4j_tpu/``:
     table, and ``tpu_watch`` must reference at least one comm family
     — a wire-bound regression with no dashboard surface lands
     unwatched.
+
+12. **The elastic serving fleet stays routable and prefetch-warm.**
+    The fleet layer's whole contract (``serving/fleet.py``,
+    ARCHITECTURE.md §20) is that a replica is only visible to the
+    router once every jitted entry point is AOT-warm, and that the
+    routing plane is observable. Producer side: the module-level
+    ``STARTUP_PREFETCH`` tuple literal must name exactly the
+    scheduler's ``WARMUP_FEEDS`` keys (both directions — a builder
+    missing from the prefetch table cold-traces on the respawned
+    replica's first request; a stale entry advertises a warmup that
+    cannot run), and inside ``ServingReplica.start`` the ``warmup``
+    call must precede every lease acquisition (``renew`` /
+    ``start_auto_renew``) — lease-before-warm would let the router
+    route to a cold replica. Metric side: every
+    ``dl4j_tpu_router_*`` / ``dl4j_tpu_serving_fleet_*`` family must
+    be declared in FAMILIES *and* have a live emit site (rule 6's
+    lockstep, re-checked here so deleting the fleet block fails with
+    a fleet-specific message), at least one family of each prefix
+    must exist while the fleet module does, every such token in
+    ``tools/tpu_watch.py``/``docs/OPS.md`` must resolve, and
+    ``tpu_watch`` must reference at least one router family — an
+    unwatched routing plane sheds silently.
 
 Exit status 0 = clean; 1 = violations (printed one per line).
 """
@@ -1186,6 +1208,152 @@ def _lint_comm_observatory(package_dir: Path,
     return problems
 
 
+# rule 12: the elastic serving fleet module, the metric-family
+# prefixes of its routing/supervision plane, and the call names that
+# count as acquiring a membership lease
+FLEET_PATH = "serving/fleet.py"
+FLEET_FAMILY_PREFIXES = ("dl4j_tpu_router_", "dl4j_tpu_serving_fleet_")
+LEASE_CALLS = frozenset({"renew", "start_auto_renew"})
+
+
+def _lint_serving_fleet(package_dir: Path,
+                        tools_dir: Optional[Path],
+                        docs_dir: Optional[Path]) -> List[str]:
+    """Rule 12 (see module doc): STARTUP_PREFETCH mirrors
+    WARMUP_FEEDS, ServingReplica.start warms before it leases, the
+    router/fleet metric surface exists with live emit sites, fleet
+    consumer tokens resolve, and tpu_watch watches the router."""
+    fleet = package_dir / FLEET_PATH
+    if not fleet.is_file():
+        return []
+    try:
+        tree = ast.parse(fleet.read_text())
+    except SyntaxError:
+        return []                   # rule-agnostic: lint_file reports it
+    problems: List[str] = []
+
+    # -- prefetch table mirrors the scheduler's warmup feeds ----------
+    prefetch: Optional[set] = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "STARTUP_PREFETCH"
+                for t in node.targets):
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                prefetch = {e.value for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)}
+    if prefetch is None:
+        problems.append(
+            f"{FLEET_PATH}: no module-level STARTUP_PREFETCH tuple "
+            "literal — the replica spawn path has no declared AOT "
+            "prefetch table, so a cold respawn's first request traces "
+            "live")
+    feeds: Optional[set] = None
+    sched = package_dir / SCHEDULER_PATH
+    if sched.is_file():
+        try:
+            stree = ast.parse(sched.read_text())
+        except SyntaxError:
+            stree = None            # rule-agnostic: lint_file reports it
+        if stree is not None:
+            for node in ast.walk(stree):
+                if isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name)
+                        and t.id == "WARMUP_FEEDS"
+                        for t in node.targets) and \
+                        isinstance(node.value, ast.Dict):
+                    feeds = {k.value for k in node.value.keys
+                             if isinstance(k, ast.Constant)
+                             and isinstance(k.value, str)}
+    if prefetch is not None and feeds is not None:
+        for b in sorted(feeds - prefetch):
+            problems.append(
+                f"{FLEET_PATH}: scheduler builder {b} is missing from "
+                "STARTUP_PREFETCH — a respawned replica passes the "
+                "readiness gate with that entry point cold and its "
+                "first live request stalls on a trace")
+        for b in sorted(prefetch - feeds):
+            problems.append(
+                f"{FLEET_PATH}: STARTUP_PREFETCH entry {b!r} names no "
+                f"WARMUP_FEEDS builder in {SCHEDULER_PATH} — stale "
+                "prefetch entry (renamed/removed entry point?)")
+
+    # -- warm-before-lease ordering inside ServingReplica.start -------
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name == "ServingReplica"):
+            continue
+        for fn in node.body:
+            if not (isinstance(fn, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef))
+                    and fn.name == "start"):
+                continue
+            warm = [c.lineno for c in _calls(fn)
+                    if _attr_chain(c.func).split(".")[-1] == "warmup"]
+            lease = [c.lineno for c in _calls(fn)
+                     if _attr_chain(c.func).split(".")[-1]
+                     in LEASE_CALLS]
+            if not warm:
+                problems.append(
+                    f"{FLEET_PATH}: ServingReplica.start never calls "
+                    "warmup() — replicas take leases cold and the "
+                    "router routes live traffic onto untraced entry "
+                    "points")
+            elif lease and min(lease) < min(warm):
+                problems.append(
+                    f"{FLEET_PATH}:{min(lease)}: ServingReplica.start "
+                    "acquires its membership lease before warmup() — "
+                    "the router sees the replica as live while every "
+                    "entry point is still cold; warm first, lease "
+                    "last")
+
+    # -- metric surface + consumer coverage ---------------------------
+    families = _parse_families(package_dir / METRICS_PATH)
+    if families is None:
+        return problems
+    emits = _family_emit_sites(package_dir)
+    for prefix in FLEET_FAMILY_PREFIXES:
+        if not any(f.startswith(prefix) for f in families):
+            problems.append(
+                f"{METRICS_PATH}: no {prefix}* family in FAMILIES — "
+                "the serving-fleet plane has no metric surface (the "
+                "block was deleted?)")
+    for fam in sorted(f for f in families
+                      if f.startswith(FLEET_FAMILY_PREFIXES)):
+        if fam not in emits:
+            problems.append(
+                f"{METRICS_PATH}: fleet family {fam!r} is declared "
+                "but never emitted — the router/supervisor path that "
+                "fed it was deleted and the fleet dashboard reads a "
+                "dead column")
+    consumers = []
+    if tools_dir is not None and (Path(tools_dir)
+                                  / "tpu_watch.py").is_file():
+        consumers.append(("tools/tpu_watch.py",
+                          (Path(tools_dir) / "tpu_watch.py")
+                          .read_text()))
+    if docs_dir is not None and (Path(docs_dir) / "OPS.md").is_file():
+        consumers.append(("docs/OPS.md",
+                          (Path(docs_dir) / "OPS.md").read_text()))
+    for label, text in consumers:
+        tokens = sorted({t for t in _family_tokens(text)
+                         if t.startswith(FLEET_FAMILY_PREFIXES)})
+        for token in tokens:
+            if not _resolve_family(token, families):
+                problems.append(
+                    f"{label}: references {token!r} which matches no "
+                    f"family in {METRICS_PATH} FAMILIES — the "
+                    "dashboard/runbook watches a fleet metric the "
+                    "code does not emit")
+        if label == "tools/tpu_watch.py" and not any(
+                t.startswith("dl4j_tpu_router_") for t in tokens):
+            problems.append(
+                f"{label}: no dl4j_tpu_router_* family referenced — "
+                "the routing plane has no dashboard surface, so "
+                "structural sheds and re-route storms land unwatched")
+    return problems
+
+
 def run(package_dir: Path = PACKAGE,
         tests_dir: Optional[Path] = None,
         tools_dir: Optional[Path] = None,
@@ -1212,6 +1380,8 @@ def run(package_dir: Path = PACKAGE,
     problems.extend(_lint_kernel_registry(package_dir, tests_dir))
     problems.extend(_lint_comm_observatory(package_dir, tools_dir,
                                            docs_dir))
+    problems.extend(_lint_serving_fleet(package_dir, tools_dir,
+                                        docs_dir))
     return problems
 
 

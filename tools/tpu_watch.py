@@ -37,7 +37,18 @@ trace-JSONL tail: the snapshots are small atomic files, the skew
 history accumulates across samples). Each interval emits a ``fleet``
 view: the per-host step/epoch/age table, a collective-skew sparkline
 with the straggler named, and NONFINITE / EVICTED alarms from the
-merged exposition and the postmortem bundles.
+merged exposition and the postmortem bundles. When the fleet is a
+SERVING fleet (serving/fleet.py) the same sample adds a ``replicas``
+table — lease-backed readiness, router-facing address, queue depth,
+KV-page occupancy, warm buckets, shed count, lease age — plus a
+NOT_READY alarm from ``dl4j_tpu_serving_fleet_replica_ready``; and
+when the scraped ``/metrics`` endpoint is a router front end, a
+``router`` view renders ``dl4j_tpu_router_requests_total`` by
+replica, ``dl4j_tpu_router_replicas_ready``, re-route/shed totals
+(``dl4j_tpu_router_reroutes_total`` / ``dl4j_tpu_router_sheds_total``
+by reason), and the supervisor's
+``dl4j_tpu_serving_fleet_spawns_total`` /
+``dl4j_tpu_serving_fleet_evictions_total`` counters.
 
 VERDICT r3 Next #1: the perf dossier must land the instant the tunnel
 answers, and if it never does the round must carry "a timestamped retry
@@ -258,6 +269,44 @@ def _serving_view(fams) -> dict:
     return view
 
 
+def _router_view(fams) -> dict:
+    """Render the elastic-fleet routing plane (serving/fleet.py) from
+    one /metrics scrape: per-replica routed-request counters, the
+    ready-replica gauge, re-route/shed totals, and the supervisor's
+    spawn/eviction counters. A SHED alarm keys structural losses by
+    reason — every one is a client-visible ``SequenceAborted``."""
+    def val(name, default=None):
+        return fams.get((name, ()), default)
+
+    routed = {dict(labels).get("replica", ""): int(v)
+              for (n, labels), v in fams.items()
+              if n == "dl4j_tpu_router_requests_total"}
+    ready = val("dl4j_tpu_router_replicas_ready")
+    if not routed and ready is None:
+        return {}
+    view: dict = {"requests_by_replica": dict(sorted(routed.items()))}
+    if ready is not None:
+        view["replicas_ready"] = int(ready)
+    reroutes = val("dl4j_tpu_router_reroutes_total")
+    if reroutes:
+        view["reroutes"] = int(reroutes)
+    spawns = val("dl4j_tpu_serving_fleet_spawns_total")
+    if spawns:
+        view["fleet_spawns"] = int(spawns)
+    evictions = val("dl4j_tpu_serving_fleet_evictions_total")
+    if evictions:
+        view["fleet_evictions"] = int(evictions)
+    warm = val("dl4j_tpu_serving_fleet_warm_buckets")
+    if warm is not None:
+        view["warm_buckets"] = int(warm)
+    shed = {dict(labels).get("reason", ""): int(v)
+            for (n, labels), v in fams.items()
+            if n == "dl4j_tpu_router_sheds_total" and v > 0}
+    if shed:
+        view["SHED"] = shed
+    return view
+
+
 def _devtime_view(fams) -> dict:
     """Render the device-time observatory families from one /metrics
     scrape: the last capture's scope ranking (each entry mirrors the
@@ -364,6 +413,23 @@ def _fleet_view(fleet_dir) -> dict:
 
     view = obs_fleet.aggregate(fleet_dir)
     out: dict = {"hosts": view.table()}
+    serving = view.serving_table()
+    if serving:
+        # serving-replica columns (serving/fleet.py): lease-backed
+        # readiness + the load signals the router steers on
+        out["replicas"] = {
+            host: {
+                "ready": bool(row.get("ready")),
+                "live": bool(row.get("live")),
+                "addr": row.get("addr"),
+                "queue_depth": row.get("queue_depth"),
+                "kv_page_occupancy": row.get("kv_page_occupancy"),
+                "warm_buckets": row.get("warm_buckets"),
+                "sheds": row.get("sheds"),
+                "lease_age_s": row.get("lease_age_s"),
+                "mesh_epoch": row.get("mesh_epoch"),
+            }
+            for host, row in sorted(serving.items())}
     rep = view.skew_report()
     if rep:
         _SKEW_HISTORY.append(rep["max_skew_s"])
@@ -390,6 +456,15 @@ def _fleet_view(fleet_dir) -> dict:
     evicted = view.evicted()
     if evicted:
         alarms["EVICTED"] = evicted
+    # a lease-live replica the router will NOT admit to (warming, or
+    # its readiness probe regressed) — the merged exposition's
+    # dl4j_tpu_serving_fleet_replica_ready gauge is authoritative
+    not_ready = sorted(
+        dict(labels).get("host", "")
+        for (name, labels), v in fams.items()
+        if name == "dl4j_tpu_serving_fleet_replica_ready" and v < 1)
+    if not_ready:
+        alarms["NOT_READY"] = not_ready
     if alarms:
         out["alarms"] = alarms
     return out
@@ -424,6 +499,9 @@ def _scrape_telemetry(metrics_url, healthz_url, trace_jsonl,
             sview = _serving_view(fams)
             if sview:
                 _log(event="serving", url=metrics_url, **sview)
+            rview = _router_view(fams)
+            if rview:
+                _log(event="router", url=metrics_url, **rview)
             dview = _devtime_view(fams)
             if dview:
                 _log(event="devtime", url=metrics_url, **dview)
